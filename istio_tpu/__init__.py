@@ -1,0 +1,37 @@
+"""istio_tpu — a TPU-native service-mesh control plane.
+
+A brand-new framework with the capabilities of early Istio (reference:
+istio/istio ~v0.4, surveyed in /root/repo/SURVEY.md): attribute-based policy
+(Check/Report/Quota) with templates and adapters, an abstract service/routing
+model compiled to sidecar configuration, and SPIFFE workload identity.
+
+Unlike the Go reference, the policy hot path is JAX/XLA-first: rule-match
+predicates, authz/listentry/quota templates and VirtualService header/URI
+matches compile into dense tensor programs (DNF atom/conjunction/rule
+matrices + byte-DFA string automata) evaluated as batched jit-compiled TPU
+steps.
+
+Layout (maps to SURVEY.md §2):
+  utils/      — shared substrate: log, config, metrics, probes, caches
+                (reference: pkg/log, pkg/probe, pkg/cache)
+  attribute/  — attribute bags, global dictionary, wire codec, tensorization
+                (reference: mixer/pkg/attribute)
+  expr/       — expression language: parser, type checker, oracle interpreter,
+                externs (reference: mixer/pkg/expr + mixer/pkg/il)
+  ops/        — TPU kernels: byte-DFA string matching, masked 3-valued logic,
+                hashed-set membership, quota counters
+  compiler/   — AST → tensor programs; rulesets → DNF matcher matrices
+                (replaces mixer/pkg/il/compiler + interpreter)
+  runtime/    — resolver/dispatcher/controller + batching front-end
+                (reference: mixer/pkg/runtime)
+  templates/  — template framework: listentry, authorization, metric, quota...
+                (reference: mixer/template)
+  adapters/   — denier, list, memquota, rbac, stdio, prometheus, noop
+                (reference: mixer/adapter)
+  pilot/      — service/config model + route compiler (reference: pilot/)
+  security/   — SPIFFE CA, CSR flow, secret controller (reference: security/)
+  parallel/   — device mesh + sharding strategy for multi-chip scale-out
+  models/     — the flagship fused policy-engine step (PolicyEngine)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+"""Attribute system (reference: mixer/pkg/attribute)."""
+
+from istio_tpu.attribute.bag import (Bag, DictBag, MutableBag, TrackingBag,
+                                     CONDITION_ABSENCE, CONDITION_EXACT)
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST, GLOBAL_WORD_INDEX
+from istio_tpu.attribute.compressed import (CompressedAttributes, encode,
+                                            decode, decode_deltas)
+
+__all__ = [
+    "Bag", "DictBag", "MutableBag", "TrackingBag",
+    "CONDITION_ABSENCE", "CONDITION_EXACT", "ValueType",
+    "GLOBAL_WORD_LIST", "GLOBAL_WORD_INDEX",
+    "CompressedAttributes", "encode", "decode", "decode_deltas",
+]

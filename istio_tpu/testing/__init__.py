@@ -1,0 +1,11 @@
+"""Shared test substrate: the conformance corpus + fake bags.
+
+Pattern from the reference's mixer/pkg/il/testing: ONE table of
+expression → expected-result cases consumed by every engine (oracle
+interpreter, TPU tensor compiler, ruleset matcher) so all backends prove
+the same semantics.
+"""
+
+from istio_tpu.testing.corpus import CORPUS, Case, CORPUS_MANIFEST
+
+__all__ = ["CORPUS", "Case", "CORPUS_MANIFEST"]

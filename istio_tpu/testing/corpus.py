@@ -1,0 +1,377 @@
+"""The expression-conformance corpus.
+
+One shared table of cases proving the semantics contract of the policy
+expression language (reference behavior defined by mixer/pkg/il/testing/
+tests.go and the IL compiler/interpreter it exercises). Consumed by:
+
+  * tests/test_expr_oracle.py   — the host oracle interpreter
+  * tests/test_tensor_compiler.py — the TPU tensor compiler
+  * tests/test_ruleset.py      — the batched DNF rule matcher
+
+Cases are authored fresh against the semantics in SURVEY.md §2.1: 3-valued
+presence, `|` fallback, short-circuit booleans, typed equality (IP and
+TIMESTAMP via externs), glob/regex string predicates, string-map indexing,
+and exact referenced-attribute tracking.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+from typing import Any
+
+from istio_tpu.attribute.types import ValueType, parse_go_duration, parse_ip, parse_rfc3339
+
+V = ValueType
+
+# The attribute vocabulary used by every corpus case.
+CORPUS_MANIFEST: dict[str, ValueType] = {
+    # generic typed test attributes (reference naming style: a<type>)
+    "a": V.INT64, "b": V.INT64, "d": V.INT64, "x": V.INT64, "y": V.INT64,
+    "ai": V.INT64, "ai2": V.INT64,
+    "ad": V.DOUBLE, "ad2": V.DOUBLE,
+    "ab": V.BOOL, "ab2": V.BOOL,
+    "as": V.STRING, "as2": V.STRING,
+    "ar": V.STRING_MAP, "ar2": V.STRING_MAP,
+    "adur": V.DURATION,
+    "at": V.TIMESTAMP, "at2": V.TIMESTAMP,
+    "aip": V.IP_ADDRESS, "aip2": V.IP_ADDRESS,
+    # mesh-flavored attributes
+    "request.user": V.STRING, "request.user2": V.STRING,
+    "request.user3": V.STRING,
+    "request.size": V.INT64,
+    "request.path": V.STRING,
+    "request.time": V.TIMESTAMP,
+    "request.header": V.STRING_MAP,
+    "headername": V.STRING,
+    "servicename": V.STRING,
+    "origin.name": V.STRING,
+    "service.name": V.STRING, "service.user": V.STRING,
+    "source.name": V.STRING, "source.namespace": V.STRING,
+    "source.labels": V.STRING_MAP,
+    "destination.service": V.STRING,
+    "destination.namespace": V.STRING,
+    "context.protocol": V.STRING,
+    "target.ip": V.IP_ADDRESS,
+    "target.service": V.STRING,
+    "connection.duration": V.DURATION,
+    "api.operation": V.STRING,
+}
+
+
+@dataclasses.dataclass
+class Case:
+    e: str                           # expression source
+    input: dict[str, Any] = dataclasses.field(default_factory=dict)
+    result: Any = None               # expected value (when err is None)
+    err: str | None = None           # expected runtime-error substring
+    compile_err: str | None = None   # expected parse/type-check error substring
+    type_: ValueType | None = None   # expected static type
+    referenced: list[str] | None = None  # expected referenced-attribute snapshot
+    name: str = ""
+
+    def id(self) -> str:
+        return self.name or self.e
+
+
+_t1 = parse_rfc3339("2015-01-02T15:04:35Z")
+_t2 = parse_rfc3339("2015-01-02T15:04:34Z")
+_d19 = parse_go_duration("19ms")
+_d20 = parse_go_duration("20ms")
+
+CORPUS: list[Case] = [
+    # ---- benchmark triple: the reference's ExprBench shapes ----
+    Case(name="ExprBench/ok_1st",
+         e='ai == 20 || ar["foo"] == "bar"', type_=V.BOOL,
+         input={"ai": 20, "ar": {"foo": "bar"}}, result=True,
+         referenced=["ai"]),
+    Case(name="ExprBench/ok_2nd",
+         e='ai == 20 || ar["foo"] == "bar"', type_=V.BOOL,
+         input={"ai": 2, "ar": {"foo": "bar"}}, result=True,
+         referenced=["ai", "ar", "ar[foo]"]),
+    Case(name="ExprBench/not_found",
+         e='ai == 20 || ar["foo"] == "bar"', type_=V.BOOL,
+         input={"ai": 2, "ar": {"foo": "baz"}}, result=False,
+         referenced=["ai", "ar", "ar[foo]"]),
+
+    # ---- literals & bare attributes ----
+    Case(e="2", type_=V.INT64, result=2),
+    Case(e="2.25", type_=V.DOUBLE, result=2.25),
+    Case(e='"str"', type_=V.STRING, result="str"),
+    Case(e="true", type_=V.BOOL, result=True),
+    Case(e="false", type_=V.BOOL, result=False),
+    Case(e='"20ms"', type_=V.DURATION, result=_d20),
+    Case(e='"1h2m"', type_=V.DURATION,
+         result=parse_go_duration("1h2m")),
+    Case(e="a", type_=V.INT64, input={"a": 2}, result=2, referenced=["a"]),
+    Case(e="a ", type_=V.INT64, input={"a": 2}, result=2),
+    Case(e="as", type_=V.STRING, input={"as": "v"}, result="v"),
+    Case(e="ab", type_=V.BOOL, input={"ab": True}, result=True),
+    Case(e="ad", type_=V.DOUBLE, input={"ad": 1.5}, result=1.5),
+    Case(e="a", input={}, err="lookup failed: 'a'", referenced=["a"]),
+
+    # ---- integer equality ----
+    Case(e="a == 2", type_=V.BOOL, input={"a": 2}, result=True,
+         referenced=["a"]),
+    Case(e="a == 3", type_=V.BOOL, input={"a": 2}, result=False),
+    Case(e="a != 2", type_=V.BOOL, input={"a": 2}, result=False,
+         referenced=["a"]),
+    Case(e="a != 2", type_=V.BOOL, input={"d": 2},
+         err="lookup failed: 'a'", referenced=["a"]),
+    Case(e="2 != a", type_=V.BOOL, input={"d": 2},
+         err="lookup failed: 'a'", referenced=["a"]),
+    Case(e="2 == 2", type_=V.BOOL, result=True),
+    Case(e="a == b", type_=V.BOOL, input={"a": 5, "b": 5}, result=True),
+    Case(e="a == b", type_=V.BOOL, input={"a": 5, "b": 6}, result=False),
+
+    # ---- double / bool / string equality ----
+    Case(e="ad == 1.5", type_=V.BOOL, input={"ad": 1.5}, result=True),
+    Case(e="ad != 1.5", type_=V.BOOL, input={"ad": 2.5}, result=True),
+    Case(e="ab == true", type_=V.BOOL, input={"ab": True}, result=True),
+    Case(e="ab == false", type_=V.BOOL, input={"ab": True}, result=False),
+    Case(e='as == "v"', type_=V.BOOL, input={"as": "v"}, result=True),
+    Case(e='as == "w"', type_=V.BOOL, input={"as": "v"}, result=False),
+    Case(e='as == as2', type_=V.BOOL, input={"as": "x", "as2": "x"},
+         result=True),
+    Case(e='request.user == "user1"', type_=V.BOOL,
+         input={"request.user": "user1"}, result=True),
+
+    # ---- type-check failures ----
+    Case(e="true == a", input={"a": 2},
+         compile_err="typeError got INT64, expected BOOL"),
+    Case(e="3.14 == a", input={"a": 2},
+         compile_err="typeError got INT64, expected DOUBLE"),
+    Case(e='as == 2', input={"as": "v"},
+         compile_err="typeError got INT64, expected STRING"),
+    Case(e="(x/y) == 30", input={"x": 20, "y": 10},
+         compile_err="unknown function: QUO"),
+    Case(e="x < 2", input={"x": 1}, compile_err="unknown function: LSS"),
+    Case(e="!ab", input={"ab": True}, compile_err="unknown function: NOT"),
+    Case(e="a = 2", input={"a": 2}, compile_err="unable to parse"),
+    Case(e="@23", compile_err="unable to parse"),
+    Case(e="unknown.attr == 2", compile_err="unknown attribute unknown.attr"),
+    Case(e="doesnotexist(as)", input={"as": "v"},
+         compile_err="unknown function: doesnotexist"),
+    Case(e='match(service.name, 1)', input={"service.name": "x"},
+         compile_err="typeError got INT64, expected STRING"),
+    Case(e='ip(2)', compile_err="typeError got INT64, expected STRING"),
+    Case(e='timestamp(2)', compile_err="typeError got INT64, expected STRING"),
+    Case(e='"aaa".matches(23)', compile_err="typeError got INT64, expected STRING"),
+    Case(e='"aaa".startsWith(23)', compile_err="typeError got INT64, expected STRING"),
+    Case(e='match(as)', input={"as": "v"}, compile_err="arity mismatch"),
+    Case(e='startsWith("x")', compile_err="invoking instance method without an instance"),
+
+    # ---- fallback `|` ----
+    Case(e='request.user | "user1"', type_=V.STRING,
+         input={"request.user": "u"}, result="u",
+         referenced=["request.user"]),
+    Case(e='request.user | "user1"', type_=V.STRING, input={},
+         result="user1", referenced=["request.user"]),
+    Case(e='request.user2 | request.user | "user1"', type_=V.STRING,
+         input={"request.user": "user2"}, result="user2",
+         referenced=["request.user", "request.user2"]),
+    Case(e='request.user2 | request.user3 | "user1"', type_=V.STRING,
+         input={"request.user": "user2"}, result="user1",
+         referenced=["request.user2", "request.user3"]),
+    Case(e="request.size | 200", type_=V.INT64,
+         input={"request.size": 120}, result=120,
+         referenced=["request.size"]),
+    Case(e="request.size | 200", type_=V.INT64,
+         input={"request.size": 0}, result=0),
+    Case(e="request.size | 200", type_=V.INT64,
+         input={"request.size1": 0}, result=200),
+    Case(e='( origin.name | "unknown" ) == "users"', type_=V.BOOL,
+         input={}, result=False),
+    Case(e='( origin.name | "unknown" ) == "users"', type_=V.BOOL,
+         input={"origin.name": "users"}, result=True),
+    Case(e='origin.name | "users"', type_=V.STRING, input={},
+         result="users"),
+    Case(e="ab | true", type_=V.BOOL, input={}, result=True),
+    Case(e="ab | false", type_=V.BOOL, input={"ab": True}, result=True),
+    Case(e="ad | 1.25", type_=V.DOUBLE, input={}, result=1.25),
+    Case(e='adur | "19ms"', type_=V.DURATION, input={}, result=_d19),
+    Case(e='adur | "19ms"', type_=V.DURATION, input={"adur": _d20},
+         result=_d20),
+    Case(e="ai | ai2 | 42", type_=V.INT64, input={"ai2": 7}, result=7,
+         referenced=["ai", "ai2"]),
+    # fallback whose right side is a hard error still errors
+    Case(e='target.ip | ip("10.1.12.3")', type_=V.IP_ADDRESS, input={},
+         result=parse_ip("10.1.12.3"), referenced=["target.ip"]),
+    Case(e='target.ip | ip("10.1.12")', type_=V.IP_ADDRESS, input={},
+         err="could not convert 10.1.12 to IP_ADDRESS"),
+    Case(e='request.time | timestamp("2015-01-02T15:04:35Z")',
+         type_=V.TIMESTAMP, input={}, result=_t1,
+         referenced=["request.time"]),
+    Case(e='request.time | timestamp("242233")', type_=V.TIMESTAMP,
+         input={}, err="could not convert '242233' to TIMESTAMP"),
+    # type mismatch across `|` arms
+    Case(e='request.size | "big"', compile_err="typeError"),
+
+    # ---- short-circuit && / || ----
+    Case(e="(x == 20 && y == 10) || x == 30", type_=V.BOOL,
+         input={"x": 20, "y": 10}, result=True),
+    Case(e="x == 20 && y == 10", input={"a": 20, "b": 10},
+         err="lookup failed: 'x'"),
+    Case(e="x == 20 && y == 10", input={"x": 20},
+         err="lookup failed: 'y'"),
+    # false && <error> short-circuits: no error
+    Case(e="x == 21 && y == 10", type_=V.BOOL, input={"x": 20},
+         result=False, referenced=["x"]),
+    # true || <error> short-circuits: no error
+    Case(e="x == 20 || y == 10", type_=V.BOOL, input={"x": 20},
+         result=True, referenced=["x"]),
+    Case(e="x == 21 || y == 10", input={"x": 20},
+         err="lookup failed: 'y'", referenced=["x", "y"]),
+    Case(e="ab && ab2", type_=V.BOOL, input={"ab": True, "ab2": True},
+         result=True),
+    Case(e="ab && ab2", type_=V.BOOL, input={"ab": False}, result=False),
+    Case(e="ab || ab2", type_=V.BOOL, input={"ab": False, "ab2": True},
+         result=True),
+    Case(e="true && false", type_=V.BOOL, result=False,
+         name="bench/land_tf"),
+    Case(e="true && true", type_=V.BOOL, result=True, name="bench/land_tt"),
+    Case(e="false && false", type_=V.BOOL, result=False,
+         name="bench/land_ff"),
+    Case(e="ab == true && as == \"v\"", type_=V.BOOL,
+         input={"ab": True, "as": "v"}, result=True),
+
+    # ---- string maps ----
+    Case(e='ar["foo"]', type_=V.STRING, input={"ar": {"foo": "bar"}},
+         result="bar", referenced=["ar", "ar[foo]"]),
+    Case(e='ar["foo"]', input={"ar": {"baz": "bar"}},
+         err="member lookup failed: 'foo'", referenced=["ar", "ar[foo]"]),
+    Case(e='ar["foo"]', input={}, err="lookup failed: 'ar'",
+         referenced=["ar"]),
+    Case(e='request.header["X-FORWARDED-HOST"] == "aaa"', type_=V.BOOL,
+         input={"request.header": {"X-FORWARDED-HOST": "bbb"}},
+         result=False,
+         referenced=["request.header", "request.header[X-FORWARDED-HOST]"]),
+    Case(e='request.header["X-FORWARDED-HOST"] == "aaa"',
+         input={"request.header1": {"X-FORWARDED-HOST": "bbb"}},
+         err="lookup failed: 'request.header'",
+         referenced=["request.header"]),
+    Case(e='request.header[headername] == "aaa"',
+         input={"request.header": {"X-FORWARDED-HOST": "bbb"}},
+         err="lookup failed: 'headername'"),
+    Case(e='request.header[headername] == "aaa"', type_=V.BOOL,
+         input={"request.header": {"X-FORWARDED-HOST": "aaa"},
+                "headername": "X-FORWARDED-HOST"},
+         result=True),
+    Case(e='ar["foo"] | "dflt"', type_=V.STRING,
+         input={"ar": {"foo": "bar"}}, result="bar"),
+    Case(e='ar["foo"] | "dflt"', type_=V.STRING,
+         input={"ar": {"baz": "bar"}}, result="dflt"),
+    # map absent under fallback ALSO falls through (tresolve_m path)
+    Case(e='ar["foo"] | "dflt"', type_=V.STRING, input={},
+         result="dflt"),
+    Case(e='ar[as] | "dflt"', type_=V.STRING,
+         input={"ar": {"k": "x"}, "as": "k"}, result="x"),
+    Case(e='ar[as] | "dflt"', type_=V.STRING, input={"ar": {"k": "x"}},
+         result="dflt"),
+    Case(e='ar["a"] == ar2["b"]', type_=V.BOOL,
+         input={"ar": {"a": "same"}, "ar2": {"b": "same"}}, result=True),
+
+    # ---- externs: match (glob) ----
+    Case(e='match(service.name, "*.ns1.cluster")', type_=V.BOOL,
+         input={"service.name": "svc1.ns1.cluster"}, result=True,
+         referenced=["service.name"]),
+    Case(e='match(service.name, "*.ns1.cluster")', type_=V.BOOL,
+         input={"service.name": "svc1.ns2.cluster"}, result=False),
+    Case(e='match(service.name, "svc1.*")', type_=V.BOOL,
+         input={"service.name": "svc1.ns1.cluster"}, result=True),
+    Case(e='match(service.name, "svc1.*")', type_=V.BOOL,
+         input={"service.name": "svc2.ns1.cluster"}, result=False),
+    Case(e='match(service.name, "svc1.ns1.cluster")', type_=V.BOOL,
+         input={"service.name": "svc1.ns1.cluster"}, result=True),
+    Case(e='match(service.name, "svc1.ns1.cluster")', type_=V.BOOL,
+         input={"service.name": "svc1.ns1.clusterX"}, result=False),
+    Case(e='match(service.name, servicename)', input={"servicename": "*.a"},
+         err="lookup failed: 'service.name'",
+         referenced=["service.name"]),
+    Case(e='match(service.name, servicename)',
+         input={"service.name": "x"}, err="lookup failed: 'servicename'"),
+    Case(e='match(service.name, "*.ns1.cluster") && service.user == "admin"',
+         type_=V.BOOL,
+         input={"service.name": "svc1.ns1.cluster", "service.user": "admin"},
+         result=True),
+
+    # ---- externs: matches (regex), startsWith, endsWith ----
+    # NOTE: the RECEIVER of .matches() is the PATTERN, the argument is the
+    # subject (reference corpus: `".*".matches("abc")` is true; extern
+    # binding pushes the target first, externs.go:118 externMatches).
+    Case(e='"st.*".matches(as)', type_=V.BOOL, input={"as": "str"},
+         result=True),
+    Case(e='"st.*".matches(as)', type_=V.BOOL, input={"as": "ts"},
+         result=False),
+    Case(e='"a.c".matches("abc")', type_=V.BOOL, result=True),
+    Case(e='"^b".matches("abc")', type_=V.BOOL, result=False),
+    Case(e='"ab.*d".matches("abc")', type_=V.BOOL, result=False),
+    Case(e='"^/api/v[0-9]+/users/[^/]+$".matches(request.path)',
+         type_=V.BOOL, input={"request.path": "/api/v1/users/alice"},
+         result=True),
+    Case(e='"^/api/v[0-9]+/users/[^/]+$".matches(request.path)',
+         type_=V.BOOL, input={"request.path": "/api/v1/users/alice/pets"},
+         result=False),
+    Case(e='as.startsWith("pre")', type_=V.BOOL, input={"as": "prefix"},
+         result=True),
+    Case(e='as.startsWith("pre")', type_=V.BOOL, input={"as": "xprefix"},
+         result=False),
+    Case(e='as.endsWith("fix")', type_=V.BOOL, input={"as": "prefix"},
+         result=True),
+    Case(e='as.endsWith("fix")', type_=V.BOOL, input={"as": "fixed"},
+         result=False),
+    Case(e='"abc".startsWith("ab")', type_=V.BOOL, result=True),
+    Case(e='"abc".endsWith("bc")', type_=V.BOOL, result=True),
+    Case(e='as.matches("st.*")', input={},
+         err="lookup failed: 'as'"),
+
+    # ---- externs: ip / timestamp equality ----
+    Case(e='aip == ip("10.1.12.3")', type_=V.BOOL,
+         input={"aip": parse_ip("10.1.12.3")}, result=True),
+    Case(e='aip == ip("10.1.12.4")', type_=V.BOOL,
+         input={"aip": parse_ip("10.1.12.3")}, result=False),
+    Case(e='aip == aip2', type_=V.BOOL,
+         input={"aip": parse_ip("10.1.12.3"),
+                "aip2": parse_ip("10.1.12.3")}, result=True),
+    Case(e='at == at2', type_=V.BOOL, input={"at": _t1, "at2": _t1},
+         result=True),
+    Case(e='at == at2', type_=V.BOOL, input={"at": _t1, "at2": _t2},
+         result=False),
+    Case(e='at != at2', type_=V.BOOL, input={"at": _t1, "at2": _t2},
+         result=True),
+    Case(e='at == timestamp("2015-01-02T15:04:35Z")', type_=V.BOOL,
+         input={"at": _t1}, result=True),
+
+    # ---- realistic mesh predicates (the resolver's diet) ----
+    Case(e='destination.service == "reviews.default.svc.cluster.local"',
+         type_=V.BOOL,
+         input={"destination.service": "reviews.default.svc.cluster.local"},
+         result=True),
+    Case(e='context.protocol == "tcp" && destination.service == "db.ns.svc"',
+         type_=V.BOOL,
+         input={"context.protocol": "http",
+                "destination.service": "db.ns.svc"},
+         result=False, referenced=["context.protocol"]),
+    Case(e='source.labels["app"] == "reviews" && '
+           'destination.namespace == "default"',
+         type_=V.BOOL,
+         input={"source.labels": {"app": "reviews"},
+                "destination.namespace": "default"},
+         result=True),
+    # `|` binds tighter than `==` (Go precedence level 4 vs 3)
+    Case(e='(source.namespace | "default") == "prod" || '
+           'request.header["x-debug"] | "off" == "on"',
+         type_=V.BOOL, input={}, result=False),
+    Case(e='request.header["x-debug"] | "off" == "on"', type_=V.BOOL,
+         input={"request.header": {"x-debug": "on"}}, result=True),
+    Case(e='match(destination.service, "*.svc.cluster.local") && '
+           '(request.user | "nobody") != "admin"',
+         type_=V.BOOL,
+         input={"destination.service": "a.svc.cluster.local"},
+         result=True),
+    Case(e='api.operation == "getPets" && '
+           'request.header["authorization"].startsWith("Bearer ")',
+         type_=V.BOOL,
+         input={"api.operation": "getPets",
+                "request.header": {"authorization": "Bearer tok"}},
+         result=True),
+]

@@ -1,0 +1,157 @@
+"""Self-metrics: counters / gauges / histograms with Prometheus text
+exposition.
+
+Role of the reference's Prometheus self-monitoring (mixer/pkg/runtime/
+monitor.go:34-88, pilot discovery.go:53-113). Host-side only — device-side
+perf comes from the bench harness.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Iterable
+
+_DEFAULT_BUCKETS = (
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+)
+
+
+def _label_key(labels: dict[str, str] | None) -> tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# TYPE {self.name} counter"
+        for key, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(key)} {v}"
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name, self.help = name, help_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def value(self, **labels: str) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> Iterable[str]:
+        yield f"# TYPE {self.name} gauge"
+        for key, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(key)} {v}"
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "",
+                 buckets: tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name, self.help = name, help_
+        self._buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sum: dict[tuple, float] = {}
+        self._n: dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = _label_key(labels)
+        idx = bisect.bisect_left(self._buckets, value)
+        with self._lock:
+            if key not in self._counts:
+                self._counts[key] = [0] * (len(self._buckets) + 1)
+                self._sum[key] = 0.0
+                self._n[key] = 0
+            self._counts[key][idx] += 1
+            self._sum[key] += value
+            self._n[key] += 1
+
+    def quantile(self, q: float, **labels: str) -> float:
+        """Approximate quantile from bucket counts (upper bound of the
+        bucket containing the q-th observation)."""
+        key = _label_key(labels)
+        counts = self._counts.get(key)
+        if not counts or self._n[key] == 0:
+            return 0.0
+        target = q * self._n[key]
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target:
+                return self._buckets[i] if i < len(self._buckets) else float("inf")
+        return float("inf")
+
+    def expose(self) -> Iterable[str]:
+        yield f"# TYPE {self.name} histogram"
+        for key, counts in sorted(self._counts.items()):
+            cum = 0
+            for i, c in enumerate(counts[:-1]):
+                cum += c
+                lk = dict(key)
+                lk["le"] = repr(self._buckets[i])
+                yield f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {cum}"
+            lk = dict(key)
+            lk["le"] = "+Inf"
+            yield f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {self._n[key]}"
+            yield f"{self.name}_sum{_fmt_labels(key)} {self._sum[key]}"
+            yield f"{self.name}_count{_fmt_labels(key)} {self._n[key]}"
+
+
+class Registry:
+    """Collects metrics for a /metrics endpoint (reference: mixer
+    monitoring server on :9093, mixer/pkg/server/monitoring.go)."""
+
+    def __init__(self) -> None:
+        self._metrics: list[Counter | Gauge | Histogram] = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        m = Counter(name, help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        m = Gauge(name, help_)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: tuple[float, ...] = _DEFAULT_BUCKETS) -> Histogram:
+        m = Histogram(name, help_, buckets)
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def expose_text(self) -> str:
+        lines: list[str] = []
+        with self._lock:
+            for m in self._metrics:
+                lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+default_registry = Registry()

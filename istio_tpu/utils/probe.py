@@ -1,0 +1,80 @@
+"""File-touch liveness/readiness probes.
+
+Role of the reference's pkg/probe (probe.go:30 Probe, controller.go:33
+Controller): components register probes, a controller periodically writes a
+file whose mtime freshness is the health signal; an external checker (k8s
+exec probe) validates mtime staleness.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class Probe:
+    """A named health condition owned by one component."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._available: bool = False
+        self._err: str = ""
+        self._lock = threading.Lock()
+
+    def set_available(self, err: str | None = None) -> None:
+        with self._lock:
+            self._available = err is None
+            self._err = err or ""
+
+    def is_available(self) -> tuple[bool, str]:
+        with self._lock:
+            return self._available, self._err
+
+
+class ProbeController:
+    """Aggregates probes; while ALL are available, keeps touching `path`
+    every `interval` seconds."""
+
+    def __init__(self, path: str, interval: float = 1.0):
+        self.path = path
+        self.interval = interval
+        self._probes: list[Probe] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self, probe: Probe) -> None:
+        self._probes.append(probe)
+
+    def status(self) -> tuple[bool, str]:
+        for p in self._probes:
+            ok, err = p.is_available()
+            if not ok:
+                return False, f"{p.name}: {err or 'unavailable'}"
+        return True, ""
+
+    def _tick(self) -> None:
+        ok, _ = self.status()
+        if ok:
+            with open(self.path, "a"):
+                os.utime(self.path, None)
+
+    def start(self) -> None:
+        def loop() -> None:
+            while not self._stop.wait(self.interval):
+                self._tick()
+        self._tick()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+
+def probe_fresh(path: str, max_age_seconds: float) -> bool:
+    """External checker: is the probe file fresh? (reference: probe client.go)"""
+    try:
+        return (time.time() - os.stat(path).st_mtime) <= max_age_seconds
+    except OSError:
+        return False

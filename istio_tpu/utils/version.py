@@ -1,0 +1,18 @@
+"""Build-info stamp (role of reference pkg/version/version.go)."""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class BuildInfo:
+    version: str = "0.1.0"
+    git_revision: str = os.environ.get("ISTIO_TPU_GIT_REV", "unknown")
+    golden: str = "istio-ref-v0.4"  # reference parity anchor
+
+    def long_form(self) -> str:
+        return f"istio_tpu {self.version} (rev {self.git_revision}, parity {self.golden})"
+
+
+BUILD_INFO = BuildInfo()

@@ -1,0 +1,106 @@
+"""Attribute bags + dictionary-compressed wire codec round-trips
+(reference behavior: mixer/pkg/attribute bag_test/mutableBag tests)."""
+import datetime
+
+from istio_tpu.attribute.bag import DictBag, MutableBag, TrackingBag
+from istio_tpu.attribute.compressed import (CompressedAttributes, decode,
+                                            decode_deltas, encode)
+from istio_tpu.attribute.global_dict import GLOBAL_WORD_INDEX, GLOBAL_WORD_LIST
+
+
+def test_global_dictionary_protocol_constants():
+    # wire-compat anchors: canonical words at fixed indices
+    assert len(GLOBAL_WORD_LIST) == 169
+    assert GLOBAL_WORD_LIST[0] == "source.ip"
+    assert "request.headers" in GLOBAL_WORD_INDEX
+    assert GLOBAL_WORD_INDEX[GLOBAL_WORD_LIST[42]] == 42
+
+
+def test_mutable_bag_overlay_and_merge():
+    parent = DictBag({"a": 1, "b": 2})
+    child = MutableBag(parent)
+    child.set("b", 20)
+    child.set("c", 30)
+    assert child.get("a") == (1, True)
+    assert child.get("b") == (20, True)
+    assert child.get("c") == (30, True)
+    assert sorted(child.names()) == ["a", "b", "c"]
+
+    # preserve_merge must NOT clobber existing values
+    child.preserve_merge(DictBag({"a": 99, "d": 4}))
+    assert child.get("a") == (1, True)
+    assert child.get("d") == (4, True)
+
+
+def test_tracking_bag_records_conditions():
+    tb = TrackingBag(DictBag({"x": 1}))
+    tb.get("x")
+    tb.get("missing")
+    tb.track_map_key("request.headers", "host", True)
+    refs = tb.referenced()
+    assert refs[("x", "")] == "EXACT"
+    assert refs[("missing", "")] == "ABSENCE"
+    assert refs[("request.headers", "host")] == "EXACT"
+    assert tb.referenced_names() == ["missing", "request.headers[host]", "x"]
+
+
+def test_wire_roundtrip_all_types():
+    now = datetime.datetime(2026, 1, 2, 3, 4, 5,
+                            tzinfo=datetime.timezone.utc)
+    values = {
+        "source.ip": b"\x0a\x00\x00\x01",           # global word
+        "source.name": "productpage",                # global word, string val
+        "request.size": 1234,
+        "custom.double": 2.5,                        # message word
+        "custom.flag": True,
+        "request.time": now,
+        "response.duration": datetime.timedelta(milliseconds=150),
+        "request.headers": {"host": "example.com", "x-custom": "v"},
+    }
+    ca = encode(DictBag(values))
+    # global words must NOT appear in the per-message word list
+    assert "source.ip" not in ca.words
+    assert "custom.double" in ca.words
+    bag = decode(ca)
+    for k, v in values.items():
+        got, ok = bag.get(k)
+        assert ok, k
+        assert got == v, k
+
+
+def test_delta_decoding_report_stream():
+    r1 = encode(DictBag({"a.one": 1, "a.two": "x"}))
+    r2 = encode(DictBag({"a.one": 2}))  # delta: only the changed attr
+    bags = decode_deltas([r1, r2])
+    assert bags[0].get("a.one") == (1, True)
+    assert bags[1].get("a.one") == (2, True)
+    assert bags[1].get("a.two") == ("x", True)  # carried forward
+
+
+def test_utils_smoke():
+    from istio_tpu.utils.cache import LRUCache, TTLCache
+    from istio_tpu.utils.metrics import Registry
+
+    lru = LRUCache(2)
+    lru.set("a", 1)
+    lru.set("b", 2)
+    lru.get("a")
+    lru.set("c", 3)          # evicts b
+    assert lru.get("b") is None
+    assert lru.get("a") == 1
+
+    clock = [0.0]
+    ttl = TTLCache(10.0, clock=lambda: clock[0])
+    ttl.set("k", "v")
+    assert ttl.get("k") == "v"
+    clock[0] = 11.0
+    assert ttl.get("k") is None
+
+    reg = Registry()
+    c = reg.counter("checks_total")
+    c.inc(5, adapter="denier")
+    h = reg.histogram("check_seconds")
+    h.observe(0.0004)
+    text = reg.expose_text()
+    assert 'checks_total{adapter="denier"} 5.0' in text
+    assert "check_seconds_bucket" in text

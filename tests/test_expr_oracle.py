@@ -1,0 +1,61 @@
+"""Oracle interpreter conformance: runs the shared corpus against the
+host-side semantics oracle (pattern: reference interpreter tests consuming
+mixer/pkg/il/testing/tests.go)."""
+import pytest
+
+from istio_tpu.attribute.bag import DictBag
+from istio_tpu.expr.checker import AttributeDescriptorFinder, TypeError_
+from istio_tpu.expr.oracle import EvalError, OracleProgram
+from istio_tpu.expr.parser import ParseError
+from istio_tpu.testing.corpus import CORPUS, CORPUS_MANIFEST, Case
+
+FINDER = AttributeDescriptorFinder(CORPUS_MANIFEST)
+
+
+@pytest.mark.parametrize("case", CORPUS, ids=lambda c: c.id())
+def test_corpus_oracle(case: Case):
+    if case.compile_err is not None:
+        with pytest.raises((ParseError, TypeError_)) as exc:
+            OracleProgram(case.e, FINDER)
+        assert case.compile_err in str(exc.value), (
+            f"expected compile error containing {case.compile_err!r}, "
+            f"got {exc.value}")
+        return
+
+    prog = OracleProgram(case.e, FINDER)
+    if case.type_ is not None:
+        assert prog.result_type == case.type_
+
+    bag = DictBag(case.input)
+    if case.err is not None:
+        with pytest.raises(EvalError) as exc:
+            _, tracking = prog.evaluate_with_tracking(bag)
+        assert case.err in str(exc.value)
+        if case.referenced is not None:
+            # re-run to capture tracking up to the error
+            from istio_tpu.attribute.bag import TrackingBag
+            tb = TrackingBag(bag)
+            with pytest.raises(EvalError):
+                prog._eval(prog.ast, tb)
+            assert tb.referenced_names() == sorted(case.referenced)
+        return
+
+    value, tracking = prog.evaluate_with_tracking(bag)
+    assert value == case.result, (
+        f"{case.e} with {case.input} -> {value!r}, want {case.result!r}")
+    if case.referenced is not None:
+        assert tracking.referenced_names() == sorted(case.referenced)
+
+
+def test_extract_eq_matches():
+    from istio_tpu.expr.parser import extract_eq_matches
+    got = extract_eq_matches(
+        'destination.service == "db.svc" && context.protocol == "tcp" '
+        '&& request.size == 10 || source.name == "x"')
+    # LOR at top level: nothing hoistable
+    assert got == {}
+    got = extract_eq_matches(
+        'destination.service == "db.svc" && (context.protocol == "tcp" '
+        '&& "y" == source.name)')
+    assert got == {"destination.service": "db.svc",
+                   "context.protocol": "tcp", "source.name": "y"}
