@@ -65,6 +65,8 @@ def parse_go_duration(s: str) -> datetime.timedelta:
     if s[0] in "+-":
         sign = -1 if s[0] == "-" else 1
         s = s[1:]
+    if not s:
+        raise ValueError(f"invalid duration {orig!r}")
     if s == "0":
         return datetime.timedelta(0)
     total_ns = 0.0

@@ -1,0 +1,257 @@
+"""Batch layout, value interning, and tensorization.
+
+The TPU data model for attribute bags (SURVEY.md §2.2 translation note):
+the wire protocol already dictionary-codes attribute names and string
+values as int32 indices, so a batch of requests tensorizes naturally into
+dense int32 arrays.
+
+Key design decision — IDENTITY SEMANTICS: the expression language has no
+ordering or arithmetic over attribute values (intrinsics are only
+EQ/NEQ/OR/LOR/LAND/INDEX, reference func.go:39-72), so every non-boolean
+scalar value is interned into one opaque int32 id space and equality
+becomes id comparison. Byte tensors exist ONLY for string slots consumed
+by byte-level predicates (glob/regex/prefix/suffix). IP addresses are
+normalized to 16-byte form before interning so `ip_equal` semantics
+(v4 == v4-in-v6, externs.go:88) hold under id equality; timestamps and
+durations normalize to epoch-/total-nanoseconds.
+
+String-map indexing with CONSTANT keys becomes "derived slots": the
+tensorizer extracts ``bag["request.header"]["host"]`` into its own id +
+present column, so INDEX costs nothing on device.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import threading
+from typing import Any, Hashable, Mapping, Sequence
+
+import jax
+import numpy as np
+
+from istio_tpu.attribute.bag import Bag
+from istio_tpu.attribute.types import ValueType
+
+# Reserved intern ids.
+ID_INVALID = 0
+ID_FALSE = 1
+ID_TRUE = 2
+
+DEFAULT_MAX_STR_LEN = 128
+
+
+def _normalize(value: Any) -> tuple[str, Hashable]:
+    """Map a runtime value to its (type_tag, canonical) intern key."""
+    if isinstance(value, bool):
+        return ("b", value)
+    if isinstance(value, int):
+        return ("i", value)
+    if isinstance(value, float):
+        return ("d", value)
+    if isinstance(value, str):
+        return ("s", value)
+    if isinstance(value, bytes):
+        if len(value) == 4:  # v4 → v4-in-v6 canonical form (net.IP.Equal)
+            value = b"\x00" * 10 + b"\xff\xff" + value
+        return ("p", value)
+    if isinstance(value, datetime.timedelta):
+        return ("D", round(value.total_seconds() * 1e9))
+    if isinstance(value, datetime.datetime):
+        return ("t", round(value.timestamp() * 1e9))
+    raise TypeError(f"cannot intern value of type {type(value)}")
+
+
+class InternTable:
+    """Grow-only value ↔ int32-id table shared by compile-time constants
+    and the runtime tensorizer. Thread-safe; ids are stable for the life
+    of the table."""
+
+    def __init__(self) -> None:
+        self._by_key: dict[tuple[str, Hashable], int] = {
+            ("b", False): ID_FALSE, ("b", True): ID_TRUE,
+        }
+        self._values: list[Any] = [None, False, True]
+        self._lock = threading.Lock()
+
+    def intern(self, value: Any) -> int:
+        key = _normalize(value)
+        with self._lock:
+            idx = self._by_key.get(key)
+            if idx is None:
+                idx = len(self._values)
+                self._by_key[key] = idx
+                self._values.append(value)
+            return idx
+
+    def lookup(self, value: Any) -> int:
+        """Id of a value WITHOUT interning; ID_INVALID if unseen."""
+        key = _normalize(value)
+        with self._lock:
+            return self._by_key.get(key, ID_INVALID)
+
+    def value_of(self, idx: int) -> Any:
+        with self._lock:
+            return self._values[idx]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._values)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchLayout:
+    """Static slot assignment for a config snapshot.
+
+    scalar slots cover every non-map attribute in the manifest plus one
+    derived slot per (map attribute, constant key) pair the compiled
+    expressions need. Byte slots exist per string source consumed by a
+    byte-level predicate.
+    """
+    manifest: Mapping[str, ValueType]
+    slots: Mapping[str, int]                       # scalar attr → column
+    derived_slots: Mapping[tuple[str, str], int]   # (map, key) → column
+    map_slots: Mapping[str, int]                   # map attr → map column
+    byte_slots: Mapping[Any, int]                  # attr | (map,key) → byte col
+    max_str_len: int = DEFAULT_MAX_STR_LEN
+
+    @property
+    def n_columns(self) -> int:
+        return len(self.slots) + len(self.derived_slots)
+
+    @property
+    def n_maps(self) -> int:
+        return len(self.map_slots)
+
+    @property
+    def n_byte_slots(self) -> int:
+        return len(self.byte_slots)
+
+    def slot_of(self, name: str) -> int:
+        return self.slots[name]
+
+    def derived_slot_of(self, map_name: str, key: str) -> int:
+        return self.derived_slots[(map_name, key)]
+
+
+def build_layout(manifest: Mapping[str, ValueType],
+                 derived_keys: Sequence[tuple[str, str]] = (),
+                 byte_sources: Sequence[Any] = (),
+                 max_str_len: int = DEFAULT_MAX_STR_LEN) -> BatchLayout:
+    """Assign columns. `derived_keys` and `byte_sources` are collected by
+    the expression/ruleset compilers (a compile → layout → recompile
+    fixpoint is avoided by collecting requirements in a pre-pass)."""
+    slots: dict[str, int] = {}
+    map_slots: dict[str, int] = {}
+    for name in sorted(manifest):
+        if manifest[name] == ValueType.STRING_MAP:
+            map_slots[name] = len(map_slots)
+        else:
+            slots[name] = len(slots)
+    derived: dict[tuple[str, str], int] = {}
+    col = len(slots)
+    for mk in sorted(set(derived_keys)):
+        if mk not in derived:
+            derived[mk] = col
+            col += 1
+    bytes_: dict[Any, int] = {}
+    for src in byte_sources:
+        if src not in bytes_:
+            bytes_[src] = len(bytes_)
+    return BatchLayout(manifest=dict(manifest), slots=slots,
+                       derived_slots=derived, map_slots=map_slots,
+                       byte_slots=dict(bytes_), max_str_len=max_str_len)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class AttributeBatch:
+    """A batch of attribute bags as device arrays.
+
+    ids        int32 [B, n_columns]   interned value per scalar/derived slot
+    present    bool  [B, n_columns]   slot has a value
+    map_present bool [B, n_maps]      map attribute itself present
+    str_bytes  uint8 [B, n_byte_slots, L]
+    str_lens   int32 [B, n_byte_slots]
+    """
+    ids: Any
+    present: Any
+    map_present: Any
+    str_bytes: Any
+    str_lens: Any
+
+    @property
+    def batch_size(self) -> int:
+        return self.ids.shape[0]
+
+    def tree_flatten(self):
+        return ((self.ids, self.present, self.map_present,
+                 self.str_bytes, self.str_lens), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+class Tensorizer:
+    """Host-side bag-batch → AttributeBatch conversion.
+
+    This is the Python reference implementation of the ingest path; the
+    C++ shim (SURVEY.md §7 layer 8) will produce identical arrays
+    straight from the wire format.
+    """
+
+    def __init__(self, layout: BatchLayout, interner: InternTable):
+        self.layout = layout
+        self.interner = interner
+
+    def tensorize(self, bags: Sequence[Bag]) -> AttributeBatch:
+        lay = self.layout
+        b = len(bags)
+        ncol = lay.n_columns
+        ids = np.zeros((b, ncol), dtype=np.int32)
+        present = np.zeros((b, ncol), dtype=bool)
+        map_present = np.zeros((b, max(lay.n_maps, 1)), dtype=bool)
+        nbyte = max(lay.n_byte_slots, 1)
+        str_bytes = np.zeros((b, nbyte, lay.max_str_len), dtype=np.uint8)
+        str_lens = np.zeros((b, nbyte), dtype=np.int32)
+
+        for i, bag in enumerate(bags):
+            for name, col in lay.slots.items():
+                v, ok = bag.get(name)
+                if not ok:
+                    continue
+                present[i, col] = True
+                ids[i, col] = self.interner.intern(v)
+            for name, mcol in lay.map_slots.items():
+                v, ok = bag.get(name)
+                if ok:
+                    map_present[i, mcol] = True
+            for (mname, key), col in lay.derived_slots.items():
+                m, ok = bag.get(mname)
+                if ok and isinstance(m, Mapping) and key in m:
+                    present[i, col] = True
+                    ids[i, col] = self.interner.intern(m[key])
+            for src, bcol in lay.byte_slots.items():
+                raw = self._byte_source_value(bag, src)
+                if raw is None:
+                    continue
+                enc = raw.encode("utf-8")[:lay.max_str_len]
+                str_bytes[i, bcol, :len(enc)] = np.frombuffer(
+                    enc, dtype=np.uint8)
+                str_lens[i, bcol] = len(enc)
+
+        return AttributeBatch(ids=ids, present=present,
+                              map_present=map_present,
+                              str_bytes=str_bytes, str_lens=str_lens)
+
+    @staticmethod
+    def _byte_source_value(bag: Bag, src: Any) -> str | None:
+        if isinstance(src, tuple):
+            mname, key = src
+            m, ok = bag.get(mname)
+            if ok and isinstance(m, Mapping) and key in m:
+                v = m[key]
+                return v if isinstance(v, str) else None
+            return None
+        v, ok = bag.get(src)
+        return v if ok and isinstance(v, str) else None

@@ -1,0 +1,446 @@
+"""Tensor expression compiler: AST → batched masked evaluation under jit.
+
+This replaces the reference's IL compiler + stack-VM interpreter hot loop
+(mixer/pkg/il/compiler + interpreter/interpreterRun.go:70 — O(rules)
+sequential per request) with data-parallel evaluation: ONE traced program
+evaluates an expression for a whole batch of requests at once.
+
+Short-circuit + 3-valued-presence semantics are compiled into masked
+boolean algebra (SURVEY.md §7 layer 3b: "no short-circuit — evaluate
+everything, mask errors, reduce"). Every node lowers to a triple
+
+    (val, ok, err)   each [B]
+
+where `ok` means "produced a value" and `err` means "hard runtime error".
+Absence (fallback-able) is `~ok & ~err`. The exact masking rules mirror
+the oracle (istio_tpu/expr/oracle.py), which mirrors the IL codegen:
+
+  eff_err(x)  = x.err | ~x.ok          # hard context turns absence → error
+  LAND(a,b):   err = ea | (~ea & a.val & eb)        ; val = a.val & b.val
+  LOR(a,b):    err = ea | (~ea & ~a.val & eb)       ; val = a.val | b.val
+  OR(a,b):     val = a.ok ? a.val : b.val
+               ok  = a.ok | (~a.err & b.ok)
+               err = a.err | (~a.ok & ~a.err & b.err)
+  EQ/NEQ, externs: err = OR of eff_err(operand)
+
+A suppressed operand's garbage value can never leak: `a.val & b.val` is
+False whenever the suppressing side is False, and `|` dually.
+
+Because the language has no ordering/arithmetic (func.go:39-72), all
+non-boolean values are interned int32 ids (see layout.py) and EQ is id
+comparison; ip()/timestamp() normalization happens at intern time. String
+byte-level predicates lower to ops/bytes_ops (+ regex_dfa).
+
+Expressions the device path cannot lower — dynamic-key INDEX, non-constant
+match/regex patterns, ip()/timestamp() over runtime strings, unsupported
+regex constructs — raise HostFallback at compile time and are routed to
+the oracle by the runtime dispatcher.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.compiler.layout import (AttributeBatch, BatchLayout,
+                                       ID_TRUE, InternTable)
+from istio_tpu.expr.checker import (AttributeDescriptorFinder, DEFAULT_FUNCS,
+                                    eval_type)
+from istio_tpu.expr.exprs import Expression, FunctionCall
+from istio_tpu.expr.externs import ExternError, extern_ip, extern_timestamp
+from istio_tpu.expr.parser import parse
+from istio_tpu.ops import bytes_ops
+from istio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex
+
+V = ValueType
+_BYTE_PREDS = ("match", "matches", "startsWith", "endsWith")
+
+
+class HostFallback(Exception):
+    """Expression cannot run on device; evaluate with the oracle."""
+
+
+@dataclasses.dataclass
+class TVal:
+    val: Any   # bool[B] for BOOL nodes, int32[B] ids otherwise
+    ok: Any    # bool[B]
+    err: Any   # bool[B]
+
+
+@dataclasses.dataclass
+class BVal:
+    """Byte-string view of a subtree (subject of a byte predicate)."""
+    data: Any  # uint8[B, L]
+    lens: Any  # int32[B]
+    ok: Any
+    err: Any
+
+
+def _eff_err(t: TVal) -> Any:
+    return t.err | ~t.ok
+
+
+# ---------------------------------------------------------------------------
+# Requirement collection (pre-pass)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Requirements:
+    """What the layout must provide for a set of expressions."""
+    derived_keys: set[tuple[str, str]] = dataclasses.field(default_factory=set)
+    byte_sources: set[Any] = dataclasses.field(default_factory=set)
+
+    def merge(self, other: "Requirements") -> None:
+        self.derived_keys |= other.derived_keys
+        self.byte_sources |= other.byte_sources
+
+
+def collect_requirements(ast: Expression, finder: AttributeDescriptorFinder,
+                         reqs: Requirements | None = None) -> Requirements:
+    """Walk the AST collecting derived-slot and byte-slot needs; raises
+    HostFallback for shapes the device path cannot express."""
+    if reqs is None:
+        reqs = Requirements()
+    _collect(ast, finder, reqs, as_bytes=False)
+    return reqs
+
+
+def _collect(e: Expression, finder: AttributeDescriptorFinder,
+             reqs: Requirements, as_bytes: bool) -> None:
+    if e.const_ is not None:
+        return
+    if e.var is not None:
+        vt = finder.get_attribute(e.var.name)
+        if vt is None:
+            raise HostFallback(f"unknown attribute {e.var.name}")
+        if as_bytes:
+            reqs.byte_sources.add(e.var.name)
+        return
+    f = e.fn
+    assert f is not None
+    if f.name == "INDEX":
+        if f.args[0].var is None:
+            raise HostFallback("INDEX over non-variable map")
+        if f.args[1].const_ is None:
+            raise HostFallback("dynamic string-map key")
+        key = f.args[1].const_.value
+        if not isinstance(key, str):
+            raise HostFallback("non-string map key")
+        pair = (f.args[0].var.name, key)
+        reqs.derived_keys.add(pair)
+        if as_bytes:
+            reqs.byte_sources.add(pair)
+        return
+    if f.name == "OR":
+        _collect(f.args[0], finder, reqs, as_bytes)
+        _collect(f.args[1], finder, reqs, as_bytes)
+        return
+    if f.name in _BYTE_PREDS:
+        if f.name == "match":
+            subject, pattern = f.args[0], f.args[1]
+        elif f.name == "matches":
+            subject, pattern = f.args[0], f.target
+        else:  # startsWith / endsWith
+            subject, pattern = f.target, f.args[0]
+        if pattern is None or pattern.const_ is None or \
+                not isinstance(pattern.const_.value, str):
+            raise HostFallback(f"non-constant pattern for {f.name}")
+        if f.name == "matches":
+            try:
+                compile_regex(pattern.const_.value)
+            except UnsupportedRegex as exc:
+                raise HostFallback(str(exc))
+        _collect(subject, finder, reqs, as_bytes=True)
+        return
+    if f.name in ("ip", "timestamp"):
+        if f.args[0].const_ is None:
+            raise HostFallback(f"{f.name}() over a runtime value")
+        return
+    if f.name in ("EQ", "NEQ", "LAND", "LOR"):
+        for a in f.args:
+            _collect(a, finder, reqs, as_bytes=False)
+        return
+    raise HostFallback(f"unsupported function on device: {f.name}")
+
+
+# ---------------------------------------------------------------------------
+# Compilation
+# ---------------------------------------------------------------------------
+
+class _Ctx:
+    def __init__(self, layout: BatchLayout, interner: InternTable,
+                 finder: AttributeDescriptorFinder):
+        self.layout = layout
+        self.interner = interner
+        self.finder = finder
+
+    def type_of(self, e: Expression) -> ValueType:
+        return eval_type(e, self.finder, DEFAULT_FUNCS)
+
+
+NodeFn = Callable[[AttributeBatch], TVal]
+ByteFn = Callable[[AttributeBatch], BVal]
+
+
+def _const_tval(value: Any, vtype: ValueType, ctx: _Ctx) -> NodeFn:
+    if vtype == V.BOOL:
+        v = bool(value)
+
+        def fn(batch: AttributeBatch) -> TVal:
+            b = batch.ids.shape[0]
+            return TVal(jnp.full(b, v, bool), jnp.ones(b, bool),
+                        jnp.zeros(b, bool))
+        return fn
+    cid = ctx.interner.intern(value)
+
+    def fn(batch: AttributeBatch) -> TVal:
+        b = batch.ids.shape[0]
+        return TVal(jnp.full(b, cid, jnp.int32), jnp.ones(b, bool),
+                    jnp.zeros(b, bool))
+    return fn
+
+
+def _error_tval() -> NodeFn:
+    def fn(batch: AttributeBatch) -> TVal:
+        b = batch.ids.shape[0]
+        return TVal(jnp.zeros(b, jnp.int32), jnp.zeros(b, bool),
+                    jnp.ones(b, bool))
+    return fn
+
+
+def _compile_node(e: Expression, ctx: _Ctx) -> NodeFn:
+    if e.const_ is not None:
+        return _const_tval(e.const_.value, e.const_.vtype, ctx)
+
+    if e.var is not None:
+        vt = ctx.finder.get_attribute(e.var.name)
+        if vt is None:
+            raise HostFallback(f"unknown attribute {e.var.name}")
+        if vt == V.STRING_MAP:
+            raise HostFallback("bare string-map variable on device")
+        col = ctx.layout.slot_of(e.var.name)
+        is_bool = vt == V.BOOL
+
+        def fn(batch: AttributeBatch) -> TVal:
+            ids = batch.ids[:, col]
+            ok = batch.present[:, col]
+            val = (ids == ID_TRUE) if is_bool else ids
+            return TVal(val, ok, jnp.zeros_like(ok))
+        return fn
+
+    f = e.fn
+    assert f is not None
+    name = f.name
+
+    if name == "INDEX":
+        col = ctx.layout.derived_slot_of(f.args[0].var.name,
+                                         f.args[1].const_.value)
+
+        def fn(batch: AttributeBatch) -> TVal:
+            ok = batch.present[:, col]
+            return TVal(batch.ids[:, col], ok, jnp.zeros_like(ok))
+        return fn
+
+    if name == "OR":
+        fa = _compile_node(f.args[0], ctx)
+        fb = _compile_node(f.args[1], ctx)
+
+        def fn(batch: AttributeBatch) -> TVal:
+            a, b = fa(batch), fb(batch)
+            val = jnp.where(a.ok, a.val, b.val)
+            ok = a.ok | (~a.err & b.ok)
+            err = a.err | (~a.ok & ~a.err & b.err)
+            return TVal(val, ok, err)
+        return fn
+
+    if name in ("EQ", "NEQ"):
+        fa = _compile_node(f.args[0], ctx)
+        fb = _compile_node(f.args[1], ctx)
+        negate = name == "NEQ"
+
+        def fn(batch: AttributeBatch) -> TVal:
+            a, b = fa(batch), fb(batch)
+            cmp = a.val == b.val
+            if negate:
+                cmp = ~cmp
+            ee = _eff_err(a) | _eff_err(b)
+            return TVal(cmp, ~ee, ee)
+        return fn
+
+    if name == "LAND":
+        fa = _compile_node(f.args[0], ctx)
+        fb = _compile_node(f.args[1], ctx)
+
+        def fn(batch: AttributeBatch) -> TVal:
+            a, b = fa(batch), fb(batch)
+            ea, eb = _eff_err(a), _eff_err(b)
+            err = ea | (~ea & a.val & eb)
+            val = a.val & b.val & ~err
+            return TVal(val, ~err, err)
+        return fn
+
+    if name == "LOR":
+        fa = _compile_node(f.args[0], ctx)
+        fb = _compile_node(f.args[1], ctx)
+
+        def fn(batch: AttributeBatch) -> TVal:
+            a, b = fa(batch), fb(batch)
+            ea, eb = _eff_err(a), _eff_err(b)
+            err = ea | (~ea & ~a.val & eb)
+            val = ((a.val & ~ea) | (b.val & ~eb)) & ~err
+            return TVal(val, ~err, err)
+        return fn
+
+    if name in _BYTE_PREDS:
+        return _compile_byte_pred(f, ctx)
+
+    if name in ("ip", "timestamp"):
+        raw = f.args[0].const_.value
+        try:
+            value = (extern_ip(raw) if name == "ip"
+                     else extern_timestamp(raw))
+        except ExternError:
+            return _error_tval()  # runtime-error constant, oracle parity
+        return _const_tval(value, V.IP_ADDRESS if name == "ip"
+                           else V.TIMESTAMP, ctx)
+
+    raise HostFallback(f"unsupported function on device: {name}")
+
+
+def _compile_byte_pred(f: FunctionCall, ctx: _Ctx) -> NodeFn:
+    if f.name == "match":
+        subject_ast, pattern = f.args[0], f.args[1].const_.value
+        op = partial(bytes_ops.glob_match, pattern=pattern)
+    elif f.name == "matches":
+        subject_ast, pattern = f.args[0], f.target.const_.value
+        dfa = compile_regex(pattern)
+        trans = jnp.asarray(dfa.transitions)
+        accept = jnp.asarray(dfa.accept)
+        op = lambda data, lens: bytes_ops.dfa_match(data, lens, trans, accept)
+    elif f.name == "startsWith":
+        subject_ast, pattern = f.target, f.args[0].const_.value
+        op = lambda data, lens: bytes_ops.prefix_match(data, lens,
+                                                       pattern.encode())
+    else:  # endsWith
+        subject_ast, pattern = f.target, f.args[0].const_.value
+        op = lambda data, lens: bytes_ops.suffix_match(data, lens,
+                                                       pattern.encode())
+
+    fsub = _compile_bytes(subject_ast, ctx)
+
+    def fn(batch: AttributeBatch) -> TVal:
+        s = fsub(batch)
+        ee = s.err | ~s.ok
+        val = op(s.data, s.lens) & ~ee
+        return TVal(val, ~ee, ee)
+    return fn
+
+
+def _compile_bytes(e: Expression, ctx: _Ctx) -> ByteFn:
+    """Compile a STRING-typed subtree to its byte-tensor view."""
+    lay = ctx.layout
+    if e.const_ is not None:
+        raw = str(e.const_.value).encode("utf-8")[:lay.max_str_len]
+        row = np.zeros(lay.max_str_len, dtype=np.uint8)
+        row[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        n = len(raw)
+
+        def fn(batch: AttributeBatch) -> BVal:
+            b = batch.ids.shape[0]
+            return BVal(jnp.broadcast_to(jnp.asarray(row),
+                                         (b, lay.max_str_len)),
+                        jnp.full(b, n, jnp.int32),
+                        jnp.ones(b, bool), jnp.zeros(b, bool))
+        return fn
+
+    if e.var is not None:
+        bcol = lay.byte_slots[e.var.name]
+        col = lay.slot_of(e.var.name)
+
+        def fn(batch: AttributeBatch) -> BVal:
+            ok = batch.present[:, col]
+            return BVal(batch.str_bytes[:, bcol, :], batch.str_lens[:, bcol],
+                        ok, jnp.zeros_like(ok))
+        return fn
+
+    f = e.fn
+    assert f is not None
+    if f.name == "INDEX":
+        pair = (f.args[0].var.name, f.args[1].const_.value)
+        bcol = lay.byte_slots[pair]
+        col = lay.derived_slot_of(*pair)
+
+        def fn(batch: AttributeBatch) -> BVal:
+            ok = batch.present[:, col]
+            return BVal(batch.str_bytes[:, bcol, :], batch.str_lens[:, bcol],
+                        ok, jnp.zeros_like(ok))
+        return fn
+
+    if f.name == "OR":
+        fa = _compile_bytes(f.args[0], ctx)
+        fb = _compile_bytes(f.args[1], ctx)
+
+        def fn(batch: AttributeBatch) -> BVal:
+            a, b = fa(batch), fb(batch)
+            sel = a.ok[:, None]
+            data = jnp.where(sel, a.data, b.data)
+            lens = jnp.where(a.ok, a.lens, b.lens)
+            ok = a.ok | (~a.err & b.ok)
+            err = a.err | (~a.ok & ~a.err & b.err)
+            return BVal(data, lens, ok, err)
+        return fn
+
+    raise HostFallback(f"cannot view {f.name}(...) as bytes on device")
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TensorProgram:
+    """A compiled expression: fn(batch) → (val [B], valid [B]).
+
+    For BOOL expressions val is bool; otherwise val holds intern ids that
+    `decode_value` maps back to Python values. `valid` is False exactly
+    where the oracle would raise an evaluation error.
+    """
+    text: str
+    result_type: ValueType
+    fn: Callable[[AttributeBatch], tuple[Any, Any]]
+    layout: BatchLayout
+    interner: InternTable
+
+    def __call__(self, batch: AttributeBatch) -> tuple[Any, Any]:
+        return self.fn(batch)
+
+    def decode_value(self, raw: Any) -> Any:
+        if self.result_type == V.BOOL:
+            return bool(raw)
+        return self.interner.value_of(int(raw))
+
+
+def compile_expression(text: str, finder: AttributeDescriptorFinder,
+                       layout: BatchLayout,
+                       interner: InternTable, jit: bool = True) -> TensorProgram:
+    """Parse + type check + lower to a jitted batched evaluator.
+
+    Raises HostFallback when the expression needs the oracle, and
+    TypeError_/ParseError exactly like the oracle path."""
+    ast = parse(text)
+    rtype = eval_type(ast, finder, DEFAULT_FUNCS)
+    ctx = _Ctx(layout, interner, finder)
+    node = _compile_node(ast, ctx)
+
+    def run(batch: AttributeBatch) -> tuple[Any, Any]:
+        t = node(batch)
+        return t.val, t.ok & ~t.err
+
+    return TensorProgram(text=text, result_type=rtype,
+                         fn=jax.jit(run) if jit else run,
+                         layout=layout, interner=interner)

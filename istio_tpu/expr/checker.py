@@ -124,7 +124,10 @@ def _fn_eval_type(f: FunctionCall, attrs: AttributeDescriptorFinder,
     elif meta.instance:
         raise TypeError_(f"invoking instance method without an instance: {f.name}")
 
-    if len(f.args) < len(meta.argument_types):
+    # The reference only rejects too-few args (expr.go:234, excess-arg
+    # check is a TODO at :259 and crashes later in extern reflection);
+    # rejecting excess here keeps the error typed instead of crashing.
+    if len(f.args) != len(meta.argument_types):
         raise TypeError_(
             f"{f} arity mismatch. Got {len(f.args)} arg(s), "
             f"expected {len(meta.argument_types)} arg(s)")

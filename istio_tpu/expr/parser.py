@@ -233,6 +233,8 @@ class _Parser:
         if t.kind == "str":
             return Expression(const_=_string_constant(t.text))
         if t.kind == "ident":
+            # case-insensitive like the reference: expr.go:344 lowercases
+            # the identifier before comparing against true/false
             low = t.text.lower()
             if low in ("true", "false"):
                 return Expression(const_=Constant(

@@ -1,0 +1,118 @@
+"""Device-side byte-string predicates over padded uint8 tensors.
+
+Strings that participate in glob/regex/prefix/suffix predicates are
+materialized as fixed-width ``uint8[B, L]`` rows plus ``int32[B]`` lengths
+(SURVEY.md §7 "hard parts #1"). Everything here is jit-compatible and
+shape-static; XLA fuses the comparisons into neighbouring ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pad_bytes(values: list[bytes], max_len: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: pack python byte strings into [N, L] uint8 + [N] int32."""
+    out = np.zeros((len(values), max_len), dtype=np.uint8)
+    lens = np.zeros(len(values), dtype=np.int32)
+    for i, v in enumerate(values):
+        trunc = v[:max_len]
+        out[i, :len(trunc)] = np.frombuffer(trunc, dtype=np.uint8)
+        lens[i] = len(trunc)
+    return out, lens
+
+
+def prefix_match(data: jnp.ndarray, lens: jnp.ndarray,
+                 prefix: bytes) -> jnp.ndarray:
+    """startsWith(const): [B, L] × pattern → bool [B]."""
+    p = np.frombuffer(prefix, dtype=np.uint8)
+    k = len(p)
+    if k == 0:
+        return jnp.ones(data.shape[0], dtype=bool)
+    if k > data.shape[1]:
+        return jnp.zeros(data.shape[0], dtype=bool)
+    eq = jnp.all(data[:, :k] == jnp.asarray(p), axis=-1)
+    return eq & (lens >= k)
+
+
+def suffix_match(data: jnp.ndarray, lens: jnp.ndarray,
+                 suffix: bytes) -> jnp.ndarray:
+    """endsWith(const): compare a window ending at each row's length."""
+    p = np.frombuffer(suffix, dtype=np.uint8)
+    k = len(p)
+    b, l = data.shape
+    if k == 0:
+        return jnp.ones(b, dtype=bool)
+    if k > l:
+        return jnp.zeros(b, dtype=bool)
+    # gather indices len-k .. len-1 per row (clipped; masked by lens >= k)
+    offs = jnp.arange(k, dtype=jnp.int32)[None, :] + (lens[:, None] - k)
+    offs = jnp.clip(offs, 0, l - 1)
+    window = jnp.take_along_axis(data, offs, axis=1)
+    return jnp.all(window == jnp.asarray(p), axis=-1) & (lens >= k)
+
+
+def exact_match(data: jnp.ndarray, lens: jnp.ndarray,
+                pattern: bytes) -> jnp.ndarray:
+    p = np.frombuffer(pattern, dtype=np.uint8)
+    k = len(p)
+    if k > data.shape[1]:
+        return jnp.zeros(data.shape[0], dtype=bool)
+    padded = np.zeros(data.shape[1], dtype=np.uint8)
+    padded[:k] = p
+    return jnp.all(data == jnp.asarray(padded), axis=-1) & (lens == k)
+
+
+def glob_match(data: jnp.ndarray, lens: jnp.ndarray,
+               pattern: str) -> jnp.ndarray:
+    """The `match()` extern with a constant pattern
+    (externs.go:108-116): trailing '*' = prefix, leading '*' = suffix,
+    else exact."""
+    pb = pattern.encode()
+    if pb.endswith(b"*"):
+        return prefix_match(data, lens, pb[:-1])
+    if pb.startswith(b"*"):
+        return suffix_match(data, lens, pb[1:])
+    return exact_match(data, lens, pb)
+
+
+def dfa_match(data: jnp.ndarray, lens: jnp.ndarray,
+              transitions: jnp.ndarray, accept: jnp.ndarray) -> jnp.ndarray:
+    """Run one dense DFA over every row: state := T[state, byte] for the
+    first `len` bytes, then read the accept bit.
+
+    data [B, L] uint8, transitions [S, 256] int32, accept [S] bool.
+    Implemented as a lax.scan over the L byte positions (time-major
+    transpose) — each step is one [B] gather from the flattened table.
+    """
+    b, l = data.shape
+    flat = transitions.reshape(-1)  # [S*256]
+
+    def step(state, inp):
+        byte, pos = inp
+        nxt = flat[state * 256 + byte.astype(jnp.int32)]
+        state = jnp.where(pos < lens, nxt, state)
+        return state, None
+
+    init = jnp.zeros(b, dtype=jnp.int32)
+    bytes_tm = data.T  # [L, B]
+    positions = jnp.arange(l, dtype=jnp.int32)[:, None]  # [L, 1] broadcasts
+    final, _ = jax.lax.scan(step, init, (bytes_tm, positions))
+    return accept[final]
+
+
+def dfa_match_many(data: jnp.ndarray, lens: jnp.ndarray,
+                   trans_bank: jnp.ndarray,
+                   accept_bank: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized multi-pattern DFA: run N packed DFAs (pack_dfas) over the
+    same subject rows in ONE scan.
+
+    data [B, L], trans_bank [N, S, 256], accept_bank [N, S] →  bool [B, N].
+    Each scan step gathers [B, N] next-states; this is the batched-NFA
+    shape the north star asks for (rules × requests per device step).
+    """
+    def one(tr, ac):
+        return dfa_match(data, lens, tr, ac)
+
+    return jax.vmap(one, in_axes=(0, 0), out_axes=1)(trans_bank, accept_bank)

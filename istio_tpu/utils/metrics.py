@@ -43,7 +43,9 @@ class Counter:
 
     def expose(self) -> Iterable[str]:
         yield f"# TYPE {self.name} counter"
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            snapshot = sorted(self._values.items())
+        for key, v in snapshot:
             yield f"{self.name}{_fmt_labels(key)} {v}"
 
 
@@ -62,7 +64,9 @@ class Gauge:
 
     def expose(self) -> Iterable[str]:
         yield f"# TYPE {self.name} gauge"
-        for key, v in sorted(self._values.items()):
+        with self._lock:
+            snapshot = sorted(self._values.items())
+        for key, v in snapshot:
             yield f"{self.name}{_fmt_labels(key)} {v}"
 
 
@@ -105,7 +109,10 @@ class Histogram:
 
     def expose(self) -> Iterable[str]:
         yield f"# TYPE {self.name} histogram"
-        for key, counts in sorted(self._counts.items()):
+        with self._lock:
+            items = sorted((k, list(v), self._sum[k], self._n[k])
+                           for k, v in self._counts.items())
+        for key, counts, total, n in items:
             cum = 0
             for i, c in enumerate(counts[:-1]):
                 cum += c
@@ -114,9 +121,9 @@ class Histogram:
                 yield f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {cum}"
             lk = dict(key)
             lk["le"] = "+Inf"
-            yield f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {self._n[key]}"
-            yield f"{self.name}_sum{_fmt_labels(key)} {self._sum[key]}"
-            yield f"{self.name}_count{_fmt_labels(key)} {self._n[key]}"
+            yield f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {n}"
+            yield f"{self.name}_sum{_fmt_labels(key)} {total}"
+            yield f"{self.name}_count{_fmt_labels(key)} {n}"
 
 
 class Registry:

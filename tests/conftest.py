@@ -1,13 +1,22 @@
 """Test configuration.
 
 Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding
-paths (Mesh/pjit/shard_map) are exercised hermetically, per the driver
-contract. Real-TPU runs happen only in bench.py.
+paths (Mesh/pjit/shard_map) are exercised hermetically. Real-TPU runs
+happen only in bench.py.
+
+NOTE: this environment injects an `axon` TPU-tunnel PJRT plugin via
+sitecustomize *before* pytest starts, and that plugin pins
+jax_platforms="axon,cpu"; plain JAX_PLATFORMS=cpu in the env is not
+enough. Updating the config key here — before any backend is
+initialized — reliably selects the hermetic CPU platform.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,119 @@
+"""Tensor-compiler conformance: the SAME corpus the oracle passes, run
+through the jitted device path (one table, many engines — the reference's
+il/testing pattern). Also checks batched evaluation agreement on mixed
+inputs."""
+import numpy as np
+import pytest
+
+from istio_tpu.attribute.bag import DictBag
+from istio_tpu.compiler.layout import InternTable, Tensorizer, build_layout
+from istio_tpu.compiler.tensor_expr import (HostFallback, collect_requirements,
+                                            compile_expression)
+from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.expr.oracle import EvalError, OracleProgram
+from istio_tpu.expr.parser import parse
+from istio_tpu.testing.corpus import CORPUS, CORPUS_MANIFEST, Case
+
+FINDER = AttributeDescriptorFinder(CORPUS_MANIFEST)
+
+RUNNABLE = [c for c in CORPUS if c.compile_err is None]
+
+
+def _try_compile(case: Case, interner: InternTable):
+    reqs = collect_requirements(parse(case.e), FINDER)
+    layout = build_layout(CORPUS_MANIFEST, sorted(reqs.derived_keys),
+                          sorted(reqs.byte_sources, key=str))
+    prog = compile_expression(case.e, FINDER, layout, interner, jit=False)
+    return layout, prog
+
+
+@pytest.mark.parametrize("case", RUNNABLE, ids=lambda c: c.id())
+def test_corpus_tensor_parity(case: Case):
+    interner = InternTable()
+    try:
+        layout, prog = _try_compile(case, interner)
+    except HostFallback:
+        pytest.skip("host-fallback expression (oracle handles it)")
+
+    bag = DictBag(case.input)
+    batch = Tensorizer(layout, interner).tensorize([bag])
+    val, valid = prog(batch)
+
+    oracle = OracleProgram(case.e, FINDER)
+    try:
+        want = oracle.evaluate(bag)
+        want_valid = True
+    except EvalError:
+        want, want_valid = None, False
+
+    assert bool(valid[0]) == want_valid, (
+        f"{case.e}: device valid={bool(valid[0])}, oracle valid={want_valid}")
+    if want_valid:
+        got = prog.decode_value(np.asarray(val)[0])
+        assert got == want, f"{case.e}: device {got!r} != oracle {want!r}"
+
+
+def test_batched_mixed_inputs():
+    """One compiled program, many heterogeneous bags in one batch —
+    the whole point of the TPU path."""
+    expr = ('destination.service == "db.svc" && '
+            '(source.labels["app"] | "none") != "blocked"')
+    interner = InternTable()
+    reqs = collect_requirements(parse(expr), FINDER)
+    layout = build_layout(CORPUS_MANIFEST, sorted(reqs.derived_keys),
+                         sorted(reqs.byte_sources, key=str))
+    prog = compile_expression(expr, FINDER, layout, interner)
+
+    bags = [
+        DictBag({"destination.service": "db.svc",
+                 "source.labels": {"app": "x"}}),          # True
+        DictBag({"destination.service": "db.svc",
+                 "source.labels": {"app": "blocked"}}),    # False
+        DictBag({"destination.service": "db.svc"}),        # fallback → True
+        DictBag({"source.labels": {"app": "x"}}),          # dest absent → err
+        DictBag({"destination.service": "other.svc"}),     # False (short-circuit)
+    ]
+    batch = Tensorizer(layout, interner).tensorize(bags)
+    val, valid = prog(batch)
+    val, valid = np.asarray(val), np.asarray(valid)
+
+    oracle = OracleProgram(expr, FINDER)
+    for i, bag in enumerate(bags):
+        try:
+            want, ok = oracle.evaluate(bag), True
+        except EvalError:
+            want, ok = None, False
+        assert bool(valid[i]) == ok, f"row {i}"
+        if ok:
+            assert bool(val[i]) == want, f"row {i}"
+
+
+def test_regex_and_glob_on_device():
+    expr = ('"^/api/v[0-9]+/.*".matches(request.path) || '
+            'match(destination.service, "*.cluster.local")')
+    interner = InternTable()
+    reqs = collect_requirements(parse(expr), FINDER)
+    layout = build_layout(CORPUS_MANIFEST, sorted(reqs.derived_keys),
+                         sorted(reqs.byte_sources, key=str))
+    prog = compile_expression(expr, FINDER, layout, interner)
+
+    rows = [
+        ({"request.path": "/api/v1/x", "destination.service": "a.b"}, True),
+        ({"request.path": "/web", "destination.service": "a.cluster.local"},
+         True),
+        ({"request.path": "/web", "destination.service": "a.b"}, False),
+    ]
+    batch = Tensorizer(layout, interner).tensorize(
+        [DictBag(r[0]) for r in rows])
+    val, valid = prog(batch)
+    for i, (_, want) in enumerate(rows):
+        assert bool(valid[i])
+        assert bool(np.asarray(val)[i]) == want, f"row {i}"
+
+
+def test_host_fallback_cases_raise():
+    for text in ["request.header[headername]",
+                 "match(service.name, servicename)",
+                 "ip(as)"]:
+        with pytest.raises(HostFallback):
+            collect_requirements(parse(text), FINDER)
