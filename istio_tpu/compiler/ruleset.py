@@ -1,0 +1,515 @@
+"""Ruleset compiler: N match predicates → one MXU matmul program.
+
+This is the batched replacement for the reference resolver's per-request
+loop (mixer/pkg/runtime/resolver.go:202-238 filterActions — which calls
+the IL interpreter once per rule per request, 100-600ns each per
+bench.baseline). Here a whole config snapshot compiles ONCE into dense
+tensors and every request batch is matched against ALL rules in two
+int8 matmuls on the MXU:
+
+    atoms:   evaluate every unique primitive predicate once per request
+             → m[B, A] "definitely true", n[B, A] "definitely false"
+    conj:    lit = [m ‖ n] int8 [B, 2A];  sat = (lit @ C == len(C_j))
+    rules:   matched = (sat @ RM) > 0 ;  not_matched = (sat @ RN) > 0
+             err = ~matched & ~not_matched      (3-valued result)
+
+Exactness: each predicate's AST is decomposed over its top-level
+LAND/LOR skeleton into a pair of monotone DNFs over per-atom literals
+{m_a, n_a}, where m_a = val∧¬err ("definitely true") and
+n_a = ¬val∧¬err ("definitely false"):
+
+    M(atom)      = {{m_a}}                 N(atom)      = {{n_a}}
+    M(a && b)    = M(a)∧M(b)               N(a && b)    = N(a) ∨ (M(a)∧N(b))
+    M(a || b)    = M(a) ∨ (N(a)∧M(b))      N(a || b)    = N(a)∧N(b)
+
+These recurrences are provably equivalent to the short-circuit +
+error-propagation semantics of the oracle (istio_tpu/expr/oracle.py,
+mirroring IL generateLand/generateLor compiler.go:373/:354): e.g. a
+short-circuited `false && err` is N(a)∧anything ⇒ not-matched, while
+`true && err` is neither M nor N ⇒ error. The conformance tests
+(tests/test_ruleset.py) check every corpus predicate against the oracle.
+
+Atoms are deduplicated ACROSS rules (10k istio rules share a few hundred
+distinct predicates in practice) and evaluated in three tiers:
+  1. a vectorized gather-compare for EQ/NEQ(slot, const) — covers the
+     overwhelming majority of real istio match clauses;
+  2. a vectorized slot-vs-slot compare;
+  3. per-atom compiled closures from tensor_expr for everything else
+     (byte predicates, `|` fallback chains, nested EQ of booleans).
+
+Rules whose predicate cannot lower (dynamic patterns, DNF blowup past
+`dnf_cap`) are marked host-fallback and carry an OracleProgram; the
+runtime dispatcher overlays their verdicts on the device result.
+
+ReferencedAttributes (protoBag.go:117 semantics) become compile-time
+per-rule attribute bitmaps (SURVEY.md §2.2 translation note).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.compiler.layout import (AttributeBatch, BatchLayout,
+                                       ID_FALSE, ID_TRUE, InternTable,
+                                       build_layout)
+from istio_tpu.compiler import tensor_expr
+from istio_tpu.compiler.tensor_expr import (HostFallback, Requirements,
+                                            collect_requirements)
+from istio_tpu.expr.checker import (AttributeDescriptorFinder, DEFAULT_FUNCS,
+                                    TypeError_, eval_type)
+from istio_tpu.expr.exprs import Expression, const_expr
+from istio_tpu.expr.externs import ExternError, extern_ip, extern_timestamp
+from istio_tpu.expr.oracle import OracleProgram
+from istio_tpu.expr.parser import parse
+
+V = ValueType
+
+# A literal is (atom_index, kind): kind 'm' = definitely-true,
+# 'n' = definitely-false. A conjunction is a frozenset of literals; a DNF
+# a set of conjunctions.
+Literal = tuple[int, str]
+Conj = frozenset
+Dnf = set
+
+DEFAULT_DNF_CAP = 128
+
+
+class DnfBlowup(HostFallback):
+    """Predicate's DNF exceeded dnf_cap conjunctions."""
+
+
+def _contradicts(c: Conj) -> bool:
+    idxs = {}
+    for idx, kind in c:
+        prev = idxs.get(idx)
+        if prev is not None and prev != kind:
+            return True
+        idxs[idx] = kind
+    return False
+
+
+def _dnf_and(a: Dnf, b: Dnf, cap: int) -> Dnf:
+    out: Dnf = set()
+    for x in a:
+        for y in b:
+            c = x | y
+            if not _contradicts(c):
+                out.add(c)
+    if len(out) > cap:
+        raise DnfBlowup(f"DNF exceeded {cap} conjunctions")
+    return _prune(out)
+
+
+def _prune(d: Dnf) -> Dnf:
+    """Drop subsumed conjunctions (c2 ⊇ c1 is redundant)."""
+    by_size = sorted(d, key=len)
+    kept: list[Conj] = []
+    for c in by_size:
+        if not any(k <= c for k in kept):
+            kept.append(c)
+    return set(kept)
+
+
+@dataclasses.dataclass
+class Rule:
+    """A policy rule's match clause (reference: the `match:` field of a
+    mixer rule, config.proto; resolver.go:34 Rule)."""
+    name: str
+    match: str = ""          # empty = always matches (resolver.go:219)
+    namespace: str = ""
+
+
+@dataclasses.dataclass
+class _AtomTable:
+    """Deduplicated primitive predicates across all rules."""
+    asts: list[Expression] = dataclasses.field(default_factory=list)
+    by_key: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def index_of(self, e: Expression) -> int:
+        key = str(e)
+        idx = self.by_key.get(key)
+        if idx is None:
+            idx = len(self.asts)
+            self.by_key[key] = idx
+            self.asts.append(e)
+        return idx
+
+
+def _decompose(e: Expression, atoms: _AtomTable, cap: int) -> tuple[Dnf, Dnf]:
+    """→ (M, N): DNFs for definitely-matched / definitely-not-matched."""
+    if e.const_ is not None and e.const_.vtype == V.BOOL:
+        if e.const_.value:
+            return ({frozenset()}, set())
+        return (set(), {frozenset()})
+    if e.fn is not None and e.fn.name in ("LAND", "LOR"):
+        name = e.fn.name
+        args = e.fn.args
+        m, n = _decompose(args[0], atoms, cap)
+        for arg in args[1:]:
+            ma, na = _decompose(arg, atoms, cap)
+            if name == "LAND":
+                m, n = _dnf_and(m, ma, cap), _prune(n | _dnf_and(m, na, cap))
+            else:
+                m, n = _prune(m | _dnf_and(n, ma, cap)), _dnf_and(n, na, cap)
+        return m, n
+    idx = atoms.index_of(e)
+    return ({frozenset([(idx, "m")])}, {frozenset([(idx, "n")])})
+
+
+def _fold_time_const(e: Expression) -> Any | None:
+    """Fold ip("c")/timestamp("c") over a constant into a value;
+    None if not that shape. ExternError propagates (oracle parity: the
+    atom then always errors — handled by the general path)."""
+    f = e.fn
+    if f is None or f.name not in ("ip", "timestamp"):
+        return None
+    if not f.args or f.args[0].const_ is None:
+        return None
+    raw = f.args[0].const_.value
+    return extern_ip(raw) if f.name == "ip" else extern_timestamp(raw)
+
+
+@dataclasses.dataclass
+class _SlotRef:
+    col: int
+
+
+def _slot_ref(e: Expression, layout: BatchLayout,
+              finder: AttributeDescriptorFinder) -> _SlotRef | None:
+    """Variable or INDEX(map, const-key) → its scalar/derived column."""
+    if e.var is not None:
+        vt = finder.get_attribute(e.var.name)
+        if vt is None or vt == V.STRING_MAP:
+            return None
+        return _SlotRef(layout.slot_of(e.var.name))
+    f = e.fn
+    if (f is not None and f.name == "INDEX" and f.args[0].var is not None
+            and f.args[1].const_ is not None
+            and isinstance(f.args[1].const_.value, str)):
+        pair = (f.args[0].var.name, f.args[1].const_.value)
+        if pair in layout.derived_slots:
+            return _SlotRef(layout.derived_slots[pair])
+    return None
+
+
+def _const_id(e: Expression, interner: InternTable) -> int | None:
+    """Constant operand (or foldable ip()/timestamp()) → intern id."""
+    if e.const_ is not None:
+        v = e.const_.value
+        if isinstance(v, bool):
+            return ID_TRUE if v else ID_FALSE
+        return interner.intern(v)
+    try:
+        folded = _fold_time_const(e)
+    except ExternError:
+        return None
+    if folded is None:
+        return None
+    return interner.intern(folded)
+
+
+@dataclasses.dataclass
+class RuleSetProgram:
+    """The compiled snapshot. `fn(batch)` → (matched, not_matched, err)
+    each bool[B, n_rules]. Host-fallback rules read False/False/True on
+    device; overlay with `host_eval`."""
+    rules: list[Rule]
+    layout: BatchLayout
+    interner: InternTable
+    fn: Callable[[AttributeBatch], tuple[Any, Any, Any]]
+    n_atoms: int
+    n_conjs: int
+    host_fallback: dict[int, OracleProgram]   # rule idx → oracle
+    fallback_reason: dict[int, str]
+    attr_mask: np.ndarray                     # bool [n_rules, n_columns]
+    attr_names: list[set]                     # per rule: names + (map,key)
+    rule_ns: np.ndarray                       # int32 [n_rules]
+    ns_ids: dict[str, int]
+
+    @property
+    def n_rules(self) -> int:
+        return len(self.rules)
+
+    def __call__(self, batch: AttributeBatch) -> tuple[Any, Any, Any]:
+        return self.fn(batch)
+
+    def namespace_id(self, ns: str) -> int:
+        """Id for a request namespace; unknown namespaces match only
+        default-namespace ('') rules."""
+        return self.ns_ids.get(ns, -1)
+
+    def namespace_mask(self, req_ns_ids: Any) -> Any:
+        """bool[B, n_rules]: rule visible to the request's namespace —
+        default-namespace rules apply to everyone (resolver.go:110
+        default + destination-namespace rule lists)."""
+        rns = jnp.asarray(self.rule_ns)
+        req = jnp.asarray(req_ns_ids)
+        return (rns[None, :] == self.ns_ids[""]) | (rns[None, :] == req[:, None])
+
+    def host_eval(self, rule_idx: int, bag) -> tuple[bool, bool, bool]:
+        """(matched, not_matched, err) for one host-fallback rule."""
+        prog = self.host_fallback[rule_idx]
+        try:
+            v = bool(prog.evaluate(bag))
+            return v, not v, False
+        except Exception:
+            return False, False, True
+
+
+def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
+                    *, interner: InternTable | None = None,
+                    max_str_len: int | None = None,
+                    dnf_cap: int = DEFAULT_DNF_CAP,
+                    jit: bool = True) -> RuleSetProgram:
+    """Compile a rule snapshot. Never raises for individual bad rules —
+    un-lowerable predicates fall back to the oracle; predicates that do
+    not even type-check to BOOL raise TypeError_ (config validation's
+    job, store/validator.go analog)."""
+    interner = interner or InternTable()
+    atoms = _AtomTable()
+    per_rule: list[tuple[Dnf, Dnf] | None] = []   # None = host fallback
+    host_fallback: dict[int, OracleProgram] = {}
+    fallback_reason: dict[int, str] = {}
+    parsed: list[Expression] = []
+
+    for ridx, rule in enumerate(rules):
+        text = rule.match.strip() or "true"
+        ast = parse(text)
+        rtype = eval_type(ast, finder, DEFAULT_FUNCS)
+        if rtype != V.BOOL:
+            raise TypeError_(
+                f"rule {rule.name}: match must be BOOL, got {rtype.name}")
+        parsed.append(ast)
+        try:
+            snapshot = (list(atoms.asts), dict(atoms.by_key))
+            mn = _decompose(ast, atoms, dnf_cap)
+            per_rule.append(mn)
+        except HostFallback as exc:
+            atoms.asts, atoms.by_key = snapshot  # undo partial atom adds
+            per_rule.append(None)
+            host_fallback[ridx] = OracleProgram(text, finder)
+            fallback_reason[ridx] = str(exc)
+
+    # Requirements for every device atom; atoms that cannot lower demote
+    # every rule that references them to host fallback.
+    reqs = Requirements()
+    bad_atoms: set[int] = set()
+    for aidx, ast in enumerate(atoms.asts):
+        try:
+            r = Requirements()
+            collect_requirements(ast, finder, r)
+        except HostFallback as exc:
+            bad_atoms.add(aidx)
+            continue
+        reqs.merge(r)
+    if bad_atoms:
+        for ridx, mn in enumerate(per_rule):
+            if mn is None:
+                continue
+            used = {i for conj in (mn[0] | mn[1]) for i, _ in conj}
+            if used & bad_atoms:
+                per_rule[ridx] = None
+                host_fallback[ridx] = OracleProgram(
+                    rules[ridx].match.strip() or "true", finder)
+                fallback_reason[ridx] = "atom not lowerable"
+
+    manifest = {n: finder.get_attribute(n) for n in finder.names()}
+    kwargs = {} if max_str_len is None else {"max_str_len": max_str_len}
+    layout = build_layout(manifest, sorted(reqs.derived_keys),
+                          sorted(reqs.byte_sources, key=str), **kwargs)
+
+    # ---- classify atoms into vectorizable tiers ----
+    live_atoms = sorted({i for mn in per_rule if mn
+                         for conj in (mn[0] | mn[1]) for i, _ in conj})
+    eq_cols: list[int] = []; eq_cids: list[int] = []; eq_neg: list[bool] = []
+    eq_atom_idx: list[int] = []
+    ss_a: list[int] = []; ss_b: list[int] = []; ss_neg: list[bool] = []
+    ss_atom_idx: list[int] = []
+    gen_fns: list[Callable] = []
+    gen_atom_idx: list[int] = []
+    ctx = tensor_expr._Ctx(layout, interner, finder)
+
+    for aidx in live_atoms:
+        ast = atoms.asts[aidx]
+        done = False
+        f = ast.fn
+        if ast.var is not None and finder.get_attribute(ast.var.name) == V.BOOL:
+            eq_cols.append(layout.slot_of(ast.var.name))
+            eq_cids.append(ID_TRUE); eq_neg.append(False)
+            eq_atom_idx.append(aidx); done = True
+        elif f is not None and f.name in ("EQ", "NEQ") and len(f.args) == 2:
+            neg = f.name == "NEQ"
+            for x, y in ((f.args[0], f.args[1]), (f.args[1], f.args[0])):
+                sref = _slot_ref(x, layout, finder)
+                if sref is None:
+                    continue
+                cid = _const_id(y, interner)
+                if cid is not None:
+                    eq_cols.append(sref.col); eq_cids.append(cid)
+                    eq_neg.append(neg); eq_atom_idx.append(aidx)
+                    done = True
+                    break
+            if not done:
+                ra = _slot_ref(f.args[0], layout, finder)
+                rb = _slot_ref(f.args[1], layout, finder)
+                if ra is not None and rb is not None:
+                    ss_a.append(ra.col); ss_b.append(rb.col)
+                    ss_neg.append(neg); ss_atom_idx.append(aidx)
+                    done = True
+        if not done:
+            gen_fns.append(tensor_expr._compile_node(ast, ctx))
+            gen_atom_idx.append(aidx)
+
+    n_atoms = len(atoms.asts)
+    order = eq_atom_idx + ss_atom_idx + gen_atom_idx
+    n_live = max(len(order), 1)   # width of the m/n literal blocks
+    # inverse permutation: position of atom i in the concatenated output
+    pos_of = np.full(max(n_atoms, 1), 0, dtype=np.int32)
+    for pos, aidx in enumerate(order):
+        pos_of[aidx] = pos
+
+    eq_cols_a = np.asarray(eq_cols, np.int32)
+    eq_cids_a = np.asarray(eq_cids, np.int32)
+    eq_neg_a = np.asarray(eq_neg, bool)
+    ss_a_a = np.asarray(ss_a, np.int32)
+    ss_b_a = np.asarray(ss_b, np.int32)
+    ss_neg_a = np.asarray(ss_neg, bool)
+
+    # ---- conjunction + rule matrices ----
+    conj_list: list[Conj] = []
+    conj_key: dict[Conj, int] = {}
+    rule_m_cols: list[list[int]] = []
+    rule_n_cols: list[list[int]] = []
+    for mn in per_rule:
+        if mn is None:
+            rule_m_cols.append([]); rule_n_cols.append([])
+            continue
+        cols_mn = []
+        for dnf in mn:
+            cols = []
+            for conj in dnf:
+                j = conj_key.get(conj)
+                if j is None:
+                    j = len(conj_list)
+                    conj_key[conj] = j
+                    conj_list.append(conj)
+                cols.append(j)
+            cols_mn.append(cols)
+        rule_m_cols.append(cols_mn[0]); rule_n_cols.append(cols_mn[1])
+
+    n_conjs = len(conj_list)
+    n_rules = len(rules)
+    C = np.zeros((2 * n_live, max(n_conjs, 1)), dtype=np.int8)
+    conj_len = np.zeros(max(n_conjs, 1), dtype=np.int32)
+    for j, conj in enumerate(conj_list):
+        conj_len[j] = len(conj)
+        for aidx, kind in conj:
+            row = pos_of[aidx] + (0 if kind == "m" else n_live)
+            C[row, j] = 1
+    RM = np.zeros((max(n_conjs, 1), max(n_rules, 1)), dtype=np.int8)
+    RN = np.zeros_like(RM)
+    for ridx in range(n_rules):
+        for j in rule_m_cols[ridx]:
+            RM[j, ridx] = 1
+        for j in rule_n_cols[ridx]:
+            RN[j, ridx] = 1
+
+    C_j = jnp.asarray(C)
+    conj_len_j = jnp.asarray(conj_len)
+    RM_j = jnp.asarray(RM)
+    RN_j = jnp.asarray(RN)
+    dims = (((1,), (0,)), ((), ()))
+
+    def run(batch: AttributeBatch) -> tuple[Any, Any, Any]:
+        b = batch.ids.shape[0]
+        parts_m, parts_n = [], []
+        if eq_cols_a.size:
+            ids = batch.ids[:, eq_cols_a]
+            pres = batch.present[:, eq_cols_a]
+            cmp = (ids == eq_cids_a[None, :]) ^ eq_neg_a[None, :]
+            parts_m.append(cmp & pres)
+            parts_n.append(~cmp & pres)
+        if ss_a_a.size:
+            pres = batch.present[:, ss_a_a] & batch.present[:, ss_b_a]
+            cmp = (batch.ids[:, ss_a_a] == batch.ids[:, ss_b_a]) ^ ss_neg_a[None, :]
+            parts_m.append(cmp & pres)
+            parts_n.append(~cmp & pres)
+        for fn in gen_fns:
+            t = fn(batch)
+            ee = t.err | ~t.ok
+            parts_m.append((t.val & ~ee)[:, None])
+            parts_n.append((~t.val & ~ee)[:, None])
+        if parts_m:
+            m_all = jnp.concatenate(parts_m, axis=1)
+            n_all = jnp.concatenate(parts_n, axis=1)
+        else:
+            m_all = jnp.zeros((b, 1), bool)
+            n_all = jnp.zeros((b, 1), bool)
+        lit = jnp.concatenate([m_all, n_all], axis=1).astype(jnp.int8)
+        counts = lax.dot_general(lit, C_j, dims,
+                                 preferred_element_type=jnp.int32)
+        sat = (counts == conj_len_j[None, :]).astype(jnp.int8)
+        matched = lax.dot_general(sat, RM_j, dims,
+                                  preferred_element_type=jnp.int32) > 0
+        not_matched = lax.dot_general(sat, RN_j, dims,
+                                      preferred_element_type=jnp.int32) > 0
+        # empty-M rules (incl. host fallback): matched stays False; the
+        # err bit below correctly reads True only for device rules whose
+        # DNF pair is inconclusive on this input.
+        err = ~matched & ~not_matched
+        return matched, not_matched, err
+
+    # ---- per-rule attribute bitmaps (compile-time ReferencedAttributes) ----
+    attr_mask = np.zeros((max(n_rules, 1), max(layout.n_columns, 1)), bool)
+    attr_names: list[set] = []
+    for ridx in range(n_rules):
+        names: set = set()
+        _collect_attr_names(parsed[ridx], finder, names)
+        attr_names.append(names)
+        for item in names:
+            if isinstance(item, tuple):
+                if item in layout.derived_slots:
+                    attr_mask[ridx, layout.derived_slots[item]] = True
+            elif item in layout.slots:
+                attr_mask[ridx, layout.slots[item]] = True
+
+    ns_ids: dict[str, int] = {"": 0}
+    rule_ns = np.zeros(max(n_rules, 1), np.int32)
+    for ridx, rule in enumerate(rules):
+        ns = rule.namespace
+        if ns not in ns_ids:
+            ns_ids[ns] = len(ns_ids)
+        rule_ns[ridx] = ns_ids[ns]
+
+    return RuleSetProgram(
+        rules=list(rules), layout=layout, interner=interner,
+        fn=jax.jit(run) if jit else run,
+        n_atoms=n_atoms, n_conjs=n_conjs,
+        host_fallback=host_fallback, fallback_reason=fallback_reason,
+        attr_mask=attr_mask, attr_names=attr_names,
+        rule_ns=rule_ns, ns_ids=ns_ids)
+
+
+def _collect_attr_names(e: Expression, finder: AttributeDescriptorFinder,
+                        out: set) -> None:
+    if e.var is not None:
+        out.add(e.var.name)
+        return
+    f = e.fn
+    if f is None:
+        return
+    if (f.name == "INDEX" and f.args[0].var is not None
+            and f.args[1].const_ is not None):
+        out.add(f.args[0].var.name)
+        out.add((f.args[0].var.name, f.args[1].const_.value))
+        return
+    if f.target is not None:
+        _collect_attr_names(f.target, finder, out)
+    for a in f.args:
+        _collect_attr_names(a, finder, out)
