@@ -1,17 +1,25 @@
-"""Ruleset compiler: N match predicates → one MXU matmul program.
+"""Ruleset compiler: N match predicates → one batched tensor program.
 
 This is the batched replacement for the reference resolver's per-request
 loop (mixer/pkg/runtime/resolver.go:202-238 filterActions — which calls
 the IL interpreter once per rule per request, 100-600ns each per
-bench.baseline). Here a whole config snapshot compiles ONCE into dense
-tensors and every request batch is matched against ALL rules in two
-int8 matmuls on the MXU:
+bench.baseline). Here a whole config snapshot compiles ONCE into device
+tensors and every request batch is matched against ALL rules in one
+fused XLA program:
 
     atoms:   evaluate every unique primitive predicate once per request
              → m[B, A] "definitely true", n[B, A] "definitely false"
-    conj:    lit = [m ‖ n] int8 [B, 2A];  sat = (lit @ C == len(C_j))
-    rules:   matched = (sat @ RM) > 0 ;  not_matched = (sat @ RN) > 0
-             err = ~matched & ~not_matched      (3-valued result)
+    conj:    lit = [m ‖ n ‖ TRUE];  sat[B, n_conj] = AND over each
+             conjunction's padded literal indices (gather + all)
+    rules:   matched = OR over each rule's M-conjunction indices;
+             not_matched likewise over N; err = ~matched & ~not_matched
+
+The conj/rule stages are padded index gathers + reductions rather than
+one-hot [2A, n_conj] / [n_conj, R] matmuls: conjunctions average only a
+few literals, so the dense matmul burns ~1000× the useful FLOPs
+(measured 23ms vs ~1ms per 2048×10k-rule step on v5e). The index
+tensors ride HBM bandwidth and shard over a rule axis ("mp") for
+VMEM-bound snapshots (istio_tpu/parallel/mesh.py).
 
 Exactness: each predicate's AST is decomposed over its top-level
 LAND/LOR skeleton into a pair of monotone DNFs over per-atom literals
@@ -52,7 +60,6 @@ from typing import Any, Callable, Mapping, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 from istio_tpu.attribute.types import ValueType
 from istio_tpu.compiler.layout import (AttributeBatch, BatchLayout,
@@ -222,7 +229,8 @@ class RuleSetProgram:
     rules: list[Rule]
     layout: BatchLayout
     interner: InternTable
-    fn: Callable[[AttributeBatch], tuple[Any, Any, Any]]
+    fn: Callable[..., tuple[Any, Any, Any]]   # fn(params, batch)
+    params: Mapping[str, Any]   # device index tensors (lit_idx/conj_*_idx)
     n_atoms: int
     n_conjs: int
     host_fallback: dict[int, OracleProgram]   # rule idx → oracle
@@ -237,7 +245,7 @@ class RuleSetProgram:
         return len(self.rules)
 
     def __call__(self, batch: AttributeBatch) -> tuple[Any, Any, Any]:
-        return self.fn(batch)
+        return self.fn(self.params, batch)
 
     def namespace_id(self, ns: str) -> int:
         """Id for a request namespace; unknown namespaces match only
@@ -405,28 +413,41 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
 
     n_conjs = len(conj_list)
     n_rules = len(rules)
-    C = np.zeros((2 * n_live, max(n_conjs, 1)), dtype=np.int8)
-    conj_len = np.zeros(max(n_conjs, 1), dtype=np.int32)
+    l_max = max((len(c) for c in conj_list), default=1) or 1
+    k_max = max((max(len(m), len(n)) for m, n in
+                 ((rule_m_cols[r], rule_n_cols[r]) for r in range(n_rules))),
+                default=1) or 1
+
+    # Sparse (gather) formulation. Conjunctions average only a few
+    # literals and rules a few conjunctions, so dense [2A, n_conj] /
+    # [n_conj, R] one-hot matmuls waste ~1000× the FLOPs (measured
+    # 23ms/step at 10k rules on v5e); padded index gathers + AND/OR
+    # reductions are pure HBM-bandwidth ops (<2ms). Sentinel columns:
+    # literal index 2·n_live is always-TRUE (AND identity), conjunction
+    # index n_conjs is always-FALSE (OR identity).
+    LIT_TRUE = 2 * n_live
+    CONJ_FALSE = max(n_conjs, 1)   # sat has max(n_conjs,1) real columns
+    lit_idx = np.full((max(n_conjs, 1), l_max), LIT_TRUE, np.int32)
     for j, conj in enumerate(conj_list):
-        conj_len[j] = len(conj)
-        for aidx, kind in conj:
-            row = pos_of[aidx] + (0 if kind == "m" else n_live)
-            C[row, j] = 1
-    RM = np.zeros((max(n_conjs, 1), max(n_rules, 1)), dtype=np.int8)
-    RN = np.zeros_like(RM)
+        for s, (aidx, kind) in enumerate(sorted(conj)):
+            lit_idx[j, s] = pos_of[aidx] + (0 if kind == "m" else n_live)
+    conj_m_idx = np.full((max(n_rules, 1), k_max), CONJ_FALSE, np.int32)
+    conj_n_idx = np.full((max(n_rules, 1), k_max), CONJ_FALSE, np.int32)
     for ridx in range(n_rules):
-        for j in rule_m_cols[ridx]:
-            RM[j, ridx] = 1
-        for j in rule_n_cols[ridx]:
-            RN[j, ridx] = 1
+        for s, j in enumerate(rule_m_cols[ridx]):
+            conj_m_idx[ridx, s] = j
+        for s, j in enumerate(rule_n_cols[ridx]):
+            conj_n_idx[ridx, s] = j
 
-    C_j = jnp.asarray(C)
-    conj_len_j = jnp.asarray(conj_len)
-    RM_j = jnp.asarray(RM)
-    RN_j = jnp.asarray(RN)
-    dims = (((1,), (0,)), ((), ()))
+    # Index tensors are ARGUMENTS, not closure constants: 10k-rule
+    # snapshots would otherwise embed MBs of literals in the HLO (the
+    # serialized program must stay small for remote compilation).
+    params = {"lit_idx": jnp.asarray(lit_idx),
+              "conj_m_idx": jnp.asarray(conj_m_idx),
+              "conj_n_idx": jnp.asarray(conj_n_idx)}
 
-    def run(batch: AttributeBatch) -> tuple[Any, Any, Any]:
+    def run(params: Mapping[str, Any],
+            batch: AttributeBatch) -> tuple[Any, Any, Any]:
         b = batch.ids.shape[0]
         parts_m, parts_n = [], []
         if eq_cols_a.size:
@@ -451,14 +472,15 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
         else:
             m_all = jnp.zeros((b, 1), bool)
             n_all = jnp.zeros((b, 1), bool)
-        lit = jnp.concatenate([m_all, n_all], axis=1).astype(jnp.int8)
-        counts = lax.dot_general(lit, C_j, dims,
-                                 preferred_element_type=jnp.int32)
-        sat = (counts == conj_len_j[None, :]).astype(jnp.int8)
-        matched = lax.dot_general(sat, RM_j, dims,
-                                  preferred_element_type=jnp.int32) > 0
-        not_matched = lax.dot_general(sat, RN_j, dims,
-                                      preferred_element_type=jnp.int32) > 0
+        # lit[:, LIT_TRUE] is the AND-identity sentinel
+        lit = jnp.concatenate(
+            [m_all, n_all, jnp.ones((b, 1), bool)], axis=1)
+        sat = jnp.all(lit[:, params["lit_idx"]], axis=2)     # [B, n_conjs]
+        # sat[:, CONJ_FALSE] is the OR-identity sentinel
+        sat_ext = jnp.concatenate(
+            [sat, jnp.zeros((b, 1), bool)], axis=1)
+        matched = jnp.any(sat_ext[:, params["conj_m_idx"]], axis=2)
+        not_matched = jnp.any(sat_ext[:, params["conj_n_idx"]], axis=2)
         # empty-M rules (incl. host fallback): matched stays False; the
         # err bit below correctly reads True only for device rules whose
         # DNF pair is inconclusive on this input.
@@ -489,7 +511,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
 
     return RuleSetProgram(
         rules=list(rules), layout=layout, interner=interner,
-        fn=jax.jit(run) if jit else run,
+        fn=jax.jit(run) if jit else run, params=params,
         n_atoms=n_atoms, n_conjs=n_conjs,
         host_fallback=host_fallback, fallback_reason=fallback_reason,
         attr_mask=attr_mask, attr_names=attr_names,

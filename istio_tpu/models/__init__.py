@@ -1,0 +1,13 @@
+"""Flagship fused policy-engine models.
+
+`PolicyEngine` is the TPU replacement for the reference's entire Mixer
+Check() hot path (SURVEY.md §3.1): resolver rule filtering + template
+instance construction + check-adapter verdicts, fused into ONE jitted
+device step over a request batch.
+"""
+from istio_tpu.models.policy_engine import (CheckVerdict, DenySpec,
+                                            ListEntrySpec, PolicyEngine,
+                                            QuotaSpec)
+
+__all__ = ["PolicyEngine", "CheckVerdict", "DenySpec", "ListEntrySpec",
+           "QuotaSpec"]

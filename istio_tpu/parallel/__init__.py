@@ -1,0 +1,23 @@
+"""Device-mesh parallelism for the policy engine.
+
+The reference scales horizontally with stateless replicas behind k8s
+Services (SURVEY.md §5.8 — no collectives of any kind). The TPU-native
+design replaces that with SPMD over a `jax.sharding.Mesh`:
+
+  axis "dp"  — data parallel over the request batch (the natural axis:
+               requests are independent; rule tensors replicate).
+  axis "mp"  — model parallel over RULES when a snapshot's tensors
+               exceed per-core VMEM (10k+ rules). The per-rule gather/
+               reduce stages shard on the rule dimension, so the only
+               collective on the request path is the final per-request
+               verdict combine (a small psum over "mp"), riding ICI.
+
+Multi-host: replicate dp groups across hosts over DCN; rule tensors are
+pure functions of config so every host compiles the same snapshot —
+there is no training state to synchronize (checkpoint = config hash,
+SURVEY.md §5.4).
+"""
+from istio_tpu.parallel.mesh import (MeshSpec, policy_mesh, shard_batch,
+                                     shard_engine_check)
+
+__all__ = ["MeshSpec", "policy_mesh", "shard_batch", "shard_engine_check"]

@@ -1,0 +1,114 @@
+"""Synthetic mesh workloads for entry(), dryrun, benches and tests.
+
+Shapes follow BASELINE.json's configs: Bookinfo-style denier +
+listchecker rules, RBAC-ish authz predicates over source/destination
+attributes, and header/URI match clauses (exact, prefix, glob, regex) —
+the same predicate mix Pilot's VirtualService match tables compile to.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from istio_tpu.attribute.bag import Bag, bag_from_mapping
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.compiler.ruleset import Rule
+from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.models.policy_engine import (DenySpec, ListEntrySpec,
+                                            PolicyEngine, QuotaSpec)
+
+V = ValueType
+
+MESH_MANIFEST: dict[str, ValueType] = {
+    "source.name": V.STRING, "source.namespace": V.STRING,
+    "source.ip": V.IP_ADDRESS, "source.labels": V.STRING_MAP,
+    "source.user": V.STRING, "source.service": V.STRING,
+    "destination.name": V.STRING, "destination.namespace": V.STRING,
+    "destination.service": V.STRING, "destination.labels": V.STRING_MAP,
+    "request.headers": V.STRING_MAP, "request.host": V.STRING,
+    "request.method": V.STRING, "request.path": V.STRING,
+    "request.scheme": V.STRING, "request.size": V.INT64,
+    "request.time": V.TIMESTAMP, "request.useragent": V.STRING,
+    "request.api_key": V.STRING,
+    "response.code": V.INT64, "response.size": V.INT64,
+    "response.duration": V.DURATION,
+    "connection.mtls": V.BOOL,
+    "context.protocol": V.STRING, "context.reporter.kind": V.STRING,
+    "api.service": V.STRING, "api.operation": V.STRING,
+    "api.version": V.STRING,
+}
+
+MESH_FINDER = AttributeDescriptorFinder(MESH_MANIFEST)
+
+
+def make_rules(n_rules: int, n_services: int | None = None,
+               with_regex: bool = True) -> list[Rule]:
+    """Bookinfo/authz-flavored rule mix: mostly EQ/NEQ conjunctions
+    (the vectorized tier), a sprinkling of header glob/regex and path
+    prefix predicates (the byte-DFA tier)."""
+    n_services = n_services or max(n_rules // 2, 1)
+    rules = []
+    for i in range(n_rules):
+        svc = f"svc{i % n_services}.ns{i % 23}.svc.cluster.local"
+        parts = [f'destination.service == "{svc}"']
+        k = i % 10
+        if k < 4:
+            parts.append(f'source.namespace != "locked{i % 5}"')
+        elif k == 4:
+            parts.append(f'request.method == "{"GET" if i % 2 else "POST"}"')
+        elif k == 5:
+            parts.append(f'request.headers["cookie"] == "session={i % 97}"')
+        elif k == 6:
+            parts.append('connection.mtls')
+        elif k == 7 and with_regex:
+            parts.append(f'request.path.startsWith("/api/v{i % 3}/")')
+        elif k == 8 and with_regex:
+            parts.append(f'match(request.host, "*.ns{i % 23}.cluster.local")')
+        elif k == 9 and with_regex:
+            parts.append(
+                f'request.path.matches("/(products|reviews)/[0-9]+/v{i % 4}")')
+        rules.append(Rule(name=f"rule{i}", match=" && ".join(parts),
+                          namespace=f"ns{i % 23}"))
+    return rules
+
+
+def make_engine(n_rules: int = 1024,
+                with_quota: bool = True, jit: bool = True) -> PolicyEngine:
+    rules = make_rules(n_rules)
+    deny = [DenySpec(rule=i) for i in range(0, n_rules, 3)]
+    lists = [ListEntrySpec(rule=i, value_attr="source.namespace",
+                           entries=[f"ns{j}" for j in range(0, 23, 2)])
+             for i in range(1, n_rules, 97)]
+    quotas = ([QuotaSpec(rule=i, key_attr="source.user", max_amount=1 << 20)
+               for i in range(2, n_rules, 301)] if with_quota else [])
+    return PolicyEngine(rules, MESH_FINDER, deny=deny, lists=lists,
+                        quotas=quotas, jit=jit)
+
+
+def make_bags(batch: int, seed: int = 1) -> list[Bag]:
+    rng = np.random.default_rng(seed)
+    bags = []
+    for _ in range(batch):
+        i = int(rng.integers(0, 4096))
+        d = {
+            "destination.service":
+                f"svc{rng.integers(0, 512)}.ns{i % 23}.svc.cluster.local",
+            "source.namespace": f"ns{rng.integers(0, 25)}",
+            "source.user": f"cluster.local/ns/ns{i % 23}/sa/sa{i % 61}",
+            "request.method": "GET" if rng.random() < 0.7 else "POST",
+            "request.path": f"/api/v{rng.integers(0, 4)}/products/{i}",
+            "request.host": f"svc{i % 31}.ns{i % 23}.cluster.local",
+            "request.size": i,
+            "connection.mtls": bool(rng.random() < 0.5),
+            "request.headers": {"cookie": f"session={rng.integers(0, 120)}",
+                                ":authority": "productpage"},
+        }
+        bags.append(bag_from_mapping(d))
+    return bags
+
+
+def make_request_ns(engine: PolicyEngine, batch: int,
+                    seed: int = 2) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    ids = [engine.ruleset.namespace_id(f"ns{rng.integers(0, 25)}")
+           for _ in range(batch)]
+    return np.asarray(ids, np.int32)

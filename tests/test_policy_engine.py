@@ -1,0 +1,119 @@
+"""PolicyEngine fused-step semantics: denier, listentry, quota, TTL
+combine, and referenced-attribute bitmaps (reference behaviors:
+dispatcher.combineResults dispatcher.go:322, denier.go, list.go:68,
+memquota.go:107)."""
+import numpy as np
+
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.compiler.ruleset import Rule
+from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.models.policy_engine import (DenySpec, ListEntrySpec, OK,
+                                            PERMISSION_DENIED, PolicyEngine,
+                                            QuotaSpec, RESOURCE_EXHAUSTED)
+from istio_tpu.testing.corpus import CORPUS_MANIFEST
+
+FINDER = AttributeDescriptorFinder(CORPUS_MANIFEST)
+
+
+def _run(engine, bag_dicts, ns=None):
+    bags = [bag_from_mapping(d) for d in bag_dicts]
+    batch = engine.tensorizer.tensorize(bags)
+    req_ns = np.zeros(len(bags), np.int32) if ns is None else np.asarray(ns)
+    return engine.check(batch, req_ns)
+
+
+def test_denier_path():
+    rules = [Rule(name="deny-user", match='request.user == "evil"')]
+    eng = PolicyEngine(rules, FINDER,
+                       deny=[DenySpec(rule=0, valid_duration_s=7.0,
+                                      valid_use_count=42)])
+    v = _run(eng, [{"request.user": "evil"}, {"request.user": "good"}, {}])
+    assert v.status.tolist() == [PERMISSION_DENIED, OK, OK]
+    assert float(v.valid_duration_s[0]) == 7.0
+    assert int(v.valid_use_count[0]) == 42
+    # non-denied requests keep "infinite" TTLs (runtime clamps to defaults)
+    assert float(v.valid_duration_s[1]) > 1e30
+
+
+def test_whitelist_and_blacklist():
+    rules = [Rule(name="wl", match=""), Rule(name="bl", match="")]
+    eng = PolicyEngine(
+        rules, FINDER,
+        lists=[ListEntrySpec(rule=0, value_attr="source.namespace",
+                             entries=["ns-a", "ns-b"]),
+               ListEntrySpec(rule=1, value_attr="request.user",
+                             entries=["bad"], blacklist=True)])
+    v = _run(eng, [
+        {"source.namespace": "ns-a", "request.user": "ok"},   # both pass
+        {"source.namespace": "ns-z", "request.user": "ok"},   # wl denies
+        {"source.namespace": "ns-b", "request.user": "bad"},  # bl denies
+    ])
+    assert v.status.tolist() == [OK, PERMISSION_DENIED, PERMISSION_DENIED]
+
+
+def test_list_requires_value_presence():
+    """Absent checked attribute → adapter can't run → no deny from it
+    (the runtime surfaces the expression-eval error separately)."""
+    rules = [Rule(name="wl", match="")]
+    eng = PolicyEngine(rules, FINDER,
+                       lists=[ListEntrySpec(rule=0, value_attr="request.user",
+                                            entries=["alice"])])
+    v = _run(eng, [{}])
+    assert v.status.tolist() == [OK]
+
+
+def test_quota_fixed_window():
+    rules = [Rule(name="q", match="")]
+    eng = PolicyEngine(rules, FINDER,
+                       quotas=[QuotaSpec(rule=0, key_attr="request.user",
+                                         max_amount=3)])
+    # 5 requests from same key in one batch: 3 granted, 2 exhausted
+    v = _run(eng, [{"request.user": "u"}] * 5)
+    assert sorted(v.status.tolist()) == [OK, OK, OK,
+                                         RESOURCE_EXHAUSTED,
+                                         RESOURCE_EXHAUSTED]
+    # next batch: window still consumed
+    v2 = _run(eng, [{"request.user": "u"}, {"request.user": "other"}])
+    assert v2.status.tolist() == [RESOURCE_EXHAUSTED, OK]
+    eng.reset_quota()
+    v3 = _run(eng, [{"request.user": "u"}])
+    assert v3.status.tolist() == [OK]
+
+
+def test_denied_requests_do_not_consume_quota():
+    """Quota runs only after a successful precondition check
+    (grpcServer.go:188-230): a denied request must not take tokens."""
+    rules = [Rule(name="deny", match='request.user == "evil"'),
+             Rule(name="q", match="")]
+    eng = PolicyEngine(rules, FINDER, deny=[DenySpec(rule=0)],
+                       quotas=[QuotaSpec(rule=1, key_attr="source.namespace",
+                                         max_amount=1)])
+    v = _run(eng, [{"request.user": "evil", "source.namespace": "ns"},
+                   {"request.user": "good", "source.namespace": "ns"}])
+    assert v.status.tolist() == [PERMISSION_DENIED, OK]
+
+
+def test_namespace_scoping():
+    rules = [Rule(name="deny-ns1", match="", namespace="ns1")]
+    eng = PolicyEngine(rules, FINDER, deny=[DenySpec(rule=0)])
+    ns1 = eng.ruleset.namespace_id("ns1")
+    other = eng.ruleset.namespace_id("absent-ns")
+    v = _run(eng, [{}, {}], ns=[ns1, other])
+    assert v.status.tolist() == [PERMISSION_DENIED, OK]
+
+
+def test_referenced_attribute_bitmap():
+    rules = [Rule(name="r", match='request.user == "x"')]
+    eng = PolicyEngine(rules, FINDER, deny=[DenySpec(rule=0)])
+    v = _run(eng, [{"request.user": "x"}])
+    col = eng.ruleset.layout.slot_of("request.user")
+    assert bool(v.referenced[0, col])
+
+
+def test_ttl_combine_takes_min():
+    rules = [Rule(name="a", match=""), Rule(name="b", match="")]
+    eng = PolicyEngine(rules, FINDER,
+                       deny=[DenySpec(rule=0, valid_duration_s=9.0),
+                             DenySpec(rule=1, valid_duration_s=2.0)])
+    v = _run(eng, [{}])
+    assert float(v.valid_duration_s[0]) == 2.0
