@@ -1,0 +1,23 @@
+"""Adapter SDK + built-in adapter inventory.
+
+Role of the reference's mixer/pkg/adapter (SDK) + mixer/adapter/*
+(inventory, SURVEY.md §2.5). An adapter declares `Info` (name, supported
+templates, builder factory, default config), a `Builder` validated and
+built once per distinct (adapter, config) signature, and a `Handler`
+receiving template instances per request.
+
+Inventory parity with the reference's 14 adapters:
+  denier, list, memquota, rbac, noop, stdio, prometheus, statsd,
+  fluentd, opa, kubernetesenv  — implemented
+  circonus, stackdriver, servicecontrol — gated stubs (external SaaS
+  backends; config-validated but Handle* raises AdapterUnavailable,
+  SURVEY.md §7 explicit non-goals for v1)
+"""
+from istio_tpu.adapters.sdk import (AdapterError, AdapterUnavailable,
+                                    Builder, CheckResult, Handler, Info,
+                                    QuotaArgs, QuotaResult)
+from istio_tpu.adapters.registry import adapter_registry
+
+__all__ = ["Info", "Builder", "Handler", "CheckResult", "QuotaArgs",
+           "QuotaResult", "AdapterError", "AdapterUnavailable",
+           "adapter_registry"]

@@ -1,0 +1,58 @@
+"""denier — unconditionally deny checks/quotas with a configured status.
+
+Reference: mixer/adapter/denier/denier.go (617 LoC): returns the
+configured status for checknothing/listentry checks and zero grant for
+quota. This is the adapter the PolicyEngine fuses on device as
+`DenySpec`; this host implementation serves the generic dispatcher path
+and is the semantics oracle for the fused one.
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+from istio_tpu.adapters.registry import adapter_registry
+from istio_tpu.adapters.sdk import (Builder, CheckResult, Env, Handler, Info,
+                                    QuotaArgs, QuotaResult)
+from istio_tpu.models.policy_engine import PERMISSION_DENIED
+
+
+class DenierHandler(Handler):
+    def __init__(self, config: Mapping[str, Any]):
+        self.status_code = int(config.get("status_code", PERMISSION_DENIED))
+        self.status_message = str(config.get("status_message", "denied"))
+        self.valid_duration_s = float(config.get("valid_duration_s", 5.0))
+        self.valid_use_count = int(config.get("valid_use_count", 10_000))
+
+    def handle_check(self, template: str,
+                     instance: Mapping[str, Any]) -> CheckResult:
+        return CheckResult(status_code=self.status_code,
+                           status_message=self.status_message,
+                           valid_duration_s=self.valid_duration_s,
+                           valid_use_count=self.valid_use_count)
+
+    def handle_quota(self, template: str, instance: Mapping[str, Any],
+                     args: QuotaArgs) -> QuotaResult:
+        return QuotaResult(granted_amount=0,
+                           valid_duration_s=self.valid_duration_s,
+                           status_code=self.status_code,
+                           status_message=self.status_message)
+
+
+class DenierBuilder(Builder):
+    def validate(self) -> list[str]:
+        errs = []
+        if not isinstance(self.config.get("status_code",
+                                          PERMISSION_DENIED), int):
+            errs.append("status_code must be an integer rpc code")
+        return errs
+
+    def build(self) -> Handler:
+        return DenierHandler(self.config)
+
+
+INFO = adapter_registry.register(Info(
+    name="denier",
+    supported_templates=("checknothing", "listentry", "quota"),
+    builder=DenierBuilder,
+    description="static deny for check/listentry/quota",
+    default_config={"status_code": PERMISSION_DENIED}))

@@ -1,0 +1,89 @@
+"""kubernetesenv — the ATTRIBUTE_GENERATOR adapter: pod metadata.
+
+Reference: mixer/adapter/kubernetesenv (2,613 LoC): a pod-informer
+cache keyed by pod UID/IP fills source/destination workload attributes
+(pod name, namespace, labels, service account, host IP) during
+Preprocess (dispatcher.go:285 → ProcessGenAttrs). This build runs with
+no k8s API server, so the pod cache is a pluggable `PodSource`:
+`StaticPodSource` (dict/YAML-file backed, used by tests and hermetic
+runs) with the informer variant left as an integration seam — the
+attribute-production contract is identical.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping
+
+from istio_tpu.adapters.registry import adapter_registry
+from istio_tpu.adapters.sdk import Builder, Env, Handler, Info
+
+# output attribute suffixes produced per prefix (source/destination/origin)
+_OUTPUTS = ("pod_name", "namespace", "labels", "service_account_name",
+            "pod_ip", "host_ip", "service")
+
+
+class StaticPodSource:
+    """Pod metadata lookup by `uid` (kubernetes://<pod>.<ns>) or ip."""
+
+    def __init__(self, pods: Mapping[str, Mapping[str, Any]] | None = None):
+        self._lock = threading.Lock()
+        self._pods = dict(pods or {})
+        self._by_ip = {p["pod_ip"]: p for p in self._pods.values()
+                       if "pod_ip" in p}
+
+    def update(self, pods: Mapping[str, Mapping[str, Any]]) -> None:
+        with self._lock:
+            self._pods = dict(pods)
+            self._by_ip = {p["pod_ip"]: p for p in self._pods.values()
+                           if "pod_ip" in p}
+
+    def by_uid(self, uid: str) -> Mapping[str, Any] | None:
+        with self._lock:
+            return self._pods.get(uid)
+
+    def by_ip(self, ip: str) -> Mapping[str, Any] | None:
+        with self._lock:
+            return self._by_ip.get(ip)
+
+
+class KubernetesEnvHandler(Handler):
+    def __init__(self, config: Mapping[str, Any], env: Env):
+        self.source: StaticPodSource = config.get("pod_source") \
+            or StaticPodSource(config.get("pods", {}))
+
+    def generate_attributes(self, template: str,
+                            instance: Mapping[str, Any]) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for prefix in ("source", "destination", "origin"):
+            pod = None
+            uid = instance.get(f"{prefix}_uid")
+            if uid:
+                pod = self.source.by_uid(str(uid).removeprefix(
+                    "kubernetes://"))
+            if pod is None:
+                ip = instance.get(f"{prefix}_ip")
+                if ip is not None:
+                    import ipaddress
+                    if isinstance(ip, bytes):
+                        ip = str(ipaddress.ip_address(
+                            ip[-4:] if len(ip) == 16 and
+                            ip[:12] == b"\x00" * 10 + b"\xff\xff" else ip))
+                    pod = self.source.by_ip(str(ip))
+            if pod is None:
+                continue
+            for key in _OUTPUTS:
+                if key in pod:
+                    out[f"{prefix}_{key}"] = pod[key]
+        return out
+
+
+class KubernetesEnvBuilder(Builder):
+    def build(self) -> Handler:
+        return KubernetesEnvHandler(self.config, self.env)
+
+
+INFO = adapter_registry.register(Info(
+    name="kubernetesenv",
+    supported_templates=("kubernetes",),
+    builder=KubernetesEnvBuilder,
+    description="pod-metadata attribute generator (APA)"))

@@ -1,0 +1,189 @@
+"""memquota — in-memory rate limits / quotas with rolling windows.
+
+Reference: mixer/adapter/memquota (2,230 LoC; HandleQuota memquota.go:
+107, alloc :118, dedup buildWithDedup :259). Semantics reproduced:
+
+  * per-quota `max_amount` with optional `valid_duration` — a rolling
+    window implemented with per-slice expiry buckets (`ticks`), or an
+    exact non-expiring counter when no duration is set;
+  * dedup: a (dedup_id → granted amount, expiry) cache so sidecar
+    retries of the same allocation don't double-count;
+  * best-effort vs all-or-nothing allocation (QuotaArgs.best_effort);
+  * quota keys are the instance's flattened dimensions (the reference
+    hashes the instance signature; we use a stable repr).
+
+State is per-replica and lost on restart — explicitly best-effort, like
+the reference. The device-side fixed-window variant lives in
+models/policy_engine.py QuotaSpec; this host adapter is the general
+path and the semantics oracle.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Mapping
+
+from istio_tpu.adapters.registry import adapter_registry
+from istio_tpu.adapters.sdk import (Builder, Env, Handler, Info, QuotaArgs,
+                                    QuotaResult)
+from istio_tpu.models.policy_engine import RESOURCE_EXHAUSTED
+
+_TICKS_PER_WINDOW = 10
+
+
+def _key(instance: Mapping[str, Any]) -> str:
+    dims = instance.get("dimensions", {})
+    return instance.get("name", "") + "|" + repr(sorted(dims.items()))
+
+
+class _Window:
+    """Rolling window: counts per tick; expired ticks are reclaimed."""
+
+    def __init__(self, max_amount: int, duration_s: float):
+        self.max = max_amount
+        self.duration = duration_s
+        self.tick_len = duration_s / _TICKS_PER_WINDOW
+        self.ticks: dict[int, int] = {}
+
+    def _gc(self, now: float) -> None:
+        horizon = int(now / self.tick_len) - _TICKS_PER_WINDOW
+        for t in [t for t in self.ticks if t <= horizon]:
+            del self.ticks[t]
+
+    def used(self, now: float) -> int:
+        self._gc(now)
+        return sum(self.ticks.values())
+
+    def alloc(self, amount: int, best_effort: bool, now: float) -> int:
+        avail = self.max - self.used(now)
+        granted = min(amount, avail) if best_effort else \
+            (amount if avail >= amount else 0)
+        if granted > 0:
+            t = int(now / self.tick_len)
+            self.ticks[t] = self.ticks.get(t, 0) + granted
+        return max(granted, 0)
+
+    def release(self, amount: int, now: float) -> int:
+        """ReleaseBestEffort: subtract from newest ticks."""
+        self._gc(now)
+        remaining = amount
+        for t in sorted(self.ticks, reverse=True):
+            take = min(self.ticks[t], remaining)
+            self.ticks[t] -= take
+            remaining -= take
+            if remaining == 0:
+                break
+        return amount - remaining
+
+
+class _Exact:
+    def __init__(self, max_amount: int):
+        self.max = max_amount
+        self.count = 0
+
+    def alloc(self, amount: int, best_effort: bool, now: float) -> int:
+        avail = self.max - self.count
+        granted = min(amount, avail) if best_effort else \
+            (amount if avail >= amount else 0)
+        self.count += max(granted, 0)
+        return max(granted, 0)
+
+    def release(self, amount: int, now: float) -> int:
+        take = min(amount, self.count)
+        self.count -= take
+        return take
+
+
+class MemQuotaHandler(Handler):
+    def __init__(self, config: Mapping[str, Any], env: Env,
+                 clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._limits: dict[str, dict] = {}
+        self._cells: dict[str, Any] = {}
+        self._dedup: dict[str, tuple[int, float]] = {}
+        self.min_dedup_s = float(config.get("min_deduplication_duration_s",
+                                            1.0))
+        for q in config.get("quotas", ()):
+            self._limits[q["name"]] = {
+                "max": int(q.get("max_amount", 0)),
+                "duration": float(q.get("valid_duration_s", 0.0)),
+            }
+
+    def _cell(self, name: str, dims_key: str):
+        lim = self._limits.get(name)
+        if lim is None:
+            return None
+        cell = self._cells.get(dims_key)
+        if cell is None:
+            cell = (_Window(lim["max"], lim["duration"])
+                    if lim["duration"] > 0 else _Exact(lim["max"]))
+            self._cells[dims_key] = cell
+        return cell
+
+    def handle_quota(self, template: str, instance: Mapping[str, Any],
+                     args: QuotaArgs) -> QuotaResult:
+        now = self._clock()
+        name = instance.get("name", "")
+        lim = self._limits.get(name)
+        if lim is None:
+            return QuotaResult(granted_amount=0,
+                               status_code=RESOURCE_EXHAUSTED,
+                               status_message=f"unknown quota {name}")
+        with self._lock:
+            self._gc_dedup(now)
+            if args.dedup_id:
+                hit = self._dedup.get(args.dedup_id)
+                if hit is not None and hit[1] > now:
+                    # replay the ORIGINAL outcome, including denial —
+                    # a cached grant of 0 must not read as success
+                    status = 0 if hit[0] > 0 or args.quota_amount == 0 \
+                        else RESOURCE_EXHAUSTED
+                    return QuotaResult(granted_amount=hit[0],
+                                       valid_duration_s=lim["duration"],
+                                       status_code=status)
+            cell = self._cell(name, _key(instance))
+            granted = cell.alloc(args.quota_amount, args.best_effort, now)
+            if args.dedup_id:
+                expiry = now + max(lim["duration"], self.min_dedup_s)
+                self._dedup[args.dedup_id] = (granted, expiry)
+        status = 0 if granted > 0 or args.quota_amount == 0 \
+            else RESOURCE_EXHAUSTED
+        return QuotaResult(granted_amount=granted,
+                           valid_duration_s=lim["duration"],
+                           status_code=status)
+
+    def release(self, instance: Mapping[str, Any], amount: int) -> int:
+        """ReleaseBestEffort (quota return path)."""
+        with self._lock:
+            cell = self._cell(instance.get("name", ""), _key(instance))
+            if cell is None:
+                return 0
+            return cell.release(amount, self._clock())
+
+    def _gc_dedup(self, now: float) -> None:
+        if len(self._dedup) > 10_000:
+            for k in [k for k, (_, exp) in self._dedup.items()
+                      if exp <= now]:
+                del self._dedup[k]
+
+
+class MemQuotaBuilder(Builder):
+    def validate(self) -> list[str]:
+        errs = []
+        for q in self.config.get("quotas", ()):
+            if "name" not in q:
+                errs.append("quota missing name")
+            if int(q.get("max_amount", 0)) < 0:
+                errs.append(f"{q.get('name')}: negative max_amount")
+        return errs
+
+    def build(self) -> Handler:
+        return MemQuotaHandler(self.config, self.env)
+
+
+INFO = adapter_registry.register(Info(
+    name="memquota",
+    supported_templates=("quota",),
+    builder=MemQuotaBuilder,
+    description="in-memory rolling-window quota with dedup"))

@@ -1,0 +1,41 @@
+"""noop — benchmarking no-op adapter (reference: mixer/adapter/noop,
+240 LoC): accepts every template, does nothing, returns OK."""
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+from istio_tpu.adapters.registry import adapter_registry
+from istio_tpu.adapters.sdk import (Builder, CheckResult, Handler, Info,
+                                    QuotaArgs, QuotaResult)
+
+
+class NoopHandler(Handler):
+    def handle_check(self, template: str,
+                     instance: Mapping[str, Any]) -> CheckResult:
+        return CheckResult()
+
+    def handle_report(self, template: str,
+                      instances: Sequence[Mapping[str, Any]]) -> None:
+        return None
+
+    def handle_quota(self, template: str, instance: Mapping[str, Any],
+                     args: QuotaArgs) -> QuotaResult:
+        return QuotaResult(granted_amount=args.quota_amount)
+
+    def generate_attributes(self, template: str,
+                            instance: Mapping[str, Any]) -> dict[str, Any]:
+        return {}
+
+
+class NoopBuilder(Builder):
+    def build(self) -> Handler:
+        return NoopHandler()
+
+
+INFO = adapter_registry.register(Info(
+    name="noop",
+    supported_templates=("checknothing", "reportnothing", "listentry",
+                         "quota", "authorization", "apikey", "metric",
+                         "logentry", "tracespan", "kubernetes"),
+    builder=NoopBuilder,
+    description="no-op adapter for benchmarking"))

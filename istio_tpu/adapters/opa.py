@@ -1,0 +1,100 @@
+"""opa — policy-engine authorization adapter.
+
+Reference: mixer/adapter/opa (1,470 LoC) embeds the Open Policy Agent
+Rego evaluator and asks it `checkMethod` over the authorization
+instance. Rego itself is a Go library with no Python/TPU equivalent in
+this image, so this adapter evaluates policies written in the
+framework's OWN expression language over the flattened authorization
+instance — the same attribute-expression dialect used everywhere else
+(a deliberate TPU-native reinterpretation: policies stay compilable to
+the device ruleset path). A policy is a list of allow rules; any rule
+evaluating true allows the action (OPA-style default-deny).
+
+Instance fields are exposed as attributes:
+  subject.user, subject.groups, subject.properties[...],
+  action.namespace, action.service, action.method, action.path,
+  action.properties[...]
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from istio_tpu.adapters.registry import adapter_registry
+from istio_tpu.adapters.sdk import (AdapterError, Builder, CheckResult, Env,
+                                    Handler, Info)
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.attribute.types import ValueType as V
+from istio_tpu.expr.checker import AttributeDescriptorFinder, TypeError_
+from istio_tpu.expr.oracle import EvalError, OracleProgram
+from istio_tpu.expr.parser import ParseError
+from istio_tpu.models.policy_engine import OK, PERMISSION_DENIED
+
+_POLICY_MANIFEST = {
+    "subject.user": V.STRING, "subject.groups": V.STRING,
+    "subject.properties": V.STRING_MAP,
+    "action.namespace": V.STRING, "action.service": V.STRING,
+    "action.method": V.STRING, "action.path": V.STRING,
+    "action.properties": V.STRING_MAP,
+}
+
+
+def _flatten(instance: Mapping[str, Any]) -> dict[str, Any]:
+    out: dict[str, Any] = {}
+    for part in ("subject", "action"):
+        sub = instance.get(part, {}) or {}
+        for k, v in sub.items():
+            if k == "properties":
+                out[f"{part}.properties"] = {
+                    str(pk): str(pv) for pk, pv in (v or {}).items()}
+            else:
+                out[f"{part}.{k}"] = v
+    return out
+
+
+class OpaHandler(Handler):
+    def __init__(self, config: Mapping[str, Any]):
+        finder = AttributeDescriptorFinder(_POLICY_MANIFEST)
+        self.fail_close = bool(config.get("fail_close", True))
+        self._rules: list[OracleProgram] = []
+        for text in config.get("policies", ()):
+            self._rules.append(OracleProgram(text, finder))
+
+    def handle_check(self, template: str,
+                     instance: Mapping[str, Any]) -> CheckResult:
+        bag = bag_from_mapping(_flatten(instance))
+        for prog in self._rules:
+            try:
+                if prog.evaluate(bag):
+                    return CheckResult(status_code=OK)
+            except EvalError:
+                if self.fail_close:
+                    continue   # treat errored rule as no-allow
+                return CheckResult(status_code=OK,
+                                   status_message="fail-open")
+        return CheckResult(status_code=PERMISSION_DENIED,
+                           status_message="opa: no policy allowed")
+
+
+class OpaBuilder(Builder):
+    def validate(self) -> list[str]:
+        errs = []
+        finder = AttributeDescriptorFinder(_POLICY_MANIFEST)
+        for text in self.config.get("policies", ()):
+            try:
+                prog = OracleProgram(text, finder)
+                if prog.result_type != V.BOOL:
+                    errs.append(f"policy {text!r} is not boolean")
+            except (ParseError, TypeError_) as exc:
+                errs.append(f"policy {text!r}: {exc}")
+        return errs
+
+    def build(self) -> Handler:
+        return OpaHandler(self.config)
+
+
+INFO = adapter_registry.register(Info(
+    name="opa",
+    supported_templates=("authorization",),
+    builder=OpaBuilder,
+    description="default-deny policy authorization (expression-language "
+                "policies; Rego not embedded)"))

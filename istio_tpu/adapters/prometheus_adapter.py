@@ -1,0 +1,97 @@
+"""prometheus — expose metric instances as Prometheus metrics.
+
+Reference: mixer/adapter/prometheus (2,767 LoC): each configured metric
+maps a metric instance to a counter/gauge/histogram with label names
+drawn from the instance's dimensions; an HTTP scrape endpoint serves
+the registry. Backed by prometheus_client here; the scrape server is
+started by the runtime's monitoring port (server assembly), not by the
+adapter itself.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping, Sequence
+
+import prometheus_client
+
+from istio_tpu.adapters.registry import adapter_registry
+from istio_tpu.adapters.sdk import (AdapterError, Builder, Env, Handler,
+                                    Info)
+
+
+def _label_value(v: Any) -> str:
+    return str(v)
+
+
+class PrometheusHandler(Handler):
+    def __init__(self, config: Mapping[str, Any], env: Env,
+                 registry: prometheus_client.CollectorRegistry | None = None):
+        self.registry = registry or prometheus_client.CollectorRegistry()
+        self._metrics: dict[str, tuple[str, Any, list[str]]] = {}
+        self._lock = threading.Lock()
+        namespace = config.get("namespace", "istio_tpu")
+        for m in config.get("metrics", ()):
+            name = m["name"]
+            kind = m.get("kind", "COUNTER")
+            labels = list(m.get("label_names", ()))
+            pname = f"{namespace}_{name}".replace(".", "_").replace("-", "_")
+            if kind == "COUNTER":
+                metric = prometheus_client.Counter(
+                    pname, m.get("description", name), labels,
+                    registry=self.registry)
+            elif kind == "GAUGE":
+                metric = prometheus_client.Gauge(
+                    pname, m.get("description", name), labels,
+                    registry=self.registry)
+            elif kind == "DISTRIBUTION":
+                buckets = m.get("buckets") or prometheus_client.Histogram \
+                    .DEFAULT_BUCKETS
+                metric = prometheus_client.Histogram(
+                    pname, m.get("description", name), labels,
+                    buckets=buckets, registry=self.registry)
+            else:
+                raise AdapterError(f"unknown metric kind {kind}")
+            self._metrics[name] = (kind, metric, labels)
+
+    def handle_report(self, template: str,
+                      instances: Sequence[Mapping[str, Any]]) -> None:
+        for inst in instances:
+            entry = self._metrics.get(inst.get("name", ""))
+            if entry is None:
+                continue
+            kind, metric, labels = entry
+            dims = inst.get("dimensions", {}) or {}
+            values = [_label_value(dims.get(l, "")) for l in labels]
+            bound = metric.labels(*values) if labels else metric
+            value = inst.get("value", 0)
+            if isinstance(value, bool):
+                value = int(value)
+            if kind == "COUNTER":
+                bound.inc(float(value))
+            elif kind == "GAUGE":
+                bound.set(float(value))
+            else:
+                bound.observe(float(value))
+
+
+class PrometheusBuilder(Builder):
+    def validate(self) -> list[str]:
+        errs = []
+        for m in self.config.get("metrics", ()):
+            if "name" not in m:
+                errs.append("metric missing name")
+            if m.get("kind", "COUNTER") not in ("COUNTER", "GAUGE",
+                                                "DISTRIBUTION"):
+                errs.append(f"{m.get('name')}: unknown kind")
+        return errs
+
+    def build(self) -> Handler:
+        return PrometheusHandler(self.config, self.env)
+
+
+INFO = adapter_registry.register(Info(
+    name="prometheus",
+    supported_templates=("metric",),
+    builder=PrometheusBuilder,
+    description="metric instances as prometheus counters/gauges/"
+                "histograms"))

@@ -1,0 +1,40 @@
+"""Adapter inventory registry (reference:
+mixer/pkg/config/adapterInfoRegistry.go + generated
+mixer/adapter/inventory.gen.go)."""
+from __future__ import annotations
+
+from typing import Iterable
+
+from istio_tpu.adapters.sdk import AdapterError, Info
+
+
+class AdapterRegistry:
+    def __init__(self) -> None:
+        self._by_name: dict[str, Info] = {}
+
+    def register(self, info: Info) -> Info:
+        if info.name in self._by_name:
+            raise AdapterError(f"duplicate adapter: {info.name}")
+        self._by_name[info.name] = info
+        return info
+
+    def get(self, name: str) -> Info:
+        info = self._by_name.get(name)
+        if info is None:
+            raise AdapterError(f"unknown adapter: {name}")
+        return info
+
+    def names(self) -> list[str]:
+        return sorted(self._by_name)
+
+
+adapter_registry = AdapterRegistry()
+
+
+def load_inventory() -> AdapterRegistry:
+    """Import every built-in adapter module (each registers itself)."""
+    from istio_tpu.adapters import (denier, fluentd, kubernetesenv,  # noqa
+                                    list_adapter, memquota, noop, opa,
+                                    prometheus_adapter, rbac, statsd,
+                                    stdio, stubs)
+    return adapter_registry

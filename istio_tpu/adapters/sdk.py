@@ -1,0 +1,126 @@
+"""Adapter SDK — the contract between runtime and adapters.
+
+Reference: mixer/pkg/adapter — `Info` (info.go:22), HandlerBuilder/
+Handler (handler.go), `CheckResult{Status, ValidDuration,
+ValidUseCount}` (check.go:28), `QuotaResult` (quotas.go:55), `Env`
+(adapter.go). The reference's adapterlinter bans goroutines in adapters;
+the equivalent rule here is that adapters must use `Env.schedule_work`
+for background work so the runtime can drain on close.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, Mapping, Sequence
+
+from istio_tpu.models.policy_engine import OK
+
+DEFAULT_VALID_DURATION_S = 5.0
+DEFAULT_VALID_USE_COUNT = 10_000
+
+
+class AdapterError(ValueError):
+    """Config/build-time adapter error (configError.go role)."""
+
+
+class AdapterUnavailable(RuntimeError):
+    """Raised by gated stub adapters whose SaaS backend is not wired."""
+
+
+@dataclasses.dataclass
+class CheckResult:
+    """adapter/check.go:28."""
+    status_code: int = OK
+    status_message: str = ""
+    valid_duration_s: float = DEFAULT_VALID_DURATION_S
+    valid_use_count: int = DEFAULT_VALID_USE_COUNT
+
+    @property
+    def ok(self) -> bool:
+        return self.status_code == OK
+
+
+@dataclasses.dataclass
+class QuotaArgs:
+    """adapter/quotas.go:33 QuotaArgs."""
+    quota_amount: int = 1
+    best_effort: bool = True
+    dedup_id: str = ""
+
+
+@dataclasses.dataclass
+class QuotaResult:
+    """adapter/quotas.go:55."""
+    granted_amount: int = 0
+    valid_duration_s: float = DEFAULT_VALID_DURATION_S
+    status_code: int = OK
+    status_message: str = ""
+
+
+class Env:
+    """adapter.Env: scoped logger + scheduled work (runtime/env.go)."""
+
+    def __init__(self, adapter_name: str, pool=None):
+        self.logger = logging.getLogger(f"istio_tpu.adapter.{adapter_name}")
+        self._pool = pool
+
+    def schedule_work(self, fn: Callable[[], None]) -> None:
+        if self._pool is None:
+            fn()
+        else:
+            self._pool.submit(fn)
+
+
+class Handler:
+    """Base runtime handler. Adapters override the Handle* methods for
+    the templates they support; the dispatcher calls exactly one method
+    per (instance, variety)."""
+
+    def handle_check(self, template: str,
+                     instance: Mapping[str, Any]) -> CheckResult:
+        raise NotImplementedError
+
+    def handle_report(self, template: str,
+                      instances: Sequence[Mapping[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def handle_quota(self, template: str, instance: Mapping[str, Any],
+                     args: QuotaArgs) -> QuotaResult:
+        raise NotImplementedError
+
+    def generate_attributes(self, template: str,
+                            instance: Mapping[str, Any]) -> dict[str, Any]:
+        """APA adapters: returns output attributes (pre-binding)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class Builder:
+    """HandlerBuilder: validate() config then build() a Handler."""
+
+    def __init__(self, config: Mapping[str, Any], env: Env):
+        self.config = dict(config)
+        self.env = env
+
+    def set_types(self, types: Mapping[str, Mapping[str, Any]]) -> None:
+        """Inferred instance types per template (SetTypeFn payload)."""
+        self.types = dict(types)
+
+    def validate(self) -> list[str]:
+        return []
+
+    def build(self) -> Handler:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Info:
+    """adapter/info.go:22."""
+    name: str
+    supported_templates: tuple[str, ...]
+    builder: Callable[[Mapping[str, Any], Env], Builder]
+    description: str = ""
+    default_config: Mapping[str, Any] = dataclasses.field(
+        default_factory=dict)

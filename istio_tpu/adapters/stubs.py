@@ -1,0 +1,67 @@
+"""Gated stubs for SaaS-backed adapters.
+
+Reference adapters whose backends are external Google/Circonus services
+(mixer/adapter/{circonus,stackdriver,servicecontrol}, ~12,400 LoC of
+mostly API-client plumbing). This build has zero network egress, so
+these validate config and register in the inventory — keeping configs
+portable — but their handlers raise AdapterUnavailable until an
+exporter seam is injected (`transport` config key).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Sequence
+
+from istio_tpu.adapters.registry import adapter_registry
+from istio_tpu.adapters.sdk import (AdapterUnavailable, Builder, CheckResult,
+                                    Env, Handler, Info, QuotaArgs,
+                                    QuotaResult)
+
+
+class _TransportHandler(Handler):
+    """Forwards instances to an injected `transport` callable; without
+    one, every call raises AdapterUnavailable."""
+
+    def __init__(self, name: str, config: Mapping[str, Any]):
+        self._name = name
+        self._transport: Callable[[str, str, Any], Any] | None = \
+            config.get("transport")
+
+    def _send(self, kind: str, template: str, payload: Any) -> Any:
+        if self._transport is None:
+            raise AdapterUnavailable(
+                f"{self._name}: SaaS backend not wired (inject `transport`)")
+        return self._transport(kind, template, payload)
+
+    def handle_check(self, template: str,
+                     instance: Mapping[str, Any]) -> CheckResult:
+        result = self._send("check", template, instance)
+        return result if isinstance(result, CheckResult) else CheckResult()
+
+    def handle_report(self, template: str,
+                      instances: Sequence[Mapping[str, Any]]) -> None:
+        self._send("report", template, instances)
+
+    def handle_quota(self, template: str, instance: Mapping[str, Any],
+                     args: QuotaArgs) -> QuotaResult:
+        result = self._send("quota", template, (instance, args))
+        return result if isinstance(result, QuotaResult) else \
+            QuotaResult(granted_amount=args.quota_amount)
+
+
+def _stub(name: str, templates: tuple[str, ...], desc: str) -> Info:
+    class _B(Builder):
+        def build(self) -> Handler:
+            return _TransportHandler(name, self.config)
+    _B.__name__ = f"{name.capitalize()}Builder"
+    return adapter_registry.register(Info(
+        name=name, supported_templates=templates, builder=_B,
+        description=desc))
+
+
+CIRCONUS = _stub("circonus", ("metric",),
+                 "metrics to circonus (gated: needs transport)")
+STACKDRIVER = _stub("stackdriver", ("metric", "logentry", "tracespan"),
+                    "metrics/logs/traces to GCP (gated: needs transport)")
+SERVICECONTROL = _stub("servicecontrol",
+                       ("metric", "logentry", "quota", "apikey"),
+                       "GCP service control (gated: needs transport)")

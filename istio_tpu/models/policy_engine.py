@@ -52,9 +52,11 @@ log = scope("models.policy_engine")
 
 # istio.mixer.v1 / google.rpc status codes used on the check path.
 OK = 0
+NOT_FOUND = 5
 PERMISSION_DENIED = 7
 RESOURCE_EXHAUSTED = 8
 INTERNAL = 13
+UNAVAILABLE = 14
 _BIG = np.float32(3.4e38)
 
 
@@ -168,6 +170,7 @@ class PolicyEngine:
         list_rule = np.zeros(max(n_lists, 1), np.int32)
         list_slot = np.zeros(max(n_lists, 1), np.int32)
         list_black = np.zeros(max(n_lists, 1), bool)
+        list_code = np.full(max(n_lists, 1), PERMISSION_DENIED, np.int32)
         list_dur = np.full(max(n_lists, 1), _BIG, np.float32)
         list_uses = np.full(max(n_lists, 1), np.iinfo(np.int32).max, np.int32)
         for i, l in enumerate(lists):
@@ -178,6 +181,9 @@ class PolicyEngine:
             list_rule[i] = l.rule
             list_slot[i] = self._slot_for(l.value_attr)
             list_black[i] = l.blacklist
+            # host-path parity (adapters/list_adapter.py): blacklist hit
+            # → PERMISSION_DENIED, whitelist miss → NOT_FOUND
+            list_code[i] = PERMISSION_DENIED if l.blacklist else NOT_FOUND
             list_dur[i] = l.valid_duration_s
             list_uses[i] = l.valid_use_count
 
@@ -188,6 +194,11 @@ class PolicyEngine:
         q_max = np.zeros(max(n_quotas, 1), np.int32)
         q_nb = np.ones(max(n_quotas, 1), np.int32)
         n_buckets = max((q.n_buckets for q in quotas), default=1)
+        if n_quotas * n_buckets >= np.iinfo(np.int32).max:
+            raise ValueError(
+                f"quota hash space too large: {n_quotas} quotas × "
+                f"{n_buckets} buckets must stay below 2^31-1 (int32 "
+                "composite sort keys)")
         for i, q in enumerate(quotas):
             q_rule[i] = q.rule
             q_slot[i] = self._slot_for(q.key_attr)
@@ -212,6 +223,7 @@ class PolicyEngine:
         list_rule_j = jnp.asarray(list_rule)
         list_slot_j = jnp.asarray(list_slot)
         list_black_j = jnp.asarray(list_black)
+        list_code_j = jnp.asarray(list_code)
         list_dur_j = jnp.asarray(list_dur)
         list_uses_j = jnp.asarray(list_uses)
         q_rule_j = jnp.asarray(q_rule)
@@ -243,9 +255,9 @@ class PolicyEngine:
                     sym[:, :, None] == list_ids_j[None, :, :], axis=2)
                 l_active = active[:, list_rule_j] & sym_ok
                 l_deny = l_active & (member == list_black_j[None, :])
-                any_l = jnp.any(l_deny, axis=1)
                 status = jnp.maximum(
-                    status, jnp.where(any_l, PERMISSION_DENIED, OK))
+                    status, jnp.max(jnp.where(l_deny, list_code_j[None, :],
+                                              OK), axis=1))
                 dur = jnp.minimum(dur, jnp.min(
                     jnp.where(l_active, list_dur_j[None, :], _BIG), axis=1))
                 uses = jnp.minimum(uses, jnp.min(
@@ -268,10 +280,15 @@ class PolicyEngine:
                 # < max. One flattened stable sort over [Q·B] composite
                 # keys ranks every quota at once (the naive [B, B, Q]
                 # pairwise compare cost 8ms/step at B=2048).
+                # composite int32 keys; the inactive sentinel INT32_MAX
+                # sorts past every real key (constructor bounds
+                # n_quotas·n_buckets < INT32_MAX — jnp has no int64
+                # without x64 mode)
                 n_q = quota_counts.shape[0]
                 qoff = jnp.arange(n_q, dtype=jnp.int32)[None, :] * \
                     quota_counts.shape[1]
-                ckey = jnp.where(q_active, bucket + qoff, jnp.int32(1) << 30)
+                ckey = jnp.where(q_active, bucket + qoff,
+                                 jnp.iinfo(jnp.int32).max)
                 rank = _batch_rank(ckey.T.reshape(-1)).reshape(n_q, b).T
                 prior_per_req = quota_counts[
                     jnp.arange(n_q)[None, :], bucket]            # [B, Q]

@@ -1,0 +1,21 @@
+"""Policy runtime: config store → controller → resolver/dispatcher.
+
+Maps the reference's mixer/pkg/runtime (+ the runtime2 config model it
+was migrating to, SURVEY.md §2.3): a generic KV+watch config store
+feeds a controller that rebuilds an immutable Snapshot on change —
+attribute vocabulary, handler table (diffed by signature), instance
+builders, and the COMPILED rule tensors — and publishes it atomically.
+The dispatcher resolves requests against the snapshot's device ruleset
+program and fans instances out to adapter handlers; the batcher
+coalesces concurrent Check() calls into single device steps.
+"""
+from istio_tpu.runtime.store import (Event, FsStore, Key, MemStore, Store,
+                                     StoreError)
+from istio_tpu.runtime.config import Snapshot, SnapshotBuilder
+from istio_tpu.runtime.dispatcher import CheckResponse, Dispatcher
+from istio_tpu.runtime.controller import Controller
+from istio_tpu.runtime.server import RuntimeServer, ServerArgs
+
+__all__ = ["Event", "FsStore", "Key", "MemStore", "Store", "StoreError",
+           "Snapshot", "SnapshotBuilder", "CheckResponse", "Dispatcher",
+           "Controller", "RuntimeServer", "ServerArgs"]

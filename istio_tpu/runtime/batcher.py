@@ -1,0 +1,131 @@
+"""Check batcher — coalesce concurrent Check() calls into device steps.
+
+The design piece with no reference counterpart (SURVEY.md §7 layer 4):
+the reference evaluates per request on CPU; the TPU path amortizes one
+device dispatch over a window of concurrent requests. Requests enqueue
+(bag, Future); the flusher thread drains up to `max_batch` per step,
+waiting at most `window_s` after the first request of a batch. Batch
+shapes are BUCKETED (pad to the next power of two) so jit re-traces a
+handful of shapes, not one per batch size.
+
+p99 story: window (≤300µs) + step (~1-2ms small batches) keeps tail
+latency in the BASELINE budget while throughput scales with load —
+under light load a request waits at most window_s; under heavy load
+batches fill instantly and the window never matters.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Sequence
+
+from istio_tpu.attribute.bag import Bag
+from istio_tpu.runtime import monitor
+
+
+def bucket_size(n: int, max_batch: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return min(b, max_batch)
+
+
+class PadBag(Bag):
+    """Empty bag used to pad a batch to its bucket size."""
+
+    def get(self, name: str):
+        return None, False
+
+    def names(self):
+        return []
+
+
+class CheckBatcher:
+    """check(bag) blocks until its batch's device step completes.
+
+    `run_batch(bags) -> list[result]` is the dispatcher hook; padding
+    rows are PadBags whose results are discarded.
+    """
+
+    def __init__(self, run_batch: Callable[[Sequence[Bag]], Sequence[Any]],
+                 window_s: float = 0.0003, max_batch: int = 1024):
+        self.run_batch = run_batch
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self._queue: "queue.Queue[tuple[Bag, Future] | None]" = queue.Queue()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="check-batcher")
+        self._closed = False
+        self._thread.start()
+
+    def check(self, bag: Bag) -> Any:
+        return self.submit(bag).result()
+
+    def submit(self, bag: Bag) -> Future:
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        fut: Future = Future()
+        self._queue.put((bag, fut))
+        return fut
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._drain_on_close()
+                return
+            batch = [item]
+            deadline = None
+            while len(batch) < self.max_batch:
+                import time
+                if deadline is None:
+                    deadline = time.perf_counter() + self.window_s
+                timeout = deadline - time.perf_counter()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._flush(batch)
+                    self._drain_on_close()
+                    return
+                batch.append(nxt)
+            self._flush(batch)
+
+    def _drain_on_close(self) -> None:
+        """Requests that raced past close() must still resolve — flush
+        whatever is left behind the sentinel instead of abandoning the
+        futures (callers block forever otherwise)."""
+        leftovers = []
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                leftovers.append(item)
+        if leftovers:
+            self._flush(leftovers)
+
+    def _flush(self, batch: list[tuple[Bag, Future]]) -> None:
+        monitor.CHECK_BATCH_SIZE.observe(len(batch))
+        bags = [bag for bag, _ in batch]
+        target = bucket_size(len(bags), self.max_batch)
+        padded = bags + [PadBag()] * (target - len(bags))
+        try:
+            results = self.run_batch(padded)
+        except Exception as exc:
+            for _, fut in batch:
+                fut.set_exception(exc)
+            return
+        for (_, fut), result in zip(batch, results):
+            fut.set_result(result)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._queue.put(None)
+            self._thread.join(timeout=5)

@@ -1,0 +1,253 @@
+"""Config kinds + validated Snapshot building.
+
+Maps the reference's runtime2 config model (mixer/pkg/runtime2/config/
+ephemeral.go → snapshot.go) with the same kinds the reference's store
+carries: `attributemanifest`, `handler`, `instance`, `rule`, plus the
+rbac adapter's `servicerole`/`servicerolebinding` (mixer/adapter/rbac
+watches those kinds itself in the reference; here the snapshot feeds
+them to the handler).
+
+A Snapshot is immutable: attribute finder, handler configs (built
+handlers live in the controller's HandlerTable so they survive snapshot
+swaps when unchanged — handlerTable.go diffing), instance builders, and
+rules with their ACTION wiring. The rule match predicates are compiled
+to the device RuleSetProgram here; action wiring that matches the fused
+fast path (denier/list/quota over id-exact entries) is extracted for
+PolicyEngine construction by the controller.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Mapping, Sequence
+
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.compiler.ruleset import Rule as RulePred
+from istio_tpu.compiler.ruleset import RuleSetProgram, compile_ruleset
+from istio_tpu.compiler.layout import InternTable, Tensorizer
+from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.templates import (InstanceBuilder, TemplateError, Variety,
+                                 registry as template_registry)
+from istio_tpu.runtime.store import Key, Store, StoreError
+
+KIND_MANIFEST = "attributemanifest"
+KIND_HANDLER = "handler"
+KIND_INSTANCE = "instance"
+KIND_RULE = "rule"
+KIND_SERVICE_ROLE = "servicerole"
+KIND_SERVICE_ROLE_BINDING = "servicerolebinding"
+
+
+@dataclasses.dataclass(frozen=True)
+class HandlerConfig:
+    name: str
+    namespace: str
+    adapter: str
+    params: Mapping[str, Any]
+
+    @property
+    def signature(self) -> str:
+        """Identity for handler reuse across snapshots
+        (handlerTable.go signature diffing)."""
+        return json.dumps([self.adapter, self.params], sort_keys=True,
+                          default=str)
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    """One rule action: a handler plus instances (config.proto Action)."""
+    handler: str                 # fully-qualified handler name
+    instances: tuple[str, ...]   # instance names
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleConfig:
+    name: str
+    namespace: str
+    match: str
+    actions: tuple[Action, ...]
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """Validated, compiled config generation (runtime2 Snapshot)."""
+    revision: int
+    finder: AttributeDescriptorFinder
+    handlers: dict[str, HandlerConfig]
+    instances: dict[str, InstanceBuilder]
+    instance_templates: dict[str, str]
+    rules: list[RuleConfig]
+    ruleset: RuleSetProgram            # one predicate row per rule
+    tensorizer: Tensorizer
+    roles: list[Mapping[str, Any]]
+    bindings: list[Mapping[str, Any]]
+    errors: list[str]                  # per-resource soft errors
+
+    def rule_index(self, name: str, namespace: str) -> int:
+        for i, r in enumerate(self.rules):
+            if r.name == name and r.namespace == namespace:
+                return i
+        raise KeyError((namespace, name))
+
+    def actions_for(self, rule_idx: int,
+                    variety: Variety) -> list[tuple[HandlerConfig, str, list[str]]]:
+        """[(handler cfg, template, instance names)] of one variety."""
+        out = []
+        for action in self.rules[rule_idx].actions:
+            h = self.handlers.get(action.handler)
+            if h is None:
+                continue
+            insts = [n for n in action.instances
+                     if n in self.instances and
+                     template_registry.get(
+                         self.instance_templates[n]).variety == variety]
+            if insts:
+                tmpl = self.instance_templates[insts[0]]
+                out.append((h, tmpl, insts))
+        return out
+
+
+def _qualify(name: str, ns: str) -> str:
+    """namespace-qualified resource name (reference uses
+    name.kind.namespace; kind is implicit in our typed dicts)."""
+    return f"{name}.{ns}" if ns else name
+
+
+class SnapshotBuilder:
+    """Ephemeral → Snapshot validation (runtime2/config/ephemeral.go):
+    reads the whole store, type-checks everything, collects soft errors
+    per resource (a bad rule/instance is dropped, not fatal — matching
+    the reference controller's tolerance), and compiles the ruleset."""
+
+    # the reference's configDefaultNamespace: rules here apply mesh-wide
+    DEFAULT_CONFIG_NAMESPACE = "istio-system"
+
+    def __init__(self, default_manifest: Mapping[str, ValueType]
+                 | None = None,
+                 interner: InternTable | None = None,
+                 max_str_len: int | None = None,
+                 config_namespace: str = DEFAULT_CONFIG_NAMESPACE):
+        self.default_manifest = dict(default_manifest or {})
+        self.interner = interner or InternTable()
+        self.max_str_len = max_str_len
+        self.config_namespace = config_namespace
+        self._revision = 0
+
+    def build(self, store: Store) -> Snapshot:
+        self._revision += 1
+        errors: list[str] = []
+
+        # 1. attribute vocabulary (processAttributeManifests
+        #    controller.go:273)
+        manifest: dict[str, ValueType] = dict(self.default_manifest)
+        for key, spec in store.list(KIND_MANIFEST).items():
+            for attr, desc in (spec.get("attributes") or {}).items():
+                vt_name = str((desc or {}).get("value_type",
+                                               "STRING")).upper()
+                try:
+                    manifest[attr] = ValueType[vt_name]
+                except KeyError:
+                    errors.append(f"{key}: bad value_type {vt_name}"
+                                  f" for {attr}")
+        finder = AttributeDescriptorFinder(manifest)
+
+        # 2. handlers
+        handlers: dict[str, HandlerConfig] = {}
+        for (kind, ns, name), spec in store.list(KIND_HANDLER).items():
+            adapter = spec.get("adapter") or spec.get("compiledAdapter")
+            if not adapter:
+                errors.append(f"handler {name}.{ns}: missing adapter")
+                continue
+            hc = HandlerConfig(name=name, namespace=ns,
+                               adapter=str(adapter),
+                               params=dict(spec.get("params") or {}))
+            handlers[_qualify(name, ns)] = hc
+
+        # 3. instances
+        instances: dict[str, InstanceBuilder] = {}
+        instance_templates: dict[str, str] = {}
+        for (kind, ns, name), spec in store.list(KIND_INSTANCE).items():
+            tmpl_name = spec.get("template") or spec.get("compiledTemplate")
+            if not tmpl_name:
+                errors.append(f"instance {name}.{ns}: missing template")
+                continue
+            qname = _qualify(name, ns)
+            try:
+                info = template_registry.get(str(tmpl_name))
+                params = dict(spec.get("params") or {})
+                bindings = params.pop("attribute_bindings", None)
+                ib = InstanceBuilder(info, qname, params, finder)
+                if bindings:
+                    ib.attribute_bindings = dict(bindings)
+                instances[qname] = ib
+                instance_templates[qname] = info.name
+            except TemplateError as exc:
+                errors.append(f"instance {qname}: {exc}")
+
+        # 4. rules (+ predicate compilation)
+        rules: list[RuleConfig] = []
+        preds: list[RulePred] = []
+        for (kind, ns, name), spec in store.list(KIND_RULE).items():
+            actions = []
+            for a in (spec.get("actions") or ()):
+                handler = str(a.get("handler", ""))
+                if "." not in handler:
+                    handler = _qualify(handler, ns)
+                inst_names = []
+                for inst in (a.get("instances") or ()):
+                    inst = str(inst)
+                    if "." not in inst:
+                        inst = _qualify(inst, ns)
+                    inst_names.append(inst)
+                missing = [h for h in [handler] if h not in handlers]
+                missing += [i for i in inst_names if i not in instances]
+                if missing:
+                    errors.append(f"rule {name}.{ns}: unknown refs "
+                                  f"{missing}")
+                    continue
+                actions.append(Action(handler=handler,
+                                      instances=tuple(inst_names)))
+            rc = RuleConfig(name=name, namespace=ns,
+                            match=str(spec.get("match", "") or ""),
+                            actions=tuple(actions))
+            rules.append(rc)
+            # rules in the config (default) namespace are global: the
+            # ruleset's "" namespace applies to every request
+            pred_ns = "" if ns in ("", self.config_namespace) else ns
+            preds.append(RulePred(name=_qualify(name, ns), match=rc.match,
+                                  namespace=pred_ns))
+
+        kwargs = {} if self.max_str_len is None \
+            else {"max_str_len": self.max_str_len}
+        try:
+            ruleset = compile_ruleset(preds, finder,
+                                      interner=self.interner, **kwargs)
+        except Exception as exc:
+            # a predicate that doesn't type-check is a config error for
+            # that rule; retry with offenders replaced by 'false'
+            safe_preds, bad = [], []
+            for p in preds:
+                try:
+                    compile_ruleset([p], finder, interner=self.interner,
+                                    **kwargs)
+                    safe_preds.append(p)
+                except Exception as e2:
+                    errors.append(f"rule {p.name}: {e2}")
+                    safe_preds.append(RulePred(name=p.name, match="false",
+                                               namespace=p.namespace))
+            ruleset = compile_ruleset(safe_preds, finder,
+                                      interner=self.interner, **kwargs)
+
+        roles = [dict(spec, name=k[2], namespace=k[1])
+                 for k, spec in store.list(KIND_SERVICE_ROLE).items()]
+        bindings = [dict(spec, name=k[2], namespace=k[1])
+                    for k, spec in store.list(
+                        KIND_SERVICE_ROLE_BINDING).items()]
+
+        return Snapshot(revision=self._revision, finder=finder,
+                        handlers=handlers, instances=instances,
+                        instance_templates=instance_templates,
+                        rules=rules, ruleset=ruleset,
+                        tensorizer=Tensorizer(ruleset.layout,
+                                              self.interner),
+                        roles=roles, bindings=bindings, errors=errors)

@@ -1,0 +1,94 @@
+"""Controller — store watch → snapshot rebuild → atomic publish.
+
+Reference: mixer/pkg/runtime/controller.go — watchChanges (:192) with a
+debounce, rebuild (attribute finder :273, handler table, rules :380),
+publishSnapShot (:115) swapping the resolver atomically; the old
+snapshot's orphaned handlers close after the swap (cleanupResolver
+:543's drain role — Python's GIL + our immutable Dispatcher make the
+swap itself safe; handler closing happens in HandlerTable.rebuild).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Mapping
+
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.compiler.layout import InternTable
+from istio_tpu.runtime import monitor
+from istio_tpu.runtime.config import Snapshot, SnapshotBuilder
+from istio_tpu.runtime.dispatcher import DEFAULT_IDENTITY_ATTR, Dispatcher
+from istio_tpu.runtime.handler_table import HandlerTable
+from istio_tpu.runtime.store import Event, Store
+
+log = logging.getLogger("istio_tpu.runtime.controller")
+
+
+class Controller:
+    def __init__(self, store: Store,
+                 default_manifest: Mapping[str, ValueType] | None = None,
+                 identity_attr: str = DEFAULT_IDENTITY_ATTR,
+                 debounce_s: float = 0.05,
+                 max_str_len: int | None = None,
+                 on_publish: Callable[[Dispatcher], None] | None = None):
+        self.store = store
+        self.identity_attr = identity_attr
+        self.debounce_s = debounce_s
+        self.on_publish = on_publish
+        self._builder = SnapshotBuilder(default_manifest,
+                                        InternTable(), max_str_len)
+        self._handler_table = HandlerTable()
+        self._lock = threading.Lock()
+        self._timer: threading.Timer | None = None
+        self._dispatcher: Dispatcher | None = None
+        self.rebuild()                      # initial snapshot
+        store.watch(self._on_events)
+
+    @property
+    def dispatcher(self) -> Dispatcher:
+        d = self._dispatcher
+        assert d is not None
+        return d
+
+    def _on_events(self, events: list[Event]) -> None:
+        """Debounced rebuild trigger (controller.go watchChanges)."""
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(self.debounce_s, self.rebuild)
+            self._timer.daemon = True
+            self._timer.start()
+
+    # grace period before closing handlers orphaned by a config swap —
+    # lets requests in flight on the OLD dispatcher finish (the
+    # reference refcounts the resolver, resolver.go:240-247; a timed
+    # drain keeps the hot path free of per-request accounting)
+    ORPHAN_DRAIN_S = 2.0
+
+    def rebuild(self) -> Dispatcher:
+        snapshot = self._builder.build(self.store)
+        handlers, orphans = self._handler_table.rebuild(snapshot)
+        for err in snapshot.errors:
+            log.warning("config: %s", err)
+        dispatcher = Dispatcher(snapshot, handlers, self.identity_attr)
+        self._dispatcher = dispatcher      # atomic publish (GIL ref swap)
+        if orphans:
+            t = threading.Timer(
+                self.ORPHAN_DRAIN_S,
+                self._handler_table.close_handlers, args=(orphans,))
+            t.daemon = True
+            t.start()
+        monitor.CONFIG_GENERATION.set(snapshot.revision)
+        log.info("published config generation %d (%d rules, %d handlers,"
+                 " %d instances, %d errors)", snapshot.revision,
+                 len(snapshot.rules), len(handlers),
+                 len(snapshot.instances), len(snapshot.errors))
+        if self.on_publish is not None:
+            self.on_publish(dispatcher)
+        return dispatcher
+
+    def close(self) -> None:
+        with self._lock:
+            if self._timer is not None:
+                self._timer.cancel()
+        self._handler_table.close()

@@ -1,0 +1,272 @@
+"""Dispatcher — batched resolve + template/adapter fan-out.
+
+Reference: mixer/pkg/runtime/dispatcher.go + resolver.go. Differences
+by design (SURVEY.md §7 layer 4):
+
+  * Resolution is BATCHED: one device ruleset evaluation matches a
+    whole batch of requests against every rule (resolver.go's
+    per-request per-rule IL loop collapses into the RuleSetProgram);
+    host-fallback rules are overlaid per request.
+  * Namespace targeting follows resolver.go:180 destAndNamespace — the
+    identity attribute `destination.service` (svc.ns.suffix…) selects
+    the rule namespace; default-namespace rules always apply.
+  * Instance construction + adapter calls stay host-side here (the
+    generic path); the all-device fused path is models/policy_engine
+    and is benchmarked separately. combineResults semantics preserved:
+    worst status wins, TTLs take the min (dispatcher.go:322).
+  * Adapter calls are panic-isolated (safeDispatch dispatcher.go:399):
+    an adapter exception degrades that action to INTERNAL, never kills
+    the request.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from istio_tpu.adapters.sdk import (CheckResult, Handler, QuotaArgs,
+                                    QuotaResult)
+from istio_tpu.attribute.bag import Bag, MutableBag
+from istio_tpu.expr.oracle import EvalError
+from istio_tpu.models.policy_engine import INTERNAL, OK
+from istio_tpu.runtime.config import Snapshot
+from istio_tpu.runtime import monitor
+from istio_tpu.templates import Variety
+
+log = logging.getLogger("istio_tpu.runtime.dispatcher")
+
+DEFAULT_IDENTITY_ATTR = "destination.service"
+
+
+@dataclasses.dataclass
+class CheckResponse:
+    """Precondition result (CheckResponse.PreconditionResult)."""
+    status_code: int = OK
+    status_message: str = ""
+    valid_duration_s: float = 5.0
+    valid_use_count: int = 10_000
+    referenced: tuple = ()
+
+
+def _namespace_of(bag: Bag, identity_attr: str) -> str:
+    """destAndNamespace (resolver.go:180): svc.ns.svc.cluster.local →
+    'ns'; bare or absent destination → default namespace ''."""
+    v, ok = bag.get(identity_attr)
+    if not ok or not isinstance(v, str):
+        return ""
+    parts = v.split(".")
+    return parts[1] if len(parts) >= 2 and parts[1] else ""
+
+
+class Dispatcher:
+    """Stateless over an immutable snapshot + built handler map; the
+    controller swaps (snapshot, handlers) pairs atomically."""
+
+    def __init__(self, snapshot: Snapshot, handlers: Mapping[str, Handler],
+                 identity_attr: str = DEFAULT_IDENTITY_ATTR):
+        self.snapshot = snapshot
+        self.handlers = dict(handlers)
+        self.identity_attr = identity_attr
+
+    # ------------------------------------------------------------------
+    # resolution
+    # ------------------------------------------------------------------
+
+    def _resolve(self, bags: Sequence[Bag]
+                 ) -> tuple[list[list[int]], list[list[int]]]:
+        """Batched rule matching → per-bag (active, namespace-visible)
+        rule index lists. One device step for the whole batch; fallback
+        + namespace masking applied host-side (cheap: bool arrays)."""
+        snap = self.snapshot
+        if snap.ruleset.n_rules == 0:   # device arrays are padded to ≥1
+            empty: list[list[int]] = [[] for _ in bags]
+            return empty, [[] for _ in bags]
+        with monitor.resolve_timer():
+            batch = snap.tensorizer.tensorize(bags)
+            matched, _, err = snap.ruleset(batch)
+            matched = np.array(matched)
+            err = np.array(err)
+        ns_ids = np.asarray([snap.ruleset.namespace_id(
+            _namespace_of(bag, self.identity_attr)) for bag in bags],
+            np.int32)
+        ns_ok = np.array(snap.ruleset.namespace_mask(ns_ids))
+        for ridx in snap.ruleset.host_fallback:
+            for b, bag in enumerate(bags):
+                m, _, e = snap.ruleset.host_eval(ridx, bag)
+                matched[b, ridx] = m
+                err[b, ridx] = e
+        active = matched & ns_ok
+        n_err = int((err & ns_ok).sum())
+        if n_err:
+            monitor.RESOLVE_ERRORS.inc(n_err)
+        return ([list(np.nonzero(active[b])[0]) for b in range(len(bags))],
+                [list(np.nonzero(ns_ok[b])[0]) for b in range(len(bags))])
+
+    # ------------------------------------------------------------------
+    # varieties
+    # ------------------------------------------------------------------
+
+    def check(self, bags: Sequence[Bag]) -> list[CheckResponse]:
+        actives, visibles = self._resolve(bags)
+        out = []
+        for bag, rule_idxs, vis in zip(bags, actives, visibles):
+            out.append(self._check_one(bag, rule_idxs, vis))
+        return out
+
+    def _check_one(self, bag: Bag, rule_idxs: list[int],
+                   visible: list[int]) -> CheckResponse:
+        snap = self.snapshot
+        resp = CheckResponse()
+        # ReferencedAttributes: every namespace-visible rule's predicate
+        # was EVALUATED for this request (protoBag.go:117 tracking →
+        # compile-time bitmaps, SURVEY.md §2.2); matched rules add their
+        # instances' attribute uses below.
+        referenced: set = set()
+        for ridx in visible:
+            referenced |= snap.ruleset.attr_names[ridx]
+        for ridx in rule_idxs:
+            for hc, template, inst_names in snap.actions_for(
+                    ridx, Variety.CHECK):
+                handler = self.handlers.get(f"{hc.name}.{hc.namespace}"
+                                            if hc.namespace else hc.name)
+                if handler is None:
+                    continue
+                for iname in inst_names:
+                    result = self._safe_check(handler, template,
+                                              snap.instances[iname], bag)
+                    self._combine(resp, result)
+        resp.referenced = tuple(sorted(referenced, key=str))
+        return resp
+
+    def _safe_check(self, handler: Handler, template: str, ib,
+                    bag: Bag) -> CheckResult:
+        with monitor.dispatch_timer():
+            try:
+                instance = ib.build(bag)
+            except EvalError as exc:
+                monitor.DISPATCH_ERRORS.inc()
+                return CheckResult(status_code=INTERNAL,
+                                   status_message=str(exc))
+            try:
+                return handler.handle_check(template, instance)
+            except Exception as exc:   # safeDispatch (dispatcher.go:399)
+                monitor.DISPATCH_ERRORS.inc()
+                log.exception("adapter check failed")
+                return CheckResult(status_code=INTERNAL,
+                                   status_message=f"adapter panic: {exc}")
+
+    @staticmethod
+    def _combine(resp: CheckResponse, r: CheckResult) -> None:
+        """combineResults (dispatcher.go:322): worst status, min TTLs."""
+        if not r.ok and resp.status_code == OK:
+            resp.status_code = r.status_code
+            resp.status_message = r.status_message
+        elif not r.ok:
+            resp.status_message = \
+                f"{resp.status_message}; {r.status_message}".strip("; ")
+        resp.valid_duration_s = min(resp.valid_duration_s,
+                                    r.valid_duration_s)
+        resp.valid_use_count = min(resp.valid_use_count,
+                                   r.valid_use_count)
+
+    def report(self, bags: Sequence[Bag]) -> None:
+        actives, _ = self._resolve(bags)
+        for bag, rule_idxs in zip(bags, actives):
+            for ridx in rule_idxs:
+                for hc, template, inst_names in self.snapshot.actions_for(
+                        ridx, Variety.REPORT):
+                    handler = self.handlers.get(
+                        f"{hc.name}.{hc.namespace}" if hc.namespace
+                        else hc.name)
+                    if handler is None:
+                        continue
+                    instances = []
+                    for iname in inst_names:
+                        try:
+                            instances.append(
+                                self.snapshot.instances[iname].build(bag))
+                        except EvalError as exc:
+                            monitor.DISPATCH_ERRORS.inc()
+                            log.warning("instance %s: %s", iname, exc)
+                    if instances:
+                        with monitor.dispatch_timer():
+                            try:
+                                handler.handle_report(template, instances)
+                            except Exception:
+                                monitor.DISPATCH_ERRORS.inc()
+                                log.exception("adapter report failed")
+
+    def quota(self, bag: Bag, quota_name: str,
+              args: QuotaArgs) -> QuotaResult:
+        """Dispatches to at most ONE handler (dispatcher.go:242-260)."""
+        actives = self._resolve([bag])[0][0]
+        for ridx in actives:
+            for hc, template, inst_names in self.snapshot.actions_for(
+                    ridx, Variety.QUOTA):
+                for iname in inst_names:
+                    if iname.split(".")[0] != quota_name and \
+                            iname != quota_name:
+                        continue
+                    handler = self.handlers.get(
+                        f"{hc.name}.{hc.namespace}" if hc.namespace
+                        else hc.name)
+                    if handler is None:
+                        continue
+                    try:
+                        instance = self.snapshot.instances[iname].build(bag)
+                        with monitor.dispatch_timer():
+                            return handler.handle_quota(template, instance,
+                                                        args)
+                    except EvalError as exc:
+                        monitor.DISPATCH_ERRORS.inc()
+                        return QuotaResult(granted_amount=0,
+                                           status_code=INTERNAL,
+                                           status_message=str(exc))
+                    except Exception as exc:
+                        monitor.DISPATCH_ERRORS.inc()
+                        log.exception("adapter quota failed")
+                        return QuotaResult(granted_amount=0,
+                                           status_code=INTERNAL,
+                                           status_message=str(exc))
+        # no matching quota rule: grant freely (reference returns empty)
+        return QuotaResult(granted_amount=args.quota_amount)
+
+    def preprocess(self, bag: Bag) -> Bag:
+        """APA phase (dispatcher.go:285): run ATTRIBUTE_GENERATOR
+        actions, bind outputs into a child bag."""
+        actives = self._resolve([bag])[0][0]
+        child = MutableBag(parent=bag)
+        for ridx in actives:
+            for hc, template, inst_names in self.snapshot.actions_for(
+                    ridx, Variety.ATTRIBUTE_GENERATOR):
+                handler = self.handlers.get(
+                    f"{hc.name}.{hc.namespace}" if hc.namespace
+                    else hc.name)
+                if handler is None:
+                    continue
+                for iname in inst_names:
+                    ib = self.snapshot.instances[iname]
+                    try:
+                        instance = ib.build(bag)
+                        outputs = handler.generate_attributes(template,
+                                                              instance)
+                    except EvalError as exc:
+                        monitor.DISPATCH_ERRORS.inc()
+                        log.warning("APA %s: %s", iname, exc)
+                        continue
+                    except Exception:
+                        monitor.DISPATCH_ERRORS.inc()
+                        log.exception("APA adapter failed")
+                        continue
+                    bindings = getattr(ib, "attribute_bindings", None)
+                    if bindings:
+                        for attr, ref in bindings.items():
+                            key = str(ref).removeprefix("$out.")
+                            if key in outputs:
+                                child.set(attr, outputs[key])
+                    else:
+                        for key, value in outputs.items():
+                            child.set(key.replace("_", "."), value)
+        return child

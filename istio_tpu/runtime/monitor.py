@@ -1,0 +1,55 @@
+"""Runtime self-metrics (reference: mixer/pkg/runtime/monitor.go:34-88
+prometheus counters/histograms for resolve + dispatch)."""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import prometheus_client
+
+REGISTRY = prometheus_client.CollectorRegistry()
+
+RESOLVE_COUNT = prometheus_client.Counter(
+    "mixer_runtime_resolve_count", "resolution batches", registry=REGISTRY)
+RESOLVE_DURATION = prometheus_client.Histogram(
+    "mixer_runtime_resolve_duration_s", "resolution latency",
+    registry=REGISTRY)
+RESOLVE_ERRORS = prometheus_client.Counter(
+    "mixer_runtime_resolve_errors", "rule predicates that errored",
+    registry=REGISTRY)
+DISPATCH_COUNT = prometheus_client.Counter(
+    "mixer_runtime_dispatch_count", "adapter dispatches",
+    registry=REGISTRY)
+DISPATCH_DURATION = prometheus_client.Histogram(
+    "mixer_runtime_dispatch_duration_s", "adapter dispatch latency",
+    registry=REGISTRY)
+DISPATCH_ERRORS = prometheus_client.Counter(
+    "mixer_runtime_dispatch_errors", "adapter/instance failures",
+    registry=REGISTRY)
+CONFIG_GENERATION = prometheus_client.Gauge(
+    "mixer_runtime_config_generation", "active snapshot revision",
+    registry=REGISTRY)
+CHECK_BATCH_SIZE = prometheus_client.Histogram(
+    "mixer_runtime_check_batch_size", "coalesced check batch sizes",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048),
+    registry=REGISTRY)
+
+
+@contextlib.contextmanager
+def resolve_timer():
+    RESOLVE_COUNT.inc()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        RESOLVE_DURATION.observe(time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def dispatch_timer():
+    DISPATCH_COUNT.inc()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        DISPATCH_DURATION.observe(time.perf_counter() - t0)

@@ -1,0 +1,80 @@
+"""Runtime server assembly (reference: mixer/pkg/server/server.go:92
+newServer — store → runtime controller → dispatcher → API, plus
+monitoring). The gRPC surface lives in istio_tpu/api; this class is the
+in-process core those servers wrap (and what tests drive directly, the
+reference's in-process e2e pattern mixer/test/e2e).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+from istio_tpu.adapters.sdk import QuotaArgs, QuotaResult
+from istio_tpu.attribute.bag import Bag
+from istio_tpu.attribute.global_dict import GLOBAL_MANIFEST
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.runtime.batcher import CheckBatcher
+from istio_tpu.runtime.controller import Controller
+from istio_tpu.runtime.dispatcher import (CheckResponse,
+                                          DEFAULT_IDENTITY_ATTR)
+from istio_tpu.runtime.store import Store
+
+
+@dataclasses.dataclass
+class ServerArgs:
+    """mixer/pkg/server/args.go:32 analog."""
+    identity_attr: str = DEFAULT_IDENTITY_ATTR
+    default_manifest: Mapping[str, ValueType] | None = None
+    batch_window_s: float = 0.0003
+    max_batch: int = 1024
+    max_str_len: int | None = None
+    preprocess: bool = True
+
+
+class RuntimeServer:
+    def __init__(self, store: Store, args: ServerArgs | None = None):
+        self.args = args or ServerArgs()
+        manifest = self.args.default_manifest
+        if manifest is None:
+            manifest = GLOBAL_MANIFEST
+        self.controller = Controller(
+            store, default_manifest=manifest,
+            identity_attr=self.args.identity_attr,
+            max_str_len=self.args.max_str_len)
+        self.batcher = CheckBatcher(self._run_check_batch,
+                                    window_s=self.args.batch_window_s,
+                                    max_batch=self.args.max_batch)
+
+    # -- API surface (grpcServer.go Check/Report semantics) --
+
+    def _run_check_batch(self,
+                         bags: Sequence[Bag]) -> Sequence[CheckResponse]:
+        d = self.controller.dispatcher
+        if self.args.preprocess:
+            bags = [d.preprocess(bag) for bag in bags]
+        return d.check(bags)
+
+    def check(self, bag: Bag) -> CheckResponse:
+        """One request; coalesced into a device batch."""
+        return self.batcher.check(bag)
+
+    def check_many(self, bags: Sequence[Bag]) -> list[CheckResponse]:
+        """Pre-batched entry (load tests / the C++ shim's batches)."""
+        return list(self._run_check_batch(bags))
+
+    def report(self, bags: Sequence[Bag]) -> None:
+        d = self.controller.dispatcher
+        if self.args.preprocess:
+            bags = [d.preprocess(bag) for bag in bags]
+        d.report(bags)
+
+    def quota(self, bag: Bag, quota_name: str,
+              args: QuotaArgs | None = None) -> QuotaResult:
+        d = self.controller.dispatcher
+        if self.args.preprocess:
+            bag = d.preprocess(bag)
+        return d.quota(bag, quota_name, args or QuotaArgs())
+
+    def close(self) -> None:
+        self.batcher.close()
+        self.controller.close()

@@ -1,0 +1,155 @@
+"""Generic config store: typed KV + watch.
+
+Reference: mixer/pkg/config/store (store.go:115 Backend, :129 Store,
+fsstore.go, queue.go). Keys are (kind, namespace, name); values are
+plain dict specs. Backends: in-memory (test backbone + programmatic
+config) and a filesystem backend reading k8s-style YAML documents
+(kind/metadata/spec), reloadable like the reference's fsstore polling.
+Watchers receive coalesced change events on a dedicated delivery thread
+(queue.go's eventQueue role) — never on the mutator's thread.
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import queue
+import threading
+from typing import Any, Callable, Iterable, Mapping
+
+import yaml
+
+
+class StoreError(ValueError):
+    pass
+
+
+Key = tuple[str, str, str]   # (kind, namespace, name)
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Update or Delete (value None = delete)."""
+    key: Key
+    value: Mapping[str, Any] | None
+
+
+Validator = Callable[[Key, Mapping[str, Any] | None], None]
+Watcher = Callable[[list[Event]], None]
+
+
+class Store:
+    """Thread-safe KV with watch; backends load into it."""
+
+    def __init__(self, validator: Validator | None = None):
+        self._data: dict[Key, Mapping[str, Any]] = {}
+        self._lock = threading.Lock()
+        self._watchers: list[Watcher] = []
+        self._validator = validator
+        self._queue: "queue.Queue[list[Event] | None]" = queue.Queue()
+        self._delivery = threading.Thread(target=self._deliver, daemon=True,
+                                          name="store-delivery")
+        self._delivery.start()
+
+    # -- read --
+    def get(self, key: Key) -> Mapping[str, Any] | None:
+        with self._lock:
+            return self._data.get(key)
+
+    def list(self, kind: str | None = None) -> dict[Key, Mapping[str, Any]]:
+        with self._lock:
+            return {k: v for k, v in self._data.items()
+                    if kind is None or k[0] == kind}
+
+    # -- write --
+    def set(self, key: Key, value: Mapping[str, Any]) -> None:
+        self.apply_events([Event(key, dict(value))])
+
+    def delete(self, key: Key) -> None:
+        self.apply_events([Event(key, None)])
+
+    def apply_events(self, events: list[Event]) -> None:
+        if self._validator is not None:
+            for ev in events:
+                self._validator(ev.key, ev.value)
+        with self._lock:
+            for ev in events:
+                if ev.value is None:
+                    self._data.pop(ev.key, None)
+                else:
+                    self._data[ev.key] = dict(ev.value)
+        self._queue.put(list(events))
+
+    # -- watch --
+    def watch(self, watcher: Watcher) -> None:
+        self._watchers.append(watcher)
+
+    def _deliver(self) -> None:
+        while True:
+            events = self._queue.get()
+            if events is None:
+                return
+            for w in list(self._watchers):
+                try:
+                    w(events)
+                except Exception:   # watcher isolation (queue.go behavior)
+                    import logging
+                    logging.getLogger("istio_tpu.store").exception(
+                        "config watcher failed")
+
+    def close(self) -> None:
+        self._queue.put(None)
+        self._delivery.join(timeout=5)
+
+
+class MemStore(Store):
+    """Programmatic backend (reference config/store memstore test
+    backend); also the target the fs backend loads into."""
+
+
+class FsStore(Store):
+    """Filesystem backend: a directory of YAML files, each holding one
+    or more k8s-style documents:
+
+        kind: rule
+        metadata: {name: r1, namespace: default}
+        spec: {match: ..., actions: [...]}
+
+    `reload()` re-reads the tree and emits the diff as events
+    (reference fsstore.go periodic-poll semantics; callers or the
+    server's timer drive the cadence)."""
+
+    def __init__(self, root: str, validator: Validator | None = None):
+        super().__init__(validator)
+        self.root = root
+        self.reload()
+
+    def _read_tree(self) -> dict[Key, Mapping[str, Any]]:
+        out: dict[Key, Mapping[str, Any]] = {}
+        for path in sorted(glob.glob(os.path.join(self.root, "**", "*.y*ml"),
+                                     recursive=True)):
+            with open(path, encoding="utf-8") as f:
+                for doc in yaml.safe_load_all(f):
+                    if not doc or not isinstance(doc, Mapping):
+                        continue
+                    kind = doc.get("kind")
+                    meta = doc.get("metadata") or {}
+                    name = meta.get("name")
+                    if not kind or not name:
+                        raise StoreError(
+                            f"{path}: document needs kind + metadata.name")
+                    ns = meta.get("namespace", "")
+                    out[(str(kind), str(ns), str(name))] = \
+                        dict(doc.get("spec") or {})
+        return out
+
+    def reload(self) -> int:
+        """Diff disk vs memory; emit changes. Returns #events."""
+        disk = self._read_tree()
+        current = self.list()
+        events = [Event(k, v) for k, v in disk.items()
+                  if current.get(k) != v]
+        events += [Event(k, None) for k in current if k not in disk]
+        if events:
+            self.apply_events(events)
+        return len(events)

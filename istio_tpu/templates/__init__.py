@@ -1,0 +1,23 @@
+"""Template framework + the built-in template inventory.
+
+Role of the reference's mixer/pkg/template + mixer/template/* (SURVEY.md
+§2.4): a template defines the typed schema of instances handed to
+adapters, how instance fields are inferred/type-checked against the
+attribute vocabulary, and how a config instance (field → expression) is
+materialized per request.
+
+The reference generates ~5,500 LoC of Go per-template plumbing
+(template.gen.go) with a codegen tool; here templates are declarative
+`TemplateInfo` records + one generic evaluator (framework.py) — Python
+metaprogramming replaces codegen (SURVEY.md §7 layer 5).
+
+Inventory (reference mixer/template/<name>/template.proto):
+  apikey, authorization, checknothing, listentry, logentry, metric,
+  quota, reportnothing, tracespan.
+"""
+from istio_tpu.templates.framework import (InstanceBuilder, TemplateError,
+                                           TemplateInfo, Variety, registry)
+from istio_tpu.templates import builtin as _builtin  # registers inventory
+
+__all__ = ["TemplateInfo", "Variety", "InstanceBuilder", "TemplateError",
+           "registry"]

@@ -1,0 +1,104 @@
+"""The built-in template inventory.
+
+Schemas transcribed from the reference's template protos
+(mixer/template/<name>/template.proto); field sets and varieties match
+1:1 so adapter configs written for the reference translate directly.
+"""
+from __future__ import annotations
+
+from istio_tpu.attribute.types import ValueType as V
+from istio_tpu.templates.framework import (Field, TemplateInfo, Variety,
+                                           registry)
+
+# mixer/template/checknothing/template.proto — empty check instance
+CHECKNOTHING = registry.register(TemplateInfo(
+    name="checknothing", variety=Variety.CHECK, fields=(),
+    description="carries no data; precondition-only checks"))
+
+# mixer/template/reportnothing/template.proto
+REPORTNOTHING = registry.register(TemplateInfo(
+    name="reportnothing", variety=Variety.REPORT, fields=(),
+    description="carries no data; signal-only reports"))
+
+# mixer/template/listentry/template.proto:25 — one string value
+LISTENTRY = registry.register(TemplateInfo(
+    name="listentry", variety=Variety.CHECK,
+    fields=(Field("value", V.STRING, required=True),),
+    description="membership check of one value against a list adapter"))
+
+# mixer/template/quota/template.proto — dimensions map
+QUOTA = registry.register(TemplateInfo(
+    name="quota", variety=Variety.QUOTA,
+    fields=(Field("dimensions", expr_map=True),),
+    description="quota allocation with dedup dimensions"))
+
+# mixer/template/apikey/template.proto — api/key attributes
+APIKEY = registry.register(TemplateInfo(
+    name="apikey", variety=Variety.CHECK,
+    fields=(Field("api", V.STRING),
+            Field("api_version", V.STRING),
+            Field("api_operation", V.STRING),
+            Field("api_key", V.STRING),
+            Field("timestamp", V.TIMESTAMP)),
+    description="api-key validity check"))
+
+# mixer/template/authorization/template.proto:26-49 — Subject/Action
+AUTHORIZATION = registry.register(TemplateInfo(
+    name="authorization", variety=Variety.CHECK,
+    fields=(Field("subject", submessage=(
+                Field("user", V.STRING),
+                Field("groups", V.STRING),
+                Field("properties", expr_map=True))),
+            Field("action", submessage=(
+                Field("namespace", V.STRING),
+                Field("service", V.STRING),
+                Field("method", V.STRING),
+                Field("path", V.STRING),
+                Field("properties", expr_map=True)))),
+    description="who(subject) may do what(action)"))
+
+# mixer/template/logentry/template.proto — variables + severity + time
+LOGENTRY = registry.register(TemplateInfo(
+    name="logentry", variety=Variety.REPORT,
+    fields=(Field("variables", expr_map=True),
+            Field("timestamp", V.TIMESTAMP),
+            Field("severity", V.STRING),
+            Field("monitored_resource_type", V.STRING),
+            Field("monitored_resource_dimensions", expr_map=True)),
+    description="structured log record"))
+
+# mixer/template/metric/template.proto — value + dimensions
+METRIC = registry.register(TemplateInfo(
+    name="metric", variety=Variety.REPORT,
+    fields=(Field("value", V.UNSPECIFIED, required=True),
+            Field("dimensions", expr_map=True),
+            Field("monitored_resource_type", V.STRING),
+            Field("monitored_resource_dimensions", expr_map=True)),
+    description="one measurement with dimensions"))
+
+# mixer/adapter/kubernetesenv/template/template.proto — the APA
+# (ATTRIBUTE_GENERATOR) template: inputs identify workloads, the
+# adapter's output attributes are merged into the request bag during
+# Preprocess (dispatcher.go:285). Output mapping comes from the
+# instance's attribute_bindings (runtime config), not the schema.
+KUBERNETES = registry.register(TemplateInfo(
+    name="kubernetes", variety=Variety.ATTRIBUTE_GENERATOR,
+    fields=(Field("source_uid", V.STRING),
+            Field("source_ip", V.IP_ADDRESS),
+            Field("destination_uid", V.STRING),
+            Field("destination_ip", V.IP_ADDRESS),
+            Field("origin_uid", V.STRING),
+            Field("origin_ip", V.IP_ADDRESS)),
+    description="k8s pod metadata attribute generation"))
+
+# mixer/template/tracespan/template.proto
+TRACESPAN = registry.register(TemplateInfo(
+    name="tracespan", variety=Variety.REPORT,
+    fields=(Field("trace_id", V.STRING, required=True),
+            Field("span_id", V.STRING),
+            Field("parent_span_id", V.STRING),
+            Field("span_name", V.STRING),
+            Field("start_time", V.TIMESTAMP),
+            Field("end_time", V.TIMESTAMP),
+            Field("span_tags", expr_map=True)),
+    description="distributed-trace span"))

@@ -1,0 +1,202 @@
+"""Generic template machinery.
+
+One `TemplateInfo` per template replaces the reference's generated
+InferTypeFn/SetTypeFn/ProcessXxxFn triple (mixer/template/
+template.gen.go, framework types mixer/pkg/template/template.go:35-110):
+
+  * `infer_types`  — type-check an instance config's field expressions
+    against the attribute vocabulary, producing the inferred instance
+    type handed to adapter builders (reference InferTypeFn).
+  * `InstanceBuilder` — compile an instance config's expressions once,
+    then materialize an Instance per attribute bag (reference
+    ProcessCheckFn/ProcessReportFn instance construction; evaluation
+    errors abort the instance exactly like errorpath.go).
+
+Field schemas support scalar expression fields, expression maps
+(`dimensions`, `labels`), and nested sub-messages (authorization's
+Subject/Action). `value_type` fields are dynamically typed: their
+declared type is V.VALUE (any) and the INFERRED type is recorded, which
+is exactly how the reference's metric/quota templates carry
+value/dimension types to adapters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Mapping
+
+from istio_tpu.attribute.bag import Bag
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.expr.checker import (AttributeDescriptorFinder, DEFAULT_FUNCS,
+                                    TypeError_, eval_type)
+from istio_tpu.expr.oracle import EvalError, OracleProgram
+from istio_tpu.expr.parser import ParseError, parse
+
+V = ValueType
+
+
+class Variety(enum.Enum):
+    """mixer/pkg/adapter TemplateVariety."""
+    CHECK = "TEMPLATE_VARIETY_CHECK"
+    REPORT = "TEMPLATE_VARIETY_REPORT"
+    QUOTA = "TEMPLATE_VARIETY_QUOTA"
+    ATTRIBUTE_GENERATOR = "TEMPLATE_VARIETY_ATTRIBUTE_GENERATOR"
+
+
+class TemplateError(ValueError):
+    """Instance config does not satisfy the template schema."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """One instance field: a fixed expected type, V.UNSPECIFIED for
+    dynamic (value_type) fields, or a map/submessage marker."""
+    name: str
+    type: ValueType | None = None     # None → submessage or expr-map
+    expr_map: bool = False            # map[string]expr (dimensions/labels)
+    submessage: tuple["Field", ...] | None = None
+    required: bool = False
+    default: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TemplateInfo:
+    """Declarative template descriptor (reference template.Info)."""
+    name: str
+    variety: Variety
+    fields: tuple[Field, ...]
+    description: str = ""
+
+    def field(self, name: str) -> Field:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+
+class _Registry:
+    def __init__(self) -> None:
+        self._by_name: dict[str, TemplateInfo] = {}
+
+    def register(self, info: TemplateInfo) -> TemplateInfo:
+        self._by_name[info.name] = info
+        return info
+
+    def get(self, name: str) -> TemplateInfo:
+        info = self._by_name.get(name)
+        if info is None:
+            raise TemplateError(f"unknown template: {name}")
+        return info
+
+    def names(self) -> list[str]:
+        return sorted(self._by_name)
+
+
+registry = _Registry()
+
+
+# ---------------------------------------------------------------------------
+# Type inference (reference InferTypeFn)
+# ---------------------------------------------------------------------------
+
+def infer_types(info: TemplateInfo, params: Mapping[str, Any],
+                finder: AttributeDescriptorFinder) -> dict[str, Any]:
+    """Validate `params` (field → expression text / nested dict) against
+    the template schema; returns the inferred type structure (field →
+    ValueType | {key → ValueType} | nested dict) that adapter builders
+    receive (reference SetTypeFn payload)."""
+    inferred: dict[str, Any] = {}
+    unknown = set(params) - {f.name for f in info.fields}
+    if unknown:
+        raise TemplateError(
+            f"template {info.name}: unknown fields {sorted(unknown)}")
+    for f in info.fields:
+        raw = params.get(f.name, None)
+        if raw is None:
+            if f.required:
+                raise TemplateError(
+                    f"template {info.name}: missing required field {f.name}")
+            continue
+        try:
+            if f.submessage is not None:
+                if not isinstance(raw, Mapping):
+                    raise TemplateError(
+                        f"{info.name}.{f.name}: expected a message")
+                sub = TemplateInfo(name=f"{info.name}.{f.name}",
+                                   variety=info.variety, fields=f.submessage)
+                inferred[f.name] = infer_types(sub, raw, finder)
+            elif f.expr_map:
+                if not isinstance(raw, Mapping):
+                    raise TemplateError(
+                        f"{info.name}.{f.name}: expected map of expressions")
+                inferred[f.name] = {
+                    k: eval_type(parse(v), finder, DEFAULT_FUNCS)
+                    for k, v in raw.items()}
+            else:
+                t = eval_type(parse(raw), finder, DEFAULT_FUNCS)
+                if f.type is not V.UNSPECIFIED and t != f.type:
+                    raise TemplateError(
+                        f"{info.name}.{f.name}: expression '{raw}' has type "
+                        f"{t.name}, expected {f.type.name}")
+                inferred[f.name] = t
+        except (ParseError, TypeError_) as exc:
+            raise TemplateError(
+                f"{info.name}.{f.name}: {exc}") from exc
+    return inferred
+
+
+# ---------------------------------------------------------------------------
+# Instance construction (reference ProcessXxxFn instance build half)
+# ---------------------------------------------------------------------------
+
+class InstanceBuilder:
+    """Compiles one instance config's expressions; `build(bag)` →
+    instance dict. Evaluation failure raises EvalError (the dispatcher
+    converts it to the adapter-skipping error path, errorpath.go)."""
+
+    def __init__(self, info: TemplateInfo, name: str,
+                 params: Mapping[str, Any],
+                 finder: AttributeDescriptorFinder):
+        self.info = info
+        self.name = name
+        self.inferred = infer_types(info, params, finder)
+        self._plan = self._compile(info.fields, params, finder)
+
+    def _compile(self, fields: tuple[Field, ...], params: Mapping[str, Any],
+                 finder: AttributeDescriptorFinder) -> list[tuple]:
+        plan: list[tuple] = []
+        for f in fields:
+            raw = params.get(f.name, None)
+            if raw is None:
+                if f.default is not None:
+                    plan.append((f.name, "const", f.default))
+                continue
+            if f.submessage is not None:
+                plan.append((f.name, "sub",
+                             self._compile(f.submessage, raw, finder)))
+            elif f.expr_map:
+                plan.append((f.name, "map",
+                             {k: OracleProgram(v, finder)
+                              for k, v in raw.items()}))
+            else:
+                plan.append((f.name, "expr", OracleProgram(raw, finder)))
+        return plan
+
+    def build(self, bag: Bag) -> dict[str, Any]:
+        return self._run(self._plan, bag)
+
+    def _run(self, plan: list[tuple], bag: Bag) -> dict[str, Any]:
+        out: dict[str, Any] = {"name": self.name}
+        for fname, kind, payload in plan:
+            if kind == "const":
+                out[fname] = payload
+            elif kind == "sub":
+                sub = self._run(payload, bag)
+                sub.pop("name", None)
+                out[fname] = sub
+            elif kind == "map":
+                out[fname] = {k: p.evaluate(bag)
+                              for k, p in payload.items()}
+            else:
+                out[fname] = payload.evaluate(bag)
+        return out

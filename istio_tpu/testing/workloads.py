@@ -18,24 +18,23 @@ from istio_tpu.models.policy_engine import (DenySpec, ListEntrySpec,
 
 V = ValueType
 
-MESH_MANIFEST: dict[str, ValueType] = {
-    "source.name": V.STRING, "source.namespace": V.STRING,
-    "source.ip": V.IP_ADDRESS, "source.labels": V.STRING_MAP,
-    "source.user": V.STRING, "source.service": V.STRING,
-    "destination.name": V.STRING, "destination.namespace": V.STRING,
-    "destination.service": V.STRING, "destination.labels": V.STRING_MAP,
-    "request.headers": V.STRING_MAP, "request.host": V.STRING,
-    "request.method": V.STRING, "request.path": V.STRING,
-    "request.scheme": V.STRING, "request.size": V.INT64,
-    "request.time": V.TIMESTAMP, "request.useragent": V.STRING,
-    "request.api_key": V.STRING,
-    "response.code": V.INT64, "response.size": V.INT64,
-    "response.duration": V.DURATION,
-    "connection.mtls": V.BOOL,
-    "context.protocol": V.STRING, "context.reporter.kind": V.STRING,
-    "api.service": V.STRING, "api.operation": V.STRING,
-    "api.version": V.STRING,
-}
+# the canonical vocabulary subset the synthetic workloads exercise —
+# typed once in attribute/global_dict.py, never duplicated
+from istio_tpu.attribute.global_dict import GLOBAL_MANIFEST as _G
+
+MESH_MANIFEST: dict[str, ValueType] = {k: _G[k] for k in (
+    "source.name", "source.namespace", "source.ip", "source.labels",
+    "source.user", "source.service",
+    "destination.name", "destination.namespace", "destination.service",
+    "destination.labels",
+    "request.headers", "request.host", "request.method", "request.path",
+    "request.scheme", "request.size", "request.time", "request.useragent",
+    "request.api_key",
+    "response.code", "response.size", "response.duration",
+    "connection.mtls",
+    "context.protocol", "context.reporter.kind",
+    "api.service", "api.operation", "api.version",
+)}
 
 MESH_FINDER = AttributeDescriptorFinder(MESH_MANIFEST)
 

@@ -7,7 +7,8 @@ import numpy as np
 from istio_tpu.attribute.bag import bag_from_mapping
 from istio_tpu.compiler.ruleset import Rule
 from istio_tpu.expr.checker import AttributeDescriptorFinder
-from istio_tpu.models.policy_engine import (DenySpec, ListEntrySpec, OK,
+from istio_tpu.models.policy_engine import (DenySpec, ListEntrySpec,
+                                            NOT_FOUND, OK,
                                             PERMISSION_DENIED, PolicyEngine,
                                             QuotaSpec, RESOURCE_EXHAUSTED)
 from istio_tpu.testing.corpus import CORPUS_MANIFEST
@@ -48,7 +49,9 @@ def test_whitelist_and_blacklist():
         {"source.namespace": "ns-z", "request.user": "ok"},   # wl denies
         {"source.namespace": "ns-b", "request.user": "bad"},  # bl denies
     ])
-    assert v.status.tolist() == [OK, PERMISSION_DENIED, PERMISSION_DENIED]
+    # host-adapter parity: whitelist miss → NOT_FOUND, blacklist hit →
+    # PERMISSION_DENIED (adapters/list_adapter.py)
+    assert v.status.tolist() == [OK, NOT_FOUND, PERMISSION_DENIED]
 
 
 def test_list_requires_value_presence():

@@ -1,0 +1,277 @@
+"""Template framework + adapter inventory behavior.
+
+Mirrors the reference's per-template/per-adapter unit tests
+(mixer/template/*/template.gen_test.go patterns, adapter *_test.go)."""
+import datetime
+
+import pytest
+
+from istio_tpu.adapters.registry import adapter_registry, load_inventory
+from istio_tpu.adapters.sdk import (AdapterUnavailable, Env, QuotaArgs)
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.attribute.types import ValueType as V
+from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.models.policy_engine import (NOT_FOUND, OK,
+                                            PERMISSION_DENIED,
+                                            RESOURCE_EXHAUSTED)
+from istio_tpu.templates import InstanceBuilder, TemplateError, registry
+from istio_tpu.templates.framework import infer_types
+from istio_tpu.testing.corpus import CORPUS_MANIFEST
+
+load_inventory()
+FINDER = AttributeDescriptorFinder(CORPUS_MANIFEST)
+ENV = Env("test")
+
+
+def _build(adapter: str, config: dict):
+    info = adapter_registry.get(adapter)
+    b = info.builder(config, ENV)
+    errs = b.validate()
+    assert not errs, errs
+    return b.build()
+
+
+# ---------------------------------------------------------------- templates
+
+def test_inventory_parity():
+    assert registry.names() == ["apikey", "authorization", "checknothing",
+                                "kubernetes", "listentry", "logentry",
+                                "metric", "quota", "reportnothing",
+                                "tracespan"]
+    assert sorted(adapter_registry.names()) == [
+        "circonus", "denier", "fluentd", "kubernetesenv", "list",
+        "memquota", "noop", "opa", "prometheus", "rbac",
+        "servicecontrol", "stackdriver", "statsd", "stdio"]
+
+
+def test_listentry_instance():
+    ib = InstanceBuilder(registry.get("listentry"), "staticversion",
+                         {"value": 'source.labels["version"] | "unknown"'},
+                         FINDER)
+    inst = ib.build(bag_from_mapping(
+        {"source.labels": {"version": "v1"}}))
+    assert inst == {"name": "staticversion", "value": "v1"}
+    inst = ib.build(bag_from_mapping({"source.labels": {}}))
+    assert inst["value"] == "unknown"
+
+
+def test_metric_instance_with_dynamic_value_and_dimensions():
+    ib = InstanceBuilder(registry.get("metric"), "requestcount", {
+        "value": "request.size",
+        "dimensions": {"service": "destination.service",
+                       "protocol": 'context.protocol | "http"'}},
+        FINDER)
+    assert ib.inferred["value"] == V.INT64
+    inst = ib.build(bag_from_mapping(
+        {"request.size": 7, "destination.service": "a.b"}))
+    assert inst["value"] == 7
+    assert inst["dimensions"] == {"service": "a.b", "protocol": "http"}
+
+
+def test_authorization_subject_action():
+    ib = InstanceBuilder(registry.get("authorization"), "authinfo", {
+        "subject": {"user": 'source.name | ""'},
+        "action": {"namespace": 'destination.namespace | "default"',
+                   "service": "destination.service",
+                   "method": 'context.protocol',
+                   "properties": {"version": 'source.labels["version"] | ""'}}},
+        FINDER)
+    inst = ib.build(bag_from_mapping({
+        "destination.service": "svc", "context.protocol": "GET",
+        "source.labels": {"version": "v2"}}))
+    assert inst["subject"] == {"user": ""}
+    assert inst["action"]["namespace"] == "default"
+    assert inst["action"]["properties"] == {"version": "v2"}
+
+
+def test_template_type_mismatch_rejected():
+    with pytest.raises(TemplateError):
+        infer_types(registry.get("listentry"),
+                    {"value": "request.size"}, FINDER)   # INT64 ≠ STRING
+    with pytest.raises(TemplateError):
+        infer_types(registry.get("listentry"),
+                    {"nope": '"x"'}, FINDER)
+    with pytest.raises(TemplateError):
+        infer_types(registry.get("listentry"), {}, FINDER)  # required
+
+
+# ---------------------------------------------------------------- adapters
+
+def test_denier():
+    h = _build("denier", {"status_code": PERMISSION_DENIED})
+    r = h.handle_check("checknothing", {"name": "i"})
+    assert r.status_code == PERMISSION_DENIED
+    q = h.handle_quota("quota", {"name": "q"}, QuotaArgs(quota_amount=5))
+    assert q.granted_amount == 0
+
+
+def test_list_whitelist_strings():
+    h = _build("list", {"overrides": ["v1", "v2"]})
+    assert h.handle_check("listentry", {"value": "v1"}).ok
+    r = h.handle_check("listentry", {"value": "v9"})
+    assert r.status_code == NOT_FOUND
+
+
+def test_list_blacklist_cidr():
+    h = _build("list", {"entry_type": "IP_ADDRESSES", "blacklist": True,
+                        "overrides": ["10.0.0.0/8"]})
+    assert h.handle_check("listentry",
+                          {"value": "10.1.2.3"}).status_code \
+        == PERMISSION_DENIED
+    assert h.handle_check("listentry", {"value": "192.168.1.1"}).ok
+    # 16-byte v4-mapped bytes form (the interned IP representation)
+    mapped = b"\x00" * 10 + b"\xff\xff" + bytes([10, 9, 9, 9])
+    assert h.handle_check("listentry",
+                          {"value": mapped}).status_code \
+        == PERMISSION_DENIED
+
+
+def test_list_regex_and_file_provider(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text("^/api/.*\n^/healthz$\n")
+    h = _build("list", {"entry_type": "REGEX",
+                        "provider_url": f"file://{p}"})
+    assert h.handle_check("listentry", {"value": "/api/v1"}).ok
+    assert not h.handle_check("listentry", {"value": "/admin"}).ok
+
+
+def test_memquota_window_and_dedup():
+    now = [0.0]
+    from istio_tpu.adapters.memquota import MemQuotaHandler
+    h = MemQuotaHandler({"quotas": [
+        {"name": "rate", "max_amount": 3, "valid_duration_s": 10.0}]},
+        ENV, clock=lambda: now[0])
+    inst = {"name": "rate", "dimensions": {"u": "alice"}}
+    assert h.handle_quota("quota", inst,
+                          QuotaArgs(quota_amount=2)).granted_amount == 2
+    # dedup: same id returns the same grant without consuming
+    r1 = h.handle_quota("quota", inst,
+                        QuotaArgs(quota_amount=1, dedup_id="d1"))
+    r2 = h.handle_quota("quota", inst,
+                        QuotaArgs(quota_amount=1, dedup_id="d1"))
+    assert r1.granted_amount == 1 and r2.granted_amount == 1
+    # window full: all-or-nothing fails, best-effort grants 0
+    r = h.handle_quota("quota", inst,
+                       QuotaArgs(quota_amount=2, best_effort=False))
+    assert r.granted_amount == 0 and r.status_code == RESOURCE_EXHAUSTED
+    # other dimensions have their own cell
+    other = {"name": "rate", "dimensions": {"u": "bob"}}
+    assert h.handle_quota("quota", other,
+                          QuotaArgs(quota_amount=3)).granted_amount == 3
+    # window expiry frees budget
+    now[0] = 11.0
+    assert h.handle_quota("quota", inst,
+                          QuotaArgs(quota_amount=3)).granted_amount == 3
+
+
+def test_rbac():
+    h = _build("rbac", {
+        "roles": [{"name": "viewer", "namespace": "ns1", "rules": [
+            {"services": ["products.*"], "methods": ["GET"],
+             "paths": ["/products*"]}]}],
+        "bindings": [{"name": "b1", "namespace": "ns1",
+                      "roleRef": {"name": "viewer"},
+                      "subjects": [{"user": "alice"}]}]})
+    ok = h.handle_check("authorization", {
+        "subject": {"user": "alice"},
+        "action": {"namespace": "ns1", "service": "products.ns1",
+                   "method": "GET", "path": "/products/1"}})
+    assert ok.status_code == OK
+    deny = h.handle_check("authorization", {
+        "subject": {"user": "bob"},
+        "action": {"namespace": "ns1", "service": "products.ns1",
+                   "method": "GET", "path": "/products/1"}})
+    assert deny.status_code == PERMISSION_DENIED
+    wrong_method = h.handle_check("authorization", {
+        "subject": {"user": "alice"},
+        "action": {"namespace": "ns1", "service": "products.ns1",
+                   "method": "DELETE", "path": "/products/1"}})
+    assert wrong_method.status_code == PERMISSION_DENIED
+
+
+def test_opa_expression_policies():
+    h = _build("opa", {"policies": [
+        'action.method == "GET" && action.path.startsWith("/public/")',
+        'subject.user == "admin"']})
+    assert h.handle_check("authorization", {
+        "subject": {"user": "joe"},
+        "action": {"method": "GET", "path": "/public/x"}}).ok
+    assert h.handle_check("authorization", {
+        "subject": {"user": "admin"},
+        "action": {"method": "DELETE", "path": "/private"}}).ok
+    assert not h.handle_check("authorization", {
+        "subject": {"user": "joe"},
+        "action": {"method": "DELETE", "path": "/private"}}).ok
+
+
+def test_stdio_and_prometheus(capsys):
+    h = _build("stdio", {})
+    h.handle_report("logentry", [{
+        "name": "accesslog", "severity": "warning",
+        "timestamp": datetime.datetime(2018, 1, 1),
+        "variables": {"url": "/x", "code": 200}}])
+    h.handle_report("metric", [{"name": "m", "value": 3,
+                                "dimensions": {"svc": "a"}}])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 2 and '"url": "/x"' in out[0]
+
+    ph = _build("prometheus", {"metrics": [
+        {"name": "requestcount", "kind": "COUNTER",
+         "label_names": ["service"]}]})
+    ph.handle_report("metric", [
+        {"name": "requestcount", "value": 2,
+         "dimensions": {"service": "a.b"}},
+        {"name": "requestcount", "value": 3,
+         "dimensions": {"service": "a.b"}}])
+    sample = ph.registry.get_sample_value(
+        "istio_tpu_requestcount_total", {"service": "a.b"})
+    assert sample == 5.0
+
+
+def test_statsd_lines():
+    import socket
+    recv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    recv.bind(("127.0.0.1", 0))
+    recv.settimeout(2.0)
+    port = recv.getsockname()[1]
+    h = _build("statsd", {"port": port, "prefix": "istio.",
+                          "metrics": [{"name": "reqs", "type": "COUNTER",
+                                       "name_template": "by_${svc}"}]})
+    h.handle_report("metric", [{"name": "reqs", "value": 4,
+                                "dimensions": {"svc": "web"}}])
+    data = recv.recvfrom(1024)[0]
+    assert data == b"istio.by_web:4|c"
+    h.close(); recv.close()
+
+
+def test_fluentd_msgpack_roundtrippable():
+    from istio_tpu.adapters.fluentd import msgpack_encode
+    enc = msgpack_encode(["tag", 123, {"k": "v", "n": 7}])
+    assert enc[0] == 0x93            # fixarray(3)
+    assert b"\xa3tag" in enc and b"\xa1k\xa1v" in enc
+
+
+def test_kubernetesenv_apa():
+    h = _build("kubernetesenv", {"pods": {
+        "productpage.default": {
+            "pod_name": "productpage-v1-abc", "namespace": "default",
+            "labels": {"app": "productpage"}, "pod_ip": "10.0.0.5",
+            "service_account_name": "sa-pp"}}})
+    out = h.generate_attributes("kubernetes", {
+        "source_uid": "kubernetes://productpage.default"})
+    assert out["source_pod_name"] == "productpage-v1-abc"
+    out2 = h.generate_attributes("kubernetes",
+                                 {"destination_ip": "10.0.0.5"})
+    assert out2["destination_namespace"] == "default"
+
+
+def test_saas_stubs_gated():
+    h = _build("stackdriver", {})
+    with pytest.raises(AdapterUnavailable):
+        h.handle_report("metric", [{"name": "m", "value": 1}])
+    # with an injected transport the stub forwards
+    seen = []
+    h2 = _build("servicecontrol",
+                {"transport": lambda k, t, p: seen.append((k, t))})
+    h2.handle_report("metric", [{"name": "m", "value": 1}])
+    assert seen == [("report", "metric")]
