@@ -1,0 +1,139 @@
+"""Mixer client — the mixc / Envoy-mixerclient role.
+
+Encodes attribute dicts with global-dictionary compression, issues
+Check/Report RPCs, and (like the C++ mixerclient) can CACHE Check
+verdicts keyed by the response's ReferencedAttributes: a subsequent
+request whose referenced attribute values are identical reuses the
+cached verdict until its TTL/use-count budget is spent.
+"""
+from __future__ import annotations
+
+import datetime
+import threading
+import time
+from typing import Any, Mapping, Sequence
+
+import grpc
+
+from istio_tpu.api import mixer_pb2 as pb
+from istio_tpu.api.wire import bag_to_compressed, _lookup
+from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
+
+
+class MixerClient:
+    def __init__(self, target: str, enable_check_cache: bool = True):
+        self._channel = grpc.insecure_channel(target)
+        self._check = self._channel.unary_unary(
+            "/istio.mixer.v1.Mixer/Check",
+            request_serializer=pb.CheckRequest.SerializeToString,
+            response_deserializer=pb.CheckResponse.FromString)
+        self._report = self._channel.unary_unary(
+            "/istio.mixer.v1.Mixer/Report",
+            request_serializer=pb.ReportRequest.SerializeToString,
+            response_deserializer=pb.ReportResponse.FromString)
+        self._cache_enabled = enable_check_cache
+        self._cache: dict[tuple, list] = {}
+        self._lock = threading.Lock()
+        self._dedup_counter = 0
+
+    # -- caching (mixerclient check_cache semantics) --
+
+    @staticmethod
+    def _signature(ref: "pb.ReferencedAttributes",
+                   values: Mapping[str, Any]) -> tuple | None:
+        """Cache signature of `values` under a response's referenced-
+        attribute set; None when the conditions don't transfer (the
+        mixerclient can't reuse the verdict). map_key=0 means "no key"
+        — the server reserves local word 0 (wire.py)."""
+        sig = []
+        words = list(ref.words)
+        gc = len(GLOBAL_WORD_LIST)
+        for m in ref.attribute_matches:
+            name = _lookup(m.name, words, gc)
+            container = values.get(name)
+            if m.map_key != 0:
+                key = _lookup(m.map_key, words, gc)
+                present = isinstance(container, Mapping) \
+                    and key in container
+                value = container.get(key) if present else None
+            else:
+                key = None
+                present = name in values
+                value = container if present else None
+            if m.condition == pb.ReferencedAttributes.ABSENCE:
+                if present:
+                    return None          # mismatch: entry unusable
+                sig.append((name, key, None))
+            elif m.condition == pb.ReferencedAttributes.EXACT:
+                if not present:
+                    return None
+                sig.append((name, key, repr(value)))
+        return tuple(sig)
+
+    def check(self, values: Mapping[str, Any],
+              quotas: Mapping[str, int] | None = None,
+              dedup_id: str | None = None) -> "pb.CheckResponse":
+        if self._cache_enabled and not quotas:
+            now = time.monotonic()
+            with self._lock:
+                hit = None
+                for ref, entry in list(self._cache.items()):
+                    resp, expiry, uses = entry
+                    if expiry <= now or uses <= 0:     # evict spent entries
+                        del self._cache[ref]
+                        continue
+                    if hit is None:
+                        sig = self._signature(
+                            resp.precondition.referenced_attributes, values)
+                        if sig is not None and sig == ref:
+                            entry[2] -= 1
+                            hit = resp
+                if hit is not None:
+                    return hit
+        req = pb.CheckRequest()
+        bag_to_compressed(values, msg=req.attributes)
+        req.global_word_count = len(GLOBAL_WORD_LIST)
+        if dedup_id is None:
+            self._dedup_counter += 1
+            dedup_id = f"py-mixc-{self._dedup_counter}"
+        req.deduplication_id = dedup_id
+        for name, amount in (quotas or {}).items():
+            req.quotas[name].amount = amount
+            req.quotas[name].best_effort = True
+        resp = self._check(req)
+        if self._cache_enabled and not quotas:
+            sig = self._signature(resp.precondition.referenced_attributes,
+                                  values)
+            if sig is not None:
+                ttl = resp.precondition.valid_duration.ToTimedelta() \
+                    .total_seconds()
+                with self._lock:
+                    self._cache[sig] = [resp,
+                                        time.monotonic() + ttl,
+                                        resp.precondition.valid_use_count]
+        return resp
+
+    def report(self, records: Sequence[Mapping[str, Any]]) -> None:
+        """Delta-encodes consecutive records (report_batch behavior).
+        The wire protocol accumulates deltas server-side and has no
+        removal marker, so a record that DROPS a key flushes the
+        current request and starts a fresh accumulation."""
+        req = pb.ReportRequest()
+        req.global_word_count = len(GLOBAL_WORD_LIST)
+        prev: dict[str, Any] = {}
+        for values in records:
+            if prev and any(k not in values for k in prev):
+                if len(req.attributes):
+                    self._report(req)
+                req = pb.ReportRequest()
+                req.global_word_count = len(GLOBAL_WORD_LIST)
+                prev = {}
+            delta = {k: v for k, v in values.items()
+                     if k not in prev or prev[k] != v}
+            bag_to_compressed(delta, msg=req.attributes.add())
+            prev = dict(values)
+        if len(req.attributes):
+            self._report(req)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,117 @@
+"""Mixer gRPC server — istio.mixer.v1.Mixer over grpcio.
+
+Reference: mixer/pkg/api/grpcServer.go. Check (:118): decode
+CompressedAttributes → Preprocess → precondition check → per-quota
+loop (:188-230); Report (:262): per-record delta decode → Preprocess →
+report. Service wiring uses generic method handlers (no grpcio-tools
+in this image); serialization is the generated mixer_pb2.
+
+The precondition path rides the RuntimeServer's batcher, so concurrent
+Check RPCs from many sidecar connections coalesce into device steps.
+"""
+from __future__ import annotations
+
+import datetime
+import logging
+from concurrent import futures
+from typing import Any
+
+import grpc
+
+from istio_tpu.adapters.sdk import QuotaArgs
+from istio_tpu.api import mixer_pb2 as pb
+from istio_tpu.api.wire import (compressed_to_dict, referenced_to_proto,
+                                update_dict_from_proto)
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.runtime.server import RuntimeServer
+
+log = logging.getLogger("istio_tpu.api")
+
+_CLAMP_DURATION_S = 3600.0
+
+
+class MixerGrpcServer:
+    """Serves Check/Report for a RuntimeServer core."""
+
+    def __init__(self, runtime: RuntimeServer, address: str = "127.0.0.1:0",
+                 max_workers: int = 16):
+        self.runtime = runtime
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers,
+                                       thread_name_prefix="mixer-grpc"))
+        handlers = {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                self._check,
+                request_deserializer=pb.CheckRequest.FromString,
+                response_serializer=pb.CheckResponse.SerializeToString),
+            "Report": grpc.unary_unary_rpc_method_handler(
+                self._report,
+                request_deserializer=pb.ReportRequest.FromString,
+                response_serializer=pb.ReportResponse.SerializeToString),
+        }
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler("istio.mixer.v1.Mixer",
+                                                 handlers),))
+        self.port = self._server.add_insecure_port(address)
+
+    # -- lifecycle --
+
+    def start(self) -> int:
+        self._server.start()
+        log.info("mixer grpc server on port %d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace).wait()
+
+    # -- RPCs --
+
+    def _check(self, request: "pb.CheckRequest", context) -> "pb.CheckResponse":
+        values = compressed_to_dict(request.attributes,
+                                    request.global_word_count or None)
+        # preprocess ONCE; precondition check and quota loop share the bag
+        bag = self.runtime.preprocess(bag_from_mapping(values))
+
+        resp = pb.CheckResponse()
+        result = self.runtime.check_preprocessed(bag)
+        resp.precondition.status.code = result.status_code
+        if result.status_message:
+            resp.precondition.status.message = result.status_message
+        resp.precondition.valid_duration.FromTimedelta(
+            datetime.timedelta(seconds=min(result.valid_duration_s,
+                                           _CLAMP_DURATION_S)))
+        resp.precondition.valid_use_count = min(result.valid_use_count,
+                                                2**31 - 1)
+        resp.precondition.referenced_attributes.CopyFrom(
+            referenced_to_proto(result.referenced, bag))
+
+        # quota loop (grpcServer.go:188-230): only on successful check
+        if result.status_code == 0:
+            for name, params in request.quotas.items():
+                args = QuotaArgs(quota_amount=params.amount,
+                                 best_effort=params.best_effort,
+                                 dedup_id=request.deduplication_id +
+                                 ":" + name if request.deduplication_id
+                                 else "")
+                qr = self.runtime.quota(bag, name, args,
+                                        preprocessed=True)
+                out = resp.quotas[name]
+                out.granted_amount = qr.granted_amount
+                out.valid_duration.FromTimedelta(datetime.timedelta(
+                    seconds=min(qr.valid_duration_s, _CLAMP_DURATION_S)))
+        return resp
+
+    def _report(self, request: "pb.ReportRequest",
+                context) -> "pb.ReportResponse":
+        bags = []
+        current: dict[str, Any] = {}
+        default_words = list(request.default_words)
+        for record in request.attributes:
+            # delta decode (grpcServer.go:262-353)
+            update_dict_from_proto(current, record,
+                                   request.global_word_count or None,
+                                   default_words)
+            bags.append(bag_from_mapping(dict(current)))
+        if bags:
+            self.runtime.report(bags)
+        return pb.ReportResponse()

@@ -1,0 +1,161 @@
+"""CompressedAttributes ↔ attribute-bag codec.
+
+Reference: mixer/pkg/attribute wire model — mutableBag.ToProto
+(mutableBag.go:230), ProtoBag lazy decode (protoBag.go:49,161), delta
+update for Report (UpdateBagFromProto :311).
+
+Index encoding (dictState.go / protoBag.go): an attribute name or
+string value is a sint32 `index`. index < 0 → global dictionary entry
+`-index - 1`; index >= 0 → per-message (or per-request default) word
+list entry. The global dictionary is the 169-word list in
+attribute/global_dict.py; both sides may agree on a shorter prefix via
+`global_word_count` (grpcServer.go global dict plumbing).
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Any, Mapping
+
+from istio_tpu.api import mixer_pb2 as pb
+from istio_tpu.attribute.global_dict import (GLOBAL_WORD_INDEX,
+                                             GLOBAL_WORD_LIST)
+
+
+class WireError(ValueError):
+    pass
+
+
+class _Words:
+    """Per-message word-list builder (dictState.go)."""
+
+    def __init__(self, global_count: int):
+        self.global_count = global_count
+        self.local: list[str] = []
+        self.index: dict[str, int] = {}
+
+    def ref(self, word: str) -> int:
+        gi = GLOBAL_WORD_INDEX.get(word)
+        if gi is not None and gi < self.global_count:
+            return -gi - 1
+        li = self.index.get(word)
+        if li is None:
+            li = len(self.local)
+            self.local.append(word)
+            self.index[word] = li
+        return li
+
+
+def _lookup(index: int, words: list[str], global_count: int) -> str:
+    if index < 0:
+        gi = -index - 1
+        if gi >= global_count or gi >= len(GLOBAL_WORD_LIST):
+            raise WireError(f"global word index {index} out of range")
+        return GLOBAL_WORD_LIST[gi]
+    if index >= len(words):
+        raise WireError(f"message word index {index} out of range")
+    return words[index]
+
+
+def bag_to_compressed(values: Mapping[str, Any],
+                      global_word_count: int | None = None,
+                      msg: "pb.CompressedAttributes | None" = None
+                      ) -> "pb.CompressedAttributes":
+    """Encode name→value attributes (mutableBag.ToProto)."""
+    gc = len(GLOBAL_WORD_LIST) if global_word_count is None \
+        else global_word_count
+    out = msg if msg is not None else pb.CompressedAttributes()
+    words = _Words(gc)
+    for name in sorted(values):
+        v = values[name]
+        k = words.ref(name)
+        if isinstance(v, bool):
+            out.bools[k] = v
+        elif isinstance(v, int):
+            out.int64s[k] = v
+        elif isinstance(v, float):
+            out.doubles[k] = v
+        elif isinstance(v, str):
+            out.strings[k] = words.ref(v)
+        elif isinstance(v, bytes):
+            out.bytes[k] = v
+        elif isinstance(v, datetime.datetime):
+            out.timestamps[k].FromDatetime(v)
+        elif isinstance(v, datetime.timedelta):
+            out.durations[k].FromTimedelta(v)
+        elif isinstance(v, Mapping):
+            sm = out.string_maps[k]
+            for mk in sorted(v):
+                sm.entries[words.ref(str(mk))] = words.ref(str(v[mk]))
+        else:
+            raise WireError(f"cannot encode {name}: {type(v)}")
+    out.words.extend(words.local)
+    return out
+
+
+def compressed_to_dict(msg: "pb.CompressedAttributes",
+                       global_word_count: int | None = None,
+                       default_words: list[str] | None = None
+                       ) -> dict[str, Any]:
+    """Decode to a plain dict (ProtoBag semantics; default_words are
+    the request-level word list Report uses when a record has none)."""
+    out: dict[str, Any] = {}
+    update_dict_from_proto(out, msg, global_word_count, default_words)
+    return out
+
+
+def update_dict_from_proto(target: dict[str, Any],
+                           msg: "pb.CompressedAttributes",
+                           global_word_count: int | None = None,
+                           default_words: list[str] | None = None) -> None:
+    """Delta-apply a record (UpdateBagFromProto mutableBag.go:311)."""
+    gc = len(GLOBAL_WORD_LIST) if global_word_count in (None, 0) \
+        else global_word_count
+    words = list(msg.words) or list(default_words or [])
+
+    def name(i: int) -> str:
+        return _lookup(i, words, gc)
+
+    for k, vi in msg.strings.items():
+        target[name(k)] = name(vi)
+    for k, v in msg.int64s.items():
+        target[name(k)] = int(v)
+    for k, v in msg.doubles.items():
+        target[name(k)] = float(v)
+    for k, v in msg.bools.items():
+        target[name(k)] = bool(v)
+    for k, ts in msg.timestamps.items():
+        target[name(k)] = ts.ToDatetime(
+            tzinfo=datetime.timezone.utc)
+    for k, d in msg.durations.items():
+        target[name(k)] = d.ToTimedelta()
+    for k, v in msg.bytes.items():
+        target[name(k)] = bytes(v)
+    for k, sm in msg.string_maps.items():
+        target[name(k)] = {name(ek): name(ev)
+                           for ek, ev in sm.entries.items()}
+
+
+def referenced_to_proto(referenced, bag) -> "pb.ReferencedAttributes":
+    """Build ReferencedAttributes from the dispatcher's referenced set
+    (names and (map, key) pairs): EXACT when the bag had the value,
+    ABSENCE when it did not (protoBag.go trackReference conditions)."""
+    out = pb.ReferencedAttributes()
+    words = _Words(len(GLOBAL_WORD_LIST))
+    words.ref("")   # reserve local index 0: proto3 default map_key=0
+    #               # must unambiguously mean "no map key"
+    for item in sorted(referenced, key=str):
+        m = out.attribute_matches.add()
+        if isinstance(item, tuple):
+            attr, key = item
+            m.name = words.ref(attr)
+            m.map_key = words.ref(key)
+            container, ok = bag.get(attr)
+            present = ok and isinstance(container, Mapping) \
+                and key in container
+        else:
+            m.name = words.ref(item)
+            _, present = bag.get(item)
+        m.condition = pb.ReferencedAttributes.EXACT if present \
+            else pb.ReferencedAttributes.ABSENCE
+    out.words.extend(words.local)
+    return out

@@ -91,18 +91,23 @@ class Snapshot:
 
     def actions_for(self, rule_idx: int,
                     variety: Variety) -> list[tuple[HandlerConfig, str, list[str]]]:
-        """[(handler cfg, template, instance names)] of one variety."""
+        """[(handler cfg, template, instance names)] of one variety —
+        one tuple PER TEMPLATE so a mixed action (e.g. stdio handling
+        both logentry and metric instances) dispatches each instance
+        under its own template."""
         out = []
         for action in self.rules[rule_idx].actions:
             h = self.handlers.get(action.handler)
             if h is None:
                 continue
-            insts = [n for n in action.instances
-                     if n in self.instances and
-                     template_registry.get(
-                         self.instance_templates[n]).variety == variety]
-            if insts:
-                tmpl = self.instance_templates[insts[0]]
+            by_template: dict[str, list[str]] = {}
+            for n in action.instances:
+                if n not in self.instances:
+                    continue
+                tmpl = self.instance_templates[n]
+                if template_registry.get(tmpl).variety == variety:
+                    by_template.setdefault(tmpl, []).append(n)
+            for tmpl, insts in by_template.items():
                 out.append((h, tmpl, insts))
         return out
 

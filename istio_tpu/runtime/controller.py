@@ -39,6 +39,7 @@ class Controller:
                                         InternTable(), max_str_len)
         self._handler_table = HandlerTable()
         self._lock = threading.Lock()
+        self._rebuild_serial = threading.Lock()   # one rebuild at a time
         self._timer: threading.Timer | None = None
         self._dispatcher: Dispatcher | None = None
         self.rebuild()                      # initial snapshot
@@ -66,6 +67,10 @@ class Controller:
     ORPHAN_DRAIN_S = 2.0
 
     def rebuild(self) -> Dispatcher:
+        with self._rebuild_serial:
+            return self._rebuild_locked()
+
+    def _rebuild_locked(self) -> Dispatcher:
         snapshot = self._builder.build(self.store)
         handlers, orphans = self._handler_table.rebuild(snapshot)
         for err in snapshot.errors:

@@ -70,6 +70,12 @@ class Dispatcher:
         self.handlers = dict(handlers)
         self.identity_attr = identity_attr
 
+    def _handler_for(self, hc) -> Handler | None:
+        """Built handler for a HandlerConfig (single home of the
+        namespace-qualification rule, see config._qualify)."""
+        from istio_tpu.runtime.config import _qualify
+        return self.handlers.get(_qualify(hc.name, hc.namespace))
+
     # ------------------------------------------------------------------
     # resolution
     # ------------------------------------------------------------------
@@ -129,13 +135,13 @@ class Dispatcher:
         for ridx in rule_idxs:
             for hc, template, inst_names in snap.actions_for(
                     ridx, Variety.CHECK):
-                handler = self.handlers.get(f"{hc.name}.{hc.namespace}"
-                                            if hc.namespace else hc.name)
+                handler = self._handler_for(hc)
                 if handler is None:
                     continue
                 for iname in inst_names:
-                    result = self._safe_check(handler, template,
-                                              snap.instances[iname], bag)
+                    ib = snap.instances[iname]
+                    referenced |= ib.referenced_attrs
+                    result = self._safe_check(handler, template, ib, bag)
                     self._combine(resp, result)
         resp.referenced = tuple(sorted(referenced, key=str))
         return resp
@@ -177,9 +183,7 @@ class Dispatcher:
             for ridx in rule_idxs:
                 for hc, template, inst_names in self.snapshot.actions_for(
                         ridx, Variety.REPORT):
-                    handler = self.handlers.get(
-                        f"{hc.name}.{hc.namespace}" if hc.namespace
-                        else hc.name)
+                    handler = self._handler_for(hc)
                     if handler is None:
                         continue
                     instances = []
@@ -209,9 +213,7 @@ class Dispatcher:
                     if iname.split(".")[0] != quota_name and \
                             iname != quota_name:
                         continue
-                    handler = self.handlers.get(
-                        f"{hc.name}.{hc.namespace}" if hc.namespace
-                        else hc.name)
+                    handler = self._handler_for(hc)
                     if handler is None:
                         continue
                     try:
@@ -241,9 +243,7 @@ class Dispatcher:
         for ridx in actives:
             for hc, template, inst_names in self.snapshot.actions_for(
                     ridx, Variety.ATTRIBUTE_GENERATOR):
-                handler = self.handlers.get(
-                    f"{hc.name}.{hc.namespace}" if hc.namespace
-                    else hc.name)
+                handler = self._handler_for(hc)
                 if handler is None:
                     continue
                 for iname in inst_names:

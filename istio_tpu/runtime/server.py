@@ -46,33 +46,43 @@ class RuntimeServer:
                                     max_batch=self.args.max_batch)
 
     # -- API surface (grpcServer.go Check/Report semantics) --
+    # Preprocessing (the APA phase) happens exactly ONCE per request, in
+    # the caller-facing entry points; everything downstream of the
+    # batcher operates on already-preprocessed bags.
+
+    def preprocess(self, bag: Bag) -> Bag:
+        if not self.args.preprocess:
+            return bag
+        return self.controller.dispatcher.preprocess(bag)
 
     def _run_check_batch(self,
                          bags: Sequence[Bag]) -> Sequence[CheckResponse]:
-        d = self.controller.dispatcher
-        if self.args.preprocess:
-            bags = [d.preprocess(bag) for bag in bags]
-        return d.check(bags)
+        return self.controller.dispatcher.check(bags)
 
     def check(self, bag: Bag) -> CheckResponse:
         """One request; coalesced into a device batch."""
+        return self.batcher.check(self.preprocess(bag))
+
+    def check_preprocessed(self, bag: Bag) -> CheckResponse:
+        """Batcher entry for callers that already ran preprocess()
+        (the gRPC server, which reuses the bag for the quota loop)."""
         return self.batcher.check(bag)
 
     def check_many(self, bags: Sequence[Bag]) -> list[CheckResponse]:
         """Pre-batched entry (load tests / the C++ shim's batches)."""
-        return list(self._run_check_batch(bags))
+        return list(self._run_check_batch(
+            [self.preprocess(b) for b in bags]))
 
     def report(self, bags: Sequence[Bag]) -> None:
         d = self.controller.dispatcher
-        if self.args.preprocess:
-            bags = [d.preprocess(bag) for bag in bags]
-        d.report(bags)
+        d.report([self.preprocess(b) for b in bags])
 
     def quota(self, bag: Bag, quota_name: str,
-              args: QuotaArgs | None = None) -> QuotaResult:
+              args: QuotaArgs | None = None,
+              preprocessed: bool = False) -> QuotaResult:
         d = self.controller.dispatcher
-        if self.args.preprocess:
-            bag = d.preprocess(bag)
+        if not preprocessed:
+            bag = self.preprocess(bag)
         return d.quota(bag, quota_name, args or QuotaArgs())
 
     def close(self) -> None:

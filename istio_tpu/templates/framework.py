@@ -149,6 +149,25 @@ def infer_types(info: TemplateInfo, params: Mapping[str, Any],
 # Instance construction (reference ProcessXxxFn instance build half)
 # ---------------------------------------------------------------------------
 
+def _collect_attrs(e, out: set) -> None:
+    """Attribute names + (map, const-key) pairs an expression reads."""
+    if e.var is not None:
+        out.add(e.var.name)
+        return
+    f = e.fn
+    if f is None:
+        return
+    if (f.name == "INDEX" and f.args[0].var is not None
+            and f.args[1].const_ is not None):
+        out.add(f.args[0].var.name)
+        out.add((f.args[0].var.name, f.args[1].const_.value))
+        return
+    if f.target is not None:
+        _collect_attrs(f.target, out)
+    for a in f.args:
+        _collect_attrs(a, out)
+
+
 class InstanceBuilder:
     """Compiles one instance config's expressions; `build(bag)` →
     instance dict. Evaluation failure raises EvalError (the dispatcher
@@ -160,6 +179,9 @@ class InstanceBuilder:
         self.info = info
         self.name = name
         self.inferred = infer_types(info, params, finder)
+        # attributes (incl. (map, key) pairs) this instance's field
+        # expressions read — feeds ReferencedAttributes (protoBag.go:117)
+        self.referenced_attrs: set = set()
         self._plan = self._compile(info.fields, params, finder)
 
     def _compile(self, fields: tuple[Field, ...], params: Mapping[str, Any],
@@ -175,11 +197,15 @@ class InstanceBuilder:
                 plan.append((f.name, "sub",
                              self._compile(f.submessage, raw, finder)))
             elif f.expr_map:
-                plan.append((f.name, "map",
-                             {k: OracleProgram(v, finder)
-                              for k, v in raw.items()}))
+                progs = {k: OracleProgram(v, finder)
+                         for k, v in raw.items()}
+                for p in progs.values():
+                    _collect_attrs(p.ast, self.referenced_attrs)
+                plan.append((f.name, "map", progs))
             else:
-                plan.append((f.name, "expr", OracleProgram(raw, finder)))
+                prog = OracleProgram(raw, finder)
+                _collect_attrs(prog.ast, self.referenced_attrs)
+                plan.append((f.name, "expr", prog))
         return plan
 
     def build(self, bag: Bag) -> dict[str, Any]:

@@ -1,0 +1,160 @@
+"""istio.mixer.v1 gRPC e2e: a real grpcio server + client over
+localhost, dictionary-compressed attributes both ways, quota loop in
+Check, delta-coded Report, and client-side check caching driven by
+ReferencedAttributes (the mixerclient contract).
+
+Reference pattern: mixer/pkg/mockapi + mixer/pkg/api tests.
+"""
+import datetime
+
+import pytest
+
+from istio_tpu.api import MixerClient, MixerGrpcServer, mixer_pb2 as pb
+from istio_tpu.api.wire import bag_to_compressed, compressed_to_dict
+from istio_tpu.models.policy_engine import (NOT_FOUND, OK,
+                                            PERMISSION_DENIED)
+from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+
+
+def _store() -> MemStore:
+    s = MemStore()
+    s.set(("handler", "istio-system", "wl"), {
+        "adapter": "list", "params": {"overrides": ["v1", "v2"]}})
+    s.set(("handler", "istio-system", "mq"), {
+        "adapter": "memquota",
+        "params": {"quotas": [{"name": "rq.istio-system",
+                               "max_amount": 3,
+                               "valid_duration_s": 600.0}]}})
+    s.set(("instance", "istio-system", "ver"), {
+        "template": "listentry",
+        "params": {"value": 'source.labels["version"] | "none"'}})
+    s.set(("instance", "istio-system", "rq"), {
+        "template": "quota", "params": {"dimensions": {}}})
+    s.set(("rule", "istio-system", "r"), {
+        "match": "",
+        "actions": [{"handler": "wl", "instances": ["ver"]},
+                    {"handler": "mq", "instances": ["rq"]}]})
+    return s
+
+
+@pytest.fixture(scope="module")
+def rig():
+    runtime = RuntimeServer(_store(), ServerArgs(batch_window_s=0.001,
+                                                 max_batch=64))
+    server = MixerGrpcServer(runtime)
+    port = server.start()
+    client = MixerClient(f"127.0.0.1:{port}", enable_check_cache=False)
+    cached = MixerClient(f"127.0.0.1:{port}", enable_check_cache=True)
+    yield runtime, server, client, cached
+    client.close(); cached.close()
+    server.stop(); runtime.close()
+
+
+def test_wire_roundtrip():
+    now = datetime.datetime(2018, 1, 7, tzinfo=datetime.timezone.utc)
+    values = {
+        "source.ip": b"\x00" * 10 + b"\xff\xff" + bytes([10, 0, 0, 1]),
+        "request.path": "/reviews/1",          # local word value
+        "request.size": 1234,
+        "request.time": now,
+        "response.duration": datetime.timedelta(milliseconds=20),
+        "connection.mtls": True,
+        "request.headers": {":path": "/reviews/1", "cookie": "x=1"},
+    }
+    msg = bag_to_compressed(values)
+    # canonical names ride the global dictionary, not message words
+    assert "request.path" not in msg.words and ":path" not in msg.words
+    assert "cookie" not in msg.words      # header words are global too
+    assert "/reviews/1" in msg.words      # payload strings are local
+    assert compressed_to_dict(msg) == values
+
+
+def test_check_allow_and_deny(rig):
+    _, _, client, _ = rig
+    ok = client.check({"destination.service": "a.b.svc",
+                       "source.labels": {"version": "v1"}})
+    assert ok.precondition.status.code == OK
+    assert ok.precondition.valid_use_count > 0
+    bad = client.check({"destination.service": "a.b.svc",
+                        "source.labels": {"version": "v7"}})
+    assert bad.precondition.status.code == NOT_FOUND
+    assert "rejected" in bad.precondition.status.message
+
+
+def test_check_quota_loop(rig):
+    _, _, client, _ = rig
+    r = client.check({"destination.service": "q.b.svc",
+                      "source.labels": {"version": "v1"}},
+                     quotas={"rq": 2})
+    assert r.quotas["rq"].granted_amount == 2
+    r2 = client.check({"destination.service": "q.b.svc",
+                       "source.labels": {"version": "v1"}},
+                      quotas={"rq": 5})
+    assert r2.quotas["rq"].granted_amount == 1    # best-effort remainder
+    # dedup replay: same dedup_id returns the original grant
+    r3 = client.check({"destination.service": "q.b.svc",
+                       "source.labels": {"version": "v1"}},
+                      quotas={"rq": 2}, dedup_id="same-rpc")
+    r4 = client.check({"destination.service": "q.b.svc",
+                       "source.labels": {"version": "v1"}},
+                      quotas={"rq": 2}, dedup_id="same-rpc")
+    assert r3.quotas["rq"].granted_amount == \
+        r4.quotas["rq"].granted_amount
+
+
+def test_referenced_attributes_on_wire(rig):
+    _, _, client, _ = rig
+    r = client.check({"destination.service": "a.b.svc",
+                      "source.labels": {"version": "v1"}})
+    ref = r.precondition.referenced_attributes
+    assert len(ref.attribute_matches) > 0
+    conds = {m.condition for m in ref.attribute_matches}
+    assert pb.ReferencedAttributes.EXACT in conds
+
+
+def test_client_check_cache(rig):
+    runtime, _, _, cached = rig
+    values = {"destination.service": "cache.b.svc",
+              "source.labels": {"version": "v1"}}
+    r1 = cached.check(values)
+    before = runtime.controller.dispatcher  # count via monitor is global;
+    r2 = cached.check(values)               # identical → served from cache
+    assert r2 is r1
+    # different referenced value → miss
+    r3 = cached.check({"destination.service": "cache.b.svc",
+                       "source.labels": {"version": "v2"}})
+    assert r3 is not r1
+
+
+def test_report_delta_coding(rig):
+    runtime, _, client, _ = rig
+    store = runtime.controller.store
+    store.set(("handler", "istio-system", "prom2"), {
+        "adapter": "prometheus",
+        "params": {"metrics": [{"name": "bytes.istio-system",
+                                "kind": "COUNTER",
+                                "label_names": ["dest"]}]}})
+    store.set(("instance", "istio-system", "bytes"), {
+        "template": "metric",
+        "params": {"value": "response.size",
+                   "dimensions": {"dest": "destination.service"}}})
+    store.set(("rule", "istio-system", "tally2"), {
+        "match": "",
+        "actions": [{"handler": "prom2", "instances": ["bytes"]}]})
+    import time
+    time.sleep(0.4)   # debounce + rebuild
+    client.report([
+        {"destination.service": "d1.ns.svc", "response.size": 100,
+         "source.labels": {"version": "v1"}},
+        {"destination.service": "d1.ns.svc", "response.size": 50,
+         "source.labels": {"version": "v1"}},   # delta: only size changes
+        {"destination.service": "d2.ns.svc", "response.size": 7,
+         "source.labels": {"version": "v1"}},
+    ])
+    handler = runtime.controller.dispatcher.handlers["prom2.istio-system"]
+    assert handler.registry.get_sample_value(
+        "istio_tpu_bytes_istio_system_total",
+        {"dest": "d1.ns.svc"}) == 150.0
+    assert handler.registry.get_sample_value(
+        "istio_tpu_bytes_istio_system_total",
+        {"dest": "d2.ns.svc"}) == 7.0
