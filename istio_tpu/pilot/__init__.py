@@ -1,0 +1,23 @@
+"""Pilot — the proxy-config control plane (reference: pilot/, SURVEY.md
+§2.6): an abstract service/routing model populated by platform
+registries, compiled into per-sidecar Envoy v1 JSON configuration and
+served over the v1 REST discovery API (SDS/CDS/RDS/LDS) with a
+wholesale-invalidated response cache; plus the sidecar agent that
+manages Envoy hot-restart epochs.
+
+TPU tie-in (BASELINE.json shared-automaton requirement): route-rule
+header/URI matches are ALSO compiled into the same ruleset tensors the
+policy engine runs (pilot/route_nfa.py), so L7 route selection for a
+batch of requests is one device step.
+"""
+from istio_tpu.pilot.model import (Config, ConfigMeta, ConfigStore,
+                                   IstioConfigStore, MemoryConfigStore,
+                                   NetworkEndpoint, Port, Service,
+                                   ServiceInstance, ValidationError)
+from istio_tpu.pilot.registry import (AggregateRegistry, MemoryRegistry,
+                                      ServiceDiscovery)
+
+__all__ = ["Config", "ConfigMeta", "ConfigStore", "IstioConfigStore",
+           "MemoryConfigStore", "NetworkEndpoint", "Port", "Service",
+           "ServiceInstance", "ValidationError", "AggregateRegistry",
+           "MemoryRegistry", "ServiceDiscovery"]

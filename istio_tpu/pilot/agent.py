@@ -1,0 +1,190 @@
+"""Sidecar agent: proxy lifecycle with hot-restart epochs.
+
+Reference: pilot/pkg/proxy/agent.go (design doc :34-58): the agent
+reconciles desired config against the set of running proxy epochs.
+Every config change starts epoch N+1 (`envoy --restart-epoch N+1`
+drains the old process); a crashed epoch is retried with an
+exponential-backoff budget (Retry :102); agent shutdown aborts all
+epochs (:300). The Proxy is injectable (tests use an in-process fake;
+production wraps the envoy binary exactly like envoy.go + the
+per-epoch config files watcher.go:233 writes).
+
+Cert watcher (envoy/watcher.go:84-210): hashes the watched cert paths
+and schedules a reconcile when the hash changes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+log = logging.getLogger("istio_tpu.pilot.agent")
+
+MAX_RETRIES = 10
+INITIAL_BACKOFF_S = 0.2
+
+
+class Proxy:
+    """envoy.go Proxy contract: run/cleanup/panic per epoch."""
+
+    def run(self, config: Any, epoch: int,
+            abort: threading.Event) -> None:
+        """Blocks until the epoch exits; raise on abnormal exit."""
+        raise NotImplementedError
+
+    def cleanup(self, epoch: int) -> None:
+        pass
+
+
+@dataclasses.dataclass
+class _Epoch:
+    config: Any
+    epoch: int
+    abort: threading.Event
+    thread: threading.Thread
+
+
+class Agent:
+    """agent.go NewAgent/Run/ScheduleConfigUpdate."""
+
+    def __init__(self, proxy: Proxy):
+        self.proxy = proxy
+        self._lock = threading.Lock()
+        self._desired: Any = None
+        self._epochs: dict[int, _Epoch] = {}
+        self._current_config: Any = object()   # sentinel ≠ any config
+        self._retries = 0
+        self._retry_timer: threading.Timer | None = None
+        self._shutdown = False
+
+    # -- public --
+
+    def schedule_config_update(self, config: Any) -> None:
+        """watcher → agent: desired config changed (agent.go:92). A new
+        desired config gets a FRESH retry budget (agent.go resets the
+        budget per reconcile; a crash-looping old config must not
+        exhaust retries for its replacement)."""
+        with self._lock:
+            if config != self._desired:
+                self._retries = 0
+            self._desired = config
+        self._reconcile()
+
+    def active_epochs(self) -> list[int]:
+        with self._lock:
+            return sorted(e for e, ep in self._epochs.items()
+                          if ep.thread.is_alive())
+
+    def close(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            if self._retry_timer is not None:
+                self._retry_timer.cancel()
+            epochs = list(self._epochs.values())
+        for ep in epochs:      # abortAll (agent.go:300)
+            ep.abort.set()
+        for ep in epochs:
+            ep.thread.join(timeout=5)
+
+    # -- internals --
+
+    def _reconcile(self) -> None:
+        """agent.go:259 reconcile: spawn a new epoch iff the desired
+        config differs from the latest running epoch's config."""
+        with self._lock:
+            if self._shutdown:
+                return
+            if self._desired == self._current_config:
+                return
+            epoch = (max(self._epochs) + 1) if self._epochs else 0
+            abort = threading.Event()
+            config = self._desired
+            ep = _Epoch(config=config, epoch=epoch, abort=abort,
+                        thread=threading.Thread(
+                            target=self._run_epoch,
+                            args=(config, epoch, abort),
+                            daemon=True, name=f"proxy-epoch-{epoch}"))
+            self._epochs[epoch] = ep
+            self._current_config = config
+        log.info("starting proxy epoch %d", epoch)
+        ep.thread.start()
+
+    def _run_epoch(self, config: Any, epoch: int,
+                   abort: threading.Event) -> None:
+        try:
+            self.proxy.run(config, epoch, abort)
+            with self._lock:
+                self._retries = 0
+        except Exception as exc:
+            log.warning("epoch %d died: %s", epoch, exc)
+            self._schedule_retry(config, epoch)
+        finally:
+            self.proxy.cleanup(epoch)
+            with self._lock:
+                self._epochs.pop(epoch, None)
+
+    def _schedule_retry(self, config: Any, epoch: int) -> None:
+        """Exponential backoff restart budget (agent.go:102 Retry)."""
+        with self._lock:
+            if self._shutdown:
+                return
+            if self._retries >= MAX_RETRIES:
+                log.error("retry budget exhausted for epoch %d", epoch)
+                return
+            delay = INITIAL_BACKOFF_S * (2 ** self._retries)
+            self._retries += 1
+            self._current_config = object()    # force respawn
+            self._retry_timer = threading.Timer(delay, self._reconcile)
+            self._retry_timer.daemon = True
+            self._retry_timer.start()
+        log.info("retry %d for proxy in %.1fs", self._retries, delay)
+
+
+class CertWatcher:
+    """envoy/watcher.go:84-210: poll cert paths, SHA-256 the contents,
+    fire the callback (agent.ScheduleConfigUpdate) on change."""
+
+    def __init__(self, paths: list[str], on_change: Callable[[str], None],
+                 poll_s: float = 0.5):
+        self.paths = list(paths)
+        self.on_change = on_change
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="cert-watcher")
+        self._last = self.hash_certs()
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def hash_certs(self) -> str:
+        h = hashlib.sha256()
+        for path in sorted(self.paths):
+            h.update(path.encode())
+            try:
+                if os.path.isdir(path):
+                    for name in sorted(os.listdir(path)):
+                        with open(os.path.join(path, name), "rb") as f:
+                            h.update(f.read())
+                else:
+                    with open(path, "rb") as f:
+                        h.update(f.read())
+            except OSError:
+                h.update(b"<missing>")
+        return h.hexdigest()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            current = self.hash_certs()
+            if current != self._last:
+                self._last = current
+                log.info("certs changed; scheduling proxy update")
+                self.on_change(current)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

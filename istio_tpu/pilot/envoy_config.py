@@ -1,0 +1,276 @@
+"""Envoy v1 bootstrap/listener/cluster generation.
+
+Reference: pilot/pkg/proxy/envoy/config.go — BuildConfig (:81,
+bootstrap with RDS/admin/tracing/mixer cluster), buildListeners (:136),
+sidecar in/outbound (:199,:496,:707); policy.go applyClusterPolicy
+(:39: circuit breakers :179, outlier detection :152, LB :128);
+mixer.go FilterMixerConfig (:82); resources.go JSON shapes.
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping, Sequence
+
+from istio_tpu.pilot.model import (IstioConfigStore, Port, Service,
+                                   ServiceInstance)
+from istio_tpu.pilot.registry import ServiceDiscovery
+from istio_tpu.pilot.routes import (build_route_config, cluster_name,
+                                    inbound_cluster_name, default_route,
+                                    build_fault_filter)
+
+DEFAULT_ADMIN_PORT = 15000
+DEFAULT_DISCOVERY_REFRESH_MS = 1000
+
+
+# ---------------------------------------------------------------------------
+# clusters
+# ---------------------------------------------------------------------------
+
+def build_outbound_clusters(services: Sequence[Service],
+                            config_store: IstioConfigStore
+                            ) -> list[dict[str, Any]]:
+    clusters: dict[str, dict[str, Any]] = {}
+    for service in services:
+        # rule scan is port-independent — hoisted out of the port loop
+        label_sets: list[Mapping[str, str] | None] = [None]
+        for rule in config_store.route_rules(service.hostname):
+            for block in rule.spec.get("route", ()):
+                labels = block.get("labels") or block.get("tags")
+                if labels:
+                    label_sets.append(labels)
+            if rule.spec.get("mirror", {}).get("labels"):
+                label_sets.append(rule.spec["mirror"]["labels"])
+        policy = config_store.destination_policy(service.hostname)
+        for port in service.ports:
+            for labels in label_sets:
+                name = cluster_name(service.hostname, port, labels)
+                if name in clusters:
+                    continue
+                cluster: dict[str, Any] = {
+                    "name": name,
+                    "type": "sds",
+                    "service_name": service.key(port) + (
+                        "|" + ",".join(f"{k}={v}" for k, v in
+                                       sorted(labels.items()))
+                        if labels else ""),
+                    "lb_type": "round_robin",
+                    "connect_timeout_ms": 1000,
+                }
+                if port.protocol in ("HTTP2", "GRPC"):
+                    cluster["features"] = "http2"
+                _apply_cluster_policy(cluster, policy)
+                clusters[name] = cluster
+    return [clusters[k] for k in sorted(clusters)]
+
+
+def _apply_cluster_policy(cluster: dict[str, Any],
+                          policy: "Any | None") -> None:
+    """policy.go:39 applyClusterPolicy."""
+    if policy is None:
+        return
+    lb = policy.spec.get("loadBalancing", {})
+    if lb.get("name"):
+        cluster["lb_type"] = {"ROUND_ROBIN": "round_robin",
+                              "LEAST_CONN": "least_request",
+                              "RANDOM": "random"}.get(lb["name"],
+                                                      "round_robin")
+    cb = policy.spec.get("circuitBreaker", {}).get("simpleCb", {})
+    if cb:
+        thresholds: dict[str, Any] = {}
+        if "maxConnections" in cb:
+            thresholds["max_connections"] = int(cb["maxConnections"])
+        if "httpMaxPendingRequests" in cb:
+            thresholds["max_pending_requests"] = \
+                int(cb["httpMaxPendingRequests"])
+        if "httpMaxRequests" in cb:
+            thresholds["max_requests"] = int(cb["httpMaxRequests"])
+        if "httpMaxRetries" in cb:
+            thresholds["max_retries"] = int(cb["httpMaxRetries"])
+        cluster["circuit_breakers"] = {"default": thresholds}
+        outlier: dict[str, Any] = {}
+        if "httpConsecutiveErrors" in cb:
+            outlier["consecutive_5xx"] = int(cb["httpConsecutiveErrors"])
+        if "httpDetectionInterval" in cb:
+            iv = cb["httpDetectionInterval"]
+            outlier["interval_ms"] = int(float(str(iv).rstrip("s")) * 1000)
+        if "sleepWindow" in cb:
+            sw = cb["sleepWindow"]
+            outlier["base_ejection_time_ms"] = \
+                int(float(str(sw).rstrip("s")) * 1000)
+        if outlier:
+            cluster["outlier_detection"] = outlier
+
+
+def build_inbound_clusters(instances: Sequence[ServiceInstance]
+                           ) -> list[dict[str, Any]]:
+    clusters = {}
+    for inst in instances:
+        name = inbound_cluster_name(inst.endpoint.port)
+        clusters[name] = {
+            "name": name, "type": "static", "lb_type": "round_robin",
+            "connect_timeout_ms": 1000,
+            "hosts": [{"url": f"tcp://127.0.0.1:{inst.endpoint.port}"}]}
+    return [clusters[k] for k in sorted(clusters)]
+
+
+# ---------------------------------------------------------------------------
+# listeners
+# ---------------------------------------------------------------------------
+
+def _http_filters(mesh: Mapping[str, Any],
+                  fault: dict[str, Any] | None = None) -> list[dict]:
+    filters = []
+    if fault:
+        filters.append(fault)
+    if mesh.get("mixer_address"):
+        # mixer.go:82 FilterMixerConfig
+        filters.append({"type": "decoder", "name": "mixer", "config": {
+            "mixer_attributes": {
+                "destination.uid": mesh.get("node_uid", ""),
+            },
+            "forward_attributes": {
+                "source.uid": mesh.get("node_uid", ""),
+            },
+            "quota_name": "RequestCount",
+        }})
+    filters.append({"type": "decoder", "name": "router", "config": {}})
+    return filters
+
+
+def build_outbound_listeners(services: Sequence[Service],
+                             config_store: IstioConfigStore,
+                             mesh: Mapping[str, Any]) -> list[dict]:
+    """One HTTP listener per outbound port using RDS; TCP services get
+    tcp_proxy with explicit routes (config.go:496)."""
+    listeners: dict[int, dict[str, Any]] = {}
+    kinds: dict[int, str] = {}    # port → http|tcp (conflict tracking)
+    for service in services:
+        for port in service.ports:
+            kind = "http" if port.is_http else "tcp"
+            if port.port in kinds and kinds[port.port] != kind:
+                # protocol conflict on a shared port: first writer wins,
+                # like the reference's listener-conflict logging
+                import logging
+                logging.getLogger("istio_tpu.pilot").warning(
+                    "listener conflict on port %d: %s vs %s (%s dropped)",
+                    port.port, kinds[port.port], kind, service.hostname)
+                continue
+            kinds[port.port] = kind
+            if port.is_http:
+                if port.port in listeners:
+                    continue
+                listeners[port.port] = {
+                    "address": f"tcp://0.0.0.0:{port.port}",
+                    "name": f"http_0.0.0.0_{port.port}",
+                    "filters": [{
+                        "type": "read", "name": "http_connection_manager",
+                        "config": {
+                            "codec_type": "auto",
+                            "stat_prefix": "http",
+                            "rds": {
+                                "cluster": "rds",
+                                "route_config_name": str(port.port),
+                                "refresh_delay_ms":
+                                    DEFAULT_DISCOVERY_REFRESH_MS},
+                            "filters": _http_filters(mesh),
+                        }}],
+                }
+            else:
+                key = port.port
+                tcp_route = {"cluster": cluster_name(service.hostname,
+                                                     port)}
+                if service.address and service.address != "0.0.0.0":
+                    tcp_route["destination_ip_list"] = \
+                        [f"{service.address}/32"]
+                entry = listeners.setdefault(key, {
+                    "address": f"tcp://0.0.0.0:{port.port}",
+                    "name": f"tcp_0.0.0.0_{port.port}",
+                    "filters": [{"type": "read", "name": "tcp_proxy",
+                                 "config": {"stat_prefix": "tcp",
+                                            "route_config":
+                                                {"routes": []}}}]})
+                entry["filters"][0]["config"]["route_config"]["routes"] \
+                    .append(tcp_route)
+    return [listeners[k] for k in sorted(listeners)]
+
+
+def build_inbound_listeners(instances: Sequence[ServiceInstance],
+                            mesh: Mapping[str, Any]) -> list[dict]:
+    """Per-endpoint-port inbound listeners (config.go:707)."""
+    listeners = {}
+    for inst in instances:
+        port = inst.endpoint.port
+        if port in listeners:
+            continue
+        sp = inst.endpoint.service_port
+        if sp.is_http:
+            vhost = {"name": "inbound", "domains": ["*"], "routes": [
+                {"prefix": "/", "cluster": inbound_cluster_name(port),
+                 "timeout_ms": 0}]}
+            listeners[port] = {
+                "address": f"tcp://{inst.endpoint.address}:{port}",
+                "name": f"http_{inst.endpoint.address}_{port}",
+                "filters": [{
+                    "type": "read", "name": "http_connection_manager",
+                    "config": {"codec_type": "auto",
+                               "stat_prefix": "http",
+                               "route_config": {"virtual_hosts": [vhost]},
+                               "filters": _http_filters(mesh)}}],
+            }
+        else:
+            listeners[port] = {
+                "address": f"tcp://{inst.endpoint.address}:{port}",
+                "name": f"tcp_{inst.endpoint.address}_{port}",
+                "filters": [{"type": "read", "name": "tcp_proxy",
+                             "config": {"stat_prefix": "tcp",
+                                        "route_config": {"routes": [
+                                            {"cluster":
+                                             inbound_cluster_name(port)}]}}}]}
+    return [listeners[k] for k in sorted(listeners)]
+
+
+# ---------------------------------------------------------------------------
+# bootstrap (config.go:81 BuildConfig)
+# ---------------------------------------------------------------------------
+
+def build_bootstrap(mesh: Mapping[str, Any]) -> dict[str, Any]:
+    discovery = mesh.get("discovery_address", "127.0.0.1:8080")
+    config: dict[str, Any] = {
+        "admin": {"access_log_path": "/dev/stdout",
+                  "address": f"tcp://127.0.0.1:"
+                             f"{mesh.get('admin_port', DEFAULT_ADMIN_PORT)}"},
+        "listeners": [],
+        "lds": {"cluster": "lds", "refresh_delay_ms":
+                DEFAULT_DISCOVERY_REFRESH_MS},
+        "cluster_manager": {
+            "clusters": [
+                {"name": "rds", "type": "strict_dns",
+                 "lb_type": "round_robin", "connect_timeout_ms": 1000,
+                 "hosts": [{"url": f"tcp://{discovery}"}]},
+                {"name": "lds", "type": "strict_dns",
+                 "lb_type": "round_robin", "connect_timeout_ms": 1000,
+                 "hosts": [{"url": f"tcp://{discovery}"}]},
+            ],
+            "sds": {"cluster": {"name": "sds", "type": "strict_dns",
+                                "lb_type": "round_robin",
+                                "connect_timeout_ms": 1000,
+                                "hosts": [{"url": f"tcp://{discovery}"}]},
+                    "refresh_delay_ms": DEFAULT_DISCOVERY_REFRESH_MS},
+        },
+    }
+    if mesh.get("mixer_address"):
+        config["cluster_manager"]["clusters"].append(
+            {"name": "mixer_server", "type": "strict_dns",
+             "lb_type": "round_robin", "connect_timeout_ms": 1000,
+             "features": "http2",
+             "hosts": [{"url": f"tcp://{mesh['mixer_address']}"}]})
+    if mesh.get("zipkin_address"):
+        # route.go:534 buildZipkinTracing
+        config["tracing"] = {"http": {"driver": {
+            "type": "zipkin",
+            "config": {"collector_cluster": "zipkin",
+                       "collector_endpoint": "/api/v1/spans"}}}}
+        config["cluster_manager"]["clusters"].append(
+            {"name": "zipkin", "type": "strict_dns",
+             "lb_type": "round_robin", "connect_timeout_ms": 1000,
+             "hosts": [{"url": f"tcp://{mesh['zipkin_address']}"}]})
+    return config

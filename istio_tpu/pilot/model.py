@@ -1,0 +1,372 @@
+"""Pilot abstract model: services, instances, config resources.
+
+Reference: pilot/pkg/model — Service (service.go:44), NetworkEndpoint
+(:170), ServiceInstance (:211), Config/ConfigMeta (config.go:34-108),
+ConfigStore (:110), ProtoSchema registry `IstioConfigTypes`
+(config.go:407-418), IstioConfigStore queries (:227-265), and per-kind
+validation (validation.go). Specs are plain dicts validated per kind
+(the reference validates protobufs; the shapes match the v1alpha1/2
+route-rule schemas so reference YAML translates 1:1).
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+import threading
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+
+class ValidationError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# services
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Port:
+    """service.go:96 Port{Name, Port, Protocol}."""
+    name: str
+    port: int
+    protocol: str = "HTTP"   # HTTP|HTTPS|HTTP2|GRPC|TCP|UDP|MONGO|REDIS
+
+    @property
+    def is_http(self) -> bool:
+        return self.protocol in ("HTTP", "HTTP2", "GRPC", "HTTPS")
+
+
+@dataclasses.dataclass(frozen=True)
+class Service:
+    """service.go:44 Service{Hostname, Address, Ports, ...}."""
+    hostname: str
+    address: str = "0.0.0.0"
+    ports: tuple[Port, ...] = ()
+    external_name: str = ""       # ExternalName for mesh-external
+    service_account: str = ""
+
+    @property
+    def namespace(self) -> str:
+        parts = self.hostname.split(".")
+        return parts[1] if len(parts) > 1 else ""
+
+    def port_by_name(self, name: str) -> Port | None:
+        for p in self.ports:
+            if p.name == name:
+                return p
+        return None
+
+    def key(self, port: Port) -> str:
+        return f"{self.hostname}|{port.name}"
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkEndpoint:
+    """service.go:170 — one addressable instance port."""
+    address: str
+    port: int
+    service_port: Port
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceInstance:
+    """service.go:211 — endpoint + owning service + labels."""
+    endpoint: NetworkEndpoint
+    service: Service
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    availability_zone: str = ""
+    service_account: str = ""
+
+
+# ---------------------------------------------------------------------------
+# config resources
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ConfigMeta:
+    """config.go:34 ConfigMeta."""
+    type: str
+    name: str
+    namespace: str = ""
+    domain: str = "cluster.local"
+    labels: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    annotations: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    resource_version: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    meta: ConfigMeta
+    spec: Mapping[str, Any]
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.meta.type, self.meta.namespace, self.meta.name)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtoSchema:
+    """config.go:181 — type descriptor + validator."""
+    type: str
+    plural: str
+    validate: Callable[[Mapping[str, Any]], None]
+
+
+def _validate_route_rule(spec: Mapping[str, Any]) -> None:
+    """validation.go ValidateRouteRule (v1alpha1 shape)."""
+    if not spec.get("destination"):
+        raise ValidationError("route-rule: destination required")
+    total = 0
+    for r in spec.get("route", ()):
+        w = int(r.get("weight", 0))
+        if w < 0 or w > 100:
+            raise ValidationError("route-rule: weight must be 0-100")
+        total += w
+    if spec.get("route") and total not in (0, 100):
+        raise ValidationError(f"route-rule: weights sum to {total}, not 100")
+    fault = spec.get("httpFault", {})
+    if fault:
+        abort = fault.get("abort", {})
+        if abort and not (100 >= float(abort.get("percent", 0)) >= 0):
+            raise ValidationError("route-rule: abort percent out of range")
+    if "precedence" in spec and int(spec["precedence"]) < 0:
+        raise ValidationError("route-rule: negative precedence")
+
+
+def _validate_v1alpha2_route_rule(spec: Mapping[str, Any]) -> None:
+    """v1alpha2 RouteRule (hosts + http routes — the VirtualService
+    precursor, config.go:312)."""
+    if not spec.get("hosts"):
+        raise ValidationError("v1alpha2 route-rule: hosts required")
+    for http in spec.get("http", ()):
+        for route in http.get("route", ()):
+            if not route.get("destination"):
+                raise ValidationError("v1alpha2: route needs destination")
+
+
+def _validate_destination_policy(spec: Mapping[str, Any]) -> None:
+    if not spec.get("destination"):
+        raise ValidationError("destination-policy: destination required")
+    cb = spec.get("circuitBreaker", {}).get("simpleCb", {})
+    for k in ("maxConnections", "httpMaxPendingRequests"):
+        if k in cb and int(cb[k]) < 0:
+            raise ValidationError(f"destination-policy: negative {k}")
+
+
+def _validate_destination_rule(spec: Mapping[str, Any]) -> None:
+    if not spec.get("host") and not spec.get("name"):
+        raise ValidationError("destination-rule: host required")
+
+
+def _validate_gateway(spec: Mapping[str, Any]) -> None:
+    if not spec.get("servers"):
+        raise ValidationError("gateway: servers required")
+
+
+def _validate_ingress_rule(spec: Mapping[str, Any]) -> None:
+    if not spec.get("destination"):
+        raise ValidationError("ingress-rule: destination required")
+
+
+def _validate_egress_rule(spec: Mapping[str, Any]) -> None:
+    dest = spec.get("destination", {})
+    if not dest.get("service"):
+        raise ValidationError("egress-rule: destination.service required")
+    if not spec.get("ports"):
+        raise ValidationError("egress-rule: ports required")
+
+
+def _validate_spec_binding(spec: Mapping[str, Any]) -> None:
+    return None
+
+
+# config.go:407-418 IstioConfigTypes
+IstioConfigTypes: dict[str, ProtoSchema] = {s.type: s for s in [
+    ProtoSchema("route-rule", "route-rules", _validate_route_rule),
+    ProtoSchema("v1alpha2-route-rule", "v1alpha2-route-rules",
+                _validate_v1alpha2_route_rule),
+    ProtoSchema("gateway", "gateways", _validate_gateway),
+    ProtoSchema("ingress-rule", "ingress-rules", _validate_ingress_rule),
+    ProtoSchema("egress-rule", "egress-rules", _validate_egress_rule),
+    ProtoSchema("destination-policy", "destination-policies",
+                _validate_destination_policy),
+    ProtoSchema("destination-rule", "destination-rules",
+                _validate_destination_rule),
+    ProtoSchema("http-api-spec", "http-api-specs", _validate_spec_binding),
+    ProtoSchema("http-api-spec-binding", "http-api-spec-bindings",
+                _validate_spec_binding),
+    ProtoSchema("quota-spec", "quota-specs", _validate_spec_binding),
+    ProtoSchema("quota-spec-binding", "quota-spec-bindings",
+                _validate_spec_binding),
+    ProtoSchema("end-user-authentication-policy-spec",
+                "end-user-authentication-policy-specs",
+                _validate_spec_binding),
+    ProtoSchema("end-user-authentication-policy-spec-binding",
+                "end-user-authentication-policy-spec-bindings",
+                _validate_spec_binding),
+]}
+
+
+class ConfigStore:
+    """config.go:110 ConfigStore: typed CRUD with validation."""
+
+    def get(self, typ: str, name: str, namespace: str) -> Config | None:
+        raise NotImplementedError
+
+    def list(self, typ: str, namespace: str | None = None) -> list[Config]:
+        raise NotImplementedError
+
+    def create(self, config: Config) -> None:
+        raise NotImplementedError
+
+    def update(self, config: Config) -> None:
+        raise NotImplementedError
+
+    def delete(self, typ: str, name: str, namespace: str) -> None:
+        raise NotImplementedError
+
+
+class MemoryConfigStore(ConfigStore):
+    """pilot/pkg/config/memory — the hermetic test backbone; also the
+    ConfigStoreCache (config.go:162): handlers fire on changes."""
+
+    def __init__(self) -> None:
+        self._data: dict[tuple[str, str, str], Config] = {}
+        self._lock = threading.Lock()
+        self._handlers: list[Callable[[Config, str], None]] = []
+
+    def register_handler(self, fn: Callable[[Config, str], None]) -> None:
+        self._handlers.append(fn)
+
+    def _notify(self, config: Config, event: str) -> None:
+        for fn in list(self._handlers):
+            fn(config, event)
+
+    def _validate(self, config: Config) -> None:
+        schema = IstioConfigTypes.get(config.meta.type)
+        if schema is None:
+            raise ValidationError(f"unknown config type {config.meta.type}")
+        schema.validate(config.spec)
+
+    def get(self, typ, name, namespace=""):
+        with self._lock:
+            return self._data.get((typ, namespace, name))
+
+    def list(self, typ, namespace=None):
+        with self._lock:
+            return [c for (t, ns, _), c in sorted(self._data.items())
+                    if t == typ and (namespace is None or ns == namespace)]
+
+    def create(self, config: Config) -> None:
+        self._validate(config)
+        with self._lock:
+            if config.key in self._data:
+                raise ValidationError(f"{config.key} already exists")
+            self._data[config.key] = config
+        self._notify(config, "add")
+
+    def update(self, config: Config) -> None:
+        self._validate(config)
+        with self._lock:
+            self._data[config.key] = config
+        self._notify(config, "update")
+
+    def delete(self, typ, name, namespace="") -> None:
+        with self._lock:
+            config = self._data.pop((typ, namespace, name), None)
+        if config is not None:
+            self._notify(config, "delete")
+
+
+def _match_source(spec: Mapping[str, Any], source: str | None,
+                  labels: Mapping[str, str] | None) -> bool:
+    want = spec.get("match", {}).get("source", None)
+    if want and source and want != source:
+        return False
+    want_labels = spec.get("match", {}).get("sourceTags") or \
+        spec.get("match", {}).get("source_labels") or {}
+    if want_labels and labels is not None:
+        if any(labels.get(k) != v for k, v in want_labels.items()):
+            return False
+    return True
+
+
+class IstioConfigStore:
+    """config.go:227 query facade over a ConfigStore."""
+
+    def __init__(self, store: ConfigStore):
+        self.store = store
+
+    @staticmethod
+    def _destination_hostname(c: Config) -> str:
+        """Resolve a rule's destination to an FQDN: short names qualify
+        against the RULE's namespace + domain (the reference resolves
+        names in the config's namespace, model.ResolveHostname)."""
+        dest = c.spec.get("destination", {})
+        name = dest if isinstance(dest, str) else str(dest.get("name", ""))
+        if "." in name or not name:
+            return name
+        ns = c.meta.namespace or "default"
+        domain = c.meta.domain or "cluster.local"
+        return f"{name}.{ns}.svc.{domain}"
+
+    def route_rules(self, destination: str, source: str | None = None,
+                    source_labels: Mapping[str, str] | None = None
+                    ) -> list[Config]:
+        """RouteRules by destination (+optional source filter), sorted
+        by precedence DESC then name (route.go sorting)."""
+        out = []
+        for c in self.store.list("route-rule"):
+            if self._destination_hostname(c) != destination:
+                continue
+            if not _match_source(c.spec, source, source_labels):
+                continue
+            out.append(c)
+        out.sort(key=lambda c: (-int(c.spec.get("precedence", 0)),
+                                c.meta.name))
+        return out
+
+    def destination_policy(self, destination: str,
+                           labels: Mapping[str, str] | None = None
+                           ) -> Config | None:
+        for c in self.store.list("destination-policy"):
+            if self._destination_hostname(c) != destination:
+                continue
+            dest = c.spec.get("destination", {})
+            want = (dest.get("tags") or dest.get("labels") or {}) \
+                if isinstance(dest, Mapping) else {}
+            if want and labels is not None and \
+                    any(labels.get(k) != v for k, v in want.items()):
+                continue
+            return c
+        return None
+
+    def egress_rules(self) -> list[Config]:
+        return self.store.list("egress-rule")
+
+    def ingress_rules(self) -> list[Config]:
+        return self.store.list("ingress-rule")
+
+    def http_api_specs(self, service: str) -> list[Config]:
+        """HTTPAPISpecByDestination (config.go:265 family)."""
+        bound = []
+        for b in self.store.list("http-api-spec-binding"):
+            for s in b.spec.get("services", ()):
+                sname = s.get("name") if isinstance(s, Mapping) else s
+                if sname == service or service.startswith(f"{sname}."):
+                    bound.extend(r.get("name") for r in
+                                 b.spec.get("api_specs", ()))
+        return [c for c in self.store.list("http-api-spec")
+                if c.meta.name in bound]
+
+    def quota_specs(self, service: str) -> list[Config]:
+        bound = []
+        for b in self.store.list("quota-spec-binding"):
+            for s in b.spec.get("services", ()):
+                sname = s.get("name") if isinstance(s, Mapping) else s
+                if sname == service or service.startswith(f"{sname}."):
+                    bound.extend(r.get("name") for r in
+                                 b.spec.get("quota_specs", ()))
+        return [c for c in self.store.list("quota-spec")
+                if c.meta.name in bound]

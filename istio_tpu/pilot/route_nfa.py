@@ -1,0 +1,220 @@
+"""Shared route automaton: route-rule matches → the policy ruleset
+tensors (BASELINE.json: "Pilot's route compiler emits the same NFA for
+VirtualService/RouteRule header+URI match so L7 routing and policy
+share one compiled automaton").
+
+Every (service, route-rule) pair lowers its match block to ONE
+predicate in the SAME expression language the policy engine compiles
+(exact → EQ, prefix → startsWith, regex → matches, header presence →
+`|` fallback probe), then the whole mesh's route table becomes a
+RuleSetProgram. Batched route selection = one device step:
+
+    matched [B, R]  →  choice[b] = highest-precedence matched rule
+                       (argmax over precedence-ordered weights)
+
+`select()` returns per-request route indices; index n_rules means "no
+rule matched → default route". The host-side `select_host()` applies
+identical semantics sequentially and is the conformance oracle.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from istio_tpu.attribute.bag import Bag, bag_from_mapping
+from istio_tpu.attribute.types import ValueType
+from istio_tpu.compiler.layout import Tensorizer
+from istio_tpu.compiler.ruleset import Rule, compile_ruleset
+from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.pilot.model import Config, Service
+
+V = ValueType
+
+# vocabulary of the route-match automaton
+ROUTE_MANIFEST: dict[str, ValueType] = {
+    "destination.service": V.STRING,
+    "request.path": V.STRING,
+    "request.method": V.STRING,
+    "request.scheme": V.STRING,
+    "request.host": V.STRING,
+    "request.headers": V.STRING_MAP,
+    "source.service": V.STRING,
+}
+ROUTE_FINDER = AttributeDescriptorFinder(ROUTE_MANIFEST)
+
+_ABSENT = "\x00absent\x00"
+
+
+def _quote(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def _header_ref(name: str) -> str:
+    """Pseudo-headers map to first-class attributes (header.go:27
+    translates :path/:method the same way)."""
+    specials = {"uri": "request.path", ":path": "request.path",
+                ":method": "request.method", "method": "request.method",
+                ":authority": "request.host", "authority": "request.host",
+                "scheme": "request.scheme", ":scheme": "request.scheme"}
+    if name in specials:
+        return specials[name]
+    return f"request.headers[{_quote(name)}]"
+
+
+def match_to_predicate(hostname: str, match: Mapping[str, Any] | None,
+                       source: str | None = None) -> str:
+    """Route-rule match block → one boolean expression."""
+    parts = [f"destination.service == {_quote(hostname)}"]
+    if source:
+        parts.append(f"source.service == {_quote(source)}")
+    headers = {}
+    if match:
+        headers = match.get("request", {}).get("headers", {}) \
+            if "request" in match else match.get("headers", {}) or {}
+    for name, cond in sorted(headers.items()):
+        ref = _header_ref(name)
+        is_map = ref.startswith("request.headers[")
+        probe = f"({ref} | {_quote(_ABSENT)})" if is_map else ref
+        if not cond or cond == {"presence": True}:
+            parts.append(f"{probe} != {_quote(_ABSENT)}")
+        elif "exact" in cond:
+            parts.append(f"{probe} == {_quote(cond['exact'])}")
+        elif "prefix" in cond:
+            parts.append(f"{probe}.startsWith({_quote(cond['prefix'])})")
+        elif "regex" in cond:
+            # Envoy route regexes are FULL match; `matches` is an
+            # unanchored search (Go regexp.MatchString parity), so
+            # ALWAYS wrap — `^(pat)$` forces full-match semantics even
+            # for alternations like `a|b`, and already-anchored
+            # patterns stay correct (the group's anchors nest). NOTE:
+            # the RECEIVER of .matches() is the PATTERN (see
+            # testing/corpus.py).
+            parts.append(f"{_quote(_anchor(cond['regex']))}"
+                         f".matches({probe})")
+    return " && ".join(parts)
+
+
+def _anchor(pattern: str) -> str:
+    return f"^({pattern})$"
+
+
+@dataclasses.dataclass
+class RouteEntry:
+    rule: Config
+    service: Service
+    predicate: str
+    precedence: int
+
+
+class RouteTable:
+    """The whole mesh's route rules as one device program."""
+
+    def __init__(self, services: Sequence[Service],
+                 rules_by_host: Mapping[str, Sequence[Config]],
+                 max_str_len: int = 256):
+        self.entries: list[RouteEntry] = []
+        host_of = {s.hostname: s for s in services}
+        for hostname in sorted(rules_by_host):
+            service = host_of.get(hostname)
+            if service is None:
+                continue
+            for rule in rules_by_host[hostname]:
+                src = rule.spec.get("match", {}).get("source")
+                pred = match_to_predicate(hostname,
+                                          rule.spec.get("match"), src)
+                self.entries.append(RouteEntry(
+                    rule=rule, service=service, predicate=pred,
+                    precedence=int(rule.spec.get("precedence", 0))))
+        rules = [Rule(name=f"route{i}", match=e.predicate)
+                 for i, e in enumerate(self.entries)]
+        self.program = compile_ruleset(rules, ROUTE_FINDER,
+                                       max_str_len=max_str_len)
+        self.tensorizer = Tensorizer(self.program.layout,
+                                     self.program.interner)
+        # selection weights: precedence first, then config order
+        # (route_rules sorting, route.go) — higher weight wins
+        n = len(self.entries)
+        order = sorted(range(n),
+                       key=lambda i: (-self.entries[i].precedence, i))
+        self._weight = np.zeros(max(n, 1), np.int64)
+        for rank, idx in enumerate(order):
+            self._weight[idx] = n - rank          # best rank → largest
+        self.default_index = n
+
+    # -- device path --
+
+    def select(self, requests: Sequence[Mapping[str, Any] | Bag]
+               ) -> np.ndarray:
+        """One device step: per-request winning route index
+        (default_index when nothing matches)."""
+        bags = [r if isinstance(r, Bag) else bag_from_mapping(dict(r))
+                for r in requests]
+        if not self.entries:
+            return np.full(len(bags), self.default_index, np.int64)
+        batch = self.tensorizer.tensorize(bags)
+        matched, _, _ = self.program(batch)
+        matched = np.array(matched)
+        for ridx in self.program.host_fallback:
+            for b, bag in enumerate(bags):
+                matched[b, ridx] = self.program.host_eval(ridx, bag)[0]
+        scores = matched * self._weight[None, :]
+        best = scores.argmax(axis=1)
+        hit = scores.max(axis=1) > 0
+        return np.where(hit, best, self.default_index)
+
+    # -- host oracle --
+
+    def select_host(self, request: Mapping[str, Any]) -> int:
+        best, best_w = self.default_index, 0
+        for i, entry in enumerate(self.entries):
+            if self._matches_host(entry, request) and \
+                    self._weight[i] > best_w:
+                best, best_w = i, int(self._weight[i])
+        return best
+
+    @staticmethod
+    def _matches_host(entry: RouteEntry,
+                      request: Mapping[str, Any]) -> bool:
+        if request.get("destination.service") != entry.service.hostname:
+            return False
+        spec = entry.rule.spec
+        src = spec.get("match", {}).get("source")
+        if src and request.get("source.service") != src:
+            return False
+        headers = {}
+        if spec.get("match"):
+            m = spec["match"]
+            headers = m.get("request", {}).get("headers", {}) \
+                if "request" in m else m.get("headers", {}) or {}
+        for name, cond in headers.items():
+            ref = _header_ref(name)
+            if ref.startswith("request.headers["):
+                value = (request.get("request.headers") or {}).get(name)
+            else:
+                value = request.get(ref)
+            if not cond or cond == {"presence": True}:
+                if value is None:
+                    return False
+            elif "exact" in cond:
+                if value != cond["exact"]:
+                    return False
+            elif "prefix" in cond:
+                if value is None or not str(value).startswith(
+                        cond["prefix"]):
+                    return False
+            elif "regex" in cond:
+                # mirror the device predicate EXACTLY: unanchored
+                # search of the ^(pat)$ wrapper (same engine semantics
+                # incl. the $-before-trailing-newline subtlety)
+                if value is None or re.search(_anchor(cond["regex"]),
+                                              str(value)) is None:
+                    return False
+        return True
+
+    def route_for(self, index: int) -> RouteEntry | None:
+        if 0 <= index < len(self.entries):
+            return self.entries[index]
+        return None

@@ -64,7 +64,8 @@ def make_rules(n_rules: int, n_services: int | None = None,
             parts.append(f'match(request.host, "*.ns{i % 23}.cluster.local")')
         elif k == 9 and with_regex:
             parts.append(
-                f'request.path.matches("/(products|reviews)/[0-9]+/v{i % 4}")')
+                f'"/(products|reviews)/[0-9]+/v{i % 4}"'
+                '.matches(request.path)')
         rules.append(Rule(name=f"rule{i}", match=" && ".join(parts),
                           namespace=f"ns{i % 23}"))
     return rules

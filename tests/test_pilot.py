@@ -1,0 +1,307 @@
+"""Pilot: model validation, route compilation, shared route-NFA parity,
+discovery REST + cache invalidation, agent hot-restart epochs.
+
+Reference patterns: pilot/pkg/proxy/envoy/config_test.go golden files,
+pilot/pkg/proxy/envoy/mock/discovery.go, agent tests.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from istio_tpu.pilot import (AggregateRegistry, Config, ConfigMeta,
+                             IstioConfigStore, MemoryConfigStore,
+                             MemoryRegistry, Port, Service,
+                             ValidationError)
+from istio_tpu.pilot.agent import Agent, CertWatcher, Proxy
+from istio_tpu.pilot.discovery import DiscoveryService
+from istio_tpu.pilot.envoy_config import (build_bootstrap,
+                                          build_outbound_clusters,
+                                          build_outbound_listeners)
+from istio_tpu.pilot.route_nfa import RouteTable
+from istio_tpu.pilot.routes import (build_http_route, build_route_match,
+                                    build_virtual_host, cluster_name)
+
+HTTP = Port("http", 80, "HTTP")
+GRPC = Port("grpc", 7070, "GRPC")
+MONGO = Port("mongo", 27017, "MONGO")
+
+
+def _svc(name: str, ports=(HTTP,), addr="10.1.0.1") -> Service:
+    return Service(hostname=f"{name}.default.svc.cluster.local",
+                   address=addr, ports=tuple(ports))
+
+
+def _rule(name, spec, ns="default") -> Config:
+    return Config(ConfigMeta(type="route-rule", name=name, namespace=ns),
+                  spec)
+
+
+@pytest.fixture()
+def world():
+    registry = MemoryRegistry()
+    reviews = _svc("reviews", (HTTP, GRPC))
+    ratings = _svc("ratings", addr="10.1.0.2")
+    db = _svc("db", (MONGO,), addr="10.1.0.3")
+    registry.add_service(reviews, [("10.0.0.1", {"version": "v1"}),
+                                   ("10.0.0.2", {"version": "v2"})])
+    registry.add_service(ratings, [("10.0.0.3", {})])
+    registry.add_service(db, [("10.0.0.4", {})])
+    store = MemoryConfigStore()
+    return registry, store, reviews, ratings
+
+
+def test_config_validation():
+    store = MemoryConfigStore()
+    with pytest.raises(ValidationError):
+        store.create(_rule("bad", {"route": [{"weight": 50}]}))  # no dest
+    with pytest.raises(ValidationError):
+        store.create(_rule("bad2", {"destination": {"name": "x"},
+                                    "route": [{"weight": 55},
+                                              {"weight": 25}]}))
+    store.create(_rule("ok", {"destination": {"name": "x"},
+                              "route": [{"weight": 75}, {"weight": 25}]}))
+    with pytest.raises(ValidationError):
+        store.create(Config(ConfigMeta(type="nope", name="x"), {}))
+
+
+def test_route_match_translation():
+    m = build_route_match({"request": {"headers": {
+        "uri": {"prefix": "/api"},
+        "cookie": {"regex": "^(.*?;)?(user=jason)(;.*)?$"},
+        "x-flag": {"exact": "on"}}}})
+    assert m["prefix"] == "/api"
+    assert {"name": "x-flag", "value": "on"} in m["headers"]
+    assert any(h.get("regex") for h in m["headers"])
+
+
+def test_weighted_route_and_policies(world):
+    registry, store, reviews, _ = world
+    store.create(_rule("split", {
+        "destination": {"name": "reviews"},
+        "precedence": 2,
+        "route": [{"labels": {"version": "v1"}, "weight": 80},
+                  {"labels": {"version": "v2"}, "weight": 20}],
+        "httpReqRetries": {"simpleRetry": {"attempts": 3}},
+        "mirror": {"labels": {"version": "v2"}}}))
+    cfg = IstioConfigStore(store)
+    rules = cfg.route_rules(reviews.hostname)
+    route = build_http_route(rules[0], reviews, HTTP)
+    wc = route["weighted_clusters"]["clusters"]
+    assert [c["weight"] for c in wc] == [80, 20]
+    assert "version=v1" in wc[0]["name"]
+    assert route["retry_policy"]["num_retries"] == 3
+    assert route["shadow"]["cluster"].endswith("version=v2")
+    vh = build_virtual_host(reviews, HTTP, cfg)
+    assert vh["routes"][-1]["cluster"] == cluster_name(reviews.hostname,
+                                                       HTTP)
+    assert "reviews" in vh["domains"]
+    assert f"{reviews.hostname}:80" in vh["domains"]
+
+
+def test_clusters_and_circuit_breaker(world):
+    registry, store, reviews, ratings = world
+    store.create(_rule("split", {
+        "destination": {"name": "reviews"},
+        "route": [{"labels": {"version": "v1"}, "weight": 100}]}))
+    store.create(Config(ConfigMeta(type="destination-policy",
+                                   name="cb", namespace="default"),
+                        {"destination": {"name":
+                                         ratings.hostname},
+                         "loadBalancing": {"name": "LEAST_CONN"},
+                         "circuitBreaker": {"simpleCb": {
+                             "maxConnections": 10,
+                             "httpConsecutiveErrors": 3,
+                             "httpDetectionInterval": "5s"}}}))
+    cfg = IstioConfigStore(store)
+    clusters = build_outbound_clusters(registry.services(), cfg)
+    names = [c["name"] for c in clusters]
+    assert cluster_name(reviews.hostname, HTTP,
+                        {"version": "v1"}) in names
+    ratings_cluster = next(c for c in clusters
+                           if c["name"] ==
+                           "out.ratings.default.svc.cluster.local|http")
+    assert ratings_cluster["lb_type"] == "least_request"
+    assert ratings_cluster["circuit_breakers"]["default"][
+        "max_connections"] == 10
+    assert ratings_cluster["outlier_detection"]["consecutive_5xx"] == 3
+
+
+def test_listeners_and_bootstrap(world):
+    registry, store, *_ = world
+    cfg = IstioConfigStore(store)
+    listeners = build_outbound_listeners(registry.services(), cfg,
+                                         {"mixer_address": "mixer:9091"})
+    by_name = {l["name"]: l for l in listeners}
+    assert "http_0.0.0.0_80" in by_name
+    assert "tcp_0.0.0.0_27017" in by_name      # mongo is TCP
+    hcm = by_name["http_0.0.0.0_80"]["filters"][0]["config"]
+    assert hcm["rds"]["route_config_name"] == "80"
+    assert [f["name"] for f in hcm["filters"]] == ["mixer", "router"]
+    boot = build_bootstrap({"discovery_address": "pilot:8080",
+                            "mixer_address": "mixer:9091",
+                            "zipkin_address": "zipkin:9411"})
+    cnames = [c["name"] for c in boot["cluster_manager"]["clusters"]]
+    assert {"rds", "lds", "mixer_server", "zipkin"} <= set(cnames)
+    assert boot["tracing"]["http"]["driver"]["type"] == "zipkin"
+
+
+def test_route_nfa_matches_host_oracle(world):
+    registry, store, reviews, ratings = world
+    store.create(_rule("jason", {
+        "destination": {"name": "reviews"}, "precedence": 2,
+        "match": {"request": {"headers": {
+            "cookie": {"regex": "^(.*?;)?(user=jason)(;.*)?$"}}}},
+        "route": [{"labels": {"version": "v2"}}]}))
+    store.create(_rule("api", {
+        "destination": {"name": "reviews"}, "precedence": 1,
+        "match": {"request": {"headers": {"uri": {"prefix": "/api/"}}}},
+        "route": [{"labels": {"version": "v1"}}]}))
+    store.create(_rule("exact", {
+        "destination": {"name": "ratings"},
+        "match": {"request": {"headers": {
+            "uri": {"exact": "/healthz"},
+            "x-debug": {"presence": True}}}},
+        "route": [{"labels": {}}]}))
+    cfg = IstioConfigStore(store)
+    table = RouteTable(registry.services(), {
+        reviews.hostname: cfg.route_rules(reviews.hostname),
+        ratings.hostname: cfg.route_rules(ratings.hostname)})
+
+    rng = np.random.default_rng(3)
+    requests = []
+    for i in range(64):
+        req = {"destination.service":
+               (reviews if i % 2 else ratings).hostname,
+               "request.path": rng.choice(
+                   ["/api/v1/reviews", "/healthz", "/other"]),
+               "request.headers": {}}
+        if rng.random() < 0.5:
+            req["request.headers"]["cookie"] = rng.choice(
+                ["user=jason", "s=1;user=jason;x=2", "user=mary"])
+        if rng.random() < 0.5:
+            req["request.headers"]["x-debug"] = "1"
+        requests.append(req)
+    got = table.select(requests)
+    for b, req in enumerate(requests):
+        assert got[b] == table.select_host(req), (b, req)
+    # spot semantic checks
+    jason = table.select([{
+        "destination.service": reviews.hostname,
+        "request.path": "/api/x",
+        "request.headers": {"cookie": "a;user=jason"}}])[0]
+    assert table.route_for(jason).rule.meta.name == "jason"
+    api = table.select([{
+        "destination.service": reviews.hostname,
+        "request.path": "/api/x", "request.headers": {}}])[0]
+    assert table.route_for(api).rule.meta.name == "api"
+
+
+def test_discovery_rest_and_cache(world):
+    registry, store, reviews, _ = world
+    ds = DiscoveryService(registry, store)
+    port = ds.start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return json.loads(r.read())
+
+        sds = get(f"/v1/registration/{reviews.hostname}|http")
+        assert {h["ip_address"] for h in sds["hosts"]} == \
+            {"10.0.0.1", "10.0.0.2"}
+        node = "sidecar~10.0.0.1~pod~cluster.local"
+        cds = get(f"/v1/clusters/istio-proxy/{node}")
+        assert any(c["name"].startswith("in.") for c in cds["clusters"])
+        lds = get(f"/v1/listeners/istio-proxy/{node}")
+        assert lds["listeners"]
+        rds = get(f"/v1/routes/80/istio-proxy/{node}")
+        assert any(vh["name"].startswith("reviews")
+                   for vh in rds["virtual_hosts"])
+        # cache: repeated call is a hit; config change clears wholesale
+        n = ds.cache_size
+        get(f"/v1/routes/80/istio-proxy/{node}")
+        assert ds.cache_size == n
+        store.create(_rule("newrule", {
+            "destination": {"name": "reviews"},
+            "route": [{"labels": {"version": "v1"}}]}))
+        assert ds.cache_size == 0
+        rds2 = get(f"/v1/routes/80/istio-proxy/{node}")
+        vh = next(v for v in rds2["virtual_hosts"]
+                  if v["name"].startswith("reviews"))
+        assert "version=v1" in vh["routes"][0]["cluster"]
+    finally:
+        ds.stop()
+
+
+class FakeProxy(Proxy):
+    def __init__(self, fail_epochs=()):
+        self.fail_epochs = set(fail_epochs)
+        self.started = []
+        self.cleaned = []
+
+    def run(self, config, epoch, abort):
+        self.started.append((epoch, config))
+        if epoch in self.fail_epochs:
+            raise RuntimeError("boom")
+        abort.wait()
+
+    def cleanup(self, epoch):
+        self.cleaned.append(epoch)
+
+
+def test_agent_epochs_and_retry():
+    proxy = FakeProxy()
+    agent = Agent(proxy)
+    agent.schedule_config_update({"v": 1})
+    time.sleep(0.1)
+    assert agent.active_epochs() == [0]
+    agent.schedule_config_update({"v": 1})   # no change → no new epoch
+    time.sleep(0.1)
+    assert agent.active_epochs() == [0]
+    agent.schedule_config_update({"v": 2})   # hot restart → epoch 1
+    time.sleep(0.1)
+    assert 1 in agent.active_epochs()
+    agent.close()
+    assert agent.active_epochs() == []
+    assert set(proxy.cleaned) >= {0, 1}
+
+    crashy = FakeProxy(fail_epochs={0})
+    agent2 = Agent(crashy)
+    agent2.schedule_config_update({"v": 1})
+    deadline = time.time() + 5
+    while time.time() < deadline and len(crashy.started) < 2:
+        time.sleep(0.05)
+    assert len(crashy.started) >= 2           # backoff retry respawned
+    agent2.close()
+
+
+def test_cert_watcher(tmp_path):
+    cert = tmp_path / "cert.pem"
+    cert.write_text("AAA")
+    changes = []
+    w = CertWatcher([str(tmp_path)], changes.append, poll_s=0.05)
+    w.start()
+    time.sleep(0.15)
+    assert changes == []
+    cert.write_text("BBB")
+    deadline = time.time() + 5
+    while time.time() < deadline and not changes:
+        time.sleep(0.05)
+    assert len(changes) == 1
+    w.stop()
+
+
+def test_aggregate_registry(world):
+    registry, *_ = world
+    extra = MemoryRegistry()
+    extra.add_service(_svc("external", addr="10.9.9.9"), [("10.2.0.1", {})])
+    agg = AggregateRegistry([registry, extra])
+    names = [s.hostname for s in agg.services()]
+    assert "external.default.svc.cluster.local" in names
+    assert len(names) == 4
+    assert agg.get_service("external.default.svc.cluster.local")
+    assert agg.host_instances({"10.2.0.1"})
