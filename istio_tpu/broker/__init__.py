@@ -1,0 +1,7 @@
+"""Broker — Open Service Broker API v2 skeleton (reference: broker/,
+SURVEY.md §2.8, 3,371 LoC embryonic): catalog listing plus service
+instance/binding CRUD over a config store, served as OSB v2 REST.
+"""
+from istio_tpu.broker.server import BrokerServer
+
+__all__ = ["BrokerServer"]

@@ -1,0 +1,140 @@
+"""OSB v2 REST server.
+
+Reference: broker/pkg/server/broker.go:37 CreateServer,
+controller.go:41 Catalog, model/osb/* (catalog/service/servicePlan/
+serviceInstance/serviceBinding shapes). Endpoints (OSB v2):
+
+    GET    /v2/catalog
+    PUT    /v2/service_instances/{id}
+    GET    /v2/service_instances/{id}
+    DELETE /v2/service_instances/{id}
+    PUT    /v2/service_instances/{id}/service_bindings/{bid}
+    DELETE /v2/service_instances/{id}/service_bindings/{bid}
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Mapping
+
+log = logging.getLogger("istio_tpu.broker")
+
+
+class BrokerServer:
+    def __init__(self, services: list[Mapping[str, Any]] | None = None):
+        """`services` is the catalog: [{id, name, description, plans:
+        [{id, name, description}], bindable}] (osb/catalog.go)."""
+        self.catalog = {"services": list(services or [])}
+        self._instances: dict[str, dict] = {}
+        self._bindings: dict[tuple[str, str], dict] = {}
+        self._lock = threading.Lock()
+        self._server: ThreadingHTTPServer | None = None
+
+    # -- operations (controller.go) --
+
+    def get_catalog(self) -> dict:
+        return self.catalog
+
+    def provision(self, instance_id: str, body: Mapping[str, Any]
+                  ) -> tuple[int, dict]:
+        with self._lock:
+            if instance_id in self._instances:
+                if self._instances[instance_id] == dict(body):
+                    return 200, {}
+                return 409, {"description": "instance exists"}
+            known = {s["id"] for s in self.catalog["services"]}
+            if body.get("service_id") not in known:
+                return 400, {"description": "unknown service_id"}
+            self._instances[instance_id] = dict(body)
+        return 201, {}
+
+    def deprovision(self, instance_id: str) -> tuple[int, dict]:
+        with self._lock:
+            if instance_id not in self._instances:
+                return 410, {}
+            del self._instances[instance_id]
+            for key in [k for k in self._bindings
+                        if k[0] == instance_id]:
+                del self._bindings[key]
+        return 200, {}
+
+    def bind(self, instance_id: str, binding_id: str,
+             body: Mapping[str, Any]) -> tuple[int, dict]:
+        with self._lock:
+            if instance_id not in self._instances:
+                return 404, {"description": "no such instance"}
+            self._bindings[(instance_id, binding_id)] = dict(body)
+        return 201, {"credentials": {}}
+
+    def unbind(self, instance_id: str, binding_id: str) -> tuple[int, dict]:
+        with self._lock:
+            if (instance_id, binding_id) not in self._bindings:
+                return 410, {}
+            del self._bindings[(instance_id, binding_id)]
+        return 200, {}
+
+    # -- HTTP --
+
+    def start(self, address: str = "127.0.0.1", port: int = 0) -> int:
+        broker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("broker: " + fmt, *args)
+
+            def _reply(self, code: int, body: dict) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["v2", "catalog"]:
+                    self._reply(200, broker.get_catalog())
+                elif len(parts) == 3 and parts[:2] == \
+                        ["v2", "service_instances"]:
+                    inst = broker._instances.get(parts[2])
+                    self._reply(200 if inst else 404, inst or {})
+                else:
+                    self._reply(404, {})
+
+            def do_PUT(self):
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) == 3 and parts[:2] == \
+                        ["v2", "service_instances"]:
+                    self._reply(*broker.provision(parts[2], self._body()))
+                elif len(parts) == 5 and parts[3] == "service_bindings":
+                    self._reply(*broker.bind(parts[2], parts[4],
+                                             self._body()))
+                else:
+                    self._reply(404, {})
+
+            def do_DELETE(self):
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) == 3 and parts[:2] == \
+                        ["v2", "service_instances"]:
+                    self._reply(*broker.deprovision(parts[2]))
+                elif len(parts) == 5 and parts[3] == "service_bindings":
+                    self._reply(*broker.unbind(parts[2], parts[4]))
+                else:
+                    self._reply(404, {})
+
+        self._server = ThreadingHTTPServer((address, port), Handler)
+        threading.Thread(target=self._server.serve_forever, daemon=True,
+                         name="broker").start()
+        self.port = self._server.server_address[1]
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()

@@ -1,0 +1,15 @@
+"""CLI entry points (reference: the cobra commands in mixer/cmd,
+pilot/cmd, security/cmd, broker/cmd — SURVEY.md §1 L7):
+
+    mixs            — mixer server (cmd/mixs)
+    mixc            — mixer check/report client (cmd/mixc)
+    pilot-discovery — discovery server (pilot/cmd/pilot-discovery)
+    pilot-agent     — sidecar agent (pilot/cmd/pilot-agent)
+    istioctl        — config CRUD + kube-inject (pilot/cmd/istioctl)
+    istio_ca        — certificate authority (security/cmd/istio_ca)
+    node_agent      — workload cert rotation (security/cmd/node_agent)
+    brks            — OSB broker (broker/cmd/brks)
+
+All are argparse subcommands of one `python -m istio_tpu.cmd` tool;
+each also has a `main()` for setuptools console_scripts.
+"""

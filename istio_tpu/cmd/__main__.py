@@ -1,0 +1,347 @@
+"""One multi-tool CLI: `python -m istio_tpu.cmd <command> ...`."""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+
+def _serve_forever() -> None:
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_mixs(args: argparse.Namespace) -> int:
+    """mixer server (cmd/mixs: server/server.go assembly)."""
+    from istio_tpu.api import MixerGrpcServer
+    from istio_tpu.runtime import FsStore, MemStore, RuntimeServer, \
+        ServerArgs
+    store = FsStore(args.config_store) if args.config_store else MemStore()
+    runtime = RuntimeServer(store, ServerArgs(
+        batch_window_s=args.batch_window_us / 1e6,
+        max_batch=args.max_batch))
+    server = MixerGrpcServer(runtime, f"{args.address}:{args.port}")
+    port = server.start()
+    print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
+          f"(config={'fs:' + args.config_store if args.config_store else 'memory'})")
+    if args.monitoring_port:
+        import prometheus_client
+        from istio_tpu.runtime import monitor
+        prometheus_client.start_http_server(args.monitoring_port,
+                                            registry=monitor.REGISTRY)
+    _serve_forever()
+    server.stop()
+    runtime.close()
+    return 0
+
+
+def cmd_mixc(args: argparse.Namespace) -> int:
+    """mixer client (cmd/mixc check/report)."""
+    from istio_tpu.api import MixerClient
+    attrs = {}
+    for kv in args.string_attributes or []:
+        k, _, v = kv.partition("=")
+        attrs[k] = v
+    for kv in args.int64_attributes or []:
+        k, _, v = kv.partition("=")
+        attrs[k] = int(v)
+    client = MixerClient(args.mixer)
+    if args.command == "check":
+        resp = client.check(attrs)
+        print(json.dumps({
+            "status_code": resp.precondition.status.code,
+            "status_message": resp.precondition.status.message,
+            "valid_use_count": resp.precondition.valid_use_count}))
+        return 0 if resp.precondition.status.code == 0 else 1
+    client.report([attrs])
+    print("{}")
+    return 0
+
+
+def cmd_pilot_discovery(args: argparse.Namespace) -> int:
+    """pilot-discovery (bootstrap/server.go assembly)."""
+    from istio_tpu.pilot import MemoryConfigStore, MemoryRegistry
+    from istio_tpu.pilot.discovery import DiscoveryService
+    registry = MemoryRegistry()
+    store = MemoryConfigStore()
+    if args.registry_file:
+        _load_world(registry, store, args.registry_file)
+    ds = DiscoveryService(registry, store,
+                          {"mixer_address": args.mixer_address})
+    port = ds.start(args.address, args.port)
+    print(f"pilot-discovery: v1 xDS on {args.address}:{port}")
+    _serve_forever()
+    ds.stop()
+    return 0
+
+
+def _load_world(registry, store, path: str) -> None:
+    """Topology + config from one YAML file: {services: [...],
+    configs: [...]} — the file-based registry mode."""
+    import yaml
+    from istio_tpu.pilot import Config, ConfigMeta, Port, Service
+    with open(path, encoding="utf-8") as f:
+        world = yaml.safe_load(f) or {}
+    for s in world.get("services", ()):
+        svc = Service(hostname=s["hostname"],
+                      address=s.get("address", "0.0.0.0"),
+                      ports=tuple(Port(p["name"], int(p["port"]),
+                                       p.get("protocol", "HTTP"))
+                                  for p in s.get("ports", ())))
+        registry.add_service(svc, [(e["address"], e.get("labels", {}))
+                                   for e in s.get("endpoints", ())])
+    for c in world.get("configs", ()):
+        meta = c.get("metadata", {})
+        store.create(Config(ConfigMeta(type=c["kind"],
+                                       name=meta.get("name", ""),
+                                       namespace=meta.get("namespace",
+                                                          "default")),
+                            c.get("spec", {})))
+
+
+def cmd_pilot_agent(args: argparse.Namespace) -> int:
+    """pilot-agent proxy (cmd/pilot-agent/main.go:71)."""
+    import subprocess
+    from istio_tpu.pilot.agent import Agent, CertWatcher, Proxy
+
+    class EnvoyProxy(Proxy):
+        def run(self, config, epoch, abort):
+            # config is (path, cert_hash): the hash participates in the
+            # agent's config comparison so cert rotation forces an epoch
+            path, _cert_hash = config
+            cmd = [args.binary_path, "--restart-epoch", str(epoch),
+                   "--drain-time-s", str(args.drain_duration),
+                   "-c", path]
+            proc = subprocess.Popen(cmd)
+            while proc.poll() is None:
+                if abort.wait(0.2):
+                    proc.terminate()
+                    proc.wait(timeout=10)
+                    return
+            if proc.returncode != 0:
+                raise RuntimeError(f"envoy exited {proc.returncode}")
+
+    agent = Agent(EnvoyProxy())
+    agent.schedule_config_update((args.config_path, ""))
+    watcher = CertWatcher([args.cert_dir],
+                          lambda h: agent.schedule_config_update(
+                              (args.config_path, h))) \
+        if args.cert_dir else None
+    if watcher:
+        watcher.start()
+    print(f"pilot-agent: managing {args.binary_path} epochs")
+    _serve_forever()
+    if watcher:
+        watcher.stop()
+    agent.close()
+    return 0
+
+
+def cmd_istioctl(args: argparse.Namespace) -> int:
+    """istioctl create/get/delete/kube-inject over an FsStore-style
+    config dir (the reference talks to k8s CRDs; the file store is this
+    build's durable backend)."""
+    import os
+    import yaml
+    from istio_tpu.pilot.model import IstioConfigTypes, ValidationError
+    if args.command == "kube-inject":
+        from istio_tpu.pilot.inject import InjectParams, into_resource_file
+        with open(args.filename, encoding="utf-8") as f:
+            print(into_resource_file(InjectParams(), f.read()))
+        return 0
+    cfg_dir = args.config_dir
+    if args.command in ("create", "replace"):
+        with open(args.filename, encoding="utf-8") as f:
+            docs = list(yaml.safe_load_all(f))
+        for doc in docs:
+            if not doc:
+                continue
+            kind = doc.get("kind", doc.get("type", ""))
+            schema = IstioConfigTypes.get(kind)
+            if schema is None:
+                print(f"unknown config kind {kind}", file=sys.stderr)
+                return 1
+            try:
+                schema.validate(doc.get("spec", {}))
+            except ValidationError as exc:
+                print(f"invalid {kind}: {exc}", file=sys.stderr)
+                return 1
+            meta = doc.get("metadata", {})
+            name = meta.get("name", "unnamed")
+            ns = meta.get("namespace", "default")
+            path = os.path.join(cfg_dir, f"{kind}-{ns}-{name}.yaml")
+            if args.command == "create" and os.path.exists(path):
+                print(f"{kind} {name} already exists", file=sys.stderr)
+                return 1
+            with open(path, "w", encoding="utf-8") as f:
+                yaml.safe_dump(doc, f, sort_keys=False)
+            print(f"{args.command}d {kind} {name}.{ns}")
+        return 0
+    if args.command == "get":
+        import glob
+        for path in sorted(glob.glob(os.path.join(cfg_dir, "*.yaml"))):
+            with open(path, encoding="utf-8") as f:
+                for doc in yaml.safe_load_all(f):
+                    if doc and (args.kind in ("all", doc.get("kind"))):
+                        meta = doc.get("metadata", {})
+                        print(f"{doc.get('kind')}\t{meta.get('name')}"
+                              f"\t{meta.get('namespace', 'default')}")
+        return 0
+    if args.command == "delete":
+        import glob
+        pattern = f"{args.kind}-{args.namespace}-{args.name}.yaml"
+        hits = glob.glob(os.path.join(cfg_dir, pattern))
+        for path in hits:
+            os.unlink(path)
+            print(f"deleted {args.kind} {args.name}.{args.namespace}")
+        return 0 if hits else 1
+    return 2
+
+
+def cmd_istio_ca(args: argparse.Namespace) -> int:
+    """istio_ca (security/cmd/istio_ca/main.go:146)."""
+    import pickle
+    from istio_tpu.security import IstioCA
+    from istio_tpu.security.ca_service import CAGrpcServer
+    secrets: dict = {}
+    if args.secret_file:
+        try:
+            with open(args.secret_file, "rb") as f:
+                secrets.update(pickle.load(f))
+        except FileNotFoundError:
+            pass
+    ca = IstioCA.new_self_signed(secrets)
+    if args.secret_file:
+        with open(args.secret_file, "wb") as f:
+            pickle.dump(secrets, f)
+    server = CAGrpcServer(ca, address=f"{args.address}:{args.port}")
+    port = server.start()
+    print(f"istio_ca: CSR service on {args.address}:{port}")
+    _serve_forever()
+    server.stop()
+    return 0
+
+
+def cmd_node_agent(args: argparse.Namespace) -> int:
+    """node_agent (security/cmd/node_agent)."""
+    import os
+    from istio_tpu.security.ca_service import CAClient, NodeAgent
+    os.makedirs(args.cert_dir, exist_ok=True)
+
+    def write_certs(key_pem: bytes, cert_pem: bytes, root_pem: bytes):
+        for fname, blob in (("key.pem", key_pem),
+                            ("cert-chain.pem", cert_pem),
+                            ("root-cert.pem", root_pem)):
+            with open(os.path.join(args.cert_dir, fname), "wb") as f:
+                f.write(blob)
+
+    client = CAClient(args.ca_address)
+    agent = NodeAgent(client, args.identity, write_certs,
+                      ttl_minutes=args.ttl_minutes)
+    agent.start()
+    print(f"node_agent: rotating {args.identity} certs in {args.cert_dir}")
+    _serve_forever()
+    agent.stop()
+    client.close()
+    return 0
+
+
+def cmd_brks(args: argparse.Namespace) -> int:
+    """brks (broker/cmd/brks)."""
+    import yaml
+    from istio_tpu.broker import BrokerServer
+    services = []
+    if args.catalog:
+        with open(args.catalog, encoding="utf-8") as f:
+            services = (yaml.safe_load(f) or {}).get("services", [])
+    broker = BrokerServer(services)
+    port = broker.start(args.address, args.port)
+    print(f"brks: OSB v2 on {args.address}:{port}")
+    _serve_forever()
+    broker.stop()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="istio-tpu",
+                                description=__doc__)
+    sub = p.add_subparsers(dest="tool", required=True)
+
+    s = sub.add_parser("mixs", help="mixer (policy) server")
+    s.add_argument("--address", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=9091)
+    s.add_argument("--monitoring-port", type=int, default=9093)
+    s.add_argument("--config-store", default="",
+                   help="YAML config dir (FsStore); empty = memory")
+    s.add_argument("--batch-window-us", type=int, default=300)
+    s.add_argument("--max-batch", type=int, default=1024)
+    s.set_defaults(fn=cmd_mixs)
+
+    s = sub.add_parser("mixc", help="mixer client")
+    s.add_argument("command", choices=["check", "report"])
+    s.add_argument("--mixer", default="127.0.0.1:9091")
+    s.add_argument("-s", "--string-attributes", action="append")
+    s.add_argument("-i", "--int64-attributes", action="append")
+    s.set_defaults(fn=cmd_mixc)
+
+    s = sub.add_parser("pilot-discovery", help="discovery server")
+    s.add_argument("--address", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8080)
+    s.add_argument("--registry-file", default="",
+                   help="YAML world file: {services: [], configs: []}")
+    s.add_argument("--mixer-address", default="")
+    s.set_defaults(fn=cmd_pilot_discovery)
+
+    s = sub.add_parser("pilot-agent", help="sidecar agent")
+    s.add_argument("--binary-path", default="/usr/local/bin/envoy")
+    s.add_argument("--config-path", default="/etc/istio/proxy/envoy.json")
+    s.add_argument("--cert-dir", default="")
+    s.add_argument("--drain-duration", type=int, default=45)
+    s.set_defaults(fn=cmd_pilot_agent)
+
+    s = sub.add_parser("istioctl", help="config CRUD + kube-inject")
+    s.add_argument("command",
+                   choices=["create", "replace", "get", "delete",
+                            "kube-inject"])
+    s.add_argument("-f", "--filename", default="")
+    s.add_argument("--config-dir", default=".")
+    s.add_argument("kind", nargs="?", default="all")
+    s.add_argument("name", nargs="?", default="")
+    s.add_argument("-n", "--namespace", default="default")
+    s.set_defaults(fn=cmd_istioctl)
+
+    s = sub.add_parser("istio-ca", help="certificate authority")
+    s.add_argument("--address", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8060)
+    s.add_argument("--secret-file", default="",
+                   help="persist the self-signed root here")
+    s.set_defaults(fn=cmd_istio_ca)
+
+    s = sub.add_parser("node-agent", help="workload cert rotation")
+    s.add_argument("--ca-address", default="127.0.0.1:8060")
+    s.add_argument("--identity", required=True)
+    s.add_argument("--cert-dir", default="/etc/certs")
+    s.add_argument("--ttl-minutes", type=int, default=60)
+    s.set_defaults(fn=cmd_node_agent)
+
+    s = sub.add_parser("brks", help="OSB broker")
+    s.add_argument("--address", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8090)
+    s.add_argument("--catalog", default="")
+    s.set_defaults(fn=cmd_brks)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

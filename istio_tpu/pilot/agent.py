@@ -115,20 +115,38 @@ class Agent:
 
     def _run_epoch(self, config: Any, epoch: int,
                    abort: threading.Event) -> None:
+        crashed = False
         try:
             self.proxy.run(config, epoch, abort)
             with self._lock:
                 self._retries = 0
         except Exception as exc:
+            crashed = True
             log.warning("epoch %d died: %s", epoch, exc)
-            self._schedule_retry(config, epoch)
         finally:
             self.proxy.cleanup(epoch)
             with self._lock:
                 self._epochs.pop(epoch, None)
+                # agent.go:199 semantics: the effective config is the
+                # latest SURVIVING epoch's; with none left, nothing runs
+                if self._epochs:
+                    latest = max(self._epochs)
+                    self._current_config = self._epochs[latest].config
+                else:
+                    self._current_config = object()
+            if crashed:
+                self._schedule_retry(epoch)
+            elif not abort.is_set():
+                # normal non-abort exit (external kill): respawn iff the
+                # desired config is no longer effectively running
+                self._reconcile()
 
-    def _schedule_retry(self, config: Any, epoch: int) -> None:
-        """Exponential backoff restart budget (agent.go:102 Retry)."""
+    def _schedule_retry(self, epoch: int) -> None:
+        """Exponential backoff restart budget (agent.go:102 Retry).
+        The epoch-exit handler already recomputed _current_config, so
+        the delayed reconcile only respawns when the crash actually
+        took down the desired config (an old draining epoch's crash is
+        a no-op because a newer epoch still carries it)."""
         with self._lock:
             if self._shutdown:
                 return
@@ -137,7 +155,6 @@ class Agent:
                 return
             delay = INITIAL_BACKOFF_S * (2 ** self._retries)
             self._retries += 1
-            self._current_config = object()    # force respawn
             self._retry_timer = threading.Timer(delay, self._reconcile)
             self._retry_timer.daemon = True
             self._retry_timer.start()

@@ -117,10 +117,8 @@ def build_inbound_clusters(instances: Sequence[ServiceInstance]
 # ---------------------------------------------------------------------------
 
 def _http_filters(mesh: Mapping[str, Any],
-                  fault: dict[str, Any] | None = None) -> list[dict]:
-    filters = []
-    if fault:
-        filters.append(fault)
+                  faults: Sequence[dict] = ()) -> list[dict]:
+    filters = list(faults)
     if mesh.get("mixer_address"):
         # mixer.go:82 FilterMixerConfig
         filters.append({"type": "decoder", "name": "mixer", "config": {
@@ -134,6 +132,33 @@ def _http_filters(mesh: Mapping[str, Any],
         }})
     filters.append({"type": "decoder", "name": "router", "config": {}})
     return filters
+
+
+def _port_fault_filters(port_num: int, services: Sequence[Service],
+                        config_store: IstioConfigStore) -> list[dict]:
+    """Fault filters for route-rules with httpFault on services exposed
+    on this port, scoped by the rule's match headers (fault.go:28-139
+    buildFaultFilters — faults live in the filter chain, not routes)."""
+    from istio_tpu.pilot.routes import build_route_match
+    faults = []
+    for service in services:
+        if not any(p.port == port_num and p.is_http
+                   for p in service.ports):
+            continue
+        for rule in config_store.route_rules(service.hostname):
+            fault_spec = rule.spec.get("httpFault")
+            if not fault_spec:
+                continue
+            match = build_route_match(rule.spec.get("match"))
+            headers = list(match.get("headers", ()))
+            filt = build_fault_filter(fault_spec, headers)
+            if filt is not None:
+                filt["config"]["upstream_cluster"] = cluster_name(
+                    service.hostname,
+                    next(p for p in service.ports
+                         if p.port == port_num and p.is_http))
+                faults.append(filt)
+    return faults
 
 
 def build_outbound_listeners(services: Sequence[Service],
@@ -171,7 +196,9 @@ def build_outbound_listeners(services: Sequence[Service],
                                 "route_config_name": str(port.port),
                                 "refresh_delay_ms":
                                     DEFAULT_DISCOVERY_REFRESH_MS},
-                            "filters": _http_filters(mesh),
+                            "filters": _http_filters(
+                                mesh, _port_fault_filters(
+                                    port.port, services, config_store)),
                         }}],
                 }
             else:

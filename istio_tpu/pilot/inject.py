@@ -1,0 +1,141 @@
+"""Sidecar injection (reference: pilot/pkg/kube/inject/inject.go):
+`inject_required` policy (:146 — opt-in/opt-out annotations over a
+default policy, host-network pods excluded), `injection_data` (:205 —
+render init + proxy containers from mesh params), and file mode
+`into_resource_file` (:243 — YAML in, YAML out; what
+`istioctl kube-inject` calls).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Any, Mapping
+
+import yaml
+
+ANNOTATION_POLICY = "sidecar.istio.io/inject"
+ISTIO_SIDECAR_NAME = "istio-proxy"
+ISTIO_INIT_NAME = "istio-init"
+
+
+@dataclasses.dataclass
+class InjectParams:
+    """inject.go:119 Params."""
+    init_image: str = "istio_tpu/proxy_init:latest"
+    proxy_image: str = "istio_tpu/proxy:latest"
+    discovery_address: str = "istio-pilot:8080"
+    mixer_address: str = "istio-mixer:9091"
+    include_ip_ranges: str = "*"
+    verbosity: int = 2
+    sidecar_proxy_uid: int = 1337
+    policy: str = "enabled"        # enabled = inject unless opted out
+
+
+def inject_required(params: InjectParams,
+                    pod_spec: Mapping[str, Any],
+                    metadata: Mapping[str, Any]) -> bool:
+    """inject.go:146 injectRequired."""
+    if pod_spec.get("hostNetwork"):
+        return False
+    annotations = (metadata.get("annotations") or {})
+    value = str(annotations.get(ANNOTATION_POLICY, "")).lower()
+    if value in ("true", "yes", "y", "on", "enabled"):
+        return True
+    if value in ("false", "no", "n", "off", "disabled"):
+        return False
+    return params.policy == "enabled"
+
+
+def injection_data(params: InjectParams,
+                   metadata: Mapping[str, Any],
+                   pod_spec: Mapping[str, Any] | None = None
+                   ) -> dict[str, Any]:
+    """inject.go:205: the containers/volumes patch. The cert secret is
+    keyed by the POD SPEC's serviceAccountName (mesh.go:136 uses
+    Spec.ServiceAccountName), matching SecretController.secret_name."""
+    sa = (pod_spec or {}).get("serviceAccountName") or \
+        (pod_spec or {}).get("serviceAccount") or "default"
+    ns = metadata.get("namespace", "default")
+    proxy_args = [
+        "proxy", "sidecar",
+        "--discoveryAddress", params.discovery_address,
+        "--mixerAddress", params.mixer_address,
+        "-v", str(params.verbosity),
+    ]
+    return {
+        "initContainers": [{
+            "name": ISTIO_INIT_NAME,
+            "image": params.init_image,
+            "args": ["-p", "15001", "-u", str(params.sidecar_proxy_uid),
+                     "-i", params.include_ip_ranges],
+            "securityContext": {"capabilities": {"add": ["NET_ADMIN"]}},
+        }],
+        "containers": [{
+            "name": ISTIO_SIDECAR_NAME,
+            "image": params.proxy_image,
+            "args": proxy_args,
+            "env": [
+                {"name": "POD_NAME", "valueFrom": {"fieldRef": {
+                    "fieldPath": "metadata.name"}}},
+                {"name": "POD_NAMESPACE", "valueFrom": {"fieldRef": {
+                    "fieldPath": "metadata.namespace"}}},
+                {"name": "INSTANCE_IP", "valueFrom": {"fieldRef": {
+                    "fieldPath": "status.podIP"}}},
+            ],
+            "securityContext": {
+                "runAsUser": params.sidecar_proxy_uid},
+            "volumeMounts": [{"name": "istio-certs",
+                              "mountPath": "/etc/certs",
+                              "readOnly": True}],
+        }],
+        "volumes": [{"name": "istio-certs", "secret": {
+            "secretName": f"istio.{sa}.{ns}"}}],
+    }
+
+
+def inject_pod(params: InjectParams, pod: Mapping[str, Any]
+               ) -> dict[str, Any]:
+    """Mutate one pod-shaped dict (webhook.go patch application)."""
+    out = copy.deepcopy(dict(pod))
+    metadata = out.setdefault("metadata", {})
+    spec = out.setdefault("spec", {})
+    if not inject_required(params, spec, metadata):
+        return out
+    if any(c.get("name") == ISTIO_SIDECAR_NAME
+           for c in spec.get("containers", ())):
+        return out   # already injected
+    data = injection_data(params, metadata, spec)
+    spec.setdefault("initContainers", []).extend(data["initContainers"])
+    spec.setdefault("containers", []).extend(data["containers"])
+    spec.setdefault("volumes", []).extend(data["volumes"])
+    annotations = metadata.setdefault("annotations", {})
+    annotations["sidecar.istio.io/status"] = "injected"
+    return out
+
+
+def _pod_template(resource: Mapping[str, Any]) -> Any:
+    kind = resource.get("kind", "")
+    if kind == "Pod":
+        return resource
+    if kind in ("Deployment", "ReplicaSet", "StatefulSet", "DaemonSet",
+                "Job", "ReplicationController"):
+        return resource.get("spec", {}).get("template")
+    return None
+
+
+def into_resource_file(params: InjectParams, in_yaml: str) -> str:
+    """inject.go:243 IntoResourceFile: inject every pod template in a
+    multi-doc YAML stream."""
+    docs = []
+    for doc in yaml.safe_load_all(in_yaml):
+        if isinstance(doc, Mapping):
+            doc = copy.deepcopy(dict(doc))
+            tmpl = _pod_template(doc)
+            if tmpl is not None:
+                injected = inject_pod(params, tmpl)
+                if doc.get("kind") == "Pod":
+                    doc = injected
+                else:
+                    doc["spec"]["template"] = injected
+        docs.append(doc)
+    return yaml.safe_dump_all(docs, sort_keys=False)

@@ -269,6 +269,8 @@ class MemoryConfigStore(ConfigStore):
     def update(self, config: Config) -> None:
         self._validate(config)
         with self._lock:
+            if config.key not in self._data:   # reference Update errors
+                raise ValidationError(f"{config.key} not found")
             self._data[config.key] = config
         self._notify(config, "update")
 

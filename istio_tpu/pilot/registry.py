@@ -19,7 +19,6 @@ from istio_tpu.pilot.model import (NetworkEndpoint, Port, Service,
                                    ServiceInstance)
 
 Handler = Callable[[Service, str], None]
-InstanceHandler = Callable[[ServiceInstance, str], None]
 
 
 class ServiceDiscovery:
@@ -53,7 +52,6 @@ class MemoryRegistry(ServiceDiscovery):
         self._instances: dict[str, list[ServiceInstance]] = {}
         self._lock = threading.Lock()
         self._svc_handlers: list[Handler] = []
-        self._inst_handlers: list[InstanceHandler] = []
 
     # -- mutation --
 
@@ -117,9 +115,6 @@ class MemoryRegistry(ServiceDiscovery):
 
     def append_service_handler(self, fn: Handler) -> None:
         self._svc_handlers.append(fn)
-
-    def append_instance_handler(self, fn: InstanceHandler) -> None:
-        self._inst_handlers.append(fn)
 
 
 class AggregateRegistry(ServiceDiscovery):

@@ -47,6 +47,7 @@ def build_route_match(match: Mapping[str, Any] | None) -> dict[str, Any]:
     request = match.get("request", {}).get("headers", {}) \
         if "request" in match else match.get("headers", {}) or {}
     for name, cond in sorted(request.items()):
+        cond = cond or {}   # null header condition = presence match
         if name == "uri":
             # exactly one of prefix/path/regex must survive — a bare
             # presence match keeps the default catch-all prefix

@@ -1,0 +1,16 @@
+"""Security — workload identity (reference: security/, SURVEY.md §2.7):
+SPIFFE-style identities, a self-signed-bootstrap CA signing workload
+CSRs, a CSR gRPC service with pluggable platform-credential
+authentication, a secret controller minting per-service-account
+key+cert bundles, and a node agent running the rotation loop.
+Backed by the `cryptography` package (real X.509, not stubs).
+"""
+from istio_tpu.security.spiffe import (identity_from_san, spiffe_id,
+                                       parse_spiffe)
+from istio_tpu.security.pki import (generate_csr, generate_key,
+                                    key_cert_pair_ok, load_cert, san_uris)
+from istio_tpu.security.ca import CertificateAuthority, IstioCA
+
+__all__ = ["identity_from_san", "spiffe_id", "parse_spiffe",
+           "generate_csr", "generate_key", "key_cert_pair_ok",
+           "load_cert", "san_uris", "CertificateAuthority", "IstioCA"]

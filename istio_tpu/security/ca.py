@@ -1,0 +1,174 @@
+"""Istio CA (reference: security/pkg/pki/ca/ca.go): the
+CertificateAuthority interface (:50 Sign/GetRootCertificate), self-
+signed bootstrap (:82 NewSelfSignedIstioCAOptions — root persisted via
+a pluggable secret store, the k8s-secret role), CSR signing (:182) with
+TTL clamping, and the secret controller minting per-service-account
+bundles (controller/secret.go).
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import threading
+from typing import Callable, Mapping
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.x509.oid import NameOID
+
+from istio_tpu.security import pki
+from istio_tpu.security.spiffe import spiffe_id
+
+DEFAULT_WORKLOAD_TTL = datetime.timedelta(hours=24 * 90)
+DEFAULT_ROOT_TTL = datetime.timedelta(days=365 * 10)
+CA_SECRET_NAME = "istio-ca-secret"       # ca.go cASecret
+WORKLOAD_SECRET_TYPE = "istio.io/key-and-cert"   # controller/secret.go
+
+
+class CAError(RuntimeError):
+    pass
+
+
+class CertificateAuthority:
+    """ca.go:50."""
+
+    def sign(self, csr_pem: bytes, ttl: datetime.timedelta | None = None
+             ) -> bytes:
+        raise NotImplementedError
+
+    def get_root_certificate(self) -> bytes:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class IstioCAOptions:
+    cert_ttl: datetime.timedelta = DEFAULT_WORKLOAD_TTL
+    max_cert_ttl: datetime.timedelta = DEFAULT_ROOT_TTL
+    org: str = "istio_tpu"
+
+
+class IstioCA(CertificateAuthority):
+    def __init__(self, signing_key_pem: bytes, signing_cert_pem: bytes,
+                 opts: IstioCAOptions | None = None):
+        self.opts = opts or IstioCAOptions()
+        self._key = pki.key_from_pem(signing_key_pem)
+        self._cert = pki.load_cert(signing_cert_pem)
+        self._cert_pem = signing_cert_pem
+        self._serial_lock = threading.Lock()
+
+    # -- construction --
+
+    @classmethod
+    def new_self_signed(cls, secret_store: "dict | None" = None,
+                        org: str = "istio_tpu",
+                        root_ttl: datetime.timedelta = DEFAULT_ROOT_TTL,
+                        opts: IstioCAOptions | None = None) -> "IstioCA":
+        """NewSelfSignedIstioCAOptions (ca.go:82): reuse the persisted
+        CA secret when present; otherwise mint a root and persist it."""
+        if secret_store is not None and CA_SECRET_NAME in secret_store:
+            blob = secret_store[CA_SECRET_NAME]
+            return cls(blob["ca-key.pem"], blob["ca-cert.pem"], opts)
+        key = pki.generate_key()
+        now = datetime.datetime.now(datetime.timezone.utc)
+        name = x509.Name([
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org)])
+        cert = (x509.CertificateBuilder()
+                .subject_name(name).issuer_name(name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - datetime.timedelta(minutes=5))
+                .not_valid_after(now + root_ttl)
+                .add_extension(x509.BasicConstraints(ca=True,
+                                                     path_length=None),
+                               critical=True)
+                .add_extension(x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=True,
+                    crl_sign=True, content_commitment=False,
+                    key_encipherment=False, data_encipherment=False,
+                    key_agreement=False, encipher_only=False,
+                    decipher_only=False), critical=True)
+                .sign(key, hashes.SHA256()))
+        key_pem = pki.key_to_pem(key)
+        cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+        if secret_store is not None:
+            secret_store[CA_SECRET_NAME] = {"ca-key.pem": key_pem,
+                                            "ca-cert.pem": cert_pem}
+        return cls(key_pem, cert_pem, opts)
+
+    # -- CertificateAuthority --
+
+    def sign(self, csr_pem: bytes,
+             ttl: datetime.timedelta | None = None) -> bytes:
+        """ca.go:182 Sign: honor the CSR's URI SANs, clamp TTL."""
+        csr = pki.load_csr(csr_pem)
+        if not csr.is_signature_valid:
+            raise CAError("CSR signature invalid")
+        ttl = ttl or self.opts.cert_ttl
+        if ttl > self.opts.max_cert_ttl:
+            raise CAError(f"requested TTL {ttl} exceeds max "
+                          f"{self.opts.max_cert_ttl}")
+        uris = pki.san_uris(csr)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (x509.CertificateBuilder()
+                   .subject_name(csr.subject)
+                   .issuer_name(self._cert.subject)
+                   .public_key(csr.public_key())
+                   .serial_number(x509.random_serial_number())
+                   .not_valid_before(now - datetime.timedelta(minutes=5))
+                   .not_valid_after(now + ttl)
+                   .add_extension(x509.BasicConstraints(ca=False,
+                                                        path_length=None),
+                                  critical=True)
+                   .add_extension(x509.ExtendedKeyUsage(
+                       [x509.ExtendedKeyUsageOID.SERVER_AUTH,
+                        x509.ExtendedKeyUsageOID.CLIENT_AUTH]),
+                       critical=False))
+        if uris:
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(
+                    [x509.UniformResourceIdentifier(u) for u in uris]),
+                critical=False)
+        cert = builder.sign(self._key, hashes.SHA256())
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+    def get_root_certificate(self) -> bytes:
+        return self._cert_pem
+
+
+class SecretController:
+    """controller/secret.go: service-account events → per-SA
+    `istio.io/key-and-cert` secrets. The SA source is pluggable (k8s in
+    the reference; any registry here); secrets land in a dict-like
+    store keyed `istio.<sa>.<ns>`."""
+
+    def __init__(self, ca: CertificateAuthority, secrets: dict,
+                 trust_domain: str = "cluster.local",
+                 ttl: datetime.timedelta = DEFAULT_WORKLOAD_TTL):
+        self.ca = ca
+        self.secrets = secrets
+        self.trust_domain = trust_domain
+        self.ttl = ttl
+
+    @staticmethod
+    def secret_name(namespace: str, sa: str) -> str:
+        return f"istio.{sa}.{namespace}"
+
+    def on_service_account(self, namespace: str, sa: str,
+                           event: str = "add") -> None:
+        name = self.secret_name(namespace, sa)
+        if event == "delete":
+            self.secrets.pop(name, None)
+            return
+        if name in self.secrets:
+            return
+        identity = spiffe_id(namespace, sa, self.trust_domain)
+        key = pki.generate_key()
+        csr = pki.generate_csr(key, identity)
+        cert = self.ca.sign(csr, self.ttl)
+        self.secrets[name] = {
+            "type": WORKLOAD_SECRET_TYPE,
+            "key.pem": pki.key_to_pem(key),
+            "cert-chain.pem": cert,
+            "root-cert.pem": self.ca.get_root_certificate(),
+            "identity": identity,
+        }

@@ -1,0 +1,172 @@
+"""CA gRPC service + client + node agent.
+
+Reference: security/pkg/server/grpc/server.go (HandleCSR :55 —
+authenticate :188 then sign), security/pkg/caclient (retrying CSR
+client), security/pkg/platform (credential fetchers: onprem certs,
+gcp/aws metadata — the cloud ones are gated here, no metadata servers
+in-image), security/cmd/node_agent/na/nodeagent.go (rotation loop).
+"""
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+import time
+from concurrent import futures
+from typing import Callable, Mapping
+
+import grpc
+
+from istio_tpu.security import pki
+from istio_tpu.security import ca_service_pb2 as pb
+from istio_tpu.security.ca import CertificateAuthority
+
+log = logging.getLogger("istio_tpu.security")
+
+# credential verifier: (credential_type, credential bytes) → identity
+# string or None (reject). The reference authenticates per platform
+# (server.go:188); tests inject their own.
+Authenticator = Callable[[str, bytes], str | None]
+
+
+def allow_all_authenticator(cred_type: str, cred: bytes) -> str | None:
+    return "anonymous"
+
+
+class CAGrpcServer:
+    def __init__(self, ca: CertificateAuthority,
+                 authenticator: Authenticator = allow_all_authenticator,
+                 address: str = "127.0.0.1:0"):
+        self.ca = ca
+        self.authenticator = authenticator
+        self._server = grpc.server(futures.ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="ca-grpc"))
+        handlers = {
+            "HandleCSR": grpc.unary_unary_rpc_method_handler(
+                self._handle_csr,
+                request_deserializer=pb.CsrRequest.FromString,
+                response_serializer=pb.CsrResponse.SerializeToString)}
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(
+                "istio.v1.auth.IstioCAService", handlers),))
+        self.port = self._server.add_insecure_port(address)
+
+    def start(self) -> int:
+        self._server.start()
+        log.info("CA grpc server on port %d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace).wait()
+
+    def _handle_csr(self, request: "pb.CsrRequest", context
+                    ) -> "pb.CsrResponse":
+        ident = self.authenticator(request.credential_type,
+                                   request.node_agent_credential)
+        if ident is None:
+            return pb.CsrResponse(is_approved=False,
+                                  status_message="authentication failed")
+        try:
+            ttl = datetime.timedelta(
+                minutes=request.requested_ttl_minutes) \
+                if request.requested_ttl_minutes else None
+            cert = self.ca.sign(bytes(request.csr_pem), ttl)
+        except Exception as exc:
+            return pb.CsrResponse(is_approved=False,
+                                  status_message=f"signing failed: {exc}")
+        return pb.CsrResponse(
+            is_approved=True, signed_cert=cert,
+            cert_chain=self.ca.get_root_certificate())
+
+
+class CAClient:
+    """caclient/grpc: CSR submission with bounded retries."""
+
+    def __init__(self, target: str, max_retries: int = 3,
+                 retry_interval_s: float = 0.2):
+        self._channel = grpc.insecure_channel(target)
+        self._call = self._channel.unary_unary(
+            "/istio.v1.auth.IstioCAService/HandleCSR",
+            request_serializer=pb.CsrRequest.SerializeToString,
+            response_deserializer=pb.CsrResponse.FromString)
+        self.max_retries = max_retries
+        self.retry_interval_s = retry_interval_s
+
+    def sign_csr(self, csr_pem: bytes, credential: bytes = b"",
+                 credential_type: str = "onprem",
+                 ttl_minutes: int = 0) -> "pb.CsrResponse":
+        req = pb.CsrRequest(csr_pem=csr_pem,
+                            node_agent_credential=credential,
+                            credential_type=credential_type,
+                            requested_ttl_minutes=ttl_minutes)
+        last: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                return self._call(req)
+            except grpc.RpcError as exc:
+                last = exc
+                time.sleep(self.retry_interval_s * (2 ** attempt))
+        raise last   # type: ignore[misc]
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class NodeAgent:
+    """node_agent rotation loop (na/nodeagent.go): obtain a workload
+    cert, sleep until ~half its lifetime remains, repeat. Certs land in
+    a pluggable sink (filesystem in the reference; callable here)."""
+
+    def __init__(self, client: CAClient, identity: str,
+                 on_certs: Callable[[bytes, bytes, bytes], None],
+                 ttl_minutes: int = 60,
+                 rotation_fraction: float = 0.5,
+                 credential: bytes = b"", credential_type: str = "onprem"):
+        self.client = client
+        self.identity = identity
+        self.on_certs = on_certs
+        self.ttl_minutes = ttl_minutes
+        self.rotation_fraction = rotation_fraction
+        self.credential = credential
+        self.credential_type = credential_type
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.rotations = 0
+
+    def rotate_once(self) -> bytes:
+        key = pki.generate_key()
+        csr = pki.generate_csr(key, self.identity)
+        resp = self.client.sign_csr(csr, self.credential,
+                                    self.credential_type,
+                                    self.ttl_minutes)
+        if not resp.is_approved:
+            raise RuntimeError(f"CSR rejected: {resp.status_message}")
+        self.on_certs(pki.key_to_pem(key), bytes(resp.signed_cert),
+                      bytes(resp.cert_chain))
+        self.rotations += 1
+        return bytes(resp.signed_cert)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-agent")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        backoff = 1.0
+        while not self._stop.is_set():
+            try:
+                cert_pem = self.rotate_once()
+                backoff = 1.0
+                remaining = pki.not_after(cert_pem) - \
+                    datetime.datetime.now(datetime.timezone.utc)
+                wait = remaining.total_seconds() * self.rotation_fraction
+            except Exception as exc:
+                log.warning("rotation failed: %s", exc)
+                wait = backoff
+                backoff = min(backoff * 2, 300.0)
+            self._stop.wait(max(wait, 0.01))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

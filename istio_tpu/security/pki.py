@@ -1,0 +1,91 @@
+"""PKI primitives (reference: security/pkg/pki/{crypto.go,san.go},
+ca/{generate_cert,generate_csr}.go) via the `cryptography` package:
+key generation, CSRs carrying SPIFFE URI SANs, PEM load/inspect
+helpers, and key↔cert consistency checks.
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Sequence
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, rsa
+from cryptography.x509.oid import NameOID
+
+
+def generate_key(ec_key: bool = True):
+    """EC P-256 by default (fast, small); RSA-2048 optional (the
+    reference default)."""
+    if ec_key:
+        return ec.generate_private_key(ec.SECP256R1())
+    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+
+def key_to_pem(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+
+
+def key_from_pem(pem: bytes):
+    return serialization.load_pem_private_key(pem, password=None)
+
+
+def generate_csr(key, identity: str, org: str = "istio_tpu") -> bytes:
+    """CSR with the workload identity as a URI SAN (generate_csr.go)."""
+    builder = x509.CertificateSigningRequestBuilder().subject_name(
+        x509.Name([x509.NameAttribute(NameOID.ORGANIZATION_NAME, org)])
+    ).add_extension(
+        x509.SubjectAlternativeName(
+            [x509.UniformResourceIdentifier(identity)]),
+        critical=False)
+    return builder.sign(key, hashes.SHA256()).public_bytes(
+        serialization.Encoding.PEM)
+
+
+def load_csr(pem: bytes) -> x509.CertificateSigningRequest:
+    return x509.load_pem_x509_csr(pem)
+
+
+def load_cert(pem: bytes) -> x509.Certificate:
+    return x509.load_pem_x509_certificate(pem)
+
+
+def san_uris(cert_or_csr) -> list[str]:
+    """URI SANs of a cert/CSR (san.go ExtractSANExtension)."""
+    try:
+        ext = cert_or_csr.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName)
+    except x509.ExtensionNotFound:
+        return []
+    return list(ext.value.get_values_for_type(
+        x509.UniformResourceIdentifier))
+
+
+def key_cert_pair_ok(key_pem: bytes, cert_pem: bytes) -> bool:
+    key = key_from_pem(key_pem)
+    cert = load_cert(cert_pem)
+    a = key.public_key().public_bytes(
+        serialization.Encoding.DER,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    b = cert.public_key().public_bytes(
+        serialization.Encoding.DER,
+        serialization.PublicFormat.SubjectPublicKeyInfo)
+    return a == b
+
+
+def verify_chain(cert_pem: bytes, root_pem: bytes) -> bool:
+    """Leaf-signed-by-root check (crypto.go verify path)."""
+    cert = load_cert(cert_pem)
+    root = load_cert(root_pem)
+    try:
+        cert.verify_directly_issued_by(root)
+        return True
+    except Exception:
+        return False
+
+
+def not_after(cert_pem: bytes) -> datetime.datetime:
+    return load_cert(cert_pem).not_valid_after_utc

@@ -1,0 +1,40 @@
+"""SPIFFE identities (reference: the URI SAN format at
+security/pkg/pki/ca/controller/secret.go:229 and
+security/pkg/registry/kube/serviceaccount.go:79):
+
+    spiffe://<trust-domain>/ns/<namespace>/sa/<service-account>
+"""
+from __future__ import annotations
+
+DEFAULT_TRUST_DOMAIN = "cluster.local"
+URI_SCHEME = "spiffe"
+
+
+class SpiffeError(ValueError):
+    pass
+
+
+def spiffe_id(namespace: str, service_account: str,
+              trust_domain: str = DEFAULT_TRUST_DOMAIN) -> str:
+    return (f"{URI_SCHEME}://{trust_domain}/ns/{namespace}"
+            f"/sa/{service_account}")
+
+
+def parse_spiffe(uri: str) -> tuple[str, str, str]:
+    """→ (trust_domain, namespace, service_account)."""
+    prefix = f"{URI_SCHEME}://"
+    if not uri.startswith(prefix):
+        raise SpiffeError(f"not a spiffe uri: {uri}")
+    rest = uri[len(prefix):]
+    parts = rest.split("/")
+    if len(parts) != 5 or parts[1] != "ns" or parts[3] != "sa":
+        raise SpiffeError(f"malformed spiffe uri: {uri}")
+    return parts[0], parts[2], parts[4]
+
+
+def identity_from_san(uris: list[str]) -> str | None:
+    """First spiffe URI SAN, if any (san.go ExtractIDs role)."""
+    for uri in uris:
+        if uri.startswith(f"{URI_SCHEME}://"):
+            return uri
+    return None

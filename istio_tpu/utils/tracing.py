@@ -1,0 +1,75 @@
+"""Control-plane tracing (reference: pkg/tracing/config.go:87
+Configure — zipkin HTTP / log-only span reporters wired into gRPC
+servers). Spans are zipkin-v2-shaped dicts; reporters are pluggable:
+LogReporter (the reference's log-span option) and MemoryReporter
+(tests). A zipkin HTTP reporter is a seam — this image has no egress.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Callable
+
+log = logging.getLogger("istio_tpu.tracing")
+
+Reporter = Callable[[dict], None]
+
+
+def log_reporter(span: dict) -> None:
+    log.info("span %s/%s %s %.3fms", span.get("traceId"),
+             span.get("id"), span.get("name"),
+             span.get("duration", 0) / 1000.0)
+
+
+class MemoryReporter:
+    def __init__(self) -> None:
+        self.spans: list[dict] = []
+        self._lock = threading.Lock()
+
+    def __call__(self, span: dict) -> None:
+        with self._lock:
+            self.spans.append(span)
+
+
+@dataclasses.dataclass
+class Tracer:
+    service_name: str = "istio-tpu"
+    reporter: Reporter = log_reporter
+    _local: threading.local = dataclasses.field(
+        default_factory=threading.local)
+
+    def _current(self) -> dict | None:
+        return getattr(self._local, "span", None)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **tags: Any):
+        parent = self._current()
+        span = {
+            "traceId": parent["traceId"] if parent
+            else uuid.uuid4().hex[:16],
+            "id": uuid.uuid4().hex[:16],
+            "name": name,
+            "localEndpoint": {"serviceName": self.service_name},
+            "timestamp": int(time.time() * 1e6),
+            "tags": {k: str(v) for k, v in tags.items()},
+        }
+        if parent:
+            span["parentId"] = parent["id"]
+        self._local.span = span
+        t0 = time.perf_counter()
+        try:
+            yield span
+        except Exception as exc:
+            span["tags"]["error"] = str(exc)
+            raise
+        finally:
+            span["duration"] = int((time.perf_counter() - t0) * 1e6)
+            self._local.span = parent
+            try:
+                self.reporter(span)
+            except Exception:
+                log.exception("span reporter failed")

@@ -1,0 +1,150 @@
+"""Broker OSB API, sidecar injection, tracing, CLI surface."""
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+import yaml
+
+from istio_tpu.broker import BrokerServer
+from istio_tpu.pilot.inject import (InjectParams, inject_pod,
+                                    inject_required, into_resource_file)
+from istio_tpu.utils.tracing import MemoryReporter, Tracer
+
+
+CATALOG = [{"id": "svc-1", "name": "reviews", "bindable": True,
+            "plans": [{"id": "plan-1", "name": "default"}]}]
+
+
+def _req(method, url, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_broker_osb_lifecycle():
+    broker = BrokerServer(CATALOG)
+    port = broker.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        code, cat = _req("GET", f"{base}/v2/catalog")
+        assert code == 200 and cat["services"][0]["name"] == "reviews"
+        code, _ = _req("PUT", f"{base}/v2/service_instances/i1",
+                       {"service_id": "svc-1", "plan_id": "plan-1"})
+        assert code == 201
+        code, _ = _req("PUT", f"{base}/v2/service_instances/i1",
+                       {"service_id": "svc-1", "plan_id": "plan-1"})
+        assert code == 200                      # idempotent re-provision
+        code, _ = _req("PUT", f"{base}/v2/service_instances/i2",
+                       {"service_id": "nope"})
+        assert code == 400
+        code, _ = _req("PUT",
+                       f"{base}/v2/service_instances/i1/service_bindings/b1",
+                       {"service_id": "svc-1"})
+        assert code == 201
+        code, _ = _req("DELETE",
+                       f"{base}/v2/service_instances/i1/service_bindings/b1")
+        assert code == 200
+        code, _ = _req("DELETE", f"{base}/v2/service_instances/i1")
+        assert code == 200
+        code, _ = _req("DELETE", f"{base}/v2/service_instances/i1")
+        assert code == 410
+    finally:
+        broker.stop()
+
+
+POD = {"kind": "Pod",
+       "metadata": {"name": "web", "namespace": "default"},
+       "spec": {"containers": [{"name": "app", "image": "web:1"}]}}
+
+
+def test_inject_policy():
+    params = InjectParams()
+    assert inject_required(params, POD["spec"], POD["metadata"])
+    assert not inject_required(params, {"hostNetwork": True}, {})
+    assert not inject_required(
+        params, POD["spec"],
+        {"annotations": {"sidecar.istio.io/inject": "false"}})
+    opt_in = InjectParams(policy="disabled")
+    assert not inject_required(opt_in, POD["spec"], POD["metadata"])
+    assert inject_required(
+        opt_in, POD["spec"],
+        {"annotations": {"sidecar.istio.io/inject": "true"}})
+
+
+def test_inject_pod_idempotent():
+    out = inject_pod(InjectParams(), POD)
+    names = [c["name"] for c in out["spec"]["containers"]]
+    assert names == ["app", "istio-proxy"]
+    assert out["spec"]["initContainers"][0]["name"] == "istio-init"
+    assert out["metadata"]["annotations"][
+        "sidecar.istio.io/status"] == "injected"
+    again = inject_pod(InjectParams(), out)
+    assert len(again["spec"]["containers"]) == 2    # no double inject
+    # original untouched
+    assert [c["name"] for c in POD["spec"]["containers"]] == ["app"]
+
+
+def test_into_resource_file_deployment():
+    deployment = {"kind": "Deployment",
+                  "metadata": {"name": "web"},
+                  "spec": {"template": dict(POD, kind=None)}}
+    out_yaml = into_resource_file(InjectParams(),
+                                  yaml.safe_dump(deployment))
+    out = list(yaml.safe_load_all(out_yaml))[0]
+    tmpl = out["spec"]["template"]
+    assert any(c["name"] == "istio-proxy"
+               for c in tmpl["spec"]["containers"])
+
+
+def test_tracer_spans_nest():
+    rep = MemoryReporter()
+    tracer = Tracer(reporter=rep)
+    with tracer.span("check", rpc="Check"):
+        with tracer.span("resolve"):
+            pass
+    assert len(rep.spans) == 2
+    child, parent = rep.spans
+    assert child["name"] == "resolve"
+    assert child["parentId"] == parent["id"]
+    assert child["traceId"] == parent["traceId"]
+    assert parent["tags"]["rpc"] == "Check"
+
+
+def test_cli_parser_covers_all_tools():
+    from istio_tpu.cmd.__main__ import build_parser
+    parser = build_parser()
+    for argv in (["mixc", "check"],
+                 ["istioctl", "get", "route-rule"],
+                 ["mixs"], ["pilot-discovery"], ["brks"],
+                 ["node-agent", "--identity", "spiffe://c/ns/a/sa/b"]):
+        args = parser.parse_args(argv)
+        assert callable(args.fn)
+
+
+def test_istioctl_create_get_delete(tmp_path):
+    from istio_tpu.cmd.__main__ import main
+    rule = {"kind": "route-rule",
+            "metadata": {"name": "r1", "namespace": "default"},
+            "spec": {"destination": {"name": "reviews"},
+                     "route": [{"labels": {"version": "v1"}}]}}
+    f = tmp_path / "rule.yaml"
+    f.write_text(yaml.safe_dump(rule))
+    assert main(["istioctl", "create", "-f", str(f),
+                 "--config-dir", str(tmp_path)]) == 0
+    assert (tmp_path / "route-rule-default-r1.yaml").exists()
+    assert main(["istioctl", "create", "-f", str(f),
+                 "--config-dir", str(tmp_path)]) == 1   # already exists
+    assert main(["istioctl", "delete", "--config-dir", str(tmp_path),
+                 "route-rule", "r1", "-n", "default"]) == 0
+    # invalid config rejected
+    bad = {"kind": "route-rule", "metadata": {"name": "bad"},
+           "spec": {"route": [{"weight": 50}]}}
+    fb = tmp_path / "bad.yaml"
+    fb.write_text(yaml.safe_dump(bad))
+    assert main(["istioctl", "create", "-f", str(fb),
+                 "--config-dir", str(tmp_path)]) == 1

@@ -1,0 +1,127 @@
+"""Security e2e: CA bootstrap/persistence, CSR signing with SPIFFE
+SANs, secret controller, CSR gRPC service + retrying client, node
+agent rotation (reference: security/pkg tests +
+security/tests/integration certificateRotationTest)."""
+import datetime
+import time
+
+import pytest
+
+from istio_tpu.security import (IstioCA, generate_csr, generate_key,
+                                key_cert_pair_ok, load_cert, san_uris,
+                                parse_spiffe, spiffe_id)
+from istio_tpu.security.ca import (CAError, IstioCAOptions,
+                                   SecretController, CA_SECRET_NAME)
+from istio_tpu.security.ca_service import (CAClient, CAGrpcServer,
+                                           NodeAgent)
+from istio_tpu.security.pki import key_to_pem, not_after, verify_chain
+
+
+def test_spiffe_roundtrip():
+    ident = spiffe_id("default", "bookinfo-productpage")
+    assert ident == ("spiffe://cluster.local/ns/default"
+                     "/sa/bookinfo-productpage")
+    assert parse_spiffe(ident) == ("cluster.local", "default",
+                                   "bookinfo-productpage")
+
+
+def test_self_signed_ca_persistence_and_sign():
+    secrets: dict = {}
+    ca = IstioCA.new_self_signed(secrets)
+    assert CA_SECRET_NAME in secrets
+    # second boot reuses the persisted root (ca.go:82 reuse branch)
+    ca2 = IstioCA.new_self_signed(secrets)
+    assert ca2.get_root_certificate() == ca.get_root_certificate()
+
+    key = generate_key()
+    ident = spiffe_id("ns1", "sa1")
+    cert_pem = ca.sign(generate_csr(key, ident))
+    assert san_uris(load_cert(cert_pem)) == [ident]
+    assert key_cert_pair_ok(key_to_pem(key), cert_pem)
+    assert verify_chain(cert_pem, ca.get_root_certificate())
+
+
+def test_ttl_clamp():
+    ca = IstioCA.new_self_signed(
+        {}, opts=IstioCAOptions(
+            max_cert_ttl=datetime.timedelta(hours=1)))
+    key = generate_key()
+    csr = generate_csr(key, spiffe_id("a", "b"))
+    with pytest.raises(CAError):
+        ca.sign(csr, datetime.timedelta(days=30))
+    cert = ca.sign(csr, datetime.timedelta(minutes=30))
+    remaining = not_after(cert) - datetime.datetime.now(
+        datetime.timezone.utc)
+    assert remaining < datetime.timedelta(hours=1)
+
+
+def test_secret_controller():
+    secrets: dict = {}
+    ca = IstioCA.new_self_signed({})
+    ctl = SecretController(ca, secrets)
+    ctl.on_service_account("default", "productpage")
+    name = "istio.productpage.default"
+    assert name in secrets
+    blob = secrets[name]
+    assert blob["identity"] == \
+        "spiffe://cluster.local/ns/default/sa/productpage"
+    assert key_cert_pair_ok(blob["key.pem"], blob["cert-chain.pem"])
+    # idempotent on repeat add; removed on delete
+    ctl.on_service_account("default", "productpage")
+    assert len(secrets) == 1
+    ctl.on_service_account("default", "productpage", event="delete")
+    assert name not in secrets
+
+
+@pytest.fixture()
+def ca_rig():
+    ca = IstioCA.new_self_signed({})
+    server = CAGrpcServer(ca)
+    port = server.start()
+    client = CAClient(f"127.0.0.1:{port}")
+    yield ca, client
+    client.close()
+    server.stop()
+
+
+def test_csr_grpc_roundtrip(ca_rig):
+    ca, client = ca_rig
+    key = generate_key()
+    ident = spiffe_id("default", "node-agent-test")
+    resp = client.sign_csr(generate_csr(key, ident), ttl_minutes=45)
+    assert resp.is_approved, resp.status_message
+    assert san_uris(load_cert(bytes(resp.signed_cert))) == [ident]
+    assert bytes(resp.cert_chain) == ca.get_root_certificate()
+
+
+def test_csr_authentication_rejected():
+    ca = IstioCA.new_self_signed({})
+    server = CAGrpcServer(
+        ca, authenticator=lambda t, c: "id" if c == b"good" else None)
+    port = server.start()
+    client = CAClient(f"127.0.0.1:{port}")
+    try:
+        key = generate_key()
+        csr = generate_csr(key, spiffe_id("a", "b"))
+        ok = client.sign_csr(csr, credential=b"good")
+        assert ok.is_approved
+        bad = client.sign_csr(csr, credential=b"evil")
+        assert not bad.is_approved
+        assert "authentication" in bad.status_message
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_node_agent_rotation(ca_rig):
+    _, client = ca_rig
+    bundles = []
+    agent = NodeAgent(client, spiffe_id("default", "vm-workload"),
+                      on_certs=lambda k, c, r: bundles.append((k, c, r)),
+                      ttl_minutes=1)   # rotate at ~30s — force manually
+    agent.rotate_once()
+    agent.rotate_once()
+    assert agent.rotations == 2 and len(bundles) == 2
+    (key_pem, cert_pem, root_pem) = bundles[-1]
+    assert key_cert_pair_ok(key_pem, cert_pem)
+    assert verify_chain(cert_pem, root_pem)
