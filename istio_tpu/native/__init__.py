@@ -1,0 +1,18 @@
+"""Native (C++) runtime components.
+
+The reference's load-bearing native code is the Envoy data plane + the
+mixerclient filter (SURVEY.md §2.9) — the pieces that sit on the wire
+and feed the policy engine. This package is their TPU-native
+equivalent: a C++ shim that parses dictionary-compressed
+istio.mixer.v1 attribute batches straight off the wire and fills the
+AttributeBatch tensor buffers the device step consumes, bypassing the
+Python per-request decode/intern loop (~30µs/request → ~1µs/request).
+
+Built on demand with g++ against the system libprotobuf; the Python
+Tensorizer (compiler/layout.py) is the semantics oracle it is
+conformance-tested against byte-for-byte.
+"""
+from istio_tpu.native.build import NativeBuildError, ensure_built
+from istio_tpu.native.tensorizer import NativeTensorizer
+
+__all__ = ["NativeTensorizer", "ensure_built", "NativeBuildError"]

@@ -1,0 +1,47 @@
+"""On-demand build of the native shim (protoc --cpp_out + g++)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "_build")
+_SO = os.path.join(_BUILD, "libmixer_shim.so")
+_PROTO_DIR = os.path.join(_DIR, "..", "api", "proto")
+_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _newer(a: str, b: str) -> bool:
+    return os.path.getmtime(a) > os.path.getmtime(b)
+
+
+def ensure_built() -> str:
+    """Compile (once) and return the shared-library path."""
+    src = os.path.join(_DIR, "shim.cpp")
+    with _lock:
+        if os.path.exists(_SO) and not _newer(src, _SO):
+            return _SO
+        os.makedirs(_BUILD, exist_ok=True)
+        proto = os.path.join(_PROTO_DIR, "mixer.proto")
+        try:
+            subprocess.run(
+                ["protoc", f"-I{_PROTO_DIR}", "-I/usr/include",
+                 f"--cpp_out={_BUILD}", proto],
+                check=True, capture_output=True, text=True)
+            subprocess.run(
+                ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+                 f"-I{_BUILD}", src,
+                 os.path.join(_BUILD, "mixer.pb.cc"),
+                 "-lprotobuf", "-o", _SO],
+                check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as exc:
+            raise NativeBuildError(
+                f"native shim build failed:\n{exc.stderr}") from exc
+        except FileNotFoundError as exc:
+            raise NativeBuildError(f"toolchain missing: {exc}") from exc
+        return _SO

@@ -1,0 +1,353 @@
+// Native wire→tensor shim.
+//
+// Parses serialized istio.mixer.v1.CompressedAttributes records and
+// fills the AttributeBatch buffers (ids / present / map_present /
+// str_bytes / str_lens) exactly like the Python Tensorizer
+// (istio_tpu/compiler/layout.py), which is the conformance oracle.
+// The intern table is authoritative HERE once the shim is in use:
+// Python seeds it with compile-time constants and imports any new
+// entries after each batch (export API below).
+//
+// C ABI only — loaded via ctypes (no pybind11 in this image).
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "mixer.pb.h"
+
+using istio::mixer::v1::CompressedAttributes;
+
+namespace {
+
+constexpr int32_t ID_INVALID = 0;
+constexpr int32_t ID_FALSE = 1;
+constexpr int32_t ID_TRUE = 2;
+
+// canonical intern key: 1 type-tag byte + canonical payload
+// (mirrors layout.py _normalize)
+using Key = std::string;
+
+Key key_bool(bool v) { return std::string("b") + (v ? '\1' : '\0'); }
+Key key_i64(int64_t v) {
+  std::string k("i");
+  k.append(reinterpret_cast<const char*>(&v), 8);
+  return k;
+}
+Key key_f64(double v) {
+  std::string k("d");
+  k.append(reinterpret_cast<const char*>(&v), 8);
+  return k;
+}
+Key key_str(const std::string& v) { return "s" + v; }
+Key key_bytes(const std::string& raw) {
+  // v4 → v4-in-v6 canonical form (net.IP.Equal semantics)
+  std::string v = raw;
+  if (v.size() == 4) {
+    std::string mapped(10, '\0');
+    mapped += "\xff\xff";
+    mapped += v;
+    v = mapped;
+  }
+  return "p" + v;
+}
+Key key_dur_ns(int64_t ns) {
+  std::string k("D");
+  k.append(reinterpret_cast<const char*>(&ns), 8);
+  return k;
+}
+Key key_ts_ns(int64_t ns) {
+  std::string k("t");
+  k.append(reinterpret_cast<const char*>(&ns), 8);
+  return k;
+}
+
+// Python normalizes datetimes/timedeltas through float seconds
+// (round(value.timestamp() * 1e9)); replicate the same IEEE ops so ids
+// agree bit-for-bit. Proto → datetime truncates to microseconds.
+int64_t ts_ns_like_python(int64_t seconds, int32_t nanos) {
+  double ts = static_cast<double>(seconds) +
+              static_cast<double>(nanos / 1000) / 1e6;
+  return llround(ts * 1e9);
+}
+int64_t dur_ns_like_python(int64_t seconds, int32_t nanos) {
+  double total = static_cast<double>(seconds) +
+                 static_cast<double>(nanos / 1000) / 1e6;
+  return llround(total * 1e9);
+}
+
+struct Layout {
+  uint32_t max_str_len = 128;
+  std::vector<std::string> global_words;
+  std::map<std::string, int32_t> scalar_slots;          // attr → col
+  std::map<std::string, int32_t> map_slots;             // map attr → mcol
+  std::map<std::pair<std::string, std::string>, int32_t> derived;  // (map,key)→col
+  std::map<std::string, int32_t> byte_attr;             // attr → bcol
+  std::map<std::pair<std::string, std::string>, int32_t> byte_pair;
+  uint32_t n_columns = 0, n_maps = 0, n_byte = 0;
+};
+
+struct Shim {
+  Layout layout;
+  std::map<Key, int32_t> interns;
+  std::vector<Key> intern_order;   // ids 3.. in assignment order
+  std::string error;
+
+  // ids: 0 invalid, 1 false, 2 true, then sequential
+  int32_t intern(const Key& k) {
+    auto it = interns.find(k);
+    if (it != interns.end()) return it->second;
+    int32_t id = next_id_++;
+    interns.emplace(k, id);
+    intern_order.push_back(k);
+    return id;
+  }
+  int32_t next_id_ = 3;
+};
+
+// ---- little binary reader for the layout blob Python packs ----
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  uint32_t u32() {
+    if (p + 4 > end) { ok = false; return 0; }
+    uint32_t v;
+    memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  uint8_t u8() {
+    if (p >= end) { ok = false; return 0; }
+    return *p++;
+  }
+  std::string str() {
+    uint32_t n = u32();
+    if (!ok || p + n > end) { ok = false; return ""; }
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+const std::string* resolve_word(const Shim& sh,
+                                const CompressedAttributes& msg,
+                                int32_t index) {
+  if (index < 0) {
+    size_t gi = static_cast<size_t>(-index - 1);
+    if (gi >= sh.layout.global_words.size()) return nullptr;
+    return &sh.layout.global_words[gi];
+  }
+  if (index >= msg.words_size()) return nullptr;
+  return &msg.words(index);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shim_create(const uint8_t* blob, size_t len) {
+  auto* sh = new Shim();
+  Reader r{blob, blob + len};
+  uint32_t magic = r.u32();
+  if (magic != 0x49545031) {  // "ITP1"
+    delete sh;
+    return nullptr;
+  }
+  Layout& L = sh->layout;
+  L.max_str_len = r.u32();
+  uint32_t n = r.u32();
+  for (uint32_t i = 0; i < n; i++) L.global_words.push_back(r.str());
+  n = r.u32();
+  for (uint32_t i = 0; i < n; i++) {
+    int32_t col = static_cast<int32_t>(r.u32());
+    L.scalar_slots[r.str()] = col;
+  }
+  n = r.u32();
+  for (uint32_t i = 0; i < n; i++) {
+    int32_t col = static_cast<int32_t>(r.u32());
+    L.map_slots[r.str()] = col;
+  }
+  n = r.u32();
+  for (uint32_t i = 0; i < n; i++) {
+    int32_t col = static_cast<int32_t>(r.u32());
+    std::string m = r.str(), k = r.str();
+    L.derived[{m, k}] = col;
+  }
+  n = r.u32();
+  for (uint32_t i = 0; i < n; i++) {
+    int32_t bcol = static_cast<int32_t>(r.u32());
+    uint8_t is_pair = r.u8();
+    std::string a = r.str();
+    if (is_pair) {
+      std::string k = r.str();
+      L.byte_pair[{a, k}] = bcol;
+    } else {
+      L.byte_attr[a] = bcol;
+    }
+  }
+  L.n_columns = r.u32();
+  L.n_maps = r.u32();
+  L.n_byte = r.u32();
+  // seed interns (tag + canonical payload, pre-keyed by Python)
+  n = r.u32();
+  sh->interns[key_bool(false)] = ID_FALSE;
+  sh->interns[key_bool(true)] = ID_TRUE;
+  for (uint32_t i = 0; i < n; i++) {
+    std::string key = r.str();
+    if (sh->interns.find(key) == sh->interns.end()) {
+      sh->interns[key] = sh->next_id_++;
+      sh->intern_order.push_back(key);   // keeps export indexable
+    }
+  }
+  if (!r.ok) {
+    delete sh;
+    return nullptr;
+  }
+  return sh;
+}
+
+void shim_destroy(void* h) { delete static_cast<Shim*>(h); }
+
+const char* shim_error(void* h) {
+  return static_cast<Shim*>(h)->error.c_str();
+}
+
+int32_t shim_intern_count(void* h) {
+  return static_cast<Shim*>(h)->next_id_;
+}
+
+// Export canonical keys for ids in [from_id, next_id): packed as
+// u32 len + bytes per key. Returns bytes written or -needed.
+int64_t shim_export_interns(void* h, int32_t from_id, uint8_t* buf,
+                            size_t cap) {
+  auto* sh = static_cast<Shim*>(h);
+  size_t need = 0;
+  std::vector<const Key*> keys;
+  for (int32_t id = from_id; id < sh->next_id_; id++) {
+    const Key& k = sh->intern_order[id - 3];
+    keys.push_back(&k);
+    need += 4 + k.size();
+  }
+  if (need > cap) return -static_cast<int64_t>(need);
+  uint8_t* p = buf;
+  for (auto* k : keys) {
+    uint32_t n = static_cast<uint32_t>(k->size());
+    memcpy(p, &n, 4);
+    p += 4;
+    memcpy(p, k->data(), n);
+    p += n;
+  }
+  return static_cast<int64_t>(need);
+}
+
+// Tensorize a batch of serialized CompressedAttributes.
+// Buffers (caller-allocated, zeroed):
+//   ids        int32 [n, n_columns]
+//   present    uint8 [n, n_columns]
+//   map_present uint8 [n, max(n_maps,1)]
+//   str_bytes  uint8 [n, max(n_byte,1), max_str_len]
+//   str_lens   int32 [n, max(n_byte,1)]
+// Returns 0 on success, <0 on parse error (row index encoded).
+int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
+                       const int64_t* msg_lens, int32_t n,
+                       int32_t* ids, uint8_t* present,
+                       uint8_t* map_present, uint8_t* str_bytes,
+                       int32_t* str_lens) {
+  auto* sh = static_cast<Shim*>(h);
+  const Layout& L = sh->layout;
+  const size_t ncol = L.n_columns;
+  const size_t nmap = L.n_maps ? L.n_maps : 1;
+  const size_t nbyte = L.n_byte ? L.n_byte : 1;
+  const size_t slen = L.max_str_len;
+
+  CompressedAttributes msg;
+  for (int32_t i = 0; i < n; i++) {
+    msg.Clear();
+    if (!msg.ParseFromArray(msgs[i], static_cast<int>(msg_lens[i]))) {
+      sh->error = "parse failure at record " + std::to_string(i);
+      return -(i + 1);
+    }
+    int32_t* row_ids = ids + i * ncol;
+    uint8_t* row_p = present + i * ncol;
+    uint8_t* row_mp = map_present + i * nmap;
+    uint8_t* row_sb = str_bytes + i * nbyte * slen;
+    int32_t* row_sl = str_lens + i * nbyte;
+
+    auto set_scalar = [&](const std::string& name, const Key& key) {
+      auto it = L.scalar_slots.find(name);
+      if (it == L.scalar_slots.end()) return;
+      row_ids[it->second] = sh->intern(key);
+      row_p[it->second] = 1;
+    };
+    auto set_bytes_slot = [&](int32_t bcol, const std::string& value) {
+      size_t m = value.size() < slen ? value.size() : slen;
+      memcpy(row_sb + bcol * slen, value.data(), m);
+      row_sl[bcol] = static_cast<int32_t>(m);
+    };
+
+    for (const auto& kv : msg.strings()) {
+      const std::string* name = resolve_word(*sh, msg, kv.first);
+      const std::string* value = resolve_word(*sh, msg, kv.second);
+      if (!name || !value) continue;
+      set_scalar(*name, key_str(*value));
+      auto bit = L.byte_attr.find(*name);
+      if (bit != L.byte_attr.end()) set_bytes_slot(bit->second, *value);
+    }
+    for (const auto& kv : msg.int64s()) {
+      const std::string* name = resolve_word(*sh, msg, kv.first);
+      if (name) set_scalar(*name, key_i64(kv.second));
+    }
+    for (const auto& kv : msg.doubles()) {
+      const std::string* name = resolve_word(*sh, msg, kv.first);
+      if (name) set_scalar(*name, key_f64(kv.second));
+    }
+    for (const auto& kv : msg.bools()) {
+      const std::string* name = resolve_word(*sh, msg, kv.first);
+      if (!name) continue;
+      auto it = L.scalar_slots.find(*name);
+      if (it == L.scalar_slots.end()) continue;
+      row_ids[it->second] = kv.second ? ID_TRUE : ID_FALSE;
+      row_p[it->second] = 1;
+    }
+    for (const auto& kv : msg.bytes()) {
+      const std::string* name = resolve_word(*sh, msg, kv.first);
+      if (name) set_scalar(*name, key_bytes(kv.second));
+    }
+    for (const auto& kv : msg.timestamps()) {
+      const std::string* name = resolve_word(*sh, msg, kv.first);
+      if (name)
+        set_scalar(*name, key_ts_ns(ts_ns_like_python(
+                              kv.second.seconds(), kv.second.nanos())));
+    }
+    for (const auto& kv : msg.durations()) {
+      const std::string* name = resolve_word(*sh, msg, kv.first);
+      if (name)
+        set_scalar(*name, key_dur_ns(dur_ns_like_python(
+                              kv.second.seconds(), kv.second.nanos())));
+    }
+    for (const auto& kv : msg.string_maps()) {
+      const std::string* mname = resolve_word(*sh, msg, kv.first);
+      if (!mname) continue;
+      auto mit = L.map_slots.find(*mname);
+      if (mit != L.map_slots.end()) row_mp[mit->second] = 1;
+      for (const auto& ekv : kv.second.entries()) {
+        const std::string* key = resolve_word(*sh, msg, ekv.first);
+        const std::string* value = resolve_word(*sh, msg, ekv.second);
+        if (!key || !value) continue;
+        auto dit = L.derived.find({*mname, *key});
+        if (dit != L.derived.end()) {
+          row_ids[dit->second] = sh->intern(key_str(*value));
+          row_p[dit->second] = 1;
+        }
+        auto bit = L.byte_pair.find({*mname, *key});
+        if (bit != L.byte_pair.end()) set_bytes_slot(bit->second, *value);
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

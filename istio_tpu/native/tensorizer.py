@@ -1,0 +1,196 @@
+"""ctypes wrapper: NativeTensorizer — wire bytes → AttributeBatch.
+
+Drop-in accelerated replacement for compiler/layout.Tensorizer on the
+serving path: input is serialized istio.mixer.v1.CompressedAttributes
+records (what Check RPCs carry), output is the same AttributeBatch the
+device step consumes. The shim owns the authoritative intern table; new
+entries are mirrored back into the Python InternTable after every batch
+(so compiled constants and verdict decode stay consistent).
+"""
+from __future__ import annotations
+
+import ctypes
+import datetime
+import struct
+from typing import Any, Sequence
+
+import numpy as np
+
+from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
+from istio_tpu.compiler.layout import (AttributeBatch, BatchLayout,
+                                       InternTable, _normalize)
+from istio_tpu.native.build import ensure_built
+
+_MAGIC = 0x49545031
+
+
+def _canonical_key(norm: tuple[str, Any]) -> bytes:
+    """Python _normalize key → the shim's canonical byte key."""
+    tag, v = norm
+    t = tag.encode()
+    if tag == "b":
+        return t + (b"\x01" if v else b"\x00")
+    if tag in ("i", "D", "t"):
+        return t + struct.pack("<q", int(v))
+    if tag == "d":
+        return t + struct.pack("<d", float(v))
+    if tag == "s":
+        return t + str(v).encode("utf-8")
+    if tag == "p":
+        return t + bytes(v)
+    raise ValueError(f"unknown intern tag {tag}")
+
+
+def _decode_key(raw: bytes) -> Any:
+    tag, payload = chr(raw[0]), raw[1:]
+    if tag == "b":
+        return payload == b"\x01"
+    if tag == "i":
+        return struct.unpack("<q", payload)[0]
+    if tag == "d":
+        return struct.unpack("<d", payload)[0]
+    if tag == "s":
+        return payload.decode("utf-8")
+    if tag == "p":
+        return payload
+    if tag == "D":
+        ns = struct.unpack("<q", payload)[0]
+        return datetime.timedelta(microseconds=ns / 1000)
+    if tag == "t":
+        ns = struct.unpack("<q", payload)[0]
+        return datetime.datetime.fromtimestamp(ns / 1e9,
+                                               datetime.timezone.utc)
+    raise ValueError(f"unknown intern tag {tag}")
+
+
+def _pack_str(s: str | bytes) -> bytes:
+    raw = s.encode("utf-8") if isinstance(s, str) else bytes(s)
+    return struct.pack("<I", len(raw)) + raw
+
+
+def _layout_blob(layout: BatchLayout, interner: InternTable) -> bytes:
+    out = [struct.pack("<II", _MAGIC, layout.max_str_len)]
+    out.append(struct.pack("<I", len(GLOBAL_WORD_LIST)))
+    out += [_pack_str(w) for w in GLOBAL_WORD_LIST]
+    out.append(struct.pack("<I", len(layout.slots)))
+    for name, col in layout.slots.items():
+        out.append(struct.pack("<I", col) + _pack_str(name))
+    out.append(struct.pack("<I", len(layout.map_slots)))
+    for name, col in layout.map_slots.items():
+        out.append(struct.pack("<I", col) + _pack_str(name))
+    out.append(struct.pack("<I", len(layout.derived_slots)))
+    for (m, k), col in layout.derived_slots.items():
+        out.append(struct.pack("<I", col) + _pack_str(m) + _pack_str(k))
+    out.append(struct.pack("<I", len(layout.byte_slots)))
+    for src, bcol in layout.byte_slots.items():
+        if isinstance(src, tuple):
+            out.append(struct.pack("<IB", bcol, 1) + _pack_str(src[0]) +
+                       _pack_str(src[1]))
+        else:
+            out.append(struct.pack("<IB", bcol, 0) + _pack_str(src))
+    out.append(struct.pack("<III", layout.n_columns, layout.n_maps,
+                           layout.n_byte_slots))
+    # seed interns in id order (ids 3..)
+    with interner._lock:
+        keys = [_canonical_key(key) for key, idx in
+                sorted(interner._by_key.items(), key=lambda kv: kv[1])
+                if idx >= 3]
+    out.append(struct.pack("<I", len(keys)))
+    out += [_pack_str(k) for k in keys]
+    return b"".join(out)
+
+
+class NativeTensorizer:
+    def __init__(self, layout: BatchLayout, interner: InternTable):
+        self.layout = layout
+        self.interner = interner
+        lib = ctypes.CDLL(ensure_built())
+        lib.shim_create.restype = ctypes.c_void_p
+        lib.shim_create.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.shim_destroy.argtypes = [ctypes.c_void_p]
+        lib.shim_error.restype = ctypes.c_char_p
+        lib.shim_error.argtypes = [ctypes.c_void_p]
+        lib.shim_intern_count.restype = ctypes.c_int32
+        lib.shim_intern_count.argtypes = [ctypes.c_void_p]
+        lib.shim_export_interns.restype = ctypes.c_int64
+        lib.shim_export_interns.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
+            ctypes.c_size_t]
+        lib.shim_tensorize.restype = ctypes.c_int32
+        lib.shim_tensorize.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p]
+        self._lib = lib
+        blob = _layout_blob(layout, interner)
+        self._h = lib.shim_create(blob, len(blob))
+        if not self._h:
+            raise RuntimeError("shim_create failed (bad layout blob)")
+        self._known_ids = lib.shim_intern_count(self._h)
+
+    def tensorize_wire(self, records: Sequence[bytes]) -> AttributeBatch:
+        lay = self.layout
+        n = len(records)
+        ncol = max(lay.n_columns, 1)
+        nmap = max(lay.n_maps, 1)
+        nbyte = max(lay.n_byte_slots, 1)
+        ids = np.zeros((n, lay.n_columns), np.int32) \
+            if lay.n_columns else np.zeros((n, 0), np.int32)
+        present_u8 = np.zeros((n, max(lay.n_columns, 0)), np.uint8)
+        map_present_u8 = np.zeros((n, nmap), np.uint8)
+        str_bytes = np.zeros((n, nbyte, lay.max_str_len), np.uint8)
+        str_lens = np.zeros((n, nbyte), np.int32)
+
+        bufs = (ctypes.c_char_p * n)(*records)
+        lens = (ctypes.c_int64 * n)(*[len(r) for r in records])
+        rc = self._lib.shim_tensorize(
+            self._h, bufs, lens, n,
+            ids.ctypes.data_as(ctypes.c_void_p),
+            present_u8.ctypes.data_as(ctypes.c_void_p),
+            map_present_u8.ctypes.data_as(ctypes.c_void_p),
+            str_bytes.ctypes.data_as(ctypes.c_void_p),
+            str_lens.ctypes.data_as(ctypes.c_void_p))
+        if rc != 0:
+            raise ValueError(self._lib.shim_error(self._h).decode())
+        self._sync_interns()
+        return AttributeBatch(ids=ids, present=present_u8.astype(bool),
+                              map_present=map_present_u8.astype(bool),
+                              str_bytes=str_bytes, str_lens=str_lens)
+
+    def _sync_interns(self) -> None:
+        """Mirror new shim interns into the Python table, preserving
+        id assignment (sequential on both sides)."""
+        count = self._lib.shim_intern_count(self._h)
+        if count == self._known_ids:
+            return
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            got = self._lib.shim_export_interns(self._h, self._known_ids,
+                                                buf, cap)
+            if got >= 0:
+                raw = buf.raw[:got]
+                break
+            cap = -got
+        off = 0
+        new_id = self._known_ids
+        while off < len(raw):
+            (k_len,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            key = raw[off:off + k_len]
+            off += k_len
+            value = _decode_key(key)
+            assigned = self.interner.intern(value)
+            if assigned != new_id:
+                raise RuntimeError(
+                    f"intern id drift: shim {new_id} != py {assigned} "
+                    f"for {value!r} — tables out of sync")
+            new_id += 1
+        self._known_ids = count
+
+    def __del__(self) -> None:
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.shim_destroy(h)
