@@ -1,0 +1,36 @@
+"""Hermetic JAX platform selection.
+
+This container injects an `axon` TPU-tunnel PJRT plugin via
+sitecustomize which pins jax_platforms="axon,cpu" at interpreter start;
+plain JAX_PLATFORMS=cpu in the environment does NOT override it. Tests
+and multi-chip dryruns therefore force the virtual host platform
+explicitly, before any backend initializes. This module is the single
+home for that dance (used by tests/conftest.py and
+__graft_entry__.dryrun_multichip).
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_platform(n_devices: int) -> None:
+    """Force JAX onto an n_devices virtual CPU platform.
+
+    Must run before any JAX backend initializes. Rewrites any existing
+    xla_force_host_platform_device_count flag whose value is smaller
+    than n_devices (a stale smaller count would silently win otherwise).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(_COUNT_FLAG + r"=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        flags = flags[:m.start(1)] + str(n_devices) + flags[m.end(1):]
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

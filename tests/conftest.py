@@ -2,21 +2,9 @@
 
 Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding
 paths (Mesh/pjit/shard_map) are exercised hermetically. Real-TPU runs
-happen only in bench.py.
-
-NOTE: this environment injects an `axon` TPU-tunnel PJRT plugin via
-sitecustomize *before* pytest starts, and that plugin pins
-jax_platforms="axon,cpu"; plain JAX_PLATFORMS=cpu in the env is not
-enough. Updating the config key here — before any backend is
-initialized — reliably selects the hermetic CPU platform.
+happen only in bench.py. See istio_tpu/platform.py for why plain
+JAX_PLATFORMS=cpu is not enough in this container.
 """
-import os
+from istio_tpu.platform import force_cpu_platform
 
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(8)
