@@ -274,11 +274,17 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                     *, interner: InternTable | None = None,
                     max_str_len: int | None = None,
                     dnf_cap: int = DEFAULT_DNF_CAP,
-                    jit: bool = True) -> RuleSetProgram:
+                    jit: bool = True,
+                    extra_derived_keys: Sequence[tuple[str, str]] = ()
+                    ) -> RuleSetProgram:
     """Compile a rule snapshot. Never raises for individual bad rules —
     un-lowerable predicates fall back to the oracle; predicates that do
     not even type-check to BOOL raise TypeError_ (config validation's
-    job, store/validator.go analog)."""
+    job, store/validator.go analog).
+
+    `extra_derived_keys` adds (map, key) columns consumers outside the
+    predicates need — e.g. listentry instances the fused engine turns
+    into id-membership scans (runtime/fused.py)."""
     interner = interner or InternTable()
     atoms = _AtomTable()
     per_rule: list[tuple[Dnf, Dnf] | None] = []   # None = host fallback
@@ -329,8 +335,10 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
 
     manifest = {n: finder.get_attribute(n) for n in finder.names()}
     kwargs = {} if max_str_len is None else {"max_str_len": max_str_len}
-    layout = build_layout(manifest, sorted(reqs.derived_keys),
-                          sorted(reqs.byte_sources, key=str), **kwargs)
+    layout = build_layout(
+        manifest,
+        sorted(set(reqs.derived_keys) | set(extra_derived_keys)),
+        sorted(reqs.byte_sources, key=str), **kwargs)
 
     # ---- classify atoms into vectorizable tiers ----
     live_atoms = sorted({i for mn in per_rule if mn

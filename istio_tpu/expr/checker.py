@@ -31,6 +31,17 @@ INTRINSICS = [
                      (ValueType.UNSPECIFIED, ValueType.UNSPECIFIED)),
     FunctionMetadata("NEQ", ValueType.BOOL,
                      (ValueType.UNSPECIFIED, ValueType.UNSPECIFIED)),
+    # ordered comparisons (reference expr/func.go LT/LEQ/GT/GEQ): both
+    # operands the same type; ordering defined for numeric/string/
+    # time-like values (oracle enforces at eval time)
+    FunctionMetadata("LSS", ValueType.BOOL,
+                     (ValueType.UNSPECIFIED, ValueType.UNSPECIFIED)),
+    FunctionMetadata("LEQ", ValueType.BOOL,
+                     (ValueType.UNSPECIFIED, ValueType.UNSPECIFIED)),
+    FunctionMetadata("GTR", ValueType.BOOL,
+                     (ValueType.UNSPECIFIED, ValueType.UNSPECIFIED)),
+    FunctionMetadata("GEQ", ValueType.BOOL,
+                     (ValueType.UNSPECIFIED, ValueType.UNSPECIFIED)),
     FunctionMetadata("OR", ValueType.UNSPECIFIED,
                      (ValueType.UNSPECIFIED, ValueType.UNSPECIFIED)),
     FunctionMetadata("LOR", ValueType.BOOL, (ValueType.BOOL, ValueType.BOOL)),

@@ -115,7 +115,31 @@ class OracleProgram:
             return self._eval_index(f, bag, soft=False)
         if name == "NOT":
             return not self._eval(f.args[0], bag)
+        if name in ("LSS", "LEQ", "GTR", "GEQ"):
+            return self._ordered(name, f, bag)
         return self._eval_extern(f, bag)
+
+    def _ordered(self, name: str, f: FunctionCall, bag: Bag) -> bool:
+        a = self._eval(f.args[0], bag)
+        b = self._eval(f.args[1], bag)
+        for v in (a, b):
+            if not isinstance(v, (int, float, str, datetime.datetime,
+                                  datetime.timedelta)) or \
+                    isinstance(v, bool):
+                raise EvalError(
+                    f"unordered operand for {name}: {type(v).__name__}")
+        try:
+            if name == "LSS":
+                return a < b
+            if name == "LEQ":
+                return a <= b
+            if name == "GTR":
+                return a > b
+            return a >= b
+        except TypeError as exc:   # mixed runtime types (bags are untyped)
+            raise EvalError(f"unordered operands for {name}: "
+                            f"{type(a).__name__} vs {type(b).__name__}"
+                            ) from exc
 
     def _eval_or(self, f: FunctionCall, bag: Bag, soft: bool) -> Any:
         try:

@@ -118,10 +118,15 @@ class CheckVerdict:
     referenced: Any        # bool [B, n_columns] attribute-use bitmap
     matched: Any           # bool [B, R] (diagnostics + host overlay)
     err: Any               # bool [B, R]
+    deny_rule: Any         # int32 [B] — lowest rule idx that produced a
+    #                        non-OK status; INT32_MAX when status is OK.
+    #                        The serving overlay merges host adapter
+    #                        results against this in rule order.
 
     def tree_flatten(self):
         return ((self.status, self.valid_duration_s, self.valid_use_count,
-                 self.referenced, self.matched, self.err), None)
+                 self.referenced, self.matched, self.err, self.deny_rule),
+                None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -136,17 +141,21 @@ class PolicyEngine:
     `self.quota_counts` (donated through each step).
     """
 
-    def __init__(self, rules: Sequence[Rule],
-                 finder: AttributeDescriptorFinder,
+    def __init__(self, rules: Sequence[Rule] | None = None,
+                 finder: AttributeDescriptorFinder | None = None,
                  deny: Sequence[DenySpec] = (),
                  lists: Sequence[ListEntrySpec] = (),
                  quotas: Sequence[QuotaSpec] = (),
                  interner: InternTable | None = None,
                  max_str_len: int | None = None,
-                 jit: bool = True):
-        self.ruleset = compile_ruleset(
-            rules, finder, interner=interner, max_str_len=max_str_len,
-            jit=False)
+                 jit: bool = True,
+                 ruleset: RuleSetProgram | None = None):
+        if ruleset is None:
+            assert rules is not None and finder is not None
+            ruleset = compile_ruleset(
+                rules, finder, interner=interner, max_str_len=max_str_len,
+                jit=False)
+        self.ruleset = ruleset
         self.finder = finder
         lay = self.ruleset.layout
         interner = self.ruleset.interner
@@ -240,10 +249,22 @@ class PolicyEngine:
                     (rule_ns[None, :] == req_ns[:, None])
             active = matched & ns_ok                      # [B, R]
 
-            # denier: worst (max) status over active deny rules; min TTLs
+            # Status combining is LOWEST-RULE-INDEX-WINS, the same
+            # deterministic rule as the host dispatcher (_combine keeps
+            # the first non-OK result, and the host iterates rules in
+            # ascending index order). google.rpc codes are not
+            # severity-ordered, so a max() over codes would diverge from
+            # the host path on multi-deny requests. Ties within one rule
+            # resolve deny → list → quota. TTLs take the min over every
+            # ACTIVE fused rule (dispatcher.go:322 semantics).
+            BIGI = jnp.iinfo(jnp.int32).max
+            rule_idx = jnp.arange(active.shape[1], dtype=jnp.int32)
+
             dmask = active & deny_mask_j[None, :]
-            status = jnp.max(jnp.where(dmask, deny_status_j[None, :], OK),
-                             axis=1)
+            d_key = jnp.where(dmask, rule_idx[None, :], BIGI)
+            d_arg = jnp.argmin(d_key, axis=1)
+            cand_rule = jnp.min(d_key, axis=1)
+            cand_status = deny_status_j[d_arg]
             dur = jnp.min(jnp.where(dmask, deny_dur_j[None, :], _BIG), axis=1)
             uses = jnp.min(jnp.where(dmask, deny_uses_j[None, :],
                                      np.iinfo(np.int32).max), axis=1)
@@ -255,14 +276,19 @@ class PolicyEngine:
                     sym[:, :, None] == list_ids_j[None, :, :], axis=2)
                 l_active = active[:, list_rule_j] & sym_ok
                 l_deny = l_active & (member == list_black_j[None, :])
-                status = jnp.maximum(
-                    status, jnp.max(jnp.where(l_deny, list_code_j[None, :],
-                                              OK), axis=1))
+                l_key = jnp.where(l_deny, list_rule_j[None, :], BIGI)
+                l_arg = jnp.argmin(l_key, axis=1)
+                l_rule = jnp.min(l_key, axis=1)
+                take_l = l_rule < cand_rule     # strict: deny wins ties
+                cand_status = jnp.where(take_l, list_code_j[l_arg],
+                                        cand_status)
+                cand_rule = jnp.minimum(cand_rule, l_rule)
                 dur = jnp.minimum(dur, jnp.min(
                     jnp.where(l_active, list_dur_j[None, :], _BIG), axis=1))
                 uses = jnp.minimum(uses, jnp.min(
                     jnp.where(l_active, list_uses_j[None, :],
                               np.iinfo(np.int32).max), axis=1))
+            status = jnp.where(cand_rule < BIGI, cand_status, OK)
 
             if self._has_quota:
                 # bucket = interned key id mod hash space; fixed window.
@@ -294,9 +320,16 @@ class PolicyEngine:
                     jnp.arange(n_q)[None, :], bucket]            # [B, Q]
                 granted = q_active & (prior_per_req + rank < q_max_j[None, :])
                 over = q_active & ~granted
-                status = jnp.maximum(
-                    status, jnp.where(jnp.any(over, axis=1),
-                                      RESOURCE_EXHAUSTED, OK))
+                # quota only runs where status is still OK (q_active
+                # gating above), so a RESOURCE_EXHAUSTED here is always
+                # the lowest-index non-OK source for that request
+                any_over = jnp.any(over, axis=1)
+                status = jnp.where(any_over, RESOURCE_EXHAUSTED, status)
+                cand_rule = jnp.where(
+                    any_over,
+                    jnp.min(jnp.where(over, q_rule_j[None, :], BIGI),
+                            axis=1),
+                    cand_rule)
                 # commit grants: scatter-add per (quota, bucket)
                 flat = bucket + jnp.arange(bucket.shape[1])[None, :] * \
                     quota_counts.shape[1]
@@ -312,7 +345,9 @@ class PolicyEngine:
                                    valid_duration_s=dur,
                                    valid_use_count=uses,
                                    referenced=referenced,
-                                   matched=matched, err=err)
+                                   matched=matched, err=err,
+                                   deny_rule=jnp.where(
+                                       status == OK, BIGI, cand_rule))
             return verdict, quota_counts
 
         self.raw_step = step   # unjitted: for entry()/sharded wrappers

@@ -224,6 +224,17 @@ class SnapshotBuilder:
 
         kwargs = {} if self.max_str_len is None \
             else {"max_str_len": self.max_str_len}
+        # listentry instances whose value is a bare (map, key) read get
+        # a derived layout column so the fused engine can absorb them
+        # (runtime/fused.py id-membership scan)
+        derived = set()
+        for qname, ib in instances.items():
+            if instance_templates[qname] != "listentry":
+                continue
+            ref = ib.value_attr_ref()
+            if isinstance(ref, tuple):
+                derived.add(ref)
+        kwargs["extra_derived_keys"] = sorted(derived)
         try:
             ruleset = compile_ruleset(preds, finder,
                                       interner=self.interner, **kwargs)

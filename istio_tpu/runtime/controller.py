@@ -30,11 +30,13 @@ class Controller:
                  identity_attr: str = DEFAULT_IDENTITY_ATTR,
                  debounce_s: float = 0.05,
                  max_str_len: int | None = None,
-                 on_publish: Callable[[Dispatcher], None] | None = None):
+                 on_publish: Callable[[Dispatcher], None] | None = None,
+                 fused: bool = True):
         self.store = store
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
         self.on_publish = on_publish
+        self.fused_enabled = fused
         self._builder = SnapshotBuilder(default_manifest,
                                         InternTable(), max_str_len)
         self._handler_table = HandlerTable()
@@ -75,7 +77,12 @@ class Controller:
         handlers, orphans = self._handler_table.rebuild(snapshot)
         for err in snapshot.errors:
             log.warning("config: %s", err)
-        dispatcher = Dispatcher(snapshot, handlers, self.identity_attr)
+        plan = None
+        if self.fused_enabled:
+            from istio_tpu.runtime.fused import build_fused_plan
+            plan = build_fused_plan(snapshot)
+        dispatcher = Dispatcher(snapshot, handlers, self.identity_attr,
+                                fused=plan)
         self._dispatcher = dispatcher      # atomic publish (GIL ref swap)
         if orphans:
             t = threading.Timer(

@@ -65,10 +65,14 @@ class Dispatcher:
     controller swaps (snapshot, handlers) pairs atomically."""
 
     def __init__(self, snapshot: Snapshot, handlers: Mapping[str, Handler],
-                 identity_attr: str = DEFAULT_IDENTITY_ATTR):
+                 identity_attr: str = DEFAULT_IDENTITY_ATTR,
+                 fused=None):
         self.snapshot = snapshot
         self.handlers = dict(handlers)
         self.identity_attr = identity_attr
+        # FusedPlan (runtime/fused.py) — when present, check() runs the
+        # fused device engine and overlays only host-only actions
+        self.fused = fused
 
     def _handler_for(self, hc) -> Handler | None:
         """Built handler for a HandlerConfig (single home of the
@@ -79,6 +83,28 @@ class Dispatcher:
     # ------------------------------------------------------------------
     # resolution
     # ------------------------------------------------------------------
+
+    def _request_ns_ids(self, bags: Sequence[Bag]) -> np.ndarray:
+        return np.asarray([self.snapshot.ruleset.namespace_id(
+            _namespace_of(bag, self.identity_attr)) for bag in bags],
+            np.int32)
+
+    def _overlay_fallback(self, matched: np.ndarray, err: np.ndarray,
+                          ns_ids: np.ndarray, bags: Sequence[Bag]
+                          ) -> tuple[np.ndarray, np.ndarray]:
+        """Patch host-fallback rules' verdicts into the device output and
+        account namespace-visible errors; returns (active, ns_ok)."""
+        rs = self.snapshot.ruleset
+        for ridx in rs.host_fallback:
+            for b, bag in enumerate(bags):
+                m, _, e = rs.host_eval(ridx, bag)
+                matched[b, ridx] = m
+                err[b, ridx] = e
+        ns_ok = np.asarray(rs.namespace_mask(ns_ids))
+        n_err = int((err & ns_ok).sum())
+        if n_err:
+            monitor.RESOLVE_ERRORS.inc(n_err)
+        return matched & ns_ok, ns_ok
 
     def _resolve(self, bags: Sequence[Bag]
                  ) -> tuple[list[list[int]], list[list[int]]]:
@@ -94,19 +120,8 @@ class Dispatcher:
             matched, _, err = snap.ruleset(batch)
             matched = np.array(matched)
             err = np.array(err)
-        ns_ids = np.asarray([snap.ruleset.namespace_id(
-            _namespace_of(bag, self.identity_attr)) for bag in bags],
-            np.int32)
-        ns_ok = np.array(snap.ruleset.namespace_mask(ns_ids))
-        for ridx in snap.ruleset.host_fallback:
-            for b, bag in enumerate(bags):
-                m, _, e = snap.ruleset.host_eval(ridx, bag)
-                matched[b, ridx] = m
-                err[b, ridx] = e
-        active = matched & ns_ok
-        n_err = int((err & ns_ok).sum())
-        if n_err:
-            monitor.RESOLVE_ERRORS.inc(n_err)
+        ns_ids = self._request_ns_ids(bags)
+        active, ns_ok = self._overlay_fallback(matched, err, ns_ids, bags)
         return ([list(np.nonzero(active[b])[0]) for b in range(len(bags))],
                 [list(np.nonzero(ns_ok[b])[0]) for b in range(len(bags))])
 
@@ -115,11 +130,90 @@ class Dispatcher:
     # ------------------------------------------------------------------
 
     def check(self, bags: Sequence[Bag]) -> list[CheckResponse]:
+        if self.fused is not None:
+            return self._check_fused(bags)
         actives, visibles = self._resolve(bags)
         out = []
         for bag, rule_idxs, vis in zip(bags, actives, visibles):
             out.append(self._check_one(bag, rule_idxs, vis))
         return out
+
+    def _check_fused(self, bags: Sequence[Bag]) -> list[CheckResponse]:
+        """Fused serving path: ONE device step computes rule matching +
+        denier/list verdicts + TTLs for the whole batch; the host loop
+        below only touches rules with non-fusable actions (and rules
+        whose predicate fell back to the host oracle). Status merge is
+        lowest-rule-index-wins on both sides, so host results from a
+        lower rule index override the device candidate and vice versa —
+        the two paths provably pick the same rule's status."""
+        snap, plan = self.snapshot, self.fused
+        with monitor.resolve_timer():
+            batch = snap.tensorizer.tensorize(bags)
+            ns_ids = self._request_ns_ids(bags)
+            verdict = plan.engine.check(batch, ns_ids)
+            status = np.asarray(verdict.status)
+            dur = np.asarray(verdict.valid_duration_s)
+            uses = np.asarray(verdict.valid_use_count)
+            deny_rule = np.asarray(verdict.deny_rule)
+            matched = np.array(verdict.matched)
+            err = np.array(verdict.err)
+        active, _ = self._overlay_fallback(matched, err, ns_ids, bags)
+
+        ha = plan.host_rule_idx
+        out = []
+        for b, bag in enumerate(bags):
+            resp = CheckResponse()
+            resp.valid_duration_s = min(resp.valid_duration_s,
+                                        float(dur[b]))
+            resp.valid_use_count = min(resp.valid_use_count,
+                                       int(uses[b]))
+            dev_rule = int(deny_rule[b])
+            dev_applied = False
+            host_active = ha[active[b, ha]] if len(ha) else ()
+            for ridx in host_active:
+                ridx = int(ridx)
+                # ties at ridx == dev_rule follow the rule's config
+                # action order: if its first CHECK action is fused, the
+                # device result applies before the host actions
+                if not dev_applied and (
+                        ridx > dev_rule or
+                        (ridx == dev_rule and
+                         dev_rule in plan.fused_first_rules)):
+                    self._apply_device_status(resp, plan, dev_rule,
+                                              int(status[b]))
+                    dev_applied = True
+                for hc, template, inst_names in plan.host_actions[ridx]:
+                    handler = self._handler_for(hc)
+                    if handler is None:
+                        continue
+                    for iname in inst_names:
+                        ib = snap.instances[iname]
+                        result = self._safe_check(handler, template, ib,
+                                                  bag)
+                        self._combine(resp, result)
+            if not dev_applied:
+                self._apply_device_status(resp, plan, dev_rule,
+                                          int(status[b]))
+            referenced = set(plan.pred_attrs_for_ns(int(ns_ids[b])))
+            for ridx in np.nonzero(active[b])[0]:
+                referenced |= plan.instance_attrs[int(ridx)]
+            resp.referenced = tuple(sorted(referenced, key=str))
+            out.append(resp)
+        return out
+
+    @staticmethod
+    def _apply_device_status(resp: CheckResponse, plan, dev_rule: int,
+                             dev_status: int) -> None:
+        """Merge the device verdict like one more adapter result."""
+        if dev_status == OK:
+            return
+        if resp.status_code == OK:
+            resp.status_code = dev_status
+            resp.status_message = plan.message_for(dev_rule, dev_status)
+        else:
+            resp.status_message = (resp.status_message + "; " +
+                                   plan.message_for(dev_rule, dev_status)
+                                   ).strip("; ")
 
     def _check_one(self, bag: Bag, rule_idxs: list[int],
                    visible: list[int]) -> CheckResponse:

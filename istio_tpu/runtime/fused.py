@@ -1,0 +1,198 @@
+"""Fused serving plan — wire the PolicyEngine into the check path.
+
+The reference server assembles the same runtime it benchmarks
+(mixer/pkg/server/server.go:92); this module is that assembly step for
+the TPU build: given a validated Snapshot, extract every CHECK action
+the fused device step can absorb (denier → DenySpec, id-exact string
+lists → ListEntrySpec) and build one PolicyEngine per snapshot —
+REUSING the snapshot's compiled RuleSetProgram, so a config swap pays
+rule compilation once. Everything that cannot lower (rbac/opa/apikey
+handlers, regex/CIDR/case-insensitive lists, refreshable list
+providers, rules whose predicate fell back to the host oracle) is
+collected into `host_actions` for the dispatcher to overlay per
+request.
+
+Quota is deliberately NOT fused on the serving path: the gRPC quota
+loop (grpcServer.go:188-230) requires dedup-id replay semantics, which
+live in the host memquota adapter. The engine's device quota path
+remains the flagship all-device benchmark step.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+import numpy as np
+
+from istio_tpu.models.policy_engine import (DenySpec, ListEntrySpec,
+                                            PolicyEngine, OK,
+                                            PERMISSION_DENIED)
+from istio_tpu.runtime.config import Snapshot
+from istio_tpu.templates import Variety
+from istio_tpu.utils.log import scope
+
+log = scope("runtime.fused")
+
+_FUSABLE_LIST_TYPES = ("STRINGS",)
+
+
+@dataclasses.dataclass
+class FusedPlan:
+    """Per-snapshot serving plan: device engine + host overlay map."""
+    engine: PolicyEngine
+    # rule idx → CHECK actions the device cannot absorb (same tuples as
+    # Snapshot.actions_for); host-fallback rules carry ALL their actions
+    host_actions: dict[int, list]
+    host_rule_idx: np.ndarray          # sorted keys of host_actions
+    # per rule: attrs referenced by its CHECK instances (generic-path
+    # ReferencedAttributes parity: active rules add instance attr uses)
+    instance_attrs: list[frozenset]
+    deny_info: dict[int, tuple[int, str]]   # rule → (code, message)
+    list_rules: frozenset
+    # rules whose FIRST check action is fused — device status wins ties
+    # against host-overlay actions of the same rule (config action order)
+    fused_first_rules: frozenset = frozenset()
+    fused_deny: int = 0
+    fused_lists: int = 0
+    _ns_pred_cache: dict = dataclasses.field(default_factory=dict)
+
+    def pred_attrs_for_ns(self, ns_id: int) -> frozenset:
+        """Union of predicate attr uses over rules visible to ns_id —
+        every visible rule's predicate is evaluated for the request
+        (protoBag.go:117 tracking → compile-time bitmaps)."""
+        cached = self._ns_pred_cache.get(ns_id)
+        if cached is not None:
+            return cached
+        rs = self.engine.ruleset
+        default = rs.ns_ids[""]
+        out: set = set()
+        for ridx in range(rs.n_rules):
+            if rs.rule_ns[ridx] == default or rs.rule_ns[ridx] == ns_id:
+                out |= rs.attr_names[ridx]
+        frozen = frozenset(out)
+        self._ns_pred_cache[ns_id] = frozen
+        return frozen
+
+    def message_for(self, rule_idx: int, status: int) -> str:
+        """Best-effort status message for a device-produced denial."""
+        info = self.deny_info.get(rule_idx)
+        if info is not None and info[0] == status:
+            return info[1]
+        if rule_idx in self.list_rules:
+            name = self.engine.ruleset.rules[rule_idx].name
+            return f"rejected by list check (rule {name})"
+        return "denied by policy"
+
+
+def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
+    """Extract fusable CHECK actions and build the snapshot's engine."""
+    rs = snapshot.ruleset
+    if rs.n_rules == 0:
+        return None
+    layout = rs.layout
+
+    deny_by_rule: dict[int, DenySpec] = {}
+    deny_info: dict[int, tuple[int, str]] = {}
+    lists: list[ListEntrySpec] = []
+    list_rules: set[int] = set()
+    host_actions: dict[int, list] = {}
+    instance_attrs: list[frozenset] = []
+
+    def add_host(ridx: int, action) -> None:
+        host_actions.setdefault(ridx, []).append(action)
+
+    fused_first: set[int] = set()
+    for ridx in range(rs.n_rules):
+        attrs: set = set()
+        for pos, action in enumerate(
+                snapshot.actions_for(ridx, Variety.CHECK)):
+            hc, template, inst_names = action
+            for iname in inst_names:
+                attrs |= snapshot.instances[iname].referenced_attrs
+            if ridx in rs.host_fallback:
+                # device matched==False for fallback rules; their fused
+                # contributions would be inert — run everything on host
+                add_host(ridx, action)
+                continue
+            if hc.adapter == "denier":
+                if pos == 0:
+                    fused_first.add(ridx)
+                code = int(hc.params.get("status_code", PERMISSION_DENIED))
+                msg = str(hc.params.get("status_message", "denied"))
+                dur = float(hc.params.get("valid_duration_s", 5.0))
+                uses = int(hc.params.get("valid_use_count", 10_000))
+                prev = deny_by_rule.get(ridx)
+                if prev is None:
+                    deny_by_rule[ridx] = DenySpec(
+                        rule=ridx, status=code, valid_duration_s=dur,
+                        valid_use_count=uses)
+                    deny_info[ridx] = (code, msg)
+                else:   # merged denier actions: first status, min TTLs
+                    deny_by_rule[ridx] = DenySpec(
+                        rule=ridx, status=prev.status,
+                        valid_duration_s=min(prev.valid_duration_s, dur),
+                        valid_use_count=min(prev.valid_use_count, uses))
+                continue
+            if hc.adapter == "list" and template == "listentry":
+                fused, host = _split_list_instances(
+                    snapshot, hc, inst_names, layout)
+                if pos == 0 and fused and not host:
+                    fused_first.add(ridx)
+                for iname, value_attr in fused:
+                    lists.append(ListEntrySpec(
+                        rule=ridx, value_attr=value_attr,
+                        entries=list(hc.params.get("overrides", ())),
+                        blacklist=bool(hc.params.get("blacklist", False)),
+                        valid_duration_s=float(
+                            hc.params.get("caching_ttl_s", 300.0)),
+                        valid_use_count=int(
+                            hc.params.get("caching_use_count", 10_000))))
+                    list_rules.add(ridx)
+                if host:
+                    add_host(ridx, (hc, template, host))
+                continue
+            add_host(ridx, action)
+        instance_attrs.append(frozenset(attrs))
+
+    engine = PolicyEngine(ruleset=rs, finder=snapshot.finder,
+                          deny=list(deny_by_rule.values()), lists=lists,
+                          quotas=(), jit=True)
+    log.info("fused plan: %d deny rules, %d lists, %d host-overlay rules",
+             len(deny_by_rule), len(lists), len(host_actions))
+    return FusedPlan(engine=engine, host_actions=host_actions,
+                     host_rule_idx=np.asarray(sorted(host_actions),
+                                              np.int64),
+                     instance_attrs=instance_attrs,
+                     deny_info=deny_info,
+                     list_rules=frozenset(list_rules),
+                     fused_first_rules=frozenset(fused_first),
+                     fused_deny=len(deny_by_rule), fused_lists=len(lists))
+
+
+def _split_list_instances(snapshot: Snapshot, hc, inst_names, layout
+                          ) -> tuple[list, list]:
+    """(fused [(iname, value_attr)], host [iname]) for a list action.
+
+    Fusable: case-sensitive exact-string lists from static overrides
+    whose instance value is a bare attribute reference with a layout
+    slot. CIDR/regex/case-insensitive entries and refreshable providers
+    keep list.go's host semantics (mixer/adapter/list/list.go:115-247).
+    """
+    p: Mapping[str, Any] = hc.params
+    if (p.get("entry_type", "STRINGS") not in _FUSABLE_LIST_TYPES
+            or p.get("provider") is not None
+            or p.get("provider_url")):
+        return [], list(inst_names)
+    if not all(isinstance(e, str) for e in p.get("overrides", ())):
+        return [], list(inst_names)
+    fused, host = [], []
+    for iname in inst_names:
+        ref = snapshot.instances[iname].value_attr_ref()
+        slot_ok = ref is not None and (
+            ref in layout.derived_slots if isinstance(ref, tuple)
+            else ref in layout.slots)
+        if slot_ok:
+            fused.append((iname, ref))
+        else:
+            host.append(iname)
+    return fused, host

@@ -29,6 +29,9 @@ class ServerArgs:
     max_batch: int = 1024
     max_str_len: int | None = None
     preprocess: bool = True
+    # serve checks through the fused device engine (runtime/fused.py);
+    # False falls back to the generic host-adapter dispatch path
+    fused: bool = True
 
 
 class RuntimeServer:
@@ -40,7 +43,8 @@ class RuntimeServer:
         self.controller = Controller(
             store, default_manifest=manifest,
             identity_attr=self.args.identity_attr,
-            max_str_len=self.args.max_str_len)
+            max_str_len=self.args.max_str_len,
+            fused=self.args.fused)
         self.batcher = CheckBatcher(self._run_check_batch,
                                     window_s=self.args.batch_window_s,
                                     max_batch=self.args.max_batch)

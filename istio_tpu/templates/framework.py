@@ -149,6 +149,20 @@ def infer_types(info: TemplateInfo, params: Mapping[str, Any],
 # Instance construction (reference ProcessXxxFn instance build half)
 # ---------------------------------------------------------------------------
 
+def plain_attr_ref(ast) -> Any | None:
+    """attr name / (map, const key) if the expression is a bare
+    attribute reference; None otherwise. The fused serving plan uses
+    this to decide whether an instance field can become a device slot
+    read (runtime/fused.py)."""
+    if ast.var is not None:
+        return ast.var.name
+    f = ast.fn
+    if (f is not None and f.name == "INDEX" and f.args[0].var is not None
+            and f.args[1].const_ is not None):
+        return (f.args[0].var.name, f.args[1].const_.value)
+    return None
+
+
 def _collect_attrs(e, out: set) -> None:
     """Attribute names + (map, const-key) pairs an expression reads."""
     if e.var is not None:
@@ -210,6 +224,15 @@ class InstanceBuilder:
 
     def build(self, bag: Bag) -> dict[str, Any]:
         return self._run(self._plan, bag)
+
+    def value_attr_ref(self) -> Any | None:
+        """attr name / (map, key) when the instance's `value` field is a
+        bare attribute read — the fusability probe shared by the layout
+        builder (runtime/config.py derived columns) and the fused plan
+        (runtime/fused.py slot check); None otherwise."""
+        prog = next((payload for fname, kind, payload in self._plan
+                     if fname == "value" and kind == "expr"), None)
+        return plain_attr_ref(prog.ast) if prog is not None else None
 
     def _run(self, plan: list[tuple], bag: Bag) -> dict[str, Any]:
         out: dict[str, Any] = {"name": self.name}

@@ -144,7 +144,21 @@ CORPUS: list[Case] = [
          compile_err="typeError got INT64, expected STRING"),
     Case(e="(x/y) == 30", input={"x": 20, "y": 10},
          compile_err="unknown function: QUO"),
-    Case(e="x < 2", input={"x": 1}, compile_err="unknown function: LSS"),
+    # ---- ordered comparisons (reference expr/func.go LT/LEQ/GT/GEQ) ----
+    Case(e="x < 2", input={"x": 1}, result=True, type_=V.BOOL,
+         referenced=["x"]),
+    Case(e="x <= 2", input={"x": 2}, result=True),
+    Case(e="x > 2", input={"x": 3}, result=True),
+    Case(e="x >= 4", input={"x": 3}, result=False),
+    Case(e='as < "b"', input={"as": "a"}, result=True),
+    Case(e='as >= "b"', input={"as": "a"}, result=False),
+    Case(e="ad > 1.5", input={"ad": 1.0}, result=False),
+    Case(e="adur <= adur", input={"adur": _d19}, result=True),
+    Case(e="x > 2", input={}, err="lookup failed: 'x'"),
+    Case(e="ab < ab2", input={"ab": True, "ab2": False},
+         err="unordered operand"),
+    Case(e="x < ad", input={"x": 1, "ad": 2.0},
+         compile_err="typeError got DOUBLE, expected INT64"),
     Case(e="!ab", input={"ab": True}, compile_err="unknown function: NOT"),
     Case(e="a = 2", input={"a": 2}, compile_err="unable to parse"),
     Case(e="@23", compile_err="unable to parse"),
