@@ -20,9 +20,10 @@ import grpc
 
 from istio_tpu.adapters.sdk import QuotaArgs
 from istio_tpu.api import mixer_pb2 as pb
-from istio_tpu.api.wire import (compressed_to_dict, referenced_to_proto,
-                                update_dict_from_proto)
+from istio_tpu.api.wire import (LazyWireBag, RawCheckRequest,
+                                referenced_to_proto, update_dict_from_proto)
 from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
 from istio_tpu.runtime.server import RuntimeServer
 
 log = logging.getLogger("istio_tpu.api")
@@ -40,9 +41,12 @@ class MixerGrpcServer:
             futures.ThreadPoolExecutor(max_workers=max_workers,
                                        thread_name_prefix="mixer-grpc"))
         handlers = {
+            # Check splits the request at the top level instead of fully
+            # parsing it: the attributes submessage stays raw bytes for
+            # the C++ tensorizer (api/wire.py RawCheckRequest)
             "Check": grpc.unary_unary_rpc_method_handler(
                 self._check,
-                request_deserializer=pb.CheckRequest.FromString,
+                request_deserializer=RawCheckRequest,
                 response_serializer=pb.CheckResponse.SerializeToString),
             "Report": grpc.unary_unary_rpc_method_handler(
                 self._report,
@@ -66,11 +70,16 @@ class MixerGrpcServer:
 
     # -- RPCs --
 
-    def _check(self, request: "pb.CheckRequest", context) -> "pb.CheckResponse":
-        values = compressed_to_dict(request.attributes,
-                                    request.global_word_count or None)
-        # preprocess ONCE; precondition check and quota loop share the bag
-        bag = self.runtime.preprocess(bag_from_mapping(values))
+    def _check(self, request: RawCheckRequest,
+               context) -> "pb.CheckResponse":
+        gwc = request.global_word_count
+        # a non-default dictionary prefix forces the python wire path —
+        # the C++ decoder assumes the full global list
+        bag = LazyWireBag(request.attributes_raw, gwc or None,
+                          native_ok=gwc in (0, len(GLOBAL_WORD_LIST)))
+        # preprocess ONCE; precondition check and quota loop share the
+        # bag (a no-op returning the wire bag when no APA is configured)
+        bag = self.runtime.preprocess(bag)
 
         resp = pb.CheckResponse()
         result = self.runtime.check_preprocessed(bag)
@@ -83,7 +92,8 @@ class MixerGrpcServer:
         resp.precondition.valid_use_count = min(result.valid_use_count,
                                                 2**31 - 1)
         resp.precondition.referenced_attributes.CopyFrom(
-            referenced_to_proto(result.referenced, bag))
+            referenced_to_proto(result.referenced, bag,
+                                result.referenced_presence))
 
         # quota loop (grpcServer.go:188-230): only on successful check
         if result.status_code == 0:

@@ -135,27 +135,165 @@ def update_dict_from_proto(target: dict[str, Any],
                            for ek, ev in sm.entries.items()}
 
 
-def referenced_to_proto(referenced, bag) -> "pb.ReferencedAttributes":
+def referenced_to_proto(referenced, bag,
+                        presence: Mapping | None = None
+                        ) -> "pb.ReferencedAttributes":
     """Build ReferencedAttributes from the dispatcher's referenced set
     (names and (map, key) pairs): EXACT when the bag had the value,
-    ABSENCE when it did not (protoBag.go trackReference conditions)."""
+    ABSENCE when it did not (protoBag.go trackReference conditions).
+
+    `presence` (item → bool) short-circuits the bag lookups — the fused
+    serving path fills it from the device batch's presence planes so a
+    wire-decoded request never needs a host-side dict decode."""
     out = pb.ReferencedAttributes()
     words = _Words(len(GLOBAL_WORD_LIST))
     words.ref("")   # reserve local index 0: proto3 default map_key=0
     #               # must unambiguously mean "no map key"
     for item in sorted(referenced, key=str):
         m = out.attribute_matches.add()
+        known = presence.get(item) if presence is not None else None
         if isinstance(item, tuple):
             attr, key = item
             m.name = words.ref(attr)
             m.map_key = words.ref(key)
-            container, ok = bag.get(attr)
-            present = ok and isinstance(container, Mapping) \
-                and key in container
+            if known is None:
+                container, ok = bag.get(attr)
+                known = ok and isinstance(container, Mapping) \
+                    and key in container
         else:
             m.name = words.ref(item)
-            _, present = bag.get(item)
-        m.condition = pb.ReferencedAttributes.EXACT if present \
+            if known is None:
+                _, known = bag.get(item)
+        m.condition = pb.ReferencedAttributes.EXACT if known \
             else pb.ReferencedAttributes.ABSENCE
     out.words.extend(words.local)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Raw request splitting — the native-shim fast path
+# ---------------------------------------------------------------------------
+
+def _read_varint(data: bytes, off: int) -> tuple[int, int]:
+    result = shift = 0
+    while True:
+        b = data[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+        if shift > 63:
+            raise WireError("varint overflow")
+
+
+class RawCheckRequest:
+    """A CheckRequest split at the top level WITHOUT full protobuf
+    parsing: the `attributes` submessage stays raw bytes for the native
+    tensorizer (istio_tpu/native); only quota params (rare) are parsed.
+    Field numbers per istio.mixer.v1 (api/proto/mixer.proto:67-76)."""
+
+    __slots__ = ("attributes_raw", "global_word_count",
+                 "deduplication_id", "quotas")
+
+    def __init__(self, data: bytes):
+        self.attributes_raw = b""
+        self.global_word_count = 0
+        self.deduplication_id = ""
+        self.quotas: dict[str, Any] = {}
+        off, n = 0, len(data)
+        while off < n:
+            tag, off = _read_varint(data, off)
+            field, wt = tag >> 3, tag & 7
+            if wt == 2:      # length-delimited
+                ln, off = _read_varint(data, off)
+                payload = data[off:off + ln]
+                off += ln
+                if field == 1:
+                    # protobuf merge semantics: repeated occurrences of
+                    # a message field concatenate
+                    self.attributes_raw += payload
+                elif field == 3:
+                    self.deduplication_id = payload.decode("utf-8")
+                elif field == 4:
+                    self._add_quota(payload)
+            elif wt == 0:    # varint
+                v, off = _read_varint(data, off)
+                if field == 2:
+                    self.global_word_count = v
+            elif wt == 1:
+                off += 8
+            elif wt == 5:
+                off += 4
+            else:
+                raise WireError(f"bad wire type {wt}")
+
+    def _add_quota(self, entry: bytes) -> None:
+        """One quotas map entry: key=1 string, value=2 QuotaParams."""
+        off, name, params = 0, "", pb.CheckRequest.QuotaParams()
+        while off < len(entry):
+            tag, off = _read_varint(entry, off)
+            field, wt = tag >> 3, tag & 7
+            if wt == 0:
+                v, off = _read_varint(entry, off)
+                continue
+            if wt == 1:
+                off += 8
+                continue
+            if wt == 5:
+                off += 4
+                continue
+            if wt != 2:
+                raise WireError(f"bad wire type {wt}")
+            ln, off = _read_varint(entry, off)
+            payload = entry[off:off + ln]
+            off += ln
+            if field == 1:
+                name = payload.decode("utf-8")
+            elif field == 2:
+                params = pb.CheckRequest.QuotaParams.FromString(payload)
+        self.quotas[name] = params
+
+
+class LazyWireBag:
+    """Bag over raw CompressedAttributes bytes.
+
+    The fused serving path tensorizes `wire` directly in C++ (zero
+    host-side decode, the mixerclient contract per SURVEY §2.9(a));
+    host consumers (APA preprocess, host-overlay adapters, quota
+    instances, referenced-attribute fallbacks) trigger a one-time
+    Python decode on first access — the ProtoBag lazy-decode role
+    (protoBag.go:49,161)."""
+
+    __slots__ = ("_wire", "_gwc", "_values", "native_ok")
+
+    def __init__(self, wire: bytes, global_word_count: int | None = None,
+                 native_ok: bool = True):
+        self._wire = wire
+        self._gwc = global_word_count
+        self._values: dict[str, Any] | None = None
+        # False → the C++ decoder can't interpret this encoding (e.g. a
+        # shortened global dictionary prefix); the dispatcher must use
+        # the python path, but the raw bytes stay intact for _decode
+        self.native_ok = native_ok
+
+    @property
+    def wire(self) -> bytes | None:
+        """Raw bytes for the native tensorizer; None when ineligible
+        (the dispatcher then python-tensorizes the whole batch)."""
+        return self._wire if self.native_ok else None
+
+    def _decode(self) -> dict[str, Any]:
+        if self._values is None:
+            msg = pb.CompressedAttributes.FromString(self._wire)
+            self._values = compressed_to_dict(msg, self._gwc)
+        return self._values
+
+    def get(self, name: str):
+        values = self._decode()
+        if name in values:
+            return values[name], True
+        return None, False
+
+    def names(self):
+        return list(self._decode())

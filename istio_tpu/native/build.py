@@ -1,6 +1,12 @@
-"""On-demand build of the native shim (protoc --cpp_out + g++)."""
+"""On-demand build of the native shim (protoc --cpp_out + g++).
+
+Build artifacts live in _build/ which is NOT under version control
+(reviewable source only — a committed binary can't be audited);
+staleness is a content hash of the sources, not mtimes (mtimes are
+arbitrary after a fresh clone)."""
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import threading
@@ -8,6 +14,7 @@ import threading
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD = os.path.join(_DIR, "_build")
 _SO = os.path.join(_BUILD, "libmixer_shim.so")
+_HASH = os.path.join(_BUILD, ".srchash")
 _PROTO_DIR = os.path.join(_DIR, "..", "api", "proto")
 _lock = threading.Lock()
 
@@ -16,22 +23,29 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def _newer(a: str, b: str) -> bool:
-    return os.path.getmtime(a) > os.path.getmtime(b)
+def _source_hash(*paths: str) -> str:
+    h = hashlib.sha256()
+    for p in paths:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
 
 
 def ensure_built() -> str:
     """Compile (once) and return the shared-library path."""
     src = os.path.join(_DIR, "shim.cpp")
+    proto_src = os.path.join(_PROTO_DIR, "mixer.proto")
+    want = _source_hash(src, proto_src)
     with _lock:
-        if os.path.exists(_SO) and not _newer(src, _SO):
-            return _SO
+        if os.path.exists(_SO) and os.path.exists(_HASH):
+            with open(_HASH, encoding="ascii") as f:
+                if f.read().strip() == want:
+                    return _SO
         os.makedirs(_BUILD, exist_ok=True)
-        proto = os.path.join(_PROTO_DIR, "mixer.proto")
         try:
             subprocess.run(
                 ["protoc", f"-I{_PROTO_DIR}", "-I/usr/include",
-                 f"--cpp_out={_BUILD}", proto],
+                 f"--cpp_out={_BUILD}", proto_src],
                 check=True, capture_output=True, text=True)
             subprocess.run(
                 ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
@@ -44,4 +58,6 @@ def ensure_built() -> str:
                 f"native shim build failed:\n{exc.stderr}") from exc
         except FileNotFoundError as exc:
             raise NativeBuildError(f"toolchain missing: {exc}") from exc
+        with open(_HASH, "w", encoding="ascii") as f:
+            f.write(want + "\n")
         return _SO

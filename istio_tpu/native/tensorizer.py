@@ -129,6 +129,11 @@ class NativeTensorizer:
         if not self._h:
             raise RuntimeError("shim_create failed (bad layout blob)")
         self._known_ids = lib.shim_intern_count(self._h)
+        # shim id → python id. Seeds preserve python id order, so the
+        # initial mapping is the identity; runtime-observed values may
+        # diverge (the python table also interns on the report/quota/
+        # generic paths), so new shim ids are remapped after each batch.
+        self._remap = np.arange(self._known_ids, dtype=np.int32)
 
     def tensorize_wire(self, records: Sequence[bytes]) -> AttributeBatch:
         lay = self.layout
@@ -155,13 +160,21 @@ class NativeTensorizer:
         if rc != 0:
             raise ValueError(self._lib.shim_error(self._h).decode())
         self._sync_interns()
+        if ids.size:
+            # translate shim id space → python id space so the ids plane
+            # compares equal against compiled constants / list entries
+            np.take(self._remap, ids, out=ids)
         return AttributeBatch(ids=ids, present=present_u8.astype(bool),
                               map_present=map_present_u8.astype(bool),
                               str_bytes=str_bytes, str_lens=str_lens)
 
     def _sync_interns(self) -> None:
-        """Mirror new shim interns into the Python table, preserving
-        id assignment (sequential on both sides)."""
+        """Extend the shim→python id remap with newly interned values.
+
+        The two tables intern independently at runtime (the python one
+        also serves the report/quota/generic paths), so ids are mapped,
+        not assumed equal — compile-time constants were seeded in python
+        id order and stay identity-mapped."""
         count = self._lib.shim_intern_count(self._h)
         if count == self._known_ids:
             return
@@ -175,19 +188,15 @@ class NativeTensorizer:
                 break
             cap = -got
         off = 0
-        new_id = self._known_ids
+        new_ids = []
         while off < len(raw):
             (k_len,) = struct.unpack_from("<I", raw, off)
             off += 4
             key = raw[off:off + k_len]
             off += k_len
-            value = _decode_key(key)
-            assigned = self.interner.intern(value)
-            if assigned != new_id:
-                raise RuntimeError(
-                    f"intern id drift: shim {new_id} != py {assigned} "
-                    f"for {value!r} — tables out of sync")
-            new_id += 1
+            new_ids.append(self.interner.intern(_decode_key(key)))
+        self._remap = np.concatenate(
+            [self._remap, np.asarray(new_ids, np.int32)])
         self._known_ids = count
 
     def __del__(self) -> None:

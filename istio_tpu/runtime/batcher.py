@@ -34,6 +34,11 @@ def bucket_size(n: int, max_batch: int) -> int:
 class PadBag(Bag):
     """Empty bag used to pad a batch to its bucket size."""
 
+    # empty CompressedAttributes — keeps a padded batch on the C++
+    # wire-decode path (dispatcher._check_fused requires every row to
+    # carry wire bytes)
+    wire = b""
+
     def get(self, name: str):
         return None, False
 

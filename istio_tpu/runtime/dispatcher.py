@@ -48,6 +48,10 @@ class CheckResponse:
     valid_duration_s: float = 5.0
     valid_use_count: int = 10_000
     referenced: tuple = ()
+    # item → was-present, filled by the fused path from device planes so
+    # ReferencedAttributes needs no host-side bag decode (None → the
+    # gRPC layer falls back to bag lookups)
+    referenced_presence: dict | None = None
 
 
 def _namespace_of(bag: Bag, identity_attr: str) -> str:
@@ -73,6 +77,11 @@ class Dispatcher:
         # FusedPlan (runtime/fused.py) — when present, check() runs the
         # fused device engine and overlays only host-only actions
         self.fused = fused
+        # any ATTRIBUTE_GENERATOR action configured? (when False the
+        # server skips the per-request preprocess resolve entirely)
+        self.has_apa = any(
+            snapshot.actions_for(r, Variety.ATTRIBUTE_GENERATOR)
+            for r in range(len(snapshot.rules)))
 
     def _handler_for(self, hc) -> Handler | None:
         """Built handler for a HandlerConfig (single home of the
@@ -88,6 +97,33 @@ class Dispatcher:
         return np.asarray([self.snapshot.ruleset.namespace_id(
             _namespace_of(bag, self.identity_attr)) for bag in bags],
             np.int32)
+
+    def _ns_ids_from_batch(self, batch) -> np.ndarray:
+        """destAndNamespace from the tensorized identity-attr column —
+        the wire path extracts namespaces without decoding the bags."""
+        rs = self.snapshot.ruleset
+        slot = rs.layout.slots.get(self.identity_attr)
+        n = batch.ids.shape[0]
+        if slot is None:
+            return np.zeros(n, np.int32)
+        ids = np.asarray(batch.ids[:, slot])
+        present = np.asarray(batch.present[:, slot])
+        interner = rs.interner
+        out = np.zeros(n, np.int32)
+        cache: dict[int, int] = {}
+        for b in range(n):
+            if not present[b]:
+                continue
+            vid = int(ids[b])
+            ns_id = cache.get(vid)
+            if ns_id is None:
+                v = interner.value_of(vid)
+                parts = v.split(".") if isinstance(v, str) else []
+                ns = parts[1] if len(parts) >= 2 and parts[1] else ""
+                ns_id = rs.namespace_id(ns)
+                cache[vid] = ns_id
+            out[b] = ns_id
+        return out
 
     def _overlay_fallback(self, matched: np.ndarray, err: np.ndarray,
                           ns_ids: np.ndarray, bags: Sequence[Bag]
@@ -148,8 +184,15 @@ class Dispatcher:
         the two paths provably pick the same rule's status."""
         snap, plan = self.snapshot, self.fused
         with monitor.resolve_timer():
-            batch = snap.tensorizer.tensorize(bags)
-            ns_ids = self._request_ns_ids(bags)
+            wires = [getattr(bag, "wire", None) for bag in bags]
+            if plan.native is not None and all(
+                    w is not None for w in wires):
+                # C++ wire→tensor decode: no per-request python work
+                batch = plan.native.tensorize_wire(wires)
+                ns_ids = self._ns_ids_from_batch(batch)
+            else:
+                batch = snap.tensorizer.tensorize(bags)
+                ns_ids = self._request_ns_ids(bags)
             verdict = plan.engine.check(batch, ns_ids)
             status = np.asarray(verdict.status)
             dur = np.asarray(verdict.valid_duration_s)
@@ -158,6 +201,9 @@ class Dispatcher:
             matched = np.array(verdict.matched)
             err = np.array(verdict.err)
         active, _ = self._overlay_fallback(matched, err, ns_ids, bags)
+        present_np = np.asarray(batch.present)
+        map_present_np = np.asarray(batch.map_present)
+        lay = snap.ruleset.layout
 
         ha = plan.host_rule_idx
         out = []
@@ -198,6 +244,23 @@ class Dispatcher:
             for ridx in np.nonzero(active[b])[0]:
                 referenced |= plan.instance_attrs[int(ridx)]
             resp.referenced = tuple(sorted(referenced, key=str))
+            # presence from the device planes → the gRPC layer builds
+            # ReferencedAttributes without decoding wire bags
+            presence: dict = {}
+            for item in referenced:
+                if isinstance(item, tuple):
+                    col = lay.derived_slots.get(item)
+                    if col is not None:
+                        presence[item] = bool(present_np[b, col])
+                else:
+                    col = lay.slots.get(item)
+                    if col is not None:
+                        presence[item] = bool(present_np[b, col])
+                    else:
+                        mcol = lay.map_slots.get(item)
+                        if mcol is not None:
+                            presence[item] = bool(map_present_np[b, mcol])
+            resp.referenced_presence = presence
             out.append(resp)
         return out
 

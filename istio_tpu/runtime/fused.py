@@ -49,6 +49,9 @@ class FusedPlan:
     instance_attrs: list[frozenset]
     deny_info: dict[int, tuple[int, str]]   # rule → (code, message)
     list_rules: frozenset
+    # C++ wire→tensor decoder (istio_tpu/native); None when the
+    # toolchain is unavailable — python Tensorizer serves instead
+    native: Any = None
     # rules whose FIRST check action is fused — device status wins ties
     # against host-overlay actions of the same rule (config action order)
     fused_first_rules: frozenset = frozenset()
@@ -157,9 +160,18 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
     engine = PolicyEngine(ruleset=rs, finder=snapshot.finder,
                           deny=list(deny_by_rule.values()), lists=lists,
                           quotas=(), jit=True)
-    log.info("fused plan: %d deny rules, %d lists, %d host-overlay rules",
-             len(deny_by_rule), len(lists), len(host_actions))
-    return FusedPlan(engine=engine, host_actions=host_actions,
+    native = None
+    try:
+        from istio_tpu.native.tensorizer import NativeTensorizer
+        native = NativeTensorizer(rs.layout, rs.interner)
+    except Exception as exc:   # toolchain missing → python tensorize
+        log.warning("native tensorizer unavailable, serving with the "
+                    "python wire decoder: %s", exc)
+    log.info("fused plan: %d deny rules, %d lists, %d host-overlay rules"
+             ", native=%s", len(deny_by_rule), len(lists),
+             len(host_actions), native is not None)
+    return FusedPlan(engine=engine, native=native,
+                     host_actions=host_actions,
                      host_rule_idx=np.asarray(sorted(host_actions),
                                               np.int64),
                      instance_attrs=instance_attrs,

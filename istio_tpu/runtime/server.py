@@ -55,9 +55,12 @@ class RuntimeServer:
     # batcher operates on already-preprocessed bags.
 
     def preprocess(self, bag: Bag) -> Bag:
-        if not self.args.preprocess:
+        d = self.controller.dispatcher
+        # the APA resolve costs a device step per request — skip it
+        # outright unless an ATTRIBUTE_GENERATOR action is configured
+        if not self.args.preprocess or not d.has_apa:
             return bag
-        return self.controller.dispatcher.preprocess(bag)
+        return d.preprocess(bag)
 
     def _run_check_batch(self,
                          bags: Sequence[Bag]) -> Sequence[CheckResponse]:
