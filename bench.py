@@ -99,6 +99,8 @@ def main() -> None:
     t_small -= sync_overhead / steps
     small_ms = float(t_small * 1e3)
 
+    served = _served_bench(n_rules, on_tpu)
+
     baseline_cps = 1e9 / (PER_PREDICATE_NS * n_rules)
     out = {
         "metric": f"mixer_check_throughput_{n_rules}_rules",
@@ -120,7 +122,64 @@ def main() -> None:
                            f"({PER_PREDICATE_NS:.0f} ns/predicate x "
                            f"{n_rules} rules)",
     }
+    out.update(served)
+    if "served_checks_per_sec" in served:
+        out["served_vs_baseline"] = round(
+            served["served_checks_per_sec"] / baseline_cps, 2)
     print(json.dumps(out))
+
+
+def _served_bench(n_rules: int, on_tpu: bool) -> dict:
+    """END-TO-END number: real gRPC Check RPCs from external client
+    processes through decode → C++ tensorize → device step → response,
+    measured at the client (mixer/pkg/perf pattern; VERDICT r1 item 3).
+
+    The axon TPU tunnel adds ~100ms per host↔device sync; the batcher
+    pipelines in-flight batches to amortize it, but per-request latency
+    carries at least one tunnel round-trip on this rig — the reported
+    device_sync_ms field makes that floor explicit (a colocated chip
+    syncs in microseconds)."""
+    import multiprocessing as mp
+
+    try:
+        from istio_tpu.api.grpc_server import MixerGrpcServer
+        from istio_tpu.runtime import RuntimeServer, ServerArgs
+        from istio_tpu.testing import perf, workloads
+
+        sync_ms = _roundtrip_s() * 1e3
+        # deep pipeline when each sync is expensive (tunnel), shallow
+        # when colocated
+        pipeline = max(2, min(32, int(sync_ms / 2) or 2))
+        store = workloads.make_store(n_rules)
+        srv = RuntimeServer(store, ServerArgs(
+            batch_window_s=0.001, max_batch=2048, pipeline=pipeline,
+            default_manifest=workloads.MESH_MANIFEST))
+        g = MixerGrpcServer(srv, max_workers=128)
+        try:
+            port = g.start()
+            payloads = perf.make_check_payloads(
+                workloads.make_request_dicts(512))
+            n_procs = min(6, max(2, (mp.cpu_count() or 4) - 2))
+            report = perf.run_load(
+                f"127.0.0.1:{port}", payloads,
+                duration_s=8.0 if on_tpu else 4.0,
+                n_procs=n_procs, concurrency=64 if on_tpu else 16,
+                warmup_s=30.0 if on_tpu else 10.0)
+        finally:
+            g.stop()
+            srv.close()
+        return {
+            "served_checks_per_sec": round(report.checks_per_sec, 1),
+            "served_p50_ms": round(report.p50_ms, 2),
+            "served_p99_ms": round(report.p99_ms, 2),
+            "served_n_requests": report.n_requests,
+            "served_errors": report.n_errors,
+            "served_first_error": report.first_error,
+            "served_clients": f"{report.n_procs}x{report.concurrency}",
+            "device_sync_ms": round(sync_ms, 1),
+        }
+    except Exception as exc:   # the device-step numbers must still print
+        return {"served_error": f"{type(exc).__name__}: {exc}"}
 
 
 if __name__ == "__main__":

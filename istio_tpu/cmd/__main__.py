@@ -220,7 +220,24 @@ def cmd_istio_ca(args: argparse.Namespace) -> int:
     if args.secret_file:
         with open(args.secret_file, "wb") as f:
             pickle.dump(secrets, f)
-    server = CAGrpcServer(ca, address=f"{args.address}:{args.port}")
+    if args.insecure_allow_all:
+        from istio_tpu.security.ca_service import (
+            allow_any_identity_authorizer,
+            insecure_allow_all_authenticator)
+        print("WARNING: --insecure-allow-all signs ANY identity for ANY "
+              "caller over plaintext; never use outside tests")
+        server = CAGrpcServer(
+            ca, authenticator=insecure_allow_all_authenticator,
+            authorizer=allow_any_identity_authorizer,
+            address=f"{args.address}:{args.port}", insecure_port=True)
+    else:
+        # onprem flow: callers present an existing cert signed by this
+        # root; they may renew only their own SPIFFE identity
+        from istio_tpu.security.ca_service import cert_authenticator
+        server = CAGrpcServer(
+            ca, authenticator=cert_authenticator(
+                ca.get_root_certificate()),
+            address=f"{args.address}:{args.port}")
     port = server.start()
     print(f"istio_ca: CSR service on {args.address}:{port}")
     _serve_forever()
@@ -241,9 +258,26 @@ def cmd_node_agent(args: argparse.Namespace) -> int:
             with open(os.path.join(args.cert_dir, fname), "wb") as f:
                 f.write(blob)
 
-    client = CAClient(args.ca_address)
+    root_pem = None
+    credential = b""
+    cred_type = "onprem"
+    if not args.insecure_ca and not (args.root_cert and
+                                     args.bootstrap_cert):
+        print("node_agent: --root-cert and --bootstrap-cert are required"
+              " (the CA serves TLS and authenticates onprem credentials);"
+              " pass --insecure-ca only against a test CA running with"
+              " --insecure-allow-all")
+        return 2
+    if args.root_cert:
+        with open(args.root_cert, "rb") as f:
+            root_pem = f.read()
+    if args.bootstrap_cert:
+        with open(args.bootstrap_cert, "rb") as f:
+            credential = f.read()
+    client = CAClient(args.ca_address, root_cert_pem=root_pem)
     agent = NodeAgent(client, args.identity, write_certs,
-                      ttl_minutes=args.ttl_minutes)
+                      ttl_minutes=args.ttl_minutes,
+                      credential=credential, credential_type=cred_type)
     agent.start()
     print(f"node_agent: rotating {args.identity} certs in {args.cert_dir}")
     _serve_forever()
@@ -321,6 +355,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=8060)
     s.add_argument("--secret-file", default="",
                    help="persist the self-signed root here")
+    s.add_argument("--insecure-allow-all", action="store_true",
+                   help="TEST ONLY: plaintext port, no authn/authz")
     s.set_defaults(fn=cmd_istio_ca)
 
     s = sub.add_parser("node-agent", help="workload cert rotation")
@@ -328,6 +364,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--identity", required=True)
     s.add_argument("--cert-dir", default="/etc/certs")
     s.add_argument("--ttl-minutes", type=int, default=60)
+    s.add_argument("--root-cert", default="",
+                   help="CA root for TLS to the CA service")
+    s.add_argument("--bootstrap-cert", default="",
+                   help="existing cert presented as the onprem credential")
+    s.add_argument("--insecure-ca", action="store_true",
+                   help="TEST ONLY: plaintext CA without credentials")
     s.set_defaults(fn=cmd_node_agent)
 
     s = sub.add_parser("brks", help="OSB broker")

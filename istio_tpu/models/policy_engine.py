@@ -122,11 +122,14 @@ class CheckVerdict:
     #                        non-OK status; INT32_MAX when status is OK.
     #                        The serving overlay merges host adapter
     #                        results against this in rule order.
+    err_count: Any         # int32 [] — total namespace-visible predicate
+    #                        errors in the batch (monitoring; lets the
+    #                        host skip converting the full err plane)
 
     def tree_flatten(self):
         return ((self.status, self.valid_duration_s, self.valid_use_count,
-                 self.referenced, self.matched, self.err, self.deny_rule),
-                None)
+                 self.referenced, self.matched, self.err, self.deny_rule,
+                 self.err_count), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -347,12 +350,19 @@ class PolicyEngine:
                                    referenced=referenced,
                                    matched=matched, err=err,
                                    deny_rule=jnp.where(
-                                       status == OK, BIGI, cand_rule))
+                                       status == OK, BIGI, cand_rule),
+                                   err_count=jnp.sum(
+                                       (err & ns_ok).astype(jnp.int32)))
             return verdict, quota_counts
 
         self.raw_step = step   # unjitted: for entry()/sharded wrappers
         self.params = self.ruleset.params
-        self._step = jax.jit(step, donate_argnums=(3,)) if jit else step
+        # donate the quota buffer only when quota state actually
+        # threads through the step: donation invalidates the input
+        # buffer, which breaks concurrent (pipelined) batches that all
+        # read the same dummy counts array
+        donate = (3,) if self._has_quota else ()
+        self._step = jax.jit(step, donate_argnums=donate) if jit else step
 
     def _slot_for(self, attr: Any) -> int:
         lay = self.ruleset.layout
@@ -365,8 +375,14 @@ class PolicyEngine:
 
     # ------------------------------------------------------------------
     def check(self, batch: AttributeBatch, req_ns: Any) -> CheckVerdict:
-        verdict, self.quota_counts = self._step(self.params, batch, req_ns,
-                                                self.quota_counts)
+        """NOTE: with device quotas this is a read-modify-write on
+        quota_counts and must not run concurrently; the quota-free
+        serving engine (runtime/fused.py) is safe under the batcher's
+        pipelined workers."""
+        verdict, counts = self._step(self.params, batch, req_ns,
+                                     self.quota_counts)
+        if self._has_quota:
+            self.quota_counts = counts
         return verdict
 
     def reset_quota(self) -> None:

@@ -102,8 +102,10 @@ def _layout_blob(layout: BatchLayout, interner: InternTable) -> bytes:
 
 class NativeTensorizer:
     def __init__(self, layout: BatchLayout, interner: InternTable):
+        import threading
         self.layout = layout
         self.interner = interner
+        self._call_lock = threading.Lock()
         lib = ctypes.CDLL(ensure_built())
         lib.shim_create.restype = ctypes.c_void_p
         lib.shim_create.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
@@ -136,6 +138,14 @@ class NativeTensorizer:
         self._remap = np.arange(self._known_ids, dtype=np.int32)
 
     def tensorize_wire(self, records: Sequence[bytes]) -> AttributeBatch:
+        # one decode at a time: the shim handle's intern table and the
+        # remap array are shared mutable state (pipelined batches may
+        # arrive concurrently from the batcher pool)
+        with self._call_lock:
+            return self._tensorize_wire_locked(records)
+
+    def _tensorize_wire_locked(self, records: Sequence[bytes]
+                               ) -> AttributeBatch:
         lay = self.layout
         n = len(records)
         ncol = max(lay.n_columns, 1)

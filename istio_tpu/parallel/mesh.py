@@ -77,7 +77,8 @@ def shard_engine_check(mesh: Mesh, engine) -> Callable:
                    "conj_m_idx": mp_rules, "conj_n_idx": mp_rules}
     out_verdict = CheckVerdict(status=dp, valid_duration_s=dp,
                                valid_use_count=dp, referenced=dp,
-                               matched=dpmp, err=dpmp, deny_rule=dp)
+                               matched=dpmp, err=dpmp, deny_rule=dp,
+                               err_count=rep)
     return jax.jit(engine.raw_step,
                    in_shardings=(param_shard, dp, dp, rep),
                    out_shardings=(out_verdict, rep))

@@ -24,11 +24,20 @@ from istio_tpu.attribute.bag import Bag
 from istio_tpu.runtime import monitor
 
 
-def bucket_size(n: int, max_batch: int) -> int:
-    b = 1
-    while b < n:
-        b <<= 1
-    return min(b, max_batch)
+def default_buckets(max_batch: int) -> tuple[int, ...]:
+    """Few, coarse bucket shapes: every bucket is one jit trace the
+    server must pay (seconds on TPU), so a small fixed set beats
+    power-of-two granularity — padding a 3-request batch to 256 rows
+    costs microseconds of MXU time, a 12th trace costs seconds."""
+    out = sorted({min(256, max_batch), max_batch})
+    return tuple(out)
+
+
+def bucket_size(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
 
 
 class PadBag(Bag):
@@ -54,11 +63,31 @@ class CheckBatcher:
     """
 
     def __init__(self, run_batch: Callable[[Sequence[Bag]], Sequence[Any]],
-                 window_s: float = 0.0003, max_batch: int = 1024):
+                 window_s: float = 0.0003, max_batch: int = 1024,
+                 pipeline: int = 4,
+                 buckets: tuple[int, ...] | None = None):
         self.run_batch = run_batch
         self.window_s = window_s
         self.max_batch = max_batch
+        self.buckets = tuple(sorted(buckets)) if buckets \
+            else default_buckets(max_batch)
+        if self.buckets[-1] < max_batch:
+            # every collectable batch size must land in a pre-warmable
+            # bucket, or over-bucket batches run at arbitrary unpadded
+            # shapes and re-trace in-band
+            self.buckets = self.buckets + (max_batch,)
         self._queue: "queue.Queue[tuple[Bag, Future] | None]" = queue.Queue()
+        # Bounded batch pipelining: the flusher hands each batch to a
+        # worker and immediately starts collecting the next, so the
+        # host↔device sync of batch N overlaps batch N+1's window and
+        # dispatch. Essential when the device sits behind a high-RTT
+        # transport (the axon TPU tunnel syncs in ~100ms); harmless
+        # (slightly better tail) when colocated. pipeline=1 restores
+        # strictly serial batches.
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max_workers=max(pipeline, 1),
+                                        thread_name_prefix="check-step")
+        self._inflight = threading.Semaphore(max(pipeline, 1))
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="check-batcher")
         self._closed = False
@@ -116,21 +145,29 @@ class CheckBatcher:
             self._flush(leftovers)
 
     def _flush(self, batch: list[tuple[Bag, Future]]) -> None:
-        monitor.CHECK_BATCH_SIZE.observe(len(batch))
-        bags = [bag for bag, _ in batch]
-        target = bucket_size(len(bags), self.max_batch)
-        padded = bags + [PadBag()] * (target - len(bags))
+        self._inflight.acquire()
+        self._pool.submit(self._run_one, batch)
+
+    def _run_one(self, batch: list[tuple[Bag, Future]]) -> None:
         try:
-            results = self.run_batch(padded)
-        except Exception as exc:
-            for _, fut in batch:
-                fut.set_exception(exc)
-            return
-        for (_, fut), result in zip(batch, results):
-            fut.set_result(result)
+            monitor.CHECK_BATCH_SIZE.observe(len(batch))
+            bags = [bag for bag, _ in batch]
+            target = bucket_size(len(bags), self.buckets)
+            padded = bags + [PadBag()] * (target - len(bags))
+            try:
+                results = self.run_batch(padded)
+            except Exception as exc:
+                for _, fut in batch:
+                    fut.set_exception(exc)
+                return
+            for (_, fut), result in zip(batch, results):
+                fut.set_result(result)
+        finally:
+            self._inflight.release()
 
     def close(self) -> None:
         if not self._closed:
             self._closed = True
             self._queue.put(None)
             self._thread.join(timeout=5)
+            self._pool.shutdown(wait=True)

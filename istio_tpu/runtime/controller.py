@@ -31,12 +31,14 @@ class Controller:
                  debounce_s: float = 0.05,
                  max_str_len: int | None = None,
                  on_publish: Callable[[Dispatcher], None] | None = None,
-                 fused: bool = True):
+                 fused: bool = True,
+                 prewarm_buckets: tuple[int, ...] = ()):
         self.store = store
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
         self.on_publish = on_publish
         self.fused_enabled = fused
+        self.prewarm_buckets = tuple(prewarm_buckets)
         self._builder = SnapshotBuilder(default_manifest,
                                         InternTable(), max_str_len)
         self._handler_table = HandlerTable()
@@ -81,6 +83,12 @@ class Controller:
         if self.fused_enabled:
             from istio_tpu.runtime.fused import build_fused_plan
             plan = build_fused_plan(snapshot)
+            # shadow-compile the serving shapes before the swap when an
+            # old dispatcher is still serving (SURVEY hard-part #5): a
+            # config change must never surface trace time in-band
+            if plan is not None and self.prewarm_buckets \
+                    and self._dispatcher is not None:
+                plan.prewarm(self.prewarm_buckets)
         dispatcher = Dispatcher(snapshot, handlers, self.identity_attr,
                                 fused=plan)
         self._dispatcher = dispatcher      # atomic publish (GIL ref swap)

@@ -198,14 +198,44 @@ class Dispatcher:
             dur = np.asarray(verdict.valid_duration_s)
             uses = np.asarray(verdict.valid_use_count)
             deny_rule = np.asarray(verdict.deny_rule)
-            matched = np.array(verdict.matched)
-            err = np.array(verdict.err)
-        active, _ = self._overlay_fallback(matched, err, ns_ids, bags)
+        rs = snap.ruleset
+        n_err = int(verdict.err_count)
+        if n_err:
+            monitor.RESOLVE_ERRORS.inc(n_err)
+
+        # Only plan.overlay_cols of the [B, R] matched plane are ever
+        # inspected host-side; converting the full plane (16MB/batch at
+        # B=2048, R=10k) was the serving bottleneck. Namespace masking
+        # for the subset happens in numpy; host-fallback rules are
+        # oracle-evaluated into their subset positions.
+        cols = plan.overlay_cols
+        if len(cols):
+            # np.array (not asarray): device→host copies are read-only
+            # and the fallback overlay writes into the subset
+            active_sub = np.array(verdict.matched[:, cols])
+            col_pos = {int(r): i for i, r in enumerate(cols)}
+            host_errs = 0
+            for ridx in rs.host_fallback:
+                pos = col_pos[ridx]
+                for b, bag in enumerate(bags):
+                    m, _, e = rs.host_eval(ridx, bag)
+                    active_sub[b, pos] = m
+                    host_errs += e
+            if host_errs:
+                monitor.RESOLVE_ERRORS.inc(host_errs)
+            rns = rs.rule_ns[cols]
+            ns_ok_sub = (rns[None, :] == rs.ns_ids[""]) | \
+                        (rns[None, :] == ns_ids[:, None])
+            active_sub &= ns_ok_sub
+        else:
+            active_sub = np.zeros((len(bags), 0), bool)
+            col_pos = {}
         present_np = np.asarray(batch.present)
         map_present_np = np.asarray(batch.map_present)
-        lay = snap.ruleset.layout
+        lay = rs.layout
 
         ha = plan.host_rule_idx
+        ha_pos = np.asarray([col_pos[int(r)] for r in ha], np.int64)
         out = []
         for b, bag in enumerate(bags):
             resp = CheckResponse()
@@ -215,7 +245,7 @@ class Dispatcher:
                                        int(uses[b]))
             dev_rule = int(deny_rule[b])
             dev_applied = False
-            host_active = ha[active[b, ha]] if len(ha) else ()
+            host_active = ha[active_sub[b, ha_pos]] if len(ha) else ()
             for ridx in host_active:
                 ridx = int(ridx)
                 # ties at ridx == dev_rule follow the rule's config
@@ -241,8 +271,8 @@ class Dispatcher:
                 self._apply_device_status(resp, plan, dev_rule,
                                           int(status[b]))
             referenced = set(plan.pred_attrs_for_ns(int(ns_ids[b])))
-            for ridx in np.nonzero(active[b])[0]:
-                referenced |= plan.instance_attrs[int(ridx)]
+            for pos in np.nonzero(active_sub[b])[0]:
+                referenced |= plan.instance_attrs[int(cols[pos])]
             resp.referenced = tuple(sorted(referenced, key=str))
             # presence from the device planes → the gRPC layer builds
             # ReferencedAttributes without decoding wire bags

@@ -55,6 +55,13 @@ class FusedPlan:
     # rules whose FIRST check action is fused — device status wins ties
     # against host-overlay actions of the same rule (config action order)
     fused_first_rules: frozenset = frozenset()
+    # the only rule columns the host ever inspects per request: rules
+    # with host-overlay actions, host-fallback predicates, or non-empty
+    # instance attribute sets. The dispatcher converts JUST these
+    # columns of the [B, R] matched plane — at 10k rules the full-plane
+    # copy was the serving bottleneck.
+    overlay_cols: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros(0, np.int64))
     fused_deny: int = 0
     fused_lists: int = 0
     _ns_pred_cache: dict = dataclasses.field(default_factory=dict)
@@ -75,6 +82,30 @@ class FusedPlan:
         frozen = frozenset(out)
         self._ns_pred_cache[ns_id] = frozen
         return frozen
+
+    def prewarm(self, buckets) -> None:
+        """Trace/compile the engine step for every serving batch shape.
+
+        Called by the controller BEFORE the atomic dispatcher swap
+        (SURVEY hard-part #5; resolver refcount-swap semantics,
+        mixer/pkg/runtime/resolver.go:240-247): the old snapshot keeps
+        serving while the new one's jit cache fills, so no request pays
+        multi-second trace time in-band after a config change."""
+        import jax
+        from istio_tpu.compiler.layout import AttributeBatch
+
+        lay = self.engine.ruleset.layout
+        for b in sorted(set(buckets)):
+            batch = AttributeBatch(
+                ids=np.zeros((b, lay.n_columns), np.int32),
+                present=np.zeros((b, lay.n_columns), bool),
+                map_present=np.zeros((b, max(lay.n_maps, 1)), bool),
+                str_bytes=np.zeros((b, max(lay.n_byte_slots, 1),
+                                    lay.max_str_len), np.uint8),
+                str_lens=np.zeros((b, max(lay.n_byte_slots, 1)),
+                                  np.int32))
+            verdict = self.engine.check(batch, np.zeros(b, np.int32))
+            jax.block_until_ready(verdict.status)
 
     def message_for(self, rule_idx: int, status: int) -> str:
         """Best-effort status message for a device-produced denial."""
@@ -170,6 +201,8 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
     log.info("fused plan: %d deny rules, %d lists, %d host-overlay rules"
              ", native=%s", len(deny_by_rule), len(lists),
              len(host_actions), native is not None)
+    overlay = set(host_actions) | set(rs.host_fallback) | \
+        {r for r in range(rs.n_rules) if instance_attrs[r]}
     return FusedPlan(engine=engine, native=native,
                      host_actions=host_actions,
                      host_rule_idx=np.asarray(sorted(host_actions),
@@ -178,6 +211,7 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
                      deny_info=deny_info,
                      list_rules=frozenset(list_rules),
                      fused_first_rules=frozenset(fused_first),
+                     overlay_cols=np.asarray(sorted(overlay), np.int64),
                      fused_deny=len(deny_by_rule), fused_lists=len(lists))
 
 

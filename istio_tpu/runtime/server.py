@@ -27,6 +27,12 @@ class ServerArgs:
     default_manifest: Mapping[str, ValueType] | None = None
     batch_window_s: float = 0.0003
     max_batch: int = 1024
+    # in-flight device batches (overlaps host↔device sync across
+    # batches; see runtime/batcher.py)
+    pipeline: int = 4
+    # serving batch shapes (None → batcher.default_buckets(max_batch));
+    # each is one jit trace, pre-warmed before config swaps
+    buckets: tuple[int, ...] | None = None
     max_str_len: int | None = None
     preprocess: bool = True
     # serve checks through the fused device engine (runtime/fused.py);
@@ -40,14 +46,20 @@ class RuntimeServer:
         manifest = self.args.default_manifest
         if manifest is None:
             manifest = GLOBAL_MANIFEST
+        from istio_tpu.runtime.batcher import default_buckets
+        buckets = tuple(sorted(self.args.buckets)) if self.args.buckets \
+            else default_buckets(self.args.max_batch)
         self.controller = Controller(
             store, default_manifest=manifest,
             identity_attr=self.args.identity_attr,
             max_str_len=self.args.max_str_len,
-            fused=self.args.fused)
+            fused=self.args.fused,
+            prewarm_buckets=buckets)
         self.batcher = CheckBatcher(self._run_check_batch,
                                     window_s=self.args.batch_window_s,
-                                    max_batch=self.args.max_batch)
+                                    max_batch=self.args.max_batch,
+                                    pipeline=self.args.pipeline,
+                                    buckets=buckets)
 
     # -- API surface (grpcServer.go Check/Report semantics) --
     # Preprocessing (the APA phase) happens exactly ONCE per request, in

@@ -70,8 +70,12 @@ class IstioCA(CertificateAuthority):
             return cls(blob["ca-key.pem"], blob["ca-cert.pem"], opts)
         key = pki.generate_key()
         now = datetime.datetime.now(datetime.timezone.utc)
+        # the root's subject must differ from leaf subjects (all
+        # O=<org>): subject==issuer on a leaf reads as self-signed to
+        # chain verifiers and TLS handshakes fail
         name = x509.Name([
-            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org)])
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+            x509.NameAttribute(NameOID.COMMON_NAME, f"{org} root CA")])
         cert = (x509.CertificateBuilder()
                 .subject_name(name).issuer_name(name)
                 .public_key(key.public_key())
@@ -108,6 +112,7 @@ class IstioCA(CertificateAuthority):
             raise CAError(f"requested TTL {ttl} exceeds max "
                           f"{self.opts.max_cert_ttl}")
         uris = pki.san_uris(csr)
+        dns = pki.san_dns(csr)
         now = datetime.datetime.now(datetime.timezone.utc)
         builder = (x509.CertificateBuilder()
                    .subject_name(csr.subject)
@@ -123,10 +128,11 @@ class IstioCA(CertificateAuthority):
                        [x509.ExtendedKeyUsageOID.SERVER_AUTH,
                         x509.ExtendedKeyUsageOID.CLIENT_AUTH]),
                        critical=False))
-        if uris:
+        if uris or dns:
             builder = builder.add_extension(
                 x509.SubjectAlternativeName(
-                    [x509.UniformResourceIdentifier(u) for u in uris]),
+                    [x509.UniformResourceIdentifier(u) for u in uris] +
+                    [x509.DNSName(d) for d in dns]),
                 critical=False)
         cert = builder.sign(self._key, hashes.SHA256())
         return cert.public_bytes(serialization.Encoding.PEM)

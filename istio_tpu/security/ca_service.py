@@ -20,6 +20,7 @@ import grpc
 from istio_tpu.security import pki
 from istio_tpu.security import ca_service_pb2 as pb
 from istio_tpu.security.ca import CertificateAuthority
+from istio_tpu.security.spiffe import identity_from_san
 
 log = logging.getLogger("istio_tpu.security")
 
@@ -28,17 +29,72 @@ log = logging.getLogger("istio_tpu.security")
 # (server.go:188); tests inject their own.
 Authenticator = Callable[[str, bytes], str | None]
 
+# (authenticated identity, requested SPIFFE ids) → None when allowed,
+# else a rejection message (server.go:74 authorizer.authorize role)
+Authorizer = Callable[[str, list[str]], str | None]
 
-def allow_all_authenticator(cred_type: str, cred: bytes) -> str | None:
+
+def insecure_allow_all_authenticator(cred_type: str,
+                                     cred: bytes) -> str | None:
+    """TEST/BOOTSTRAP ONLY: accepts any caller as 'anonymous'. Under the
+    default same-id authorizer an anonymous caller can sign nothing, so
+    pairing this with `authorizer=None` (the default) is still safe;
+    pairing it with allow_any_identity_authorizer is the fully open
+    configuration and must never ship."""
     return "anonymous"
 
 
+def cert_authenticator(root_cert_pem: bytes) -> Authenticator:
+    """onprem platform flow (security/pkg/platform/onprem.go): the
+    credential is an existing cert signed by our root; the caller's
+    identity is its SPIFFE URI SAN."""
+    def auth(cred_type: str, cred: bytes) -> str | None:
+        if cred_type != "onprem":
+            return None
+        try:
+            if not pki.verify_chain(cred, root_cert_pem):
+                return None
+            return identity_from_san(pki.san_uris(pki.load_cert(cred)))
+        except Exception:
+            return None
+    return auth
+
+
+def same_id_authorizer(caller: str, requested: list[str]) -> str | None:
+    """Default: a workload may only request certificates for its own
+    SPIFFE identity (the reference's per-caller authorization contract,
+    server.go:74)."""
+    for rid in requested:
+        if rid != caller:
+            return f"caller {caller!r} may not request identity {rid!r}"
+    return None
+
+
+def allow_any_identity_authorizer(caller: str,
+                                  requested: list[str]) -> str | None:
+    """TEST ONLY: no identity restriction."""
+    return None
+
+
 class CAGrpcServer:
+    """CSR signing service.
+
+    Security posture (ADVICE r1 high): authentication is explicit (no
+    permissive default), the CSR's requested SPIFFE ids are authorized
+    against the authenticated identity before signing, and serving is
+    TLS by default with a CA-signed certificate (server.go:165-199) —
+    `insecure_port=True` is for tests."""
+
+    TLS_DNS = "istio-ca"
+
     def __init__(self, ca: CertificateAuthority,
-                 authenticator: Authenticator = allow_all_authenticator,
-                 address: str = "127.0.0.1:0"):
+                 authenticator: Authenticator,
+                 authorizer: Authorizer | None = None,
+                 address: str = "127.0.0.1:0",
+                 insecure_port: bool = False):
         self.ca = ca
         self.authenticator = authenticator
+        self.authorizer = authorizer or same_id_authorizer
         self._server = grpc.server(futures.ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="ca-grpc"))
         handlers = {
@@ -49,7 +105,18 @@ class CAGrpcServer:
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler(
                 "istio.v1.auth.IstioCAService", handlers),))
-        self.port = self._server.add_insecure_port(address)
+        if insecure_port:
+            self.port = self._server.add_insecure_port(address)
+        else:
+            key = pki.generate_key()
+            csr = pki.generate_csr(
+                key, "spiffe://cluster.local/ns/istio-system/sa/istio-ca",
+                dns_names=(self.TLS_DNS,))
+            cert = ca.sign(csr)
+            creds = grpc.ssl_server_credentials(
+                [(pki.key_to_pem(key),
+                  cert + ca.get_root_certificate())])
+            self.port = self._server.add_secure_port(address, creds)
 
     def start(self) -> int:
         self._server.start()
@@ -67,6 +134,27 @@ class CAGrpcServer:
             return pb.CsrResponse(is_approved=False,
                                   status_message="authentication failed")
         try:
+            csr = pki.load_csr(bytes(request.csr_pem))
+            # EVERY SAN the signed cert would carry needs authorization:
+            # ca.sign copies DNS SANs too, and an unauthorized
+            # DNS=istio-ca would let a workload impersonate this CA's
+            # TLS identity to every node agent
+            requested = pki.san_uris(csr) + pki.san_dns(csr)
+        except Exception as exc:
+            return pb.CsrResponse(is_approved=False,
+                                  status_message=f"bad CSR: {exc}")
+        if not requested:
+            return pb.CsrResponse(
+                is_approved=False,
+                status_message="authorization failed: CSR requests no "
+                               "identities")
+        denied = self.authorizer(ident, requested)
+        if denied is not None:
+            log.warning("CSR rejected: %s", denied)
+            return pb.CsrResponse(
+                is_approved=False,
+                status_message=f"authorization failed: {denied}")
+        try:
             ttl = datetime.timedelta(
                 minutes=request.requested_ttl_minutes) \
                 if request.requested_ttl_minutes else None
@@ -83,8 +171,17 @@ class CAClient:
     """caclient/grpc: CSR submission with bounded retries."""
 
     def __init__(self, target: str, max_retries: int = 3,
-                 retry_interval_s: float = 0.2):
-        self._channel = grpc.insecure_channel(target)
+                 retry_interval_s: float = 0.2,
+                 root_cert_pem: bytes | None = None):
+        if root_cert_pem:
+            creds = grpc.ssl_channel_credentials(
+                root_certificates=root_cert_pem)
+            self._channel = grpc.secure_channel(
+                target, creds,
+                options=(("grpc.ssl_target_name_override",
+                          CAGrpcServer.TLS_DNS),))
+        else:
+            self._channel = grpc.insecure_channel(target)
         self._call = self._channel.unary_unary(
             "/istio.v1.auth.IstioCAService/HandleCSR",
             request_serializer=pb.CsrRequest.SerializeToString,

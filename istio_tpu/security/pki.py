@@ -33,14 +33,17 @@ def key_from_pem(pem: bytes):
     return serialization.load_pem_private_key(pem, password=None)
 
 
-def generate_csr(key, identity: str, org: str = "istio_tpu") -> bytes:
-    """CSR with the workload identity as a URI SAN (generate_csr.go)."""
+def generate_csr(key, identity: str, org: str = "istio_tpu",
+                 dns_names: tuple[str, ...] = ()) -> bytes:
+    """CSR with the workload identity as a URI SAN (generate_csr.go);
+    optional DNS SANs for serving certs (e.g. the CA's own TLS cert,
+    server.go:165-199)."""
+    sans = [x509.UniformResourceIdentifier(identity)]
+    sans += [x509.DNSName(d) for d in dns_names]
     builder = x509.CertificateSigningRequestBuilder().subject_name(
         x509.Name([x509.NameAttribute(NameOID.ORGANIZATION_NAME, org)])
     ).add_extension(
-        x509.SubjectAlternativeName(
-            [x509.UniformResourceIdentifier(identity)]),
-        critical=False)
+        x509.SubjectAlternativeName(sans), critical=False)
     return builder.sign(key, hashes.SHA256()).public_bytes(
         serialization.Encoding.PEM)
 
@@ -62,6 +65,16 @@ def san_uris(cert_or_csr) -> list[str]:
         return []
     return list(ext.value.get_values_for_type(
         x509.UniformResourceIdentifier))
+
+
+def san_dns(cert_or_csr) -> list[str]:
+    """DNS SANs of a cert/CSR."""
+    try:
+        ext = cert_or_csr.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName)
+    except x509.ExtensionNotFound:
+        return []
+    return list(ext.value.get_values_for_type(x509.DNSName))
 
 
 def key_cert_pair_ok(key_pem: bytes, cert_pem: bytes) -> bool:

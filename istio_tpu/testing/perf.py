@@ -1,0 +1,150 @@
+"""Perf rig — load generation decoupled from the server.
+
+Reference: mixer/pkg/perf (controller.go:27 + clientserver.go): a
+controller drives external client processes that fire attribute load at
+the server, and throughput/latency are measured AT THE CLIENT, through
+the full stack (gRPC decode → tensorize → device step → response).
+Benchmarks: mixer/test/perf/singlecheck_test.go:53.
+
+Clients are separate OS processes (the GIL must not couple load
+generation to the server under test); each worker runs `concurrency`
+threads of blocking Check RPCs over its own channel, cycling through
+pre-serialized request payloads, and reports latency samples back over
+a queue.
+"""
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing as mp
+import time
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+
+def make_check_payloads(dicts: Sequence[Mapping[str, Any]]) -> list[bytes]:
+    """Pre-serialized CheckRequest bytes for the worker processes."""
+    from istio_tpu.api import mixer_pb2 as pb
+    from istio_tpu.api.wire import bag_to_compressed
+    from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
+
+    out = []
+    for values in dicts:
+        req = pb.CheckRequest(global_word_count=len(GLOBAL_WORD_LIST))
+        bag_to_compressed(values, msg=req.attributes)
+        out.append(req.SerializeToString())
+    return out
+
+
+@dataclasses.dataclass
+class PerfReport:
+    checks_per_sec: float
+    p50_ms: float
+    p99_ms: float
+    mean_ms: float
+    n_requests: int
+    n_errors: int
+    duration_s: float
+    n_procs: int
+    concurrency: int
+    first_error: str = ""
+
+
+def _worker(target: str, payloads: list[bytes], duration_s: float,
+            concurrency: int, start_at: float, q: "mp.Queue") -> None:
+    import threading
+
+    import grpc
+
+    channel = grpc.insecure_channel(target)
+    call = channel.unary_unary(
+        "/istio.mixer.v1.Mixer/Check",
+        request_serializer=lambda b: b,       # already serialized
+        response_deserializer=lambda b: b)    # latency only; skip parse
+    grpc.channel_ready_future(channel).result(timeout=30)
+
+    lat: list[float] = []
+    errors = [0]
+    first_error: list[str] = []
+    lock = threading.Lock()
+
+    def run(tid: int) -> None:
+        i = tid
+        my_lat = []
+        my_err = 0
+        deadline = start_at + duration_s
+        # traffic flows immediately (warming jit buckets/caches); only
+        # calls begun inside the measurement window are recorded
+        while True:
+            now = time.time()
+            if now >= deadline:
+                break
+            p = payloads[i % len(payloads)]
+            i += concurrency
+            t0 = time.perf_counter()
+            try:
+                call(p)
+                if now >= start_at:
+                    my_lat.append(time.perf_counter() - t0)
+            except Exception as exc:
+                if now >= start_at:
+                    my_err += 1
+                with lock:
+                    if not first_error:
+                        first_error.append(f"{type(exc).__name__}: "
+                                           f"{exc}"[:300])
+        with lock:
+            lat.extend(my_lat)
+            errors[0] += my_err
+
+    threads = [threading.Thread(target=run, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    channel.close()
+    q.put((np.asarray(lat, np.float64), errors[0],
+           first_error[0] if first_error else ""))
+
+
+def run_load(target: str, payloads: Sequence[bytes],
+             duration_s: float = 5.0, n_procs: int = 4,
+             concurrency: int = 32, warmup_s: float = 2.0) -> PerfReport:
+    """Fire Check load at `target` and report client-side numbers.
+
+    A shared start timestamp aligns the measurement window across
+    workers; `warmup_s` of pre-traffic warms the server's jit buckets
+    before the window opens."""
+    # spawn, not fork: grpc's internal threads/state do not survive a
+    # fork once the parent has created a server/channel
+    ctx = mp.get_context("spawn")
+    q: "mp.Queue" = ctx.Queue()
+    start_at = time.time() + warmup_s
+    procs = [ctx.Process(target=_worker,
+                         args=(target, list(payloads), duration_s,
+                               concurrency, start_at, q), daemon=True)
+             for _ in range(n_procs)]
+    for p in procs:
+        p.start()
+    all_lat: list[np.ndarray] = []
+    n_err = 0
+    first_error = ""
+    for _ in procs:
+        lat, errs, err_msg = q.get(timeout=duration_s + warmup_s + 120)
+        all_lat.append(lat)
+        n_err += errs
+        first_error = first_error or err_msg
+    for p in procs:
+        p.join(timeout=10)
+    lat = np.concatenate(all_lat) if all_lat else np.zeros(0)
+    n = int(lat.size)
+    wall = duration_s
+    return PerfReport(
+        checks_per_sec=n / wall if wall > 0 else 0.0,
+        p50_ms=float(np.percentile(lat, 50) * 1e3) if n else 0.0,
+        p99_ms=float(np.percentile(lat, 99) * 1e3) if n else 0.0,
+        mean_ms=float(lat.mean() * 1e3) if n else 0.0,
+        n_requests=n, n_errors=n_err, duration_s=wall,
+        n_procs=len(procs), concurrency=concurrency,
+        first_error=first_error)

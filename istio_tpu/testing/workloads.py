@@ -84,12 +84,48 @@ def make_engine(n_rules: int = 1024,
                         quotas=quotas, jit=jit)
 
 
-def make_bags(batch: int, seed: int = 1) -> list[Bag]:
+def make_store(n_rules: int, n_services: int | None = None,
+               with_regex: bool = True):
+    """A MemStore carrying the make_rules() workload as REAL config
+    kinds (handlers/instances/rules), for serving-path benches and the
+    perf rig: every 3rd rule deny + every 97th a whitelist, mirroring
+    make_engine()'s fused-action mix. Rules live in their own
+    namespaces (namespace targeting identical to make_rules)."""
+    from istio_tpu.runtime.store import MemStore
+
+    s = MemStore()
+    s.set(("handler", "istio-system", "denyall"), {
+        "adapter": "denier", "params": {"status_code": 7}})
+    s.set(("handler", "istio-system", "nswhitelist"), {
+        "adapter": "list",
+        "params": {"overrides": [f"ns{j}" for j in range(0, 23, 2)],
+                   "blacklist": False}})
+    s.set(("instance", "istio-system", "nothing"), {
+        "template": "checknothing", "params": {}})
+    s.set(("instance", "istio-system", "srcns"), {
+        "template": "listentry", "params": {"value": "source.namespace"}})
+    for i, rule in enumerate(make_rules(n_rules, n_services, with_regex)):
+        actions = []
+        if i % 3 == 0:
+            actions.append({"handler": "denyall.istio-system",
+                            "instances": ["nothing.istio-system"]})
+        if i % 97 == 1:
+            actions.append({"handler": "nswhitelist.istio-system",
+                            "instances": ["srcns.istio-system"]})
+        if not actions:   # every rule carries at least a no-op check
+            actions.append({"handler": "denyall.istio-system",
+                            "instances": []})
+        s.set(("rule", rule.namespace, rule.name),
+              {"match": rule.match, "actions": actions})
+    return s
+
+
+def make_request_dicts(batch: int, seed: int = 1) -> list[dict]:
     rng = np.random.default_rng(seed)
-    bags = []
+    dicts = []
     for _ in range(batch):
         i = int(rng.integers(0, 4096))
-        d = {
+        dicts.append({
             "destination.service":
                 f"svc{rng.integers(0, 512)}.ns{i % 23}.svc.cluster.local",
             "source.namespace": f"ns{rng.integers(0, 25)}",
@@ -101,9 +137,12 @@ def make_bags(batch: int, seed: int = 1) -> list[Bag]:
             "connection.mtls": bool(rng.random() < 0.5),
             "request.headers": {"cookie": f"session={rng.integers(0, 120)}",
                                 ":authority": "productpage"},
-        }
-        bags.append(bag_from_mapping(d))
-    return bags
+        })
+    return dicts
+
+
+def make_bags(batch: int, seed: int = 1) -> list[Bag]:
+    return [bag_from_mapping(d) for d in make_request_dicts(batch, seed)]
 
 
 def make_request_ns(engine: PolicyEngine, batch: int,

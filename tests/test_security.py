@@ -73,12 +73,22 @@ def test_secret_controller():
     assert name not in secrets
 
 
+def _claimed_identity_authenticator(cred_type: str,
+                                    cred: bytes) -> str | None:
+    """Test authenticator: the credential IS the caller identity (the
+    same-id authorizer still constrains what it may sign)."""
+    return cred.decode() if cred else None
+
+
 @pytest.fixture()
 def ca_rig():
     ca = IstioCA.new_self_signed({})
-    server = CAGrpcServer(ca)
+    # TLS serving (default) with a CA-signed serving cert; the client
+    # verifies against the CA root
+    server = CAGrpcServer(ca, authenticator=_claimed_identity_authenticator)
     port = server.start()
-    client = CAClient(f"127.0.0.1:{port}")
+    client = CAClient(f"127.0.0.1:{port}",
+                      root_cert_pem=ca.get_root_certificate())
     yield ca, client
     client.close()
     server.stop()
@@ -88,16 +98,60 @@ def test_csr_grpc_roundtrip(ca_rig):
     ca, client = ca_rig
     key = generate_key()
     ident = spiffe_id("default", "node-agent-test")
-    resp = client.sign_csr(generate_csr(key, ident), ttl_minutes=45)
+    resp = client.sign_csr(generate_csr(key, ident), ttl_minutes=45,
+                           credential=ident.encode())
     assert resp.is_approved, resp.status_message
     assert san_uris(load_cert(bytes(resp.signed_cert))) == [ident]
     assert bytes(resp.cert_chain) == ca.get_root_certificate()
 
 
+def test_csr_authorization_rejected(ca_rig):
+    """ADVICE r1 high: a caller must not obtain a cert for an identity
+    other than its own (server.go:74 authorize-before-sign)."""
+    _, client = ca_rig
+    key = generate_key()
+    victim = spiffe_id("istio-system", "istio-pilot")
+    attacker = spiffe_id("default", "compromised-workload")
+    resp = client.sign_csr(generate_csr(key, victim),
+                           credential=attacker.encode())
+    assert not resp.is_approved
+    assert "authorization failed" in resp.status_message
+
+
+def test_csr_dns_san_impersonation_rejected(ca_rig):
+    """A workload must not obtain a cert carrying DNS=istio-ca (the CA's
+    TLS identity) even when its URI SAN is its own: every SAN the signed
+    cert would carry needs authorization."""
+    from istio_tpu.security.pki import generate_csr as gen
+    _, client = ca_rig
+    ident = spiffe_id("default", "sneaky")
+    csr = gen(generate_key(), ident, dns_names=("istio-ca",))
+    resp = client.sign_csr(csr, credential=ident.encode())
+    assert not resp.is_approved
+    assert "authorization failed" in resp.status_message
+
+
+def test_csr_without_identities_rejected(ca_rig):
+    """A SAN-free CSR must not be vacuously authorized."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.x509.oid import NameOID
+    _, client = ca_rig
+    key = generate_key()
+    bare = x509.CertificateSigningRequestBuilder().subject_name(
+        x509.Name([x509.NameAttribute(NameOID.ORGANIZATION_NAME, "x")])
+    ).sign(key, hashes.SHA256()).public_bytes(serialization.Encoding.PEM)
+    resp = client.sign_csr(bare, credential=b"spiffe://c/ns/a/sa/b")
+    assert not resp.is_approved
+    assert "no identities" in resp.status_message
+
+
 def test_csr_authentication_rejected():
     ca = IstioCA.new_self_signed({})
+    from istio_tpu.security.ca_service import allow_any_identity_authorizer
     server = CAGrpcServer(
-        ca, authenticator=lambda t, c: "id" if c == b"good" else None)
+        ca, authenticator=lambda t, c: "id" if c == b"good" else None,
+        authorizer=allow_any_identity_authorizer, insecure_port=True)
     port = server.start()
     client = CAClient(f"127.0.0.1:{port}")
     try:
@@ -113,12 +167,46 @@ def test_csr_authentication_rejected():
         server.stop()
 
 
+def test_cert_authenticator_onprem_flow():
+    """Full onprem loop: a workload bootstrapped with a CA-signed cert
+    renews itself using that cert as the credential; a cert signed by a
+    DIFFERENT root is rejected (security/pkg/platform/onprem.go)."""
+    from istio_tpu.security.ca_service import cert_authenticator
+    ca = IstioCA.new_self_signed({})
+    ident = spiffe_id("default", "vm-workload")
+    boot_key = generate_key()
+    boot_cert = ca.sign(generate_csr(boot_key, ident))
+
+    server = CAGrpcServer(ca, authenticator=cert_authenticator(
+        ca.get_root_certificate()))
+    port = server.start()
+    client = CAClient(f"127.0.0.1:{port}",
+                      root_cert_pem=ca.get_root_certificate())
+    try:
+        renew = client.sign_csr(generate_csr(generate_key(), ident),
+                                credential=boot_cert)
+        assert renew.is_approved, renew.status_message
+
+        other_ca = IstioCA.new_self_signed({})
+        rogue_cert = other_ca.sign(
+            generate_csr(generate_key(), ident))
+        rejected = client.sign_csr(generate_csr(generate_key(), ident),
+                                   credential=rogue_cert)
+        assert not rejected.is_approved
+        assert "authentication" in rejected.status_message
+    finally:
+        client.close()
+        server.stop()
+
+
 def test_node_agent_rotation(ca_rig):
     _, client = ca_rig
     bundles = []
-    agent = NodeAgent(client, spiffe_id("default", "vm-workload"),
+    ident = spiffe_id("default", "vm-workload")
+    agent = NodeAgent(client, ident,
                       on_certs=lambda k, c, r: bundles.append((k, c, r)),
-                      ttl_minutes=1)   # rotate at ~30s — force manually
+                      ttl_minutes=1,   # rotate at ~30s — force manually
+                      credential=ident.encode())
     agent.rotate_once()
     agent.rotate_once()
     assert agent.rotations == 2 and len(bundles) == 2
