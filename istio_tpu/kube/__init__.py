@@ -1,0 +1,25 @@
+"""Kubernetes-shaped platform layer (SURVEY §2 L2), hermetic by design.
+
+The reference's L2 is informer machinery over a live API server:
+mixer/pkg/config/crd/store.go (config watch), pilot/pkg/serviceregistry/
+kube/controller.go (service discovery), pilot/pkg/config/kube/crd/
+client.go (pilot config), pilot/pkg/config/kube/ingress/ and
+pilot/pkg/kube/admit/admit.go. This image has no cluster, so the same
+contracts are implemented over `FakeKubeCluster` — an in-process API
+server double with typed objects, resourceVersions, list+watch, and
+validating-admission hooks — exactly the fake the reference's own unit
+tests run against (k8s.io/client-go/testing fixtures).
+"""
+from istio_tpu.kube.fake import AdmissionDenied, FakeKubeCluster, WatchEvent
+from istio_tpu.kube.crd import CrdStore, KubeConfigStore, ISTIO_CRD_KINDS
+from istio_tpu.kube.registry import KubeServiceRegistry
+from istio_tpu.kube.ingress import IngressController
+from istio_tpu.kube.admission import register_istio_admission
+from istio_tpu.kube.secrets import ServiceAccountSecretController
+
+__all__ = [
+    "AdmissionDenied", "FakeKubeCluster", "WatchEvent",
+    "CrdStore", "KubeConfigStore", "ISTIO_CRD_KINDS",
+    "KubeServiceRegistry", "IngressController",
+    "register_istio_admission", "ServiceAccountSecretController",
+]

@@ -1,0 +1,57 @@
+"""Validating admission for istio config kinds.
+
+Reference: pilot/pkg/kube/admit/admit.go (ValidatingAdmissionWebhook
+over pilot's schema validators) + mixer/pkg/config/crd/admit — bad
+config is rejected at write time, before any controller sees it.
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from istio_tpu.expr.checker import TypeError_
+from istio_tpu.expr.parser import ParseError, parse
+from istio_tpu.kube.crd import ISTIO_CRD_KINDS
+from istio_tpu.kube.fake import AdmissionDenied, FakeKubeCluster
+from istio_tpu.pilot.model import IstioConfigTypes, ValidationError
+
+
+def _validate_pilot_kind(verb: str, obj: Mapping[str, Any]) -> None:
+    schema = IstioConfigTypes[str(obj.get("kind"))]
+    try:
+        schema.validate(dict(obj.get("spec") or {}))
+    except ValidationError as exc:
+        raise AdmissionDenied(str(exc)) from exc
+
+
+def _validate_mixer_kind(verb: str, obj: Mapping[str, Any]) -> None:
+    """Structural checks on mixer kinds — the deep cross-resource
+    validation (unknown handlers etc.) stays in SnapshotBuilder, which
+    tolerates and reports; admission catches what is locally provable:
+    rule match expressions must at least parse."""
+    kind = str(obj.get("kind"))
+    spec = dict(obj.get("spec") or {})
+    if kind == "rule":
+        match = str(spec.get("match", "") or "")
+        if match:
+            try:
+                parse(match)
+            except (ParseError, TypeError_) as exc:
+                raise AdmissionDenied(
+                    f"rule match does not parse: {exc}") from exc
+        for action in spec.get("actions") or ():
+            if not action.get("handler"):
+                raise AdmissionDenied("rule action missing handler")
+    elif kind == "handler":
+        if not (spec.get("adapter") or spec.get("compiledAdapter")):
+            raise AdmissionDenied("handler missing adapter")
+    elif kind == "instance":
+        if not (spec.get("template") or spec.get("compiledTemplate")):
+            raise AdmissionDenied("instance missing template")
+
+
+def register_istio_admission(cluster: FakeKubeCluster) -> None:
+    """Install pilot + mixer validators on the cluster."""
+    cluster.register_admission(_validate_pilot_kind,
+                               kinds=tuple(IstioConfigTypes))
+    cluster.register_admission(_validate_mixer_kind,
+                               kinds=ISTIO_CRD_KINDS)

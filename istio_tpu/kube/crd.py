@@ -1,0 +1,123 @@
+"""CRD-backed config sources.
+
+CrdStore       — the mixer store over cluster CRDs
+                 (mixer/pkg/config/crd/store.go: Init lists every
+                 registered kind, Watch streams changes into the
+                 runtime controller's event queue).
+KubeConfigStore — pilot's ConfigStore over cluster CRDs
+                 (pilot/pkg/config/kube/crd/client.go + controller.go:
+                 informer cache + handler fan-out; istioctl writes
+                 through the same client).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+from istio_tpu.kube.fake import FakeKubeCluster, WatchEvent
+from istio_tpu.pilot.model import (Config, ConfigMeta, ConfigStore,
+                                   IstioConfigTypes)
+from istio_tpu.runtime.store import Event, Store, Validator
+
+# the mixer config kinds served as CRDs (crd/store.go criteria — the
+# runtime watches these; SnapshotBuilder consumes the same names)
+ISTIO_CRD_KINDS = ("attributemanifest", "handler", "instance", "rule",
+                   "servicerole", "servicerolebinding")
+
+
+class CrdStore(Store):
+    """Mixer store fed by cluster watches. Read path + watch only —
+    config writes flow through the cluster (kubectl in the reference),
+    land here as watch events, and fan out to the runtime controller."""
+
+    def __init__(self, cluster: FakeKubeCluster,
+                 validator: Validator | None = None,
+                 kinds: tuple[str, ...] = ISTIO_CRD_KINDS):
+        super().__init__(validator)
+        self.cluster = cluster
+        for kind in kinds:
+            cluster.watch(kind, self._on_event)
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        key = (ev.kind, ev.namespace, ev.name)
+        value = None if ev.type == "DELETED" \
+            else dict(ev.obj.get("spec") or {})
+        self.apply_events([Event(key, value)])
+
+
+class KubeConfigStore(ConfigStore):
+    """Pilot ConfigStore over cluster CRDs with an informer-style local
+    cache and change-handler fan-out (crd/{client,controller}.go)."""
+
+    def __init__(self, cluster: FakeKubeCluster,
+                 schemas: Mapping[str, Any] | None = None):
+        self.cluster = cluster
+        self.schemas = dict(schemas or IstioConfigTypes)
+        self._cache: dict[tuple[str, str, str], Config] = {}
+        self._handlers: list[Callable[[Config, str], None]] = []
+        for typ in self.schemas:
+            cluster.watch(typ, self._on_event)
+
+    def register_handler(self, fn: Callable[[Config, str], None]) -> None:
+        self._handlers.append(fn)
+
+    @staticmethod
+    def _to_config(obj: Mapping[str, Any]) -> Config:
+        meta = obj.get("metadata") or {}
+        return Config(meta=ConfigMeta(
+            type=str(obj.get("kind", "")),
+            name=str(meta.get("name", "")),
+            namespace=str(meta.get("namespace", "")),
+            labels=dict(meta.get("labels") or {}),
+            annotations=dict(meta.get("annotations") or {}),
+            resource_version=str(meta.get("resourceVersion", ""))),
+            spec=dict(obj.get("spec") or {}))
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        config = self._to_config(ev.obj)
+        key = (config.meta.type, config.meta.namespace, config.meta.name)
+        event = {"ADDED": "add", "MODIFIED": "update",
+                 "DELETED": "delete"}[ev.type]
+        if ev.type == "DELETED":
+            self._cache.pop(key, None)
+        else:
+            self._cache[key] = config
+        for fn in list(self._handlers):
+            fn(config, event)
+
+    # -- ConfigStore reads (cache) --
+
+    def get(self, typ: str, name: str, namespace: str = "") -> Config | None:
+        return self._cache.get((typ, namespace, name))
+
+    def list(self, typ: str, namespace: str | None = None) -> list[Config]:
+        return sorted(
+            (c for (t, ns, _), c in self._cache.items()
+             if t == typ and (namespace is None or ns == namespace)),
+            key=lambda c: (c.meta.namespace, c.meta.name))
+
+    # -- ConfigStore writes (through the cluster, like istioctl) --
+
+    def _validate(self, config: Config) -> None:
+        schema = self.schemas.get(config.meta.type)
+        if schema is None:
+            raise KeyError(f"unknown config type {config.meta.type}")
+        schema.validate(config.spec)
+
+    def _to_obj(self, config: Config) -> dict:
+        return {"kind": config.meta.type,
+                "metadata": {"name": config.meta.name,
+                             "namespace": config.meta.namespace,
+                             "labels": dict(config.meta.labels),
+                             "annotations": dict(config.meta.annotations)},
+                "spec": dict(config.spec)}
+
+    def create(self, config: Config) -> None:
+        self._validate(config)
+        self.cluster.create(self._to_obj(config))
+
+    def update(self, config: Config) -> None:
+        self._validate(config)
+        self.cluster.update(self._to_obj(config))
+
+    def delete(self, typ: str, name: str, namespace: str = "") -> None:
+        self.cluster.delete(typ, namespace, name)

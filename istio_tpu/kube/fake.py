@@ -1,0 +1,186 @@
+"""FakeKubeCluster — an in-process API-server double.
+
+Scope: exactly the API semantics the L2 adapters consume —
+  * typed objects {apiVersion?, kind, metadata{name, namespace, labels,
+    resourceVersion, uid}, spec/...} stored per (kind, ns, name);
+  * monotonically increasing resourceVersion on every mutation;
+  * list + watch per kind: a watcher first receives the current state
+    as ADDED events (the informer's initial list) and then live
+    ADDED/MODIFIED/DELETED events, synchronously on the mutator's
+    thread (deterministic tests; real informers add a queue, which the
+    consumers here already tolerate);
+  * validating-admission hooks invoked before create/update commits
+    (pilot/pkg/kube/admit/admit.go's ValidatingAdmissionWebhook role) —
+    a hook raising AdmissionDenied rejects the write.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import logging
+import threading
+from typing import Any, Callable, Mapping
+
+__all__ = ["AdmissionDenied", "AlreadyExists", "FakeKubeCluster",
+           "WatchEvent"]
+
+log = logging.getLogger("istio_tpu.kube")
+
+
+class AdmissionDenied(ValueError):
+    """Raised by an admission hook to reject a write."""
+
+
+class AlreadyExists(ValueError):
+    """create() of an object that is already stored."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WatchEvent:
+    type: str              # ADDED | MODIFIED | DELETED
+    obj: Mapping[str, Any]
+
+    @property
+    def kind(self) -> str:
+        return str(self.obj.get("kind", ""))
+
+    @property
+    def name(self) -> str:
+        return str(self.obj.get("metadata", {}).get("name", ""))
+
+    @property
+    def namespace(self) -> str:
+        return str(self.obj.get("metadata", {}).get("namespace", ""))
+
+
+WatchHandler = Callable[[WatchEvent], None]
+AdmissionHook = Callable[[str, Mapping[str, Any]], None]  # (verb, obj)
+
+
+class FakeKubeCluster:
+    def __init__(self) -> None:
+        self._objs: dict[tuple[str, str, str], dict] = {}
+        self._rv = 0
+        self._uid = 0
+        self._watchers: dict[str, list[WatchHandler]] = {}
+        self._admission: list[tuple[frozenset | None, AdmissionHook]] = []
+        self._lock = threading.RLock()
+
+    # -- admission --
+
+    def register_admission(self, hook: AdmissionHook,
+                           kinds: tuple[str, ...] | None = None) -> None:
+        """Validating hook for `kinds` (None = all); runs pre-commit."""
+        self._admission.append(
+            (frozenset(kinds) if kinds is not None else None, hook))
+
+    def _admit(self, verb: str, obj: Mapping[str, Any]) -> None:
+        kind = str(obj.get("kind", ""))
+        for kinds, hook in self._admission:
+            if kinds is None or kind in kinds:
+                hook(verb, obj)
+
+    # -- writes --
+
+    def _key(self, obj: Mapping[str, Any]) -> tuple[str, str, str]:
+        meta = obj.get("metadata") or {}
+        kind = str(obj.get("kind", ""))
+        if not kind or not meta.get("name"):
+            raise ValueError("object needs kind + metadata.name")
+        return (kind, str(meta.get("namespace", "")), str(meta["name"]))
+
+    def create(self, obj: Mapping[str, Any]) -> dict:
+        self._admit("CREATE", obj)
+        with self._lock:
+            key = self._key(obj)
+            if key in self._objs:
+                raise AlreadyExists(f"{key} already exists")
+            stored = self._commit(key, obj)
+            self._notify(WatchEvent("ADDED", stored))
+        return copy.deepcopy(stored)
+
+    def update(self, obj: Mapping[str, Any]) -> dict:
+        self._admit("UPDATE", obj)
+        with self._lock:
+            key = self._key(obj)
+            if key not in self._objs:
+                raise KeyError(key)
+            stored = self._commit(key, obj,
+                                  uid=self._objs[key]["metadata"]["uid"])
+            self._notify(WatchEvent("MODIFIED", stored))
+        return copy.deepcopy(stored)
+
+    def apply(self, obj: Mapping[str, Any]) -> dict:
+        """create-or-update convenience."""
+        try:
+            return self.create(obj)
+        except AlreadyExists:
+            return self.update(obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            stored = self._objs.pop((kind, namespace, name), None)
+            if stored is not None:
+                self._notify(WatchEvent("DELETED", stored))
+
+    def _commit(self, key, obj: Mapping[str, Any],
+                uid: str | None = None) -> dict:
+        # deep copy in: a real API server serializes, so later caller
+        # mutations must not alias stored state
+        stored = copy.deepcopy(dict(obj))
+        meta = dict(stored.get("metadata") or {})
+        self._rv += 1
+        if uid is None:
+            self._uid += 1
+            uid = f"uid-{self._uid}"
+        meta["resourceVersion"] = str(self._rv)
+        meta["uid"] = uid
+        meta.setdefault("namespace", "")
+        stored["metadata"] = meta
+        self._objs[key] = stored
+        return stored
+
+    # -- reads (deep copies: consumers must not corrupt cluster state) --
+
+    def get(self, kind: str, namespace: str, name: str) -> dict | None:
+        with self._lock:
+            obj = self._objs.get((kind, namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, kind: str, namespace: str | None = None) -> list[dict]:
+        with self._lock:
+            return [copy.deepcopy(o)
+                    for (k, ns, _), o in sorted(self._objs.items())
+                    if k == kind and (namespace is None or ns == namespace)]
+
+    # -- watch --
+
+    def watch(self, kind: str, handler: WatchHandler,
+              replay: bool = True) -> None:
+        """list+watch: replay current state as ADDED, then stream.
+        Replay + registration happen under the cluster lock, so no
+        event between them is lost (mutators notify under the same
+        lock; it is reentrant, so handlers may read the cluster)."""
+        with self._lock:
+            if replay:
+                for (k, _, _), obj in sorted(self._objs.items()):
+                    if k == kind:
+                        self._safe_call(handler,
+                                        WatchEvent("ADDED",
+                                                   copy.deepcopy(obj)))
+            self._watchers.setdefault(kind, []).append(handler)
+
+    def _notify(self, event: WatchEvent) -> None:
+        for handler in list(self._watchers.get(event.kind, ())):
+            self._safe_call(handler, dataclasses.replace(
+                event, obj=copy.deepcopy(event.obj)))
+
+    @staticmethod
+    def _safe_call(handler: WatchHandler, event: WatchEvent) -> None:
+        """Watcher isolation (informers never poison each other or the
+        writer; same stance as runtime/store.py's delivery thread)."""
+        try:
+            handler(event)
+        except Exception:
+            log.exception("kube watch handler failed on %s %s/%s",
+                          event.kind, event.namespace, event.name)

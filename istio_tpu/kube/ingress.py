@@ -1,0 +1,95 @@
+"""Ingress controller — k8s Ingress → istio ingress-rule configs.
+
+Reference: pilot/pkg/config/kube/ingress/{controller,conversion}.go —
+watch Ingress resources, decompose each (host, path, backend) tuple
+into one `ingress-rule` config named `<ingress>-<i>-<j>`, and keep the
+target config store in sync (status writing is the only part omitted:
+there is no cloud LB to report).
+
+The emitted rules land in a pilot ConfigStore; the envoy config
+generator's ingress route builder consumes them (pilot/routes.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from istio_tpu.kube.fake import FakeKubeCluster, WatchEvent
+from istio_tpu.pilot.model import Config, ConfigMeta, ConfigStore
+
+
+def _backend_service(backend: Mapping[str, Any], namespace: str,
+                     domain: str) -> tuple[str, Any]:
+    name = str(backend.get("serviceName", ""))
+    port = backend.get("servicePort", 80)
+    host = f"{name}.{namespace or 'default'}.svc.{domain}"
+    return host, port
+
+
+class IngressController:
+    def __init__(self, cluster: FakeKubeCluster, store: ConfigStore,
+                 domain: str = "cluster.local",
+                 ingress_class: str = "istio"):
+        self.cluster = cluster
+        self.store = store
+        self.domain = domain
+        self.ingress_class = ingress_class
+        self._emitted: dict[tuple[str, str], list[str]] = {}
+        cluster.watch("Ingress", self._on_event)
+
+    def _should_process(self, obj: Mapping[str, Any]) -> bool:
+        """conversion.go class check: kubernetes.io/ingress.class."""
+        annotations = (obj.get("metadata") or {}).get("annotations") or {}
+        cls = annotations.get("kubernetes.io/ingress.class")
+        return cls is None or cls == self.ingress_class
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        key = (ev.namespace, ev.name)
+        # drop previously emitted rules for this ingress, then re-emit
+        for rule_name in self._emitted.pop(key, []):
+            self.store.delete("ingress-rule", rule_name, ev.namespace)
+        if ev.type == "DELETED" or not self._should_process(ev.obj):
+            return
+        emitted = []
+        for config in self._convert(ev.obj):
+            self.store.create(config)
+            emitted.append(config.meta.name)
+        self._emitted[key] = emitted
+
+    def _convert(self, obj: Mapping[str, Any]) -> list[Config]:
+        """conversion.go ConvertIngress: one rule per (host, path)."""
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        namespace = str(meta.get("namespace", ""))
+        name = str(meta.get("name", ""))
+        out: list[Config] = []
+
+        def rule(i: int, j: int, host: str, path: str,
+                 backend: Mapping[str, Any]) -> Config:
+            dest, port = _backend_service(backend, namespace, self.domain)
+            spec_out: dict[str, Any] = {
+                "destination": {"service": dest},
+                "port": port,
+            }
+            if host:
+                spec_out["match"] = {"request": {"headers": {
+                    "authority": {"exact": host}}}}
+            if path:
+                kind = "prefix" if path.endswith("*") else "exact"
+                value = path.rstrip("*") if kind == "prefix" else path
+                spec_out.setdefault("match", {}).setdefault(
+                    "request", {}).setdefault("headers", {})["uri"] = {
+                        kind: value}
+            return Config(meta=ConfigMeta(
+                type="ingress-rule", name=f"{name}-{i}-{j}",
+                namespace=namespace), spec=spec_out)
+
+        default = spec.get("backend")
+        if default:
+            out.append(rule(0, 0, "", "", default))
+        for i, r in enumerate(spec.get("rules") or (), start=1):
+            host = str(r.get("host", "") or "")
+            paths = ((r.get("http") or {}).get("paths")) or ()
+            for j, p in enumerate(paths):
+                out.append(rule(i, j, host, str(p.get("path", "") or ""),
+                                p.get("backend") or {}))
+        return out

@@ -1,0 +1,188 @@
+"""Kube service registry — ServiceDiscovery over cluster objects.
+
+Reference: pilot/pkg/serviceregistry/kube/{controller,conversion}.go —
+informer caches over Services/Endpoints/Pods, converted to the abstract
+model on read: hostname `<name>.<ns>.svc.<domain>`, port protocols from
+the port-name prefix convention (http-, http2-, grpc-, tcp-, udp-,
+mongo-, redis-; bare names default TCP like conversion.go), instance
+labels and service accounts joined from the pod backing each endpoint
+address.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Mapping, Sequence
+
+from istio_tpu.kube.fake import FakeKubeCluster, WatchEvent
+from istio_tpu.pilot.model import (NetworkEndpoint, Port, Service,
+                                   ServiceInstance)
+from istio_tpu.pilot.registry import Handler, ServiceDiscovery
+
+_PROTO_BY_PREFIX = {"http": "HTTP", "http2": "HTTP2", "grpc": "GRPC",
+                    "https": "HTTPS", "tcp": "TCP", "udp": "UDP",
+                    "mongo": "MONGO", "redis": "REDIS"}
+
+
+def protocol_from_port_name(name: str) -> str:
+    """kube/conversion.go ConvertProtocol: '<proto>[-suffix]'."""
+    prefix = name.split("-", 1)[0].lower()
+    return _PROTO_BY_PREFIX.get(prefix, "TCP")
+
+
+class KubeServiceRegistry(ServiceDiscovery):
+    def __init__(self, cluster: FakeKubeCluster,
+                 domain: str = "cluster.local"):
+        self.cluster = cluster
+        self.domain = domain
+        self._lock = threading.Lock()
+        self._services: dict[str, Service] = {}        # hostname → svc
+        self._endpoints: dict[str, Mapping[str, Any]] = {}
+        self._pods_by_ip: dict[str, Mapping[str, Any]] = {}
+        self._svc_handlers: list[Handler] = []
+        cluster.watch("Service", self._on_service)
+        cluster.watch("Endpoints", self._on_endpoints)
+        cluster.watch("Pod", self._on_pod)
+
+    # -- conversion (kube/conversion.go) --
+
+    def _hostname(self, name: str, namespace: str) -> str:
+        return f"{name}.{namespace or 'default'}.svc.{self.domain}"
+
+    def _to_service(self, obj: Mapping[str, Any]) -> Service:
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        ports = tuple(
+            Port(name=str(p.get("name") or p.get("port")),
+                 port=int(p.get("port")),
+                 protocol=protocol_from_port_name(str(p.get("name", ""))))
+            for p in spec.get("ports") or ())
+        return Service(
+            hostname=self._hostname(meta.get("name", ""),
+                                    meta.get("namespace", "")),
+            address=str(spec.get("clusterIP", "0.0.0.0") or "0.0.0.0"),
+            ports=ports,
+            external_name=str(spec.get("externalName", "") or ""))
+
+    # -- watch handlers (informer cache updates) --
+
+    def _on_service(self, ev: WatchEvent) -> None:
+        svc = self._to_service(ev.obj)
+        with self._lock:
+            if ev.type == "DELETED":
+                self._services.pop(svc.hostname, None)
+            else:
+                self._services[svc.hostname] = svc
+        event = "delete" if ev.type == "DELETED" else "add"
+        for fn in list(self._svc_handlers):
+            fn(svc, event)
+
+    def _on_endpoints(self, ev: WatchEvent) -> None:
+        host = self._hostname(ev.name, ev.namespace)
+        with self._lock:
+            if ev.type == "DELETED":
+                self._endpoints.pop(host, None)
+            else:
+                self._endpoints[host] = ev.obj
+            svc = self._services.get(host)
+        if svc is not None:
+            for fn in list(self._svc_handlers):
+                fn(svc, "update")
+
+    def _on_pod(self, ev: WatchEvent) -> None:
+        ip = str((ev.obj.get("status") or {}).get("podIP", ""))
+        if not ip:
+            return
+        with self._lock:
+            if ev.type == "DELETED":
+                self._pods_by_ip.pop(ip, None)
+            else:
+                self._pods_by_ip[ip] = ev.obj
+
+    # -- ServiceDiscovery reads --
+
+    def services(self) -> list[Service]:
+        with self._lock:
+            return sorted(self._services.values(),
+                          key=lambda s: s.hostname)
+
+    def get_service(self, hostname: str) -> Service | None:
+        with self._lock:
+            return self._services.get(hostname)
+
+    def _pod_of(self, address: str) -> Mapping[str, Any] | None:
+        return self._pods_by_ip.get(address)
+
+    def _sa_of(self, address: str, namespace: str) -> str:
+        pod = self._pod_of(address)
+        if pod is None:
+            return ""
+        sa = str((pod.get("spec") or {}).get("serviceAccountName", ""))
+        if not sa:
+            return ""
+        return (f"spiffe://{self.domain}/ns/{namespace or 'default'}"
+                f"/sa/{sa}")
+
+    def _service_instances(self, svc: Service) -> list[ServiceInstance]:
+        eps = self._endpoints.get(svc.hostname)
+        if eps is None:
+            return []
+        out = []
+        namespace = svc.namespace
+        for subset in (eps.get("subsets") or ()):
+            port_by_name = {str(p.get("name") or p.get("port")): p
+                            for p in subset.get("ports") or ()}
+            for addr in (subset.get("addresses") or ()):
+                ip = str(addr.get("ip", ""))
+                pod = self._pod_of(ip)
+                labels = dict(((pod or {}).get("metadata") or {})
+                              .get("labels") or {})
+                for sp in svc.ports:
+                    ep_port = port_by_name.get(sp.name)
+                    if ep_port is None and len(port_by_name) == 1:
+                        ep_port = next(iter(port_by_name.values()))
+                    if ep_port is None:
+                        continue
+                    out.append(ServiceInstance(
+                        endpoint=NetworkEndpoint(
+                            address=ip,
+                            port=int(ep_port.get("port", sp.port)),
+                            service_port=sp),
+                        service=svc, labels=labels,
+                        service_account=self._sa_of(ip, namespace)))
+        return out
+
+    def instances(self, hostname: str, ports: Sequence[str] = (),
+                  labels: Mapping[str, str] | None = None
+                  ) -> list[ServiceInstance]:
+        with self._lock:
+            svc = self._services.get(hostname)
+            if svc is None:
+                return []
+            out = []
+            for inst in self._service_instances(svc):
+                if ports and inst.endpoint.service_port.name not in ports:
+                    continue
+                if labels and any(inst.labels.get(k) != v
+                                  for k, v in labels.items()):
+                    continue
+                out.append(inst)
+            return out
+
+    def host_instances(self, addrs: set[str]) -> list[ServiceInstance]:
+        with self._lock:
+            out = []
+            for svc in self._services.values():
+                out.extend(i for i in self._service_instances(svc)
+                           if i.endpoint.address in addrs)
+            return out
+
+    def get_istio_service_accounts(self, hostname: str,
+                                   ports: Sequence[str]) -> list[str]:
+        """service.go:259 ServiceAccounts: accounts of the instances
+        backing the service."""
+        return sorted({i.service_account
+                       for i in self.instances(hostname, ports)
+                       if i.service_account})
+
+    def append_service_handler(self, fn: Handler) -> None:
+        self._svc_handlers.append(fn)

@@ -1,0 +1,46 @@
+"""ServiceAccount → workload-cert secret controller over the cluster.
+
+Reference: security/pkg/pki/ca/controller/secret.go — watch
+ServiceAccounts; for each, mint a key + CA-signed SPIFFE cert and store
+an `istio.io/key-and-cert` Secret named `istio.<sa>.<ns>`; delete the
+secret when the SA goes away. This binds the platform-agnostic
+SecretController (security/ca.py) to the kube watch + Secret storage.
+"""
+from __future__ import annotations
+
+import base64
+
+from istio_tpu.kube.fake import FakeKubeCluster, WatchEvent
+from istio_tpu.security.ca import CertificateAuthority, SecretController
+
+
+class ServiceAccountSecretController:
+    def __init__(self, cluster: FakeKubeCluster,
+                 ca: CertificateAuthority,
+                 trust_domain: str = "cluster.local"):
+        self.cluster = cluster
+        self._bundles: dict = {}
+        self._inner = SecretController(ca, self._bundles,
+                                       trust_domain=trust_domain)
+        cluster.watch("ServiceAccount", self._on_event)
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        ns = ev.namespace or "default"
+        if ev.type == "DELETED":
+            self._inner.on_service_account(ns, ev.name, event="delete")
+            self.cluster.delete(
+                "Secret", ns, SecretController.secret_name(ns, ev.name))
+            return
+        self._inner.on_service_account(ns, ev.name)
+        name = SecretController.secret_name(ns, ev.name)
+        bundle = self._bundles[name]
+        self.cluster.apply({
+            "kind": "Secret",
+            "metadata": {"name": name, "namespace": ns,
+                         "annotations": {
+                             "istio.io/identity": bundle["identity"]}},
+            "type": bundle["type"],
+            "data": {k: base64.b64encode(v).decode("ascii")
+                     for k, v in bundle.items()
+                     if isinstance(v, bytes)},
+        })

@@ -142,7 +142,11 @@ def test_report_delta_coding(rig):
         "match": "",
         "actions": [{"handler": "prom2", "instances": ["bytes"]}]})
     import time
-    time.sleep(0.4)   # debounce + rebuild
+    deadline = time.time() + 15   # debounce + rebuild (+ plan build)
+    while time.time() < deadline:
+        if "prom2.istio-system" in runtime.controller.dispatcher.handlers:
+            break
+        time.sleep(0.05)
     client.report([
         {"destination.service": "d1.ns.svc", "response.size": 100,
          "source.labels": {"version": "v1"}},

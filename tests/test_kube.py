@@ -1,0 +1,314 @@
+"""Kubernetes-shaped L2, hermetic (VERDICT r1 item 5): CRD config
+backend, kube service registry, pilot CRD client, ingress controller,
+admission validation, and the SA-secret controller — all over the
+in-process FakeKubeCluster, reacting to live watch events.
+
+Reference anchors: mixer/pkg/config/crd/store.go, pilot/pkg/
+serviceregistry/kube/controller.go, pilot/pkg/config/kube/crd/client.go,
+pilot/pkg/config/kube/ingress/, pilot/pkg/kube/admit/admit.go,
+security/pkg/pki/ca/controller/secret.go.
+"""
+import base64
+
+import pytest
+
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.kube import (AdmissionDenied, CrdStore, FakeKubeCluster,
+                            IngressController, KubeConfigStore,
+                            KubeServiceRegistry,
+                            ServiceAccountSecretController,
+                            register_istio_admission)
+from istio_tpu.models.policy_engine import OK, PERMISSION_DENIED
+from istio_tpu.pilot.model import Config, ConfigMeta, MemoryConfigStore
+from istio_tpu.runtime import RuntimeServer, ServerArgs
+
+
+def _svc(name, ns="default", ports=None, cluster_ip="10.0.0.1"):
+    return {"kind": "Service",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"clusterIP": cluster_ip,
+                     "ports": ports or [{"name": "http", "port": 80}]}}
+
+
+def _endpoints(name, ns="default", ips=(), port=8080, port_name="http"):
+    return {"kind": "Endpoints",
+            "metadata": {"name": name, "namespace": ns},
+            "subsets": [{"addresses": [{"ip": ip} for ip in ips],
+                         "ports": [{"name": port_name, "port": port}]}]}
+
+
+def _pod(name, ip, ns="default", labels=None, sa=""):
+    return {"kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": labels or {}},
+            "spec": {"serviceAccountName": sa},
+            "status": {"podIP": ip}}
+
+
+# ---------------------------------------------------------------------------
+# kube service registry
+# ---------------------------------------------------------------------------
+
+def test_kube_registry_conversion_and_watch():
+    cluster = FakeKubeCluster()
+    cluster.create(_svc("reviews", ports=[
+        {"name": "http", "port": 9080},
+        {"name": "grpc-status", "port": 9090},
+        {"name": "metrics", "port": 15090}]))
+    cluster.create(_endpoints("reviews", ips=["10.1.0.4"], port=9080))
+    cluster.create(_pod("reviews-v1-x", "10.1.0.4",
+                        labels={"app": "reviews", "version": "v1"},
+                        sa="bookinfo-reviews"))
+    reg = KubeServiceRegistry(cluster)
+
+    svcs = reg.services()
+    assert [s.hostname for s in svcs] == [
+        "reviews.default.svc.cluster.local"]
+    protos = {p.name: p.protocol for p in svcs[0].ports}
+    assert protos == {"http": "HTTP", "grpc-status": "GRPC",
+                      "metrics": "TCP"}   # bare name → TCP
+
+    insts = reg.instances("reviews.default.svc.cluster.local", ("http",))
+    assert len(insts) == 1
+    assert insts[0].endpoint.address == "10.1.0.4"
+    assert insts[0].endpoint.port == 9080
+    assert insts[0].labels == {"app": "reviews", "version": "v1"}
+    assert insts[0].service_account == \
+        "spiffe://cluster.local/ns/default/sa/bookinfo-reviews"
+    assert reg.get_istio_service_accounts(
+        "reviews.default.svc.cluster.local", ("http",)) == [
+        "spiffe://cluster.local/ns/default/sa/bookinfo-reviews"]
+
+    # label-selected subset + host_instances
+    assert reg.instances("reviews.default.svc.cluster.local",
+                         labels={"version": "v2"}) == []
+    assert len(reg.host_instances({"10.1.0.4"})) >= 1
+
+    # live watch: a new service fires handlers and appears in reads
+    events = []
+    reg.append_service_handler(lambda svc, ev: events.append((svc.hostname,
+                                                              ev)))
+    cluster.create(_svc("ratings"))
+    assert ("ratings.default.svc.cluster.local", "add") in events
+    assert reg.get_service("ratings.default.svc.cluster.local")
+    cluster.delete("Service", "default", "ratings")
+    assert reg.get_service("ratings.default.svc.cluster.local") is None
+
+
+# ---------------------------------------------------------------------------
+# pilot CRD config client
+# ---------------------------------------------------------------------------
+
+def test_kube_config_store_watch_and_write():
+    cluster = FakeKubeCluster()
+    store = KubeConfigStore(cluster)
+    seen = []
+    store.register_handler(lambda c, ev: seen.append((c.meta.name, ev)))
+
+    # write path (istioctl flow) → cluster → watch → cache
+    store.create(Config(meta=ConfigMeta(type="route-rule", name="r1",
+                                        namespace="default"),
+                        spec={"destination": {"service": "x"},
+                              "precedence": 1}))
+    assert ("r1", "add") in seen
+    assert store.get("route-rule", "r1", "default").spec["precedence"] == 1
+
+    # out-of-band cluster write (kubectl flow) also lands in the cache
+    cluster.create({"kind": "v1alpha2-route-rule",
+                    "metadata": {"name": "vs", "namespace": "default"},
+                    "spec": {"hosts": ["x"], "http": []}})
+    assert store.list("v1alpha2-route-rule")[0].meta.name == "vs"
+
+    store.delete("route-rule", "r1", "default")
+    assert ("r1", "delete") in seen
+    assert store.get("route-rule", "r1", "default") is None
+
+    # invalid spec is rejected client-side before the cluster sees it
+    with pytest.raises(Exception):
+        store.create(Config(meta=ConfigMeta(type="route-rule", name="bad",
+                                            namespace="default"),
+                            spec={}))
+
+
+# ---------------------------------------------------------------------------
+# admission
+# ---------------------------------------------------------------------------
+
+def test_admission_rejects_bad_config():
+    cluster = FakeKubeCluster()
+    register_istio_admission(cluster)
+    with pytest.raises(AdmissionDenied):
+        cluster.create({"kind": "route-rule",
+                        "metadata": {"name": "bad", "namespace": "d"},
+                        "spec": {}})   # no destination
+    with pytest.raises(AdmissionDenied):
+        cluster.create({"kind": "rule",
+                        "metadata": {"name": "bad", "namespace": "d"},
+                        "spec": {"match": "@@@not an expression@@@",
+                                 "actions": []}})
+    with pytest.raises(AdmissionDenied):
+        cluster.create({"kind": "handler",
+                        "metadata": {"name": "h", "namespace": "d"},
+                        "spec": {}})   # no adapter
+    # valid writes pass
+    cluster.create({"kind": "rule",
+                    "metadata": {"name": "ok", "namespace": "d"},
+                    "spec": {"match": 'source.namespace == "x"',
+                             "actions": []}})
+
+
+# ---------------------------------------------------------------------------
+# mixer boots from cluster CRDs and reacts to watch events
+# ---------------------------------------------------------------------------
+
+def test_mixs_boots_from_cluster_crds():
+    cluster = FakeKubeCluster()
+    register_istio_admission(cluster)
+    cluster.create({"kind": "handler",
+                    "metadata": {"name": "denyall",
+                                 "namespace": "istio-system"},
+                    "spec": {"adapter": "denier",
+                             "params": {"status_code": PERMISSION_DENIED}}})
+    cluster.create({"kind": "instance",
+                    "metadata": {"name": "nothing",
+                                 "namespace": "istio-system"},
+                    "spec": {"template": "checknothing", "params": {}}})
+    cluster.create({"kind": "rule",
+                    "metadata": {"name": "deny-admin",
+                                 "namespace": "istio-system"},
+                    "spec": {"match": 'request.path.startsWith("/admin")',
+                             "actions": [{"handler": "denyall",
+                                          "instances": ["nothing"]}]}})
+
+    srv = RuntimeServer(CrdStore(cluster),
+                        ServerArgs(batch_window_s=0.001))
+    try:
+        deny = srv.check(bag_from_mapping({"request.path": "/admin/x"}))
+        assert deny.status_code == PERMISSION_DENIED
+        ok = srv.check(bag_from_mapping({"request.path": "/ok"}))
+        assert ok.status_code == OK
+
+        # live config change via the cluster → debounced rebuild
+        cluster.create({"kind": "rule",
+                        "metadata": {"name": "deny-secret",
+                                     "namespace": "istio-system"},
+                        "spec": {
+                            "match": 'request.path.startsWith("/secret")',
+                            "actions": [{"handler": "denyall",
+                                         "instances": ["nothing"]}]}})
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            r = srv.check(bag_from_mapping({"request.path": "/secret/x"}))
+            if r.status_code == PERMISSION_DENIED:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("CRD watch change never took effect")
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# ingress controller
+# ---------------------------------------------------------------------------
+
+def test_ingress_controller_emits_rules():
+    cluster = FakeKubeCluster()
+    store = MemoryConfigStore()
+    IngressController(cluster, store)
+    cluster.create({
+        "kind": "Ingress",
+        "metadata": {"name": "gw", "namespace": "default",
+                     "annotations": {
+                         "kubernetes.io/ingress.class": "istio"}},
+        "spec": {"rules": [{
+            "host": "bookinfo.example.com",
+            "http": {"paths": [
+                {"path": "/productpage",
+                 "backend": {"serviceName": "productpage",
+                             "servicePort": 9080}},
+                {"path": "/static*",
+                 "backend": {"serviceName": "productpage",
+                             "servicePort": 9080}},
+            ]}}]}})
+    rules = store.list("ingress-rule")
+    assert len(rules) == 2
+    dests = {r.spec["destination"]["service"] for r in rules}
+    assert dests == {"productpage.default.svc.cluster.local"}
+    exact = next(r for r in rules
+                 if r.spec["match"]["request"]["headers"]["uri"]
+                 .get("exact"))
+    assert exact.spec["match"]["request"]["headers"]["authority"] == \
+        {"exact": "bookinfo.example.com"}
+
+    # non-istio class ingresses are ignored; deletion retracts rules
+    cluster.create({
+        "kind": "Ingress",
+        "metadata": {"name": "other", "namespace": "default",
+                     "annotations": {
+                         "kubernetes.io/ingress.class": "nginx"}},
+        "spec": {"backend": {"serviceName": "x", "servicePort": 80}}})
+    assert len(store.list("ingress-rule")) == 2
+    cluster.delete("Ingress", "default", "gw")
+    assert store.list("ingress-rule") == []
+
+
+# ---------------------------------------------------------------------------
+# SA → workload-cert secrets
+# ---------------------------------------------------------------------------
+
+def test_service_account_secret_controller():
+    from istio_tpu.security import IstioCA
+    from istio_tpu.security.pki import load_cert, san_uris, verify_chain
+
+    cluster = FakeKubeCluster()
+    ca = IstioCA.new_self_signed({})
+    ServiceAccountSecretController(cluster, ca)
+    cluster.create({"kind": "ServiceAccount",
+                    "metadata": {"name": "bookinfo-productpage",
+                                 "namespace": "default"}})
+    secret = cluster.get("Secret", "default",
+                         "istio.bookinfo-productpage.default")
+    assert secret is not None and secret["type"] == "istio.io/key-and-cert"
+    cert = base64.b64decode(secret["data"]["cert-chain.pem"])
+    root = base64.b64decode(secret["data"]["root-cert.pem"])
+    assert verify_chain(cert, root)
+    assert san_uris(load_cert(cert)) == [
+        "spiffe://cluster.local/ns/default/sa/bookinfo-productpage"]
+
+    cluster.delete("ServiceAccount", "default", "bookinfo-productpage")
+    assert cluster.get("Secret", "default",
+                       "istio.bookinfo-productpage.default") is None
+
+
+# ---------------------------------------------------------------------------
+# pilot-discovery boots from the cluster (registry + CRD config)
+# ---------------------------------------------------------------------------
+
+def test_pilot_discovery_from_cluster():
+    import json
+
+    from istio_tpu.pilot.discovery import DiscoveryService
+
+    cluster = FakeKubeCluster()
+    cluster.create(_svc("productpage", ports=[
+        {"name": "http", "port": 9080}]))
+    cluster.create(_endpoints("productpage", ips=["10.1.0.7"], port=9080))
+    cluster.create(_pod("productpage-v1", "10.1.0.7",
+                        labels={"app": "productpage"}))
+    reg = KubeServiceRegistry(cluster)
+    config = KubeConfigStore(cluster)
+    ds = DiscoveryService(reg, config)
+
+    eps = json.loads(ds.list_endpoints(
+        "productpage.default.svc.cluster.local|http"))
+    assert eps["hosts"][0]["ip_address"] == "10.1.0.7"
+
+    # a cluster event invalidates the whole discovery cache
+    assert ds.cache_size > 0
+    cluster.create(_svc("details", cluster_ip="10.0.0.9"))
+    assert ds.cache_size == 0
+    eps2 = json.loads(ds.list_endpoints(
+        "details.default.svc.cluster.local|http"))
+    assert eps2["hosts"] == []
