@@ -18,11 +18,17 @@ from typing import Any, Mapping
 
 import prometheus_client
 
-from istio_tpu.pilot.envoy_config import (build_inbound_clusters,
+from istio_tpu.pilot.envoy_config import (build_egress_clusters,
+                                          build_inbound_clusters,
                                           build_inbound_listeners,
+                                          build_ingress_listeners,
+                                          build_jwks_clusters,
                                           build_outbound_clusters,
                                           build_outbound_listeners)
-from istio_tpu.pilot.model import IstioConfigStore, MemoryConfigStore
+from istio_tpu.pilot.routes import build_ingress_route_config
+from istio_tpu.pilot.model import (NODE_INGRESS, NODE_SIDECAR,
+                                   IstioConfigStore, MemoryConfigStore,
+                                   Node)
 from istio_tpu.pilot.registry import ServiceDiscovery
 from istio_tpu.pilot.routes import build_route_config
 
@@ -97,14 +103,20 @@ class DiscoveryService:
     def list_clusters(self, cluster: str, node: str) -> bytes:
         def build():
             services = self.registry.services()
-            instances = self._node_instances(node)
-            return {"clusters": build_outbound_clusters(services,
-                                                        self.config) +
-                    build_inbound_clusters(instances)}
+            clusters = build_outbound_clusters(services, self.config)
+            clusters += build_egress_clusters(self.config)
+            clusters += build_jwks_clusters(self.config)
+            if Node.parse(node).type == NODE_SIDECAR:
+                clusters += build_inbound_clusters(
+                    self._node_instances(node))
+            return {"clusters": clusters}
         return self._cached(f"cds/{cluster}/{node}", "cds", build)
 
     def list_routes(self, name: str, cluster: str, node: str) -> bytes:
         def build():
+            if Node.parse(node).type == NODE_INGRESS:
+                return build_ingress_route_config(self.config,
+                                                  self.registry)
             return build_route_config(self.registry.services(),
                                       int(name), self.config)
         return self._cached(f"rds/{name}/{node}", "rds", build)
@@ -112,18 +124,23 @@ class DiscoveryService:
     def list_listeners(self, cluster: str, node: str) -> bytes:
         def build():
             services = self.registry.services()
-            instances = self._node_instances(node)
-            return {"listeners":
-                    build_outbound_listeners(services, self.config,
-                                             self.mesh) +
-                    build_inbound_listeners(instances, self.mesh)}
+            role = Node.parse(node)
+            if role.type == NODE_INGRESS:
+                listeners = build_ingress_listeners(
+                    self.config, self.registry, self.mesh,
+                    tls_context=self.mesh.get("ingress_tls"))
+            else:
+                listeners = build_outbound_listeners(services, self.config,
+                                                     self.mesh)
+                if role.type == NODE_SIDECAR:
+                    listeners += build_inbound_listeners(
+                        self._node_instances(node), self.mesh)
+            return {"listeners": listeners}
         return self._cached(f"lds/{cluster}/{node}", "lds", build)
 
     def _node_instances(self, node: str):
-        # node id convention sidecar~ip~id~domain (context.go:51)
-        parts = node.split("~")
-        ip = parts[1] if len(parts) > 1 else node
-        return self.registry.host_instances({ip})
+        return self.registry.host_instances(
+            {Node.parse(node).ip_address})
 
     # -- HTTP server --
 

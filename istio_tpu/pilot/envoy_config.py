@@ -13,7 +13,10 @@ from typing import Any, Mapping, Sequence
 from istio_tpu.pilot.model import (IstioConfigStore, Port, Service,
                                    ServiceInstance)
 from istio_tpu.pilot.registry import ServiceDiscovery
-from istio_tpu.pilot.routes import (build_route_config, cluster_name,
+from istio_tpu.pilot.routes import (_egress_rule_ports,
+                                    build_ingress_route_config,
+                                    build_route_config, cluster_name,
+                                    egress_cluster_name,
                                     inbound_cluster_name, default_route,
                                     build_fault_filter)
 
@@ -100,6 +103,67 @@ def _apply_cluster_policy(cluster: dict[str, Any],
             cluster["outlier_detection"] = outlier
 
 
+def build_egress_clusters(config_store: IstioConfigStore
+                          ) -> list[dict[str, Any]]:
+    """config.go:849-1026: one cluster per (egress rule, port). Exact
+    hosts resolve via strict_dns with TLS upstream for https ports;
+    wildcard hosts use original-destination (the sidecar already knows
+    the resolved address)."""
+    clusters: dict[str, dict[str, Any]] = {}
+    for rule in config_store.egress_rules():
+        host = str(rule.spec.get("destination", {}).get("service", ""))
+        tls = bool(rule.spec.get("useEgressProxy", False))
+        for pnum, proto in _egress_rule_ports(rule):
+            name = egress_cluster_name(host, pnum)
+            if name in clusters:
+                continue
+            if host.startswith("*"):
+                cluster: dict[str, Any] = {
+                    "name": name, "type": "original_dst",
+                    "lb_type": "original_dst_lb",
+                    "connect_timeout_ms": 1000}
+            else:
+                cluster = {"name": name, "type": "strict_dns",
+                           "lb_type": "round_robin",
+                           "connect_timeout_ms": 1000,
+                           "hosts": [{"url": f"tcp://{host}:{pnum}"}]}
+            if proto in ("https",) or tls:
+                cluster["ssl_context"] = {}
+            if proto in ("http2", "grpc"):
+                cluster["features"] = "http2"
+            clusters[name] = cluster
+    return [clusters[k] for k in sorted(clusters)]
+
+
+def build_jwks_clusters(config_store: IstioConfigStore
+                        ) -> list[dict[str, Any]]:
+    """mixer.go:301-331 buildJwksURIClustersForProxyConfig: each JWT
+    issuer's jwks_uri needs an upstream cluster so the auth filter can
+    fetch signing keys."""
+    from urllib.parse import urlparse
+    clusters: dict[str, dict[str, Any]] = {}
+    for config in config_store.store.list(
+            "end-user-authentication-policy-spec"):
+        for jwt in config.spec.get("jwts", ()):
+            uri = str(jwt.get("jwksUri", jwt.get("jwks_uri", "")) or "")
+            if not uri:
+                continue
+            parsed = urlparse(uri)
+            if not parsed.hostname:
+                continue
+            secure = parsed.scheme == "https"
+            port = parsed.port or (443 if secure else 80)
+            name = f"jwks.{parsed.hostname}|{port}"
+            cluster: dict[str, Any] = {
+                "name": name, "type": "strict_dns",
+                "lb_type": "round_robin", "connect_timeout_ms": 1000,
+                "hosts": [{"url": f"tcp://{parsed.hostname}:{port}"}]}
+            if secure:
+                cluster["ssl_context"] = {}
+            clusters[name] = cluster
+    return [clusters[k] for k in sorted(clusters)]
+
+
 def build_inbound_clusters(instances: Sequence[ServiceInstance]
                            ) -> list[dict[str, Any]]:
     clusters = {}
@@ -161,62 +225,126 @@ def _port_fault_filters(port_num: int, services: Sequence[Service],
     return faults
 
 
+def _listener_kind(port: Port) -> str:
+    if port.is_http:
+        return "http"
+    if port.protocol == "REDIS":
+        return "redis"   # redis_proxy replaces tcp_proxy: exclusive
+    return "tcp"         # MONGO = tcp + passive sniffer
+
+
 def build_outbound_listeners(services: Sequence[Service],
                              config_store: IstioConfigStore,
                              mesh: Mapping[str, Any]) -> list[dict]:
     """One HTTP listener per outbound port using RDS; TCP services get
-    tcp_proxy with explicit routes (config.go:496)."""
+    tcp_proxy with explicit routes (config.go:496); egress rules add
+    listeners for their ports even when no in-mesh service shares them
+    (config.go:849-1026 — otherwise egress traffic is blackholed)."""
+    import logging
+    plog = logging.getLogger("istio_tpu.pilot")
     listeners: dict[int, dict[str, Any]] = {}
-    kinds: dict[int, str] = {}    # port → http|tcp (conflict tracking)
+    kinds: dict[int, str] = {}    # port → http|tcp|redis conflict map
+
+    def claim(port_num: int, kind: str, who: str) -> bool:
+        prev = kinds.get(port_num)
+        if prev is None:
+            kinds[port_num] = kind
+            return True
+        # redis owns its port exclusively; http vs tcp also conflict —
+        # first writer wins, like the reference's conflict logging
+        if prev != kind or prev == "redis":
+            plog.warning("listener conflict on port %d: %s vs %s "
+                         "(%s dropped)", port_num, prev, kind, who)
+            return False
+        return True
+
+    def http_listener(port_num: int) -> dict[str, Any]:
+        return {
+            "address": f"tcp://0.0.0.0:{port_num}",
+            "name": f"http_0.0.0.0_{port_num}",
+            "filters": [{
+                "type": "read", "name": "http_connection_manager",
+                "config": {
+                    "codec_type": "auto",
+                    "stat_prefix": "http",
+                    "rds": {"cluster": "rds",
+                            "route_config_name": str(port_num),
+                            "refresh_delay_ms":
+                                DEFAULT_DISCOVERY_REFRESH_MS},
+                    "filters": _http_filters(
+                        mesh, _port_fault_filters(port_num, services,
+                                                  config_store)),
+                }}],
+        }
+
+    def append_tcp_route(entry: dict[str, Any], route: dict) -> None:
+        tcp = next(f for f in entry["filters"]
+                   if f["name"] == "tcp_proxy")
+        tcp["config"]["route_config"]["routes"].append(route)
+
     for service in services:
         for port in service.ports:
-            kind = "http" if port.is_http else "tcp"
-            if port.port in kinds and kinds[port.port] != kind:
-                # protocol conflict on a shared port: first writer wins,
-                # like the reference's listener-conflict logging
-                import logging
-                logging.getLogger("istio_tpu.pilot").warning(
-                    "listener conflict on port %d: %s vs %s (%s dropped)",
-                    port.port, kinds[port.port], kind, service.hostname)
+            kind = _listener_kind(port)
+            if not claim(port.port, kind, service.hostname):
                 continue
-            kinds[port.port] = kind
-            if port.is_http:
-                if port.port in listeners:
-                    continue
+            if kind == "http":
+                listeners.setdefault(port.port, http_listener(port.port))
+            elif kind == "redis":
                 listeners[port.port] = {
                     "address": f"tcp://0.0.0.0:{port.port}",
-                    "name": f"http_0.0.0.0_{port.port}",
+                    "name": f"redis_0.0.0.0_{port.port}",
                     "filters": [{
-                        "type": "read", "name": "http_connection_manager",
-                        "config": {
-                            "codec_type": "auto",
-                            "stat_prefix": "http",
-                            "rds": {
-                                "cluster": "rds",
-                                "route_config_name": str(port.port),
-                                "refresh_delay_ms":
-                                    DEFAULT_DISCOVERY_REFRESH_MS},
-                            "filters": _http_filters(
-                                mesh, _port_fault_filters(
-                                    port.port, services, config_store)),
-                        }}],
-                }
+                        "type": "read", "name": "redis_proxy",
+                        "config": {"cluster_name":
+                                   cluster_name(service.hostname, port),
+                                   "stat_prefix": "redis",
+                                   "conn_pool": {"op_timeout_ms":
+                                                 30_000}}}]}
             else:
-                key = port.port
                 tcp_route = {"cluster": cluster_name(service.hostname,
                                                      port)}
                 if service.address and service.address != "0.0.0.0":
                     tcp_route["destination_ip_list"] = \
                         [f"{service.address}/32"]
-                entry = listeners.setdefault(key, {
+                entry = listeners.setdefault(port.port, {
                     "address": f"tcp://0.0.0.0:{port.port}",
                     "name": f"tcp_0.0.0.0_{port.port}",
                     "filters": [{"type": "read", "name": "tcp_proxy",
                                  "config": {"stat_prefix": "tcp",
                                             "route_config":
                                                 {"routes": []}}}]})
-                entry["filters"][0]["config"]["route_config"]["routes"] \
-                    .append(tcp_route)
+                append_tcp_route(entry, tcp_route)
+                if port.protocol == "MONGO" and not any(
+                        f["name"] == "mongo_proxy"
+                        for f in entry["filters"]):
+                    # passive sniffer ahead of tcp_proxy
+                    # (resources.go:516-613)
+                    entry["filters"].insert(0, {
+                        "type": "both", "name": "mongo_proxy",
+                        "config": {"stat_prefix": "mongo"}})
+
+    # egress ports: http rides RDS (the route table carries the egress
+    # virtual hosts); https/tcp egress forwards raw bytes to the
+    # external cluster
+    for rule in config_store.egress_rules():
+        host = str(rule.spec.get("destination", {}).get("service", ""))
+        for pnum, proto in _egress_rule_ports(rule):
+            if proto in ("http", "http2", "grpc"):
+                if claim(pnum, "http", f"egress {host}"):
+                    listeners.setdefault(pnum, http_listener(pnum))
+            else:
+                if not claim(pnum, "tcp", f"egress {host}"):
+                    continue
+                entry = listeners.setdefault(pnum, {
+                    "address": f"tcp://0.0.0.0:{pnum}",
+                    "name": f"tcp_0.0.0.0_{pnum}",
+                    "filters": [{"type": "read", "name": "tcp_proxy",
+                                 "config": {"stat_prefix": "tcp",
+                                            "route_config":
+                                                {"routes": []}}}]})
+                append_tcp_route(entry,
+                                 {"cluster": egress_cluster_name(host,
+                                                                 pnum)})
     return [listeners[k] for k in sorted(listeners)]
 
 
@@ -253,6 +381,33 @@ def build_inbound_listeners(instances: Sequence[ServiceInstance],
                                             {"cluster":
                                              inbound_cluster_name(port)}]}}}]}
     return [listeners[k] for k in sorted(listeners)]
+
+
+def build_ingress_listeners(config_store: IstioConfigStore, registry,
+                            mesh: Mapping[str, Any],
+                            tls_context: Mapping[str, Any] | None = None
+                            ) -> list[dict]:
+    """Ingress proxy listeners on 80/443 (ingress.go buildIngress
+    Listeners): the route table comes from ingress-rule configs."""
+    route_config = build_ingress_route_config(config_store, registry)
+    out = []
+    for port, secure in ((80, False), (443, True)):
+        if secure and tls_context is None:
+            continue
+        listener = {
+            "address": f"tcp://0.0.0.0:{port}",
+            "name": f"ingress_{port}",
+            "filters": [{
+                "type": "read", "name": "http_connection_manager",
+                "config": {"codec_type": "auto",
+                           "stat_prefix": "ingress",
+                           "route_config": route_config,
+                           "filters": _http_filters(mesh)}}],
+        }
+        if secure:
+            listener["ssl_context"] = dict(tls_context)
+        out.append(listener)
+    return out
 
 
 # ---------------------------------------------------------------------------

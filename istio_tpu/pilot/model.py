@@ -83,6 +83,38 @@ class ServiceInstance:
 # config resources
 # ---------------------------------------------------------------------------
 
+NODE_SIDECAR = "sidecar"
+NODE_INGRESS = "ingress"
+NODE_ROUTER = "router"
+
+
+@dataclasses.dataclass(frozen=True)
+class Node:
+    """context.go:51 Node{Type, IPAddress, ID, Domain} — the proxy
+    role; the discovery node-id convention is `type~ip~id~domain`."""
+    type: str = NODE_SIDECAR
+    ip_address: str = ""
+    id: str = ""
+    domain: str = "cluster.local"
+
+    @classmethod
+    def parse(cls, service_node: str) -> "Node":
+        parts = service_node.split("~")
+        if parts[0] in (NODE_SIDECAR, NODE_INGRESS, NODE_ROUTER):
+            return cls(type=parts[0],
+                       ip_address=parts[1] if len(parts) > 1 else "",
+                       id=parts[2] if len(parts) > 2 else "",
+                       domain=parts[3] if len(parts) > 3
+                       else "cluster.local")
+        # legacy bare-IP node ids read as sidecars
+        return cls(type=NODE_SIDECAR, ip_address=parts[0])
+
+    @property
+    def service_node(self) -> str:
+        return "~".join([self.type, self.ip_address, self.id,
+                         self.domain])
+
+
 @dataclasses.dataclass(frozen=True)
 class ConfigMeta:
     """config.go:34 ConfigMeta."""
@@ -113,25 +145,126 @@ class ProtoSchema:
     validate: Callable[[Mapping[str, Any]], None]
 
 
+def _check_percent(value: Any, what: str) -> None:
+    try:
+        p = float(value)
+    except (TypeError, ValueError):
+        raise ValidationError(f"{what}: percent not a number: {value!r}")
+    if not 0 <= p <= 100:
+        raise ValidationError(f"{what}: percent {p} out of [0, 100]")
+
+
+def _check_duration(value: Any, what: str) -> None:
+    """Go-style duration strings ('5s', '100ms') or plain seconds."""
+    if isinstance(value, (int, float)):
+        seconds = float(value)
+    else:
+        s = str(value)
+        try:
+            if s.endswith("ms"):
+                seconds = float(s[:-2]) / 1000
+            elif s.endswith("s"):
+                seconds = float(s[:-1])
+            else:
+                seconds = float(s)
+        except ValueError:
+            raise ValidationError(f"{what}: bad duration {value!r}")
+    if seconds < 0:
+        raise ValidationError(f"{what}: negative duration {value!r}")
+
+
+def _check_port_number(value: Any, what: str) -> None:
+    try:
+        port = int(value)
+    except (TypeError, ValueError):
+        raise ValidationError(f"{what}: port not a number: {value!r}")
+    if not 1 <= port <= 65535:
+        raise ValidationError(f"{what}: port {port} out of [1, 65535]")
+
+
+_MATCH_SCHEMES = {"exact", "prefix", "regex", "presence"}
+
+
+def _check_match(match: Mapping[str, Any], what: str) -> None:
+    """validation.go ValidateMatchCondition: each header condition uses
+    exactly one known scheme; conflicting URI schemes rejected."""
+    if not match:
+        return
+    headers = (match.get("request", {}) or {}).get("headers", {}) \
+        if "request" in match else match.get("headers", {}) or {}
+    if not isinstance(headers, Mapping):
+        raise ValidationError(f"{what}: match headers must be a map")
+    for name, cond in headers.items():
+        if cond in (None, {}):
+            continue   # presence match
+        if not isinstance(cond, Mapping):
+            raise ValidationError(
+                f"{what}: header {name} condition must be a map")
+        schemes = set(cond) & _MATCH_SCHEMES
+        unknown = set(cond) - _MATCH_SCHEMES
+        if unknown:
+            raise ValidationError(
+                f"{what}: header {name} unknown scheme(s) "
+                f"{sorted(unknown)}")
+        if len(schemes) > 1:
+            raise ValidationError(
+                f"{what}: header {name} has conflicting schemes "
+                f"{sorted(schemes)} (exactly one allowed)")
+
+
 def _validate_route_rule(spec: Mapping[str, Any]) -> None:
-    """validation.go ValidateRouteRule (v1alpha1 shape)."""
+    """validation.go ValidateRouteRule (v1alpha1 shape): the rejection
+    set covers weights, percentages, durations, conflicting match
+    schemes, redirect/route exclusivity, and port semantics."""
     if not spec.get("destination"):
         raise ValidationError("route-rule: destination required")
+    _check_match(spec.get("match") or {}, "route-rule match")
+    if spec.get("redirect") and spec.get("route"):
+        raise ValidationError(
+            "route-rule: redirect and route are mutually exclusive")
+    if spec.get("redirect") and spec.get("httpFault"):
+        raise ValidationError(
+            "route-rule: redirect cannot carry httpFault")
     total = 0
     for r in spec.get("route", ()):
         w = int(r.get("weight", 0))
         if w < 0 or w > 100:
             raise ValidationError("route-rule: weight must be 0-100")
         total += w
-    if spec.get("route") and total not in (0, 100):
-        raise ValidationError(f"route-rule: weights sum to {total}, not 100")
+    routes = spec.get("route", ())
+    if len(routes) > 1 and total != 100:
+        raise ValidationError(
+            f"route-rule: weights sum to {total}, not 100")
+    if len(routes) == 1 and total not in (0, 100):
+        raise ValidationError(
+            f"route-rule: single-route weight must be 0 or 100, "
+            f"got {total}")
     fault = spec.get("httpFault", {})
     if fault:
         abort = fault.get("abort", {})
-        if abort and not (100 >= float(abort.get("percent", 0)) >= 0):
-            raise ValidationError("route-rule: abort percent out of range")
+        if abort:
+            _check_percent(abort.get("percent", 0), "route-rule abort")
+            status = int(abort.get("httpStatus",
+                                   abort.get("http_status", 503)))
+            if not 200 <= status <= 599:
+                raise ValidationError(
+                    f"route-rule: abort httpStatus {status} invalid")
+        delay = fault.get("delay", {})
+        if delay:
+            _check_percent(delay.get("percent", 0), "route-rule delay")
+            _check_duration(delay.get("fixedDelay", "0s"),
+                            "route-rule delay")
+    timeout = spec.get("httpReqTimeout", {}).get("simpleTimeout", {})
+    if timeout.get("timeout") is not None:
+        _check_duration(timeout["timeout"], "route-rule timeout")
+    retries = spec.get("httpReqRetries", {}).get("simpleRetry", {})
+    if retries and int(retries.get("attempts", 0)) < 0:
+        raise ValidationError("route-rule: negative retry attempts")
     if "precedence" in spec and int(spec["precedence"]) < 0:
         raise ValidationError("route-rule: negative precedence")
+    mirror = spec.get("mirror")
+    if mirror is not None and not isinstance(mirror, Mapping):
+        raise ValidationError("route-rule: mirror must be a message")
 
 
 def _validate_v1alpha2_route_rule(spec: Mapping[str, Any]) -> None:
@@ -140,41 +273,94 @@ def _validate_v1alpha2_route_rule(spec: Mapping[str, Any]) -> None:
     if not spec.get("hosts"):
         raise ValidationError("v1alpha2 route-rule: hosts required")
     for http in spec.get("http", ()):
+        total = 0
         for route in http.get("route", ()):
             if not route.get("destination"):
                 raise ValidationError("v1alpha2: route needs destination")
+            total += int(route.get("weight", 0))
+        if len(http.get("route", ())) > 1 and total != 100:
+            raise ValidationError(
+                f"v1alpha2: weights sum to {total}, not 100")
 
 
 def _validate_destination_policy(spec: Mapping[str, Any]) -> None:
     if not spec.get("destination"):
         raise ValidationError("destination-policy: destination required")
+    lb = spec.get("loadBalancing", {})
+    if lb.get("name") and lb["name"] not in ("ROUND_ROBIN", "LEAST_CONN",
+                                             "RANDOM"):
+        raise ValidationError(
+            f"destination-policy: unknown LB policy {lb['name']!r}")
     cb = spec.get("circuitBreaker", {}).get("simpleCb", {})
-    for k in ("maxConnections", "httpMaxPendingRequests"):
+    for k in ("maxConnections", "httpMaxPendingRequests",
+              "httpMaxRequests", "httpMaxRetries",
+              "httpConsecutiveErrors"):
         if k in cb and int(cb[k]) < 0:
             raise ValidationError(f"destination-policy: negative {k}")
+    for k in ("httpDetectionInterval", "sleepWindow"):
+        if k in cb:
+            _check_duration(cb[k], f"destination-policy {k}")
 
 
 def _validate_destination_rule(spec: Mapping[str, Any]) -> None:
     if not spec.get("host") and not spec.get("name"):
         raise ValidationError("destination-rule: host required")
+    seen = set()
+    for subset in spec.get("subsets", ()):
+        name = subset.get("name")
+        if not name:
+            raise ValidationError("destination-rule: subset needs a name")
+        if name in seen:
+            raise ValidationError(
+                f"destination-rule: duplicate subset {name!r}")
+        seen.add(name)
+        if not subset.get("labels"):
+            raise ValidationError(
+                f"destination-rule: subset {name!r} needs labels")
 
 
 def _validate_gateway(spec: Mapping[str, Any]) -> None:
     if not spec.get("servers"):
         raise ValidationError("gateway: servers required")
+    for server in spec["servers"]:
+        port = server.get("port", {})
+        if not port:
+            raise ValidationError("gateway: server needs a port")
+        _check_port_number(port.get("number", port.get("port")),
+                           "gateway server")
+        if not server.get("hosts"):
+            raise ValidationError("gateway: server needs hosts")
 
 
 def _validate_ingress_rule(spec: Mapping[str, Any]) -> None:
     if not spec.get("destination"):
         raise ValidationError("ingress-rule: destination required")
+    port = spec.get("port")
+    if port is None:
+        raise ValidationError("ingress-rule: port required")
+    # numeric ports (including numeric strings) must be in range;
+    # non-numeric strings are named service ports
+    if not isinstance(port, str) or port.isdigit():
+        _check_port_number(port, "ingress-rule")
+    _check_match(spec.get("match") or {}, "ingress-rule match")
 
 
 def _validate_egress_rule(spec: Mapping[str, Any]) -> None:
     dest = spec.get("destination", {})
-    if not dest.get("service"):
+    service = str(dest.get("service", "") or "")
+    if not service:
         raise ValidationError("egress-rule: destination.service required")
+    if "*" in service[1:]:
+        raise ValidationError(
+            "egress-rule: wildcard only allowed as a leading label")
     if not spec.get("ports"):
         raise ValidationError("egress-rule: ports required")
+    for p in spec["ports"]:
+        _check_port_number(p.get("port"), "egress-rule")
+        proto = str(p.get("protocol", "http")).lower()
+        if proto not in ("http", "http2", "grpc", "https", "tcp"):
+            raise ValidationError(
+                f"egress-rule: unsupported protocol {proto!r}")
 
 
 def _validate_spec_binding(spec: Mapping[str, Any]) -> None:
@@ -306,7 +492,13 @@ class IstioConfigStore:
         against the RULE's namespace + domain (the reference resolves
         names in the config's namespace, model.ResolveHostname)."""
         dest = c.spec.get("destination", {})
-        name = dest if isinstance(dest, str) else str(dest.get("name", ""))
+        if isinstance(dest, str):
+            name = dest
+        elif dest.get("service"):
+            # IstioService.service: an FQDN, used verbatim
+            return str(dest["service"])
+        else:
+            name = str(dest.get("name", ""))
         if "." in name or not name:
             return name
         ns = c.meta.namespace or "default"

@@ -232,13 +232,120 @@ def build_virtual_host(service: Service, port: Port,
 def build_route_config(services: Sequence[Service], port_num: int,
                        config_store: IstioConfigStore,
                        source: str | None = None) -> dict[str, Any]:
-    """RDS payload for one outbound port (config.go:288 buildRDSRoute)."""
+    """RDS payload for one outbound port (config.go:288 buildRDSRoute);
+    egress virtual hosts for the port ride the same route table
+    (config.go:849-1026 — external domains resolve per-sidecar)."""
     vhosts = []
     for service in services:
         for port in service.ports:
             if port.port == port_num and port.is_http:
                 vhosts.append(build_virtual_host(service, port,
                                                  config_store, source))
+    vhosts.extend(build_egress_virtual_hosts(config_store, port_num))
     vhosts.sort(key=lambda v: v["name"])
     return {"virtual_hosts": vhosts,
             "validate_clusters": False}
+
+
+# ---------------------------------------------------------------------------
+# egress (config.go:849-1026)
+# ---------------------------------------------------------------------------
+
+def egress_cluster_name(host: str, port_num: int) -> str:
+    return f"egress.{host}|{port_num}"
+
+
+def _egress_rule_ports(rule: Config) -> list[tuple[int, str]]:
+    return [(int(p.get("port", 80)),
+             str(p.get("protocol", "http")).lower())
+            for p in rule.spec.get("ports", ())]
+
+
+def build_egress_virtual_hosts(config_store: IstioConfigStore,
+                               port_num: int) -> list[dict[str, Any]]:
+    """One virtual host per egress rule exposing `port_num` over http:
+    external domains route to the rule's egress cluster with the
+    authority preserved (auto host rewrite for exact hosts)."""
+    vhosts: dict[str, dict[str, Any]] = {}
+    for rule in config_store.egress_rules():
+        host = str(rule.spec.get("destination", {}).get("service", ""))
+        for pnum, proto in _egress_rule_ports(rule):
+            if pnum != port_num or proto not in ("http", "http2", "grpc"):
+                continue
+            name = f"egress|{host}|{pnum}"
+            if name in vhosts:
+                continue   # rules sharing host+port: envoy rejects
+                #            duplicate domains, so dedupe here
+            route: dict[str, Any] = {
+                "prefix": "/",
+                "cluster": egress_cluster_name(host, pnum),
+                "timeout_ms": DEFAULT_TIMEOUT_MS,
+            }
+            if not host.startswith("*"):
+                route["auto_host_rewrite"] = True
+            vhosts[name] = {"name": name,
+                            "domains": [host, f"{host}:{pnum}"],
+                            "routes": [route]}
+    return [vhosts[k] for k in sorted(vhosts)]
+
+
+# ---------------------------------------------------------------------------
+# ingress (pilot/pkg/proxy/envoy/ingress.go)
+# ---------------------------------------------------------------------------
+
+def build_ingress_route_config(config_store: IstioConfigStore,
+                               registry) -> dict[str, Any]:
+    """Route config for an ingress proxy: ingress-rule configs (as
+    emitted by the kube ingress controller or written directly) grouped
+    into per-authority virtual hosts routing to the backend service's
+    outbound cluster."""
+    by_host: dict[str, list[dict[str, Any]]] = {}
+    for rule in config_store.ingress_rules():
+        spec = rule.spec
+        dest = str(spec.get("destination", {}).get("service", ""))
+        service = registry.get_service(dest) if registry else None
+        port = _resolve_ingress_port(service, spec.get("port"))
+        if port is None:
+            continue
+        match = build_route_match(spec.get("match"))
+        authority = "*"
+        headers = []
+        for h in match.pop("headers", ()):
+            if h["name"] == "authority" and not h.get("regex"):
+                authority = h["value"]
+            else:
+                headers.append(h)
+        route = dict(match)
+        if headers:
+            route["headers"] = headers
+        route["cluster"] = cluster_name(dest, port)
+        route["timeout_ms"] = _timeout_ms(spec)
+        by_host.setdefault(authority, []).append(route)
+    vhosts = []
+    for authority in sorted(by_host):
+        domains = ["*"] if authority == "*" else [authority,
+                                                  f"{authority}:80",
+                                                  f"{authority}:443"]
+        # exact-path routes sort before prefix routes (first match wins)
+        routes = sorted(by_host[authority],
+                        key=lambda r: (0 if "path" in r else 1,
+                                       -len(r.get("prefix", ""))))
+        vhosts.append({"name": f"ingress|{authority}",
+                       "domains": domains, "routes": routes})
+    return {"virtual_hosts": vhosts, "validate_clusters": False}
+
+
+def _resolve_ingress_port(service: Service | None,
+                          port_ref: Any) -> Port | None:
+    if service is None:
+        return None
+    if isinstance(port_ref, str) and not port_ref.isdigit():
+        return service.port_by_name(port_ref)
+    try:
+        num = int(port_ref)
+    except (TypeError, ValueError):
+        return None
+    for p in service.ports:
+        if p.port == num:
+            return p
+    return None
