@@ -122,8 +122,17 @@ def ip_equal(a: bytes, b: bytes) -> bool:
     if len(a) == len(b):
         return a == b
     import ipaddress
+
+    def canon(raw: bytes):
+        addr = ipaddress.ip_address(raw)
+        # python's IPv6Address never equals an IPv4Address, even for
+        # the ::ffff:a.b.c.d mapped form Go's net.IP.Equal accepts —
+        # unmap before comparing
+        mapped = getattr(addr, "ipv4_mapped", None)
+        return mapped if mapped is not None else addr
+
     try:
-        return ipaddress.ip_address(a) == ipaddress.ip_address(b)
+        return canon(a) == canon(b)
     except ValueError:
         return False
 

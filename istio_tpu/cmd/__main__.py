@@ -41,6 +41,32 @@ def cmd_mixs(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_rule_dump(args: argparse.Namespace) -> int:
+    """Disassemble a config snapshot's compiled ruleset; optionally
+    step one synthetic request through it (the il/text + Stepper
+    tooling, mixer/pkg/il/text/write.go + interpreter/stepper.go)."""
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.attribute.global_dict import GLOBAL_MANIFEST
+    from istio_tpu.compiler.disasm import Stepper, disassemble
+    from istio_tpu.runtime import FsStore
+    from istio_tpu.runtime.config import SnapshotBuilder
+
+    store = FsStore(args.config_store)
+    snapshot = SnapshotBuilder(GLOBAL_MANIFEST).build(store)
+    for err in snapshot.errors:
+        print(f"# config error: {err}")
+    print(disassemble(snapshot.ruleset), end="")
+    if args.explain:
+        values = {}
+        for pair in args.explain:
+            name, _, value = pair.partition("=")
+            values[name] = value
+        print()
+        print(Stepper(snapshot.ruleset, snapshot.finder).explain(
+            bag_from_mapping(values)), end="")
+    return 0
+
+
 def cmd_mixc(args: argparse.Namespace) -> int:
     """mixer client (cmd/mixc check/report)."""
     from istio_tpu.api import MixerClient
@@ -316,6 +342,15 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--batch-window-us", type=int, default=300)
     s.add_argument("--max-batch", type=int, default=1024)
     s.set_defaults(fn=cmd_mixs)
+
+    s = sub.add_parser("rule-dump",
+                       help="disassemble a compiled config snapshot")
+    s.add_argument("--config-store", required=True,
+                   help="config directory (k8s-style YAML docs)")
+    s.add_argument("--explain", nargs="*", metavar="attr=value",
+                   help="step one request (string attrs) through the "
+                        "ruleset and show per-atom/per-rule verdicts")
+    s.set_defaults(fn=cmd_rule_dump)
 
     s = sub.add_parser("mixc", help="mixer client")
     s.add_argument("command", choices=["check", "report"])

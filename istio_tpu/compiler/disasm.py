@@ -1,0 +1,155 @@
+"""Compiled-ruleset debugging: disassembler + single-bag stepper.
+
+The il/text + interpreter/Stepper role (mixer/pkg/il/text/write.go,
+il/interpreter/stepper.go:1-152): at 10k rules nobody can reason about
+a compiled snapshot from its index tensors, so `disassemble` renders
+the retained source structure — the deduplicated atom table with each
+atom's lowering tier, every rule's match/not-match DNFs over those
+atoms, host-fallback reasons, namespaces, and referenced-attribute
+bitmaps — and `Stepper` replays ONE attribute bag through the same
+decomposition on the host oracle, showing exactly which atoms fired,
+which conjunctions satisfied, and why each rule matched or not.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from istio_tpu.attribute.bag import Bag
+from istio_tpu.expr.oracle import EvalError, OracleProgram
+from istio_tpu.compiler.ruleset import RuleSetProgram
+
+
+def _dnf_str(dnf, kind: str) -> str:
+    """{frozenset((atom,'m'|'n'))...} → '(a0 ∧ ¬a2) ∨ (a3)'."""
+    if not dnf:
+        return "⊥"
+    parts = []
+    for conj in sorted(dnf, key=lambda c: sorted(c)):
+        lits = [("¬" if k == "n" else "") + f"a{i}"
+                for i, k in sorted(conj)]
+        parts.append("(" + " ∧ ".join(lits) + ")" if lits else "(⊤)")
+    return " ∨ ".join(parts)
+
+
+def disassemble(prog: RuleSetProgram) -> str:
+    """Human-readable dump of a compiled ruleset."""
+    lay = prog.layout
+    lines = [
+        f"ruleset: {prog.n_rules} rules, {prog.n_atoms} atoms, "
+        f"{prog.n_conjs} conjunctions, {len(prog.ns_ids)} namespaces, "
+        f"{len(prog.host_fallback)} host-fallback",
+        f"layout: {len(lay.slots)} scalar + {len(lay.derived_slots)} "
+        f"derived columns, {lay.n_maps} maps, {lay.n_byte_slots} byte "
+        f"slots (max_str_len={lay.max_str_len})",
+        "",
+        "atoms:",
+    ]
+    for aidx, ast in enumerate(prog.atom_asts):
+        tier = prog.atom_tier.get(aidx, "dead")
+        lines.append(f"  a{aidx}: {ast}   [{tier}]")
+    lines.append("")
+    lines.append("rules:")
+    ns_by_id = {v: k for k, v in prog.ns_ids.items()}
+    for ridx, rule in enumerate(prog.rules):
+        ns = ns_by_id.get(int(prog.rule_ns[ridx]), "?") or "<default>"
+        lines.append(f"  r{ridx} {rule.name}  ns={ns}")
+        lines.append(f"      match: {rule.match.strip() or 'true'}")
+        if ridx in prog.host_fallback:
+            lines.append(f"      HOST FALLBACK: "
+                         f"{prog.fallback_reason.get(ridx, '?')}")
+        else:
+            mn = prog.per_rule_dnf[ridx]
+            if mn is not None:
+                lines.append(f"      M: {_dnf_str(mn[0], 'm')}")
+                lines.append(f"      N: {_dnf_str(mn[1], 'n')}")
+        refs = sorted(prog.attr_names[ridx], key=str)
+        if refs:
+            shown = ", ".join(
+                f"{m}[{k}]" if isinstance(r, tuple) else str(r)
+                for r in refs
+                for m, k in [(r if isinstance(r, tuple) else (r, ""))])
+            lines.append(f"      refs: {shown}")
+    return "\n".join(lines) + "\n"
+
+
+class Stepper:
+    """Step one bag through the compiled decomposition on the host
+    oracle (stepper.go's instruction-level trace, at atom granularity —
+    the tensor program has no instructions, atoms are its opcodes)."""
+
+    def __init__(self, prog: RuleSetProgram, finder):
+        self.prog = prog
+        self.finder = finder
+        self._atom_progs = [OracleProgram.from_ast(ast, finder)
+                            for ast in prog.atom_asts]
+
+    def eval_atom(self, aidx: int, bag: Bag) -> tuple[Any, str | None]:
+        try:
+            return self._atom_progs[aidx].evaluate(bag), None
+        except EvalError as exc:
+            return None, str(exc)
+
+    def explain(self, bag: Bag, rule: int | None = None) -> str:
+        """Trace: atom values → conjunction sat → rule verdicts."""
+        prog = self.prog
+        rule_idxs = [rule] if rule is not None else range(prog.n_rules)
+        used: set[int] = set()
+        for ridx in rule_idxs:
+            mn = prog.per_rule_dnf[ridx] \
+                if ridx not in prog.host_fallback else None
+            if mn is not None:
+                for dnf in mn:
+                    for conj in dnf:
+                        used |= {i for i, _ in conj}
+        lines = ["atoms:"]
+        results: dict[int, tuple[Any, str | None]] = {}
+        for aidx in sorted(used):
+            value, err = self.eval_atom(aidx, bag)
+            results[aidx] = (value, err)
+            shown = f"ERROR: {err}" if err is not None else repr(value)
+            lines.append(f"  a{aidx} = {shown}    "
+                         f"# {prog.atom_asts[aidx]}")
+        lines.append("rules:")
+        for ridx in rule_idxs:
+            name = prog.rules[ridx].name
+            if ridx in prog.host_fallback:
+                m, _, e = prog.host_eval(ridx, bag)
+                verdict = "ERROR" if e else ("MATCH" if m else "NO MATCH")
+                lines.append(f"  r{ridx} {name}: {verdict} "
+                             f"(host oracle: "
+                             f"{prog.fallback_reason.get(ridx, '?')})")
+                continue
+            mn = prog.per_rule_dnf[ridx]
+            m_sat = self._dnf_sat(mn[0], results)
+            n_sat = self._dnf_sat(mn[1], results)
+            if m_sat is not None:
+                lines.append(f"  r{ridx} {name}: MATCH via {m_sat}")
+            elif n_sat is not None:
+                lines.append(f"  r{ridx} {name}: NO MATCH via {n_sat}")
+            else:
+                lines.append(f"  r{ridx} {name}: ERROR "
+                             f"(neither DNF conclusive — an operand "
+                             f"errored or was absent)")
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _dnf_sat(dnf, results) -> str | None:
+        """First satisfied conjunction's rendering, or None."""
+        for conj in sorted(dnf, key=lambda c: sorted(c)):
+            ok = True
+            for aidx, kind in sorted(conj):
+                value, err = results[aidx]
+                if err is not None or value is None:
+                    ok = False
+                    break
+                if kind == "m" and not value:
+                    ok = False
+                    break
+                if kind == "n" and value:
+                    ok = False
+                    break
+            if ok:
+                lits = [("¬" if k == "n" else "") + f"a{i}"
+                        for i, k in sorted(conj)] or ["⊤"]
+                return "(" + " ∧ ".join(lits) + ")"
+        return None

@@ -239,6 +239,11 @@ class RuleSetProgram:
     attr_names: list[set]                     # per rule: names + (map,key)
     rule_ns: np.ndarray                       # int32 [n_rules]
     ns_ids: dict[str, int]
+    # ---- debugging surface (compiler/disasm.py — the il/text +
+    #      Stepper role). Retained source structure, not device state:
+    atom_asts: list[Any] = dataclasses.field(default_factory=list)
+    atom_tier: dict[int, str] = dataclasses.field(default_factory=dict)
+    per_rule_dnf: list[Any] = dataclasses.field(default_factory=list)
 
     @property
     def n_rules(self) -> int:
@@ -517,13 +522,19 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
             ns_ids[ns] = len(ns_ids)
         rule_ns[ridx] = ns_ids[ns]
 
+    atom_tier = {aidx: "id-eq" for aidx in eq_atom_idx}
+    atom_tier.update({aidx: "slot-eq" for aidx in ss_atom_idx})
+    atom_tier.update({aidx: "tensor" for aidx in gen_atom_idx})
+
     return RuleSetProgram(
         rules=list(rules), layout=layout, interner=interner,
         fn=jax.jit(run) if jit else run, params=params,
         n_atoms=n_atoms, n_conjs=n_conjs,
         host_fallback=host_fallback, fallback_reason=fallback_reason,
         attr_mask=attr_mask, attr_names=attr_names,
-        rule_ns=rule_ns, ns_ids=ns_ids)
+        rule_ns=rule_ns, ns_ids=ns_ids,
+        atom_asts=list(atoms.asts), atom_tier=atom_tier,
+        per_rule_dnf=list(per_rule))
 
 
 def _collect_attr_names(e: Expression, finder: AttributeDescriptorFinder,

@@ -56,6 +56,19 @@ class OracleProgram:
         self.ast = parse(text)
         self.result_type = eval_type(self.ast, finder, self.funcs)
 
+    @classmethod
+    def from_ast(cls, ast, finder: AttributeDescriptorFinder
+                 ) -> "OracleProgram":
+        """Bind an already-parsed expression (e.g. a compiled ruleset's
+        retained atom AST — the disassembler/stepper path)."""
+        prog = cls.__new__(cls)
+        prog.text = str(ast)
+        prog.finder = finder
+        prog.funcs = DEFAULT_FUNCS
+        prog.ast = ast
+        prog.result_type = eval_type(ast, finder, DEFAULT_FUNCS)
+        return prog
+
     # --- public API (role of il/interpreter Interpreter.Eval) ---
 
     def evaluate(self, bag: Bag) -> Any:

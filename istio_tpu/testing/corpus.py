@@ -355,6 +355,112 @@ CORPUS: list[Case] = [
     Case(e='at == timestamp("2015-01-02T15:04:35Z")', type_=V.BOOL,
          input={"at": _t1}, result=True),
 
+    # ---- fallback chains & typed defaults (tests.go OR breadth) ----
+    Case(e="a | b | 2", type_=V.INT64, input={"a": 7, "b": 9}, result=7),
+    Case(e="a | b | 2", type_=V.INT64, input={"b": 9}, result=9),
+    Case(e="a | b | 2", type_=V.INT64, input={}, result=2),
+    Case(e="(a | b) | 2", type_=V.INT64, input={"b": 5}, result=5),
+    Case(e="a | b", type_=V.INT64, input={},
+         err="lookup failed: 'b'"),
+    Case(e="ab | true", type_=V.BOOL, input={}, result=True),
+    Case(e="ab | false", type_=V.BOOL, input={"ab": True}, result=True),
+    Case(e="ad | 0.5", type_=V.DOUBLE, input={}, result=0.5),
+    Case(e='as | as2 | "z"', type_=V.STRING, input={"as2": "y"},
+         result="y"),
+    Case(e='ar["k"] | ar2["k"] | "d"', type_=V.STRING,
+         input={"ar": {}, "ar2": {"k": "v2"}}, result="v2"),
+    Case(e='ar[as] | "d"', type_=V.STRING, input={"ar": {"k": "x"}},
+         result="d", name="dynkey-absent-key-falls-back"),
+    Case(e='(ab | true) && (as | "x") == "x"', type_=V.BOOL,
+         input={}, result=True),
+    Case(e='a | "x"', compile_err="typeError"),
+
+    # ---- error-masking boolean semantics (short-circuit parity) ----
+    Case(e="false && a == 1", type_=V.BOOL, input={}, result=False,
+         name="land-short-circuit-masks-absence"),
+    Case(e="a == 1 && false", input={},
+         err="lookup failed: 'a'",
+         name="land-left-error-raises"),
+    Case(e="true || a == 1", type_=V.BOOL, input={}, result=True,
+         name="lor-short-circuit-masks-absence"),
+    Case(e="a == 1 || true", input={},
+         err="lookup failed: 'a'",
+         name="lor-left-error-raises"),
+    Case(e="ab && a == 1", input={"ab": True},
+         err="lookup failed: 'a'"),
+    Case(e="ab && a == 1", type_=V.BOOL, input={"ab": False},
+         result=False),
+    Case(e="(a == 1 || b == 2) && (as == \"x\" || ab)", type_=V.BOOL,
+         input={"a": 9, "b": 2, "as": "y", "ab": True}, result=True),
+    Case(e="(a == 1 || b == 2) && (as == \"x\" || ab)", type_=V.BOOL,
+         input={"a": 1, "b": 9, "as": "y", "ab": False}, result=False),
+
+    # ---- map edge semantics ----
+    Case(e='ar[as]', input={"ar": {"k": "v"}, "as": "missing"},
+         err="member lookup failed: 'missing'"),
+    Case(e='ar[""]', type_=V.STRING, input={"ar": {"": "empty-key"}},
+         result="empty-key", name="empty-string-map-key"),
+    Case(e='ar["k"] == ar["k"]', type_=V.BOOL, input={"ar": {"k": "v"}},
+         result=True, referenced=["ar", "ar[k]"]),
+    Case(e='ar["a"] == ar["b"]', input={"ar": {"a": "x"}},
+         err="member lookup failed: 'b'"),
+
+    # ---- extern runtime errors & edge patterns ----
+    Case(e='ip(as)', input={"as": "not-an-ip"},
+         err="could not convert not-an-ip to IP_ADDRESS"),
+    Case(e='timestamp(as)', input={"as": "not-a-time"},
+         err="to TIMESTAMP. expected format: RFC3339"),
+    Case(e='match(as, "*")', type_=V.BOOL, input={"as": "anything"},
+         result=True, name="glob-star-matches-all"),
+    Case(e='match(as, "")', type_=V.BOOL, input={"as": ""},
+         result=True, name="glob-empty-exact"),
+    Case(e='match(as, "")', type_=V.BOOL, input={"as": "x"},
+         result=False),
+    Case(e='match(as, "exact")', type_=V.BOOL, input={"as": "exact"},
+         result=True),
+    Case(e='match(as, "ex*") && match(as2, "*ct")', type_=V.BOOL,
+         input={"as": "extra", "as2": "exact"}, result=True),
+    Case(e='"[".matches(as)', input={"as": "x"},
+         err="bad regex"),
+    Case(e='"ab.*f".matches(as)', type_=V.BOOL, input={"as": "xabcdefy"},
+         result=True, name="regex-unanchored-search"),
+    Case(e='"^ab$".matches(as)', type_=V.BOOL, input={"as": "xaby"},
+         result=False, name="regex-anchors-honored"),
+    Case(e='as.startsWith("")', type_=V.BOOL, input={"as": "x"},
+         result=True),
+    Case(e='as.startsWith(as)', type_=V.BOOL, input={"as": "full"},
+         result=True, name="prefix-equal-to-string"),
+    Case(e='as.startsWith("longer-than-value")', type_=V.BOOL,
+         input={"as": "lon"}, result=False),
+    Case(e='as.endsWith("")', type_=V.BOOL, input={"as": "x"},
+         result=True),
+    Case(e='as.endsWith(as2)', type_=V.BOOL,
+         input={"as": "a.svc.cluster", "as2": ".cluster"}, result=True),
+
+    # ---- typed equality breadth ----
+    Case(e='adur == "19ms"', type_=V.BOOL, input={"adur": _d19},
+         result=True),
+    Case(e='adur == "20ms"', type_=V.BOOL, input={"adur": _d19},
+         result=False),
+    Case(e="at == at2", type_=V.BOOL, input={"at": _t1, "at2": _t1},
+         result=True),
+    Case(e="at != at2", type_=V.BOOL, input={"at": _t1, "at2": _t2},
+         result=True),
+    Case(e='aip == ip("1.2.3.4")', type_=V.BOOL,
+         input={"aip": parse_ip("1.2.3.4")}, result=True),
+    Case(e='aip == ip("::ffff:1.2.3.4")', type_=V.BOOL,
+         input={"aip": parse_ip("1.2.3.4")}, result=True,
+         name="v4-equals-v4-in-v6"),
+    Case(e='timestamp("2015-01-02T15:04:35Z") == at', type_=V.BOOL,
+         input={"at": _t1}, result=True),
+
+    # ---- parsing edges ----
+    Case(e="((a)) == (2)", type_=V.BOOL, input={"a": 2}, result=True),
+    Case(e='as == "quote\\"inside"', type_=V.BOOL,
+         input={"as": 'quote"inside'}, result=True),
+    Case(e="a==2&&b==3", type_=V.BOOL, input={"a": 2, "b": 3},
+         result=True, name="no-whitespace"),
+
     # ---- realistic mesh predicates (the resolver's diet) ----
     Case(e='destination.service == "reviews.default.svc.cluster.local"',
          type_=V.BOOL,
