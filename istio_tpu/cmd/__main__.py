@@ -94,10 +94,25 @@ def cmd_pilot_discovery(args: argparse.Namespace) -> int:
     """pilot-discovery (bootstrap/server.go assembly)."""
     from istio_tpu.pilot import MemoryConfigStore, MemoryRegistry
     from istio_tpu.pilot.discovery import DiscoveryService
-    registry = MemoryRegistry()
+    from istio_tpu.pilot.registry import AggregateRegistry
+    memory = MemoryRegistry()
     store = MemoryConfigStore()
     if args.registry_file:
-        _load_world(registry, store, args.registry_file)
+        _load_world(memory, store, args.registry_file)
+    backends = [memory]
+    # platform registries (bootstrap/server.go:360 initServiceControllers)
+    if args.consul_address:
+        from istio_tpu.pilot.consul import ConsulRegistry
+        consul = ConsulRegistry(args.consul_address)
+        consul.start()
+        backends.append(consul)
+    if args.eureka_address:
+        from istio_tpu.pilot.eureka import EurekaRegistry
+        eka = EurekaRegistry(args.eureka_address)
+        eka.start()
+        backends.append(eka)
+    registry = backends[0] if len(backends) == 1 \
+        else AggregateRegistry(backends)
     ds = DiscoveryService(registry, store,
                           {"mixer_address": args.mixer_address})
     port = ds.start(args.address, args.port)
@@ -365,6 +380,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--registry-file", default="",
                    help="YAML world file: {services: [], configs: []}")
     s.add_argument("--mixer-address", default="")
+    s.add_argument("--consul-address", default="",
+                   help="consul agent addr (host:port) to federate")
+    s.add_argument("--eureka-address", default="",
+                   help="eureka server URL to federate")
     s.set_defaults(fn=cmd_pilot_discovery)
 
     s = sub.add_parser("pilot-agent", help="sidecar agent")

@@ -1,0 +1,163 @@
+"""Cloud Foundry service registry (copilot-backed).
+
+Reference: pilot/pkg/serviceregistry/cloudfoundry/{servicediscovery,
+controller,config}.go — a ServiceDiscovery over CF's *copilot* gRPC
+API: one ``Routes()`` RPC returns a map of hostname → backend set
+(address, port), and every model query is a view over that response.
+CF apps expose a single HTTP port (typically 8080), so every service
+gets exactly one ServicePort (servicediscovery.go:20-23).
+
+The copilot wire contract is reduced to :class:`CopilotClient`
+(``routes() -> {hostname: [(address, port), ...]}``); production
+would back it with the copilot gRPC stub + client TLS from
+config.go, tests use :class:`InProcessCopilot`. The reference's
+controller has no watch — Routes() is polled per query and a ticker
+fires cache invalidation (controller.go); the same ticker drives
+`append_service_handler` here.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Mapping, Sequence
+
+from istio_tpu.pilot.model import (NetworkEndpoint, Port, Service,
+                                   ServiceInstance)
+from istio_tpu.pilot.registry import ServiceDiscovery
+
+import logging
+
+log = logging.getLogger("istio_tpu.pilot.cloudfoundry")
+
+DEFAULT_SERVICE_PORT = 8080
+
+
+class CopilotClient:
+    """copilotapi.IstioCopilotClient, reduced to the one used RPC."""
+
+    def routes(self) -> Mapping[str, Sequence[tuple[str, int]]]:
+        raise NotImplementedError
+
+
+class InProcessCopilot(CopilotClient):
+    """Test/fake copilot (mockcopilotclient_test.go role)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._backends: dict[str, list[tuple[str, int]]] = {}
+
+    def set_route(self, hostname: str,
+                  backends: Sequence[tuple[str, int]]) -> None:
+        with self._lock:
+            self._backends[hostname] = list(backends)
+
+    def delete_route(self, hostname: str) -> None:
+        with self._lock:
+            self._backends.pop(hostname, None)
+
+    def routes(self) -> dict[str, list[tuple[str, int]]]:
+        with self._lock:
+            return {h: list(b) for h, b in self._backends.items()}
+
+
+class CloudFoundryRegistry(ServiceDiscovery):
+    """servicediscovery.go over a CopilotClient."""
+
+    def __init__(self, client: CopilotClient,
+                 service_port: int = DEFAULT_SERVICE_PORT,
+                 poll_s: float = 2.0):
+        self.client = client
+        self.service_port = service_port
+        self.poll_s = poll_s
+        self._svc_handlers: list[Callable[[Service, str], None]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._snapshot: set[str] = set()
+
+    def _port(self) -> Port:
+        return Port(name="http", port=self.service_port, protocol="HTTP")
+
+    def _service(self, hostname: str) -> Service:
+        return Service(hostname=hostname, address="",
+                       ports=(self._port(),))
+
+    def _routes(self) -> Mapping[str, Sequence[tuple[str, int]]]:
+        try:
+            return self.client.routes()
+        except Exception as exc:
+            log.warning("copilot Routes() failed: %s", exc)
+            return {}
+
+    # -- ServiceDiscovery --
+
+    def services(self) -> list[Service]:
+        return [self._service(h) for h in sorted(self._routes())]
+
+    def get_service(self, hostname: str) -> Service | None:
+        return (self._service(hostname)
+                if hostname in self._routes() else None)
+
+    def instances(self, hostname, ports=(), labels=None):
+        if labels:   # CF has no instance labels (servicediscovery.go)
+            return []
+        backends = self._routes().get(hostname)
+        if not backends:
+            return []
+        port = self._port()
+        if ports and port.name not in set(ports):
+            return []
+        svc = self._service(hostname)
+        return [ServiceInstance(
+                    endpoint=NetworkEndpoint(address=addr, port=p,
+                                             service_port=port),
+                    service=svc)
+                for addr, p in backends]
+
+    def host_instances(self, addrs: set[str]) -> list[ServiceInstance]:
+        out = []
+        port = self._port()
+        for hostname, backends in self._routes().items():
+            svc = self._service(hostname)
+            for addr, p in backends:
+                if addr in addrs:
+                    out.append(ServiceInstance(
+                        endpoint=NetworkEndpoint(address=addr, port=p,
+                                                 service_port=port),
+                        service=svc))
+        return out
+
+    # -- controller.go ticker --
+
+    def append_service_handler(self, fn: Callable[[Service, str], None]
+                               ) -> None:
+        self._svc_handlers.append(fn)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._snapshot = set(self._routes())
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cf-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            now = set(self._routes())
+            before, self._snapshot = self._snapshot, now
+            for host in now - before:
+                self._fire(host, "add")
+            for host in before - now:
+                self._fire(host, "delete")
+
+    def _fire(self, hostname: str, event: str) -> None:
+        svc = self._service(hostname)
+        for fn in list(self._svc_handlers):
+            try:
+                fn(svc, event)
+            except Exception:
+                log.exception("cf service handler failed")

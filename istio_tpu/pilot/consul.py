@@ -1,0 +1,316 @@
+"""Consul service registry.
+
+Reference: pilot/pkg/serviceregistry/consul/{controller,conversion,
+monitor}.go — a ServiceDiscovery backend over Consul's HTTP catalog
+API (`/v1/catalog/services`, `/v1/catalog/service/<name>`), plus a
+polling monitor that diffs successive catalog snapshots and fires
+service/instance change handlers (monitor.go:49-76).
+
+Conversion semantics preserved (conversion.go):
+  - tags of the form ``key|value`` become labels; malformed tags are
+    ignored (conversion.go:33-45),
+  - node-meta ``protocol`` selects the port protocol, default name
+    "http" (conversion.go:47-57),
+  - node-meta ``external`` marks mesh-external services,
+  - ServiceAddress falls back to the node Address (conversion.go:100),
+  - hostname is ``<name>.service.consul`` (parseHostname inverse).
+
+This image has no consul agent, so the client speaks the real HTTP
+API against :class:`FakeConsulServer` — an in-process catalog that
+serves the same JSON shapes (the hermetic-registry testing lesson,
+SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Mapping, Sequence
+
+from istio_tpu.pilot.model import (NetworkEndpoint, Port, Service,
+                                   ServiceInstance)
+from istio_tpu.pilot.registry import ServiceDiscovery
+
+import logging
+
+log = logging.getLogger("istio_tpu.pilot.consul")
+
+PROTOCOL_TAG = "protocol"
+EXTERNAL_TAG = "external"
+DOMAIN_SUFFIX = ".service.consul"
+
+
+def service_hostname(name: str) -> str:
+    return f"{name}{DOMAIN_SUFFIX}"
+
+
+def parse_hostname(hostname: str) -> str:
+    """controller.go parseHostname: strip the .service.consul suffix."""
+    if not hostname.endswith(DOMAIN_SUFFIX):
+        raise ValueError(f"not a consul hostname: {hostname!r}")
+    return hostname[: -len(DOMAIN_SUFFIX)]
+
+
+def convert_labels(tags: Sequence[str]) -> dict[str, str]:
+    """conversion.go:33-45 — only ``key|value`` tags become labels."""
+    out: dict[str, str] = {}
+    for tag in tags:
+        vals = tag.split("|")
+        if len(vals) > 1:
+            out[vals[0]] = vals[1]
+        else:
+            log.warning("consul tag %r ignored (not key|value)", tag)
+    return out
+
+
+def convert_port(port: int, name: str) -> Port:
+    name = name or "http"
+    from istio_tpu.kube.registry import protocol_from_port_name
+    return Port(name=name, port=port,
+                protocol=protocol_from_port_name(name))
+
+
+def convert_service(endpoints: Sequence[Mapping[str, Any]]) -> Service:
+    """conversion.go:59-97 — merge catalog entries into one Service."""
+    name, external = "", ""
+    ports: dict[int, Port] = {}
+    for ep in endpoints:
+        name = ep["ServiceName"]
+        meta = ep.get("NodeMeta") or {}
+        port = convert_port(ep["ServicePort"], meta.get(PROTOCOL_TAG, ""))
+        prev = ports.get(port.port)
+        if prev is not None and prev.protocol != port.protocol:
+            log.warning("consul service %s port %d has conflicting "
+                     "protocols (%s, %s)", name, port.port,
+                     prev.protocol, port.protocol)
+        else:
+            ports[port.port] = port
+        if meta.get(EXTERNAL_TAG):
+            external = meta[EXTERNAL_TAG]
+    return Service(hostname=service_hostname(name), address="",
+                   ports=tuple(ports[p] for p in sorted(ports)),
+                   external_name=external)
+
+
+def convert_instance(ep: Mapping[str, Any]) -> ServiceInstance:
+    """conversion.go:99-130."""
+    meta = ep.get("NodeMeta") or {}
+    labels = convert_labels(ep.get("ServiceTags") or [])
+    port = convert_port(ep["ServicePort"], meta.get(PROTOCOL_TAG, ""))
+    addr = ep.get("ServiceAddress") or ep.get("Address") or ""
+    svc = Service(hostname=service_hostname(ep["ServiceName"]),
+                  address=ep.get("ServiceAddress") or "",
+                  ports=(port,),
+                  external_name=meta.get(EXTERNAL_TAG, ""))
+    return ServiceInstance(
+        endpoint=NetworkEndpoint(address=addr, port=ep["ServicePort"],
+                                 service_port=port),
+        service=svc, labels=labels,
+        availability_zone=ep.get("Datacenter", ""))
+
+
+class ConsulClient:
+    """Minimal Consul catalog HTTP client (hashicorp/consul/api role)."""
+
+    def __init__(self, addr: str, timeout_s: float = 10.0):
+        self.base = f"http://{addr}" if "://" not in addr else addr
+        self.timeout_s = timeout_s
+
+    def _get(self, path: str) -> Any:
+        with urllib.request.urlopen(self.base + path,
+                                    timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def services(self) -> dict[str, list[str]]:
+        return self._get("/v1/catalog/services")
+
+    def service(self, name: str) -> list[dict]:
+        return self._get(f"/v1/catalog/service/{name}")
+
+
+class ConsulRegistry(ServiceDiscovery):
+    """controller.go Controller + monitor.go polling diff.
+
+    Queries go straight to the catalog (the reference controller is
+    uncached too); the monitor thread polls at `poll_s`, diffs the
+    snapshot, and fires service handlers so the discovery cache
+    invalidates exactly like the kube registry does.
+    """
+
+    def __init__(self, addr: str, poll_s: float = 2.0,
+                 client: ConsulClient | None = None):
+        self.client = client or ConsulClient(addr)
+        self.poll_s = poll_s
+        self._svc_handlers: list[Callable[[Service, str], None]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._snapshot: dict[str, list[str]] = {}
+
+    # -- ServiceDiscovery --
+
+    def services(self) -> list[Service]:
+        out = []
+        for name in sorted(self.client.services()):
+            eps = self.client.service(name)
+            if eps:
+                out.append(convert_service(eps))
+        return out
+
+    def get_service(self, hostname: str) -> Service | None:
+        try:
+            name = parse_hostname(hostname)
+        except ValueError:
+            return None
+        eps = self.client.service(name)
+        return convert_service(eps) if eps else None
+
+    def instances(self, hostname, ports=(), labels=None):
+        try:
+            name = parse_hostname(hostname)
+        except ValueError:
+            return []
+        want_ports = set(ports)
+        out = []
+        for ep in self.client.service(name):
+            inst = convert_instance(ep)
+            if want_ports and inst.endpoint.service_port.name not in want_ports:
+                continue
+            if labels and any(inst.labels.get(k) != v
+                              for k, v in labels.items()):
+                continue
+            out.append(inst)
+        return out
+
+    def host_instances(self, addrs: set[str]) -> list[ServiceInstance]:
+        out = []
+        for name in self.client.services():
+            for ep in self.client.service(name):
+                inst = convert_instance(ep)
+                if inst.endpoint.address in addrs:
+                    out.append(inst)
+        return out
+
+    # -- monitor (monitor.go) --
+
+    def append_service_handler(self, fn: Callable[[Service, str], None]
+                               ) -> None:
+        self._svc_handlers.append(fn)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._snapshot = dict(self.client.services())
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="consul-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self._poll_once()
+
+    def _poll_once(self) -> None:
+        try:
+            now = dict(self.client.services())
+        except Exception as exc:   # monitor.go logs and keeps polling
+            log.warning("consul poll failed: %s", exc)
+            return
+        before = self._snapshot
+        self._snapshot = now
+        for name in now:
+            if name not in before:
+                self._fire(name, "add")
+            elif now[name] != before[name]:
+                self._fire(name, "update")
+        for name in before:
+            if name not in now:
+                self._fire(name, "delete")
+
+    def _fire(self, name: str, event: str) -> None:
+        svc = Service(hostname=service_hostname(name))
+        for fn in list(self._svc_handlers):
+            try:
+                fn(svc, event)
+            except Exception:
+                log.exception("consul service handler failed")
+
+
+# ---------------------------------------------------------------------------
+# in-process fake (hermetic test backbone, SURVEY §4 lesson (e))
+# ---------------------------------------------------------------------------
+
+class FakeConsulServer:
+    """Serves the two catalog endpoints the registry consumes, with the
+    real API's JSON shapes, over a loopback HTTP server."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._catalog: dict[str, list[dict]] = {}
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # silence
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/v1/catalog/services":
+                    body = fake._services_json()
+                elif path.startswith("/v1/catalog/service/"):
+                    body = fake._service_json(path.rsplit("/", 1)[1])
+                else:
+                    self.send_error(404)
+                    return
+                raw = json.dumps(body).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="fake-consul")
+        self._thread.start()
+
+    @property
+    def addr(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"{host}:{port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def register(self, name: str, *, address: str, port: int,
+                 service_address: str = "", tags: Sequence[str] = (),
+                 node_meta: Mapping[str, str] | None = None,
+                 datacenter: str = "dc1") -> None:
+        entry = {"ServiceName": name, "Address": address,
+                 "ServiceAddress": service_address, "ServicePort": port,
+                 "ServiceTags": list(tags),
+                 "NodeMeta": dict(node_meta or {}),
+                 "Datacenter": datacenter}
+        with self._lock:
+            self._catalog.setdefault(name, []).append(entry)
+
+    def deregister(self, name: str) -> None:
+        with self._lock:
+            self._catalog.pop(name, None)
+
+    def _services_json(self) -> dict[str, list[str]]:
+        with self._lock:
+            return {n: sorted({t for e in eps
+                               for t in e["ServiceTags"]})
+                    for n, eps in self._catalog.items()}
+
+    def _service_json(self, name: str) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._catalog.get(name, [])]

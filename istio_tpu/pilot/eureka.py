@@ -1,0 +1,295 @@
+"""Eureka service registry.
+
+Reference: pilot/pkg/serviceregistry/eureka/{client,conversion,
+controller,servicediscovery}.go — a ServiceDiscovery backend over the
+Eureka v2 REST API (`GET /eureka/v2/apps`), with a polling controller
+that fires change handlers when the application set changes
+(controller.go) and conversion rules (conversion.go):
+
+  - only instances with ``status == "UP"`` count,
+  - an instance exposes 0..2 ports (port, securePort), each gated by
+    ``@enabled`` (conversion.go:106-117),
+  - the protocol comes from instance metadata key ``istio.protocol``,
+  - all remaining metadata keys become labels (``istio.``-prefixed
+    keys are filtered out of labels),
+  - services are keyed by instance hostname; conflicting protocol
+    definitions on one port are logged and first-wins.
+
+Hermetic backend: :class:`FakeEurekaServer` serves the same JSON
+wire shape (client.go:26-45) in-process.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Mapping, Sequence
+
+from istio_tpu.pilot.model import (NetworkEndpoint, Port, Service,
+                                   ServiceInstance)
+from istio_tpu.pilot.registry import ServiceDiscovery
+
+import logging
+
+log = logging.getLogger("istio_tpu.pilot.eureka")
+
+STATUS_UP = "UP"
+APPS_PATH = "/eureka/v2/apps"
+PROTOCOL_METADATA = "istio.protocol"   # conversion.go protocolMetadata
+
+
+def convert_labels(metadata: Mapping[str, str]) -> dict[str, str]:
+    """conversion.go convertLabels: drop istio.* keys."""
+    return {k: v for k, v in metadata.items()
+            if not k.startswith("istio.")}
+
+
+def convert_protocol(metadata: Mapping[str, str]) -> str:
+    from istio_tpu.kube.registry import protocol_from_port_name
+    name = metadata.get(PROTOCOL_METADATA, "")
+    return protocol_from_port_name(name) if name else "TCP"
+
+
+def convert_ports(inst: Mapping[str, Any]) -> list[Port]:
+    """conversion.go:106-117 — 0..2 enabled ports per instance."""
+    protocol = convert_protocol(inst.get("metadata") or {})
+    out = []
+    for key in ("port", "securePort"):
+        p = inst.get(key) or {}
+        if not _enabled(p):
+            continue
+        num = int(p.get("$", 0))
+        out.append(Port(name=f"{key.lower()}-{num}", port=num,
+                        protocol=protocol))
+    return out
+
+
+def _enabled(p: Mapping[str, Any]) -> bool:
+    v = p.get("@enabled", False)
+    return v if isinstance(v, bool) else str(v).lower() == "true"
+
+
+def convert_services(apps: Sequence[Mapping[str, Any]],
+                     hostnames: set[str] | None = None
+                     ) -> dict[str, Service]:
+    """conversion.go:28-74 — group UP instances by hostname."""
+    ports_by_host: dict[str, dict[int, Port]] = {}
+    for app in apps:
+        for inst in app.get("instance", []):
+            host = inst.get("hostName", "")
+            if hostnames and host not in hostnames:
+                continue
+            if inst.get("status") != STATUS_UP:
+                continue
+            ports = convert_ports(inst)
+            if not ports:
+                continue
+            acc = ports_by_host.setdefault(host, {})
+            for port in ports:
+                prev = acc.get(port.port)
+                if prev is not None:
+                    if prev.protocol != port.protocol:
+                        log.warning("eureka %s:%d conflicting protocols "
+                                 "(%s, %s)", host, port.port,
+                                 prev.protocol, port.protocol)
+                    continue
+                acc[port.port] = port
+    return {h: Service(hostname=h, address="",
+                       ports=tuple(ports[p] for p in sorted(ports)))
+            for h, ports in ports_by_host.items()}
+
+
+def convert_instances(services: Mapping[str, Service],
+                      apps: Sequence[Mapping[str, Any]]
+                      ) -> list[ServiceInstance]:
+    """conversion.go:76-104."""
+    out = []
+    for app in apps:
+        for inst in app.get("instance", []):
+            svc = services.get(inst.get("hostName", ""))
+            if svc is None or inst.get("status") != STATUS_UP:
+                continue
+            for port in convert_ports(inst):
+                out.append(ServiceInstance(
+                    endpoint=NetworkEndpoint(
+                        address=inst.get("ipAddr", ""),
+                        port=port.port, service_port=port),
+                    service=svc,
+                    labels=convert_labels(inst.get("metadata") or {})))
+    return out
+
+
+class EurekaClient:
+    """client.go — `Applications()` via GET /eureka/v2/apps."""
+
+    def __init__(self, url: str, timeout_s: float = 10.0):
+        self.url = url if "://" in url else f"http://{url}"
+        self.timeout_s = timeout_s
+
+    def applications(self) -> list[dict]:
+        req = urllib.request.Request(
+            self.url + APPS_PATH, headers={"Accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            data = json.loads(resp.read().decode("utf-8"))
+        apps = (data.get("applications") or {}).get("application") or []
+        # Eureka serializes a single app as an object, many as a list.
+        if isinstance(apps, dict):
+            apps = [apps]
+        return apps
+
+
+class EurekaRegistry(ServiceDiscovery):
+    """servicediscovery.go + controller.go polling handler loop."""
+
+    def __init__(self, url: str, poll_s: float = 2.0,
+                 client: EurekaClient | None = None):
+        self.client = client or EurekaClient(url)
+        self.poll_s = poll_s
+        self._svc_handlers: list[Callable[[Service, str], None]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._snapshot: dict[str, Service] = {}
+
+    # -- ServiceDiscovery --
+
+    def services(self) -> list[Service]:
+        svcs = convert_services(self._apps())
+        return sorted(svcs.values(), key=lambda s: s.hostname)
+
+    def get_service(self, hostname: str) -> Service | None:
+        return convert_services(self._apps(), {hostname}).get(hostname)
+
+    def instances(self, hostname, ports=(), labels=None):
+        apps = self._apps()
+        services = convert_services(apps, {hostname})
+        want = set(ports)
+        out = []
+        for inst in convert_instances(services, apps):
+            if want and inst.endpoint.service_port.name not in want:
+                continue
+            if labels and any(inst.labels.get(k) != v
+                              for k, v in labels.items()):
+                continue
+            out.append(inst)
+        return out
+
+    def host_instances(self, addrs: set[str]) -> list[ServiceInstance]:
+        apps = self._apps()
+        services = convert_services(apps)
+        return [i for i in convert_instances(services, apps)
+                if i.endpoint.address in addrs]
+
+    def _apps(self) -> list[dict]:
+        try:
+            return self.client.applications()
+        except Exception as exc:
+            log.warning("eureka fetch failed: %s", exc)
+            return []
+
+    # -- controller.go --
+
+    def append_service_handler(self, fn: Callable[[Service, str], None]
+                               ) -> None:
+        self._svc_handlers.append(fn)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._snapshot = convert_services(self._apps())
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="eureka-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            now = convert_services(self._apps())
+            before, self._snapshot = self._snapshot, now
+            for host, svc in now.items():
+                if host not in before:
+                    self._fire(svc, "add")
+                elif before[host] != svc:
+                    self._fire(svc, "update")
+            for host, svc in before.items():
+                if host not in now:
+                    self._fire(svc, "delete")
+
+    def _fire(self, svc: Service, event: str) -> None:
+        for fn in list(self._svc_handlers):
+            try:
+                fn(svc, event)
+            except Exception:
+                log.exception("eureka service handler failed")
+
+
+# ---------------------------------------------------------------------------
+# in-process fake
+# ---------------------------------------------------------------------------
+
+class FakeEurekaServer:
+    """Serves GET /eureka/v2/apps with the real wire JSON shape."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._apps: dict[str, list[dict]] = {}
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.split("?")[0] != APPS_PATH:
+                    self.send_error(404)
+                    return
+                raw = json.dumps(fake._payload()).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="fake-eureka")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def register(self, app: str, *, hostname: str, ip: str,
+                 port: int | None = None, secure_port: int | None = None,
+                 status: str = STATUS_UP,
+                 metadata: Mapping[str, str] | None = None) -> None:
+        inst = {"hostName": hostname, "ipAddr": ip, "status": status,
+                "port": {"$": port or 0,
+                         "@enabled": "true" if port else "false"},
+                "securePort": {"$": secure_port or 0,
+                               "@enabled": "true" if secure_port
+                               else "false"},
+                "metadata": dict(metadata or {})}
+        with self._lock:
+            self._apps.setdefault(app.upper(), []).append(inst)
+
+    def deregister(self, app: str) -> None:
+        with self._lock:
+            self._apps.pop(app.upper(), None)
+
+    def _payload(self) -> dict:
+        with self._lock:
+            apps = [{"name": name, "instance": [dict(i) for i in insts]}
+                    for name, insts in sorted(self._apps.items())]
+        return {"applications": {"application": apps}}

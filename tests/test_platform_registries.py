@@ -1,0 +1,193 @@
+"""Consul / Eureka / CloudFoundry registry backends.
+
+Mirrors the reference's hermetic registry tests
+(pilot/pkg/serviceregistry/{consul,eureka,cloudfoundry}/*_test.go):
+each backend is driven against an in-process fake speaking the real
+wire shapes, and the conversion rules are asserted table-style.
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from istio_tpu.pilot import cloudfoundry, consul, eureka
+from istio_tpu.pilot.registry import AggregateRegistry
+
+
+# ---------------------------------------------------------------------------
+# consul
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def consul_pair():
+    fake = consul.FakeConsulServer()
+    reg = consul.ConsulRegistry(fake.addr, poll_s=0.05)
+    yield fake, reg
+    reg.stop()
+    fake.close()
+
+
+def test_consul_services_and_conversion(consul_pair):
+    fake, reg = consul_pair
+    fake.register("reviews", address="10.0.0.1", port=9080,
+                  tags=["version|v1", "notalabel"],
+                  node_meta={"protocol": "grpc"})
+    fake.register("reviews", address="10.0.0.2", port=9080,
+                  service_address="172.16.0.2",
+                  tags=["version|v2"], node_meta={"protocol": "grpc"})
+    svcs = reg.services()
+    assert [s.hostname for s in svcs] == ["reviews.service.consul"]
+    assert svcs[0].ports[0].protocol == "GRPC"
+
+    svc = reg.get_service("reviews.service.consul")
+    assert svc is not None and svc.ports[0].port == 9080
+    assert reg.get_service("nope.service.consul") is None
+    assert reg.get_service("not-a-consul-name") is None
+
+
+def test_consul_instances_labels_and_address_fallback(consul_pair):
+    fake, reg = consul_pair
+    fake.register("ratings", address="10.1.1.1", port=8080,
+                  tags=["version|v1"])
+    fake.register("ratings", address="10.1.1.2",
+                  service_address="172.16.5.5", port=8080,
+                  tags=["version|v2"])
+    insts = reg.instances("ratings.service.consul")
+    assert len(insts) == 2
+    # ServiceAddress wins; node Address is the fallback (conversion.go:100)
+    addrs = sorted(i.endpoint.address for i in insts)
+    assert addrs == ["10.1.1.1", "172.16.5.5"]
+    # malformed tags were dropped; key|value became labels
+    v2 = reg.instances("ratings.service.consul",
+                       labels={"version": "v2"})
+    assert [i.endpoint.address for i in v2] == ["172.16.5.5"]
+
+    host = reg.host_instances({"10.1.1.1"})
+    assert len(host) == 1 and host[0].labels == {"version": "v1"}
+
+
+def test_consul_monitor_fires_on_change(consul_pair):
+    fake, reg = consul_pair
+    events = []
+    reg.append_service_handler(lambda svc, ev: events.append((svc.hostname, ev)))
+    reg.start()
+    fake.register("newsvc", address="10.9.9.9", port=80)
+    deadline = time.time() + 3.0
+    while not events and time.time() < deadline:
+        time.sleep(0.02)
+    assert ("newsvc.service.consul", "add") in events
+    fake.deregister("newsvc")
+    deadline = time.time() + 3.0
+    while len(events) < 2 and time.time() < deadline:
+        time.sleep(0.02)
+    assert ("newsvc.service.consul", "delete") in events
+
+
+# ---------------------------------------------------------------------------
+# eureka
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def eureka_pair():
+    fake = eureka.FakeEurekaServer()
+    reg = eureka.EurekaRegistry(fake.url, poll_s=0.05)
+    yield fake, reg
+    reg.stop()
+    fake.close()
+
+
+def test_eureka_conversion_rules(eureka_pair):
+    fake, reg = eureka_pair
+    fake.register("PRODUCTPAGE", hostname="productpage.default",
+                  ip="10.0.0.1", port=9080,
+                  metadata={"istio.protocol": "http2", "version": "v1"})
+    fake.register("PRODUCTPAGE", hostname="productpage.default",
+                  ip="10.0.0.2", port=9080, secure_port=9443,
+                  metadata={"istio.protocol": "http2", "version": "v2"})
+    # DOWN instances are ignored (conversion.go statusUp filter)
+    fake.register("PRODUCTPAGE", hostname="productpage.default",
+                  ip="10.0.0.3", port=9080, status="DOWN")
+
+    svcs = reg.services()
+    assert [s.hostname for s in svcs] == ["productpage.default"]
+    assert sorted(p.port for p in svcs[0].ports) == [9080, 9443]
+    assert svcs[0].ports[0].protocol == "HTTP2"
+
+    insts = reg.instances("productpage.default")
+    # instance 1 exposes one port, instance 2 exposes two
+    assert len(insts) == 3
+    # istio.* metadata keys are NOT labels
+    assert all("istio.protocol" not in i.labels for i in insts)
+    v2 = reg.instances("productpage.default", labels={"version": "v2"})
+    assert sorted(i.endpoint.port for i in v2) == [9080, 9443]
+
+    host = reg.host_instances({"10.0.0.1"})
+    assert len(host) == 1 and host[0].endpoint.port == 9080
+    assert reg.get_service("missing.host") is None
+
+
+def test_eureka_monitor_and_aggregate(eureka_pair):
+    fake, reg = eureka_pair
+    events = []
+    reg.append_service_handler(lambda svc, ev: events.append((svc.hostname, ev)))
+    reg.start()
+    fake.register("DETAILS", hostname="details.default", ip="10.2.0.1",
+                  port=8080)
+    deadline = time.time() + 3.0
+    while not events and time.time() < deadline:
+        time.sleep(0.02)
+    assert ("details.default", "add") in events
+
+    # plugs into the aggregate exactly like kube/memory registries
+    agg = AggregateRegistry([reg])
+    assert [s.hostname for s in agg.services()] == ["details.default"]
+
+
+# ---------------------------------------------------------------------------
+# cloudfoundry
+# ---------------------------------------------------------------------------
+
+def test_cloudfoundry_routes_view():
+    copilot = cloudfoundry.InProcessCopilot()
+    reg = cloudfoundry.CloudFoundryRegistry(copilot)
+    copilot.set_route("app1.apps.internal",
+                      [("10.255.0.1", 61001), ("10.255.0.2", 61002)])
+    copilot.set_route("app2.apps.internal", [("10.255.9.9", 61009)])
+
+    svcs = reg.services()
+    assert [s.hostname for s in svcs] == ["app1.apps.internal",
+                                          "app2.apps.internal"]
+    # CF services expose a single fixed HTTP service port
+    assert all(s.ports[0].port == 8080 and s.ports[0].protocol == "HTTP"
+               for s in svcs)
+
+    insts = reg.instances("app1.apps.internal")
+    assert [(i.endpoint.address, i.endpoint.port) for i in insts] == \
+        [("10.255.0.1", 61001), ("10.255.0.2", 61002)]
+    assert reg.instances("app1.apps.internal", labels={"a": "b"}) == []
+    assert reg.get_service("gone.apps.internal") is None
+
+    host = reg.host_instances({"10.255.9.9"})
+    assert [i.service.hostname for i in host] == ["app2.apps.internal"]
+
+
+def test_cloudfoundry_ticker_events():
+    copilot = cloudfoundry.InProcessCopilot()
+    reg = cloudfoundry.CloudFoundryRegistry(copilot, poll_s=0.05)
+    events = []
+    reg.append_service_handler(lambda svc, ev: events.append((svc.hostname, ev)))
+    reg.start()
+    try:
+        copilot.set_route("new.apps.internal", [("10.255.1.1", 61001)])
+        deadline = time.time() + 3.0
+        while not events and time.time() < deadline:
+            time.sleep(0.02)
+        assert ("new.apps.internal", "add") in events
+        copilot.delete_route("new.apps.internal")
+        deadline = time.time() + 3.0
+        while len(events) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert ("new.apps.internal", "delete") in events
+    finally:
+        reg.stop()
