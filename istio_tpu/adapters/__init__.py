@@ -6,12 +6,13 @@ templates, builder factory, default config), a `Builder` validated and
 built once per distinct (adapter, config) signature, and a `Handler`
 receiving template instances per request.
 
-Inventory parity with the reference's 14 adapters:
-  denier, list, memquota, rbac, noop, stdio, prometheus, statsd,
-  fluentd, opa, kubernetesenv  — implemented
-  circonus, stackdriver, servicecontrol — gated stubs (external SaaS
-  backends; config-validated but Handle* raises AdapterUnavailable,
-  SURVEY.md §7 explicit non-goals for v1)
+Inventory parity with the reference's 14 adapters: denier, list,
+memquota, rbac, noop, stdio, prometheus, statsd, fluentd, opa,
+kubernetesenv, circonus, stackdriver, servicecontrol — all with their
+real processing logic. The three SaaS-backed ones (circonus,
+stackdriver, servicecontrol) implement the full aggregation/translation
+pipelines natively; only the final network hop is an injectable
+`transport` seam (this image has zero egress).
 """
 from istio_tpu.adapters.sdk import (AdapterError, AdapterUnavailable,
                                     Builder, CheckResult, Handler, Info,

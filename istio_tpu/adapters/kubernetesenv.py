@@ -3,11 +3,13 @@
 Reference: mixer/adapter/kubernetesenv (2,613 LoC): a pod-informer
 cache keyed by pod UID/IP fills source/destination workload attributes
 (pod name, namespace, labels, service account, host IP) during
-Preprocess (dispatcher.go:285 → ProcessGenAttrs). This build runs with
-no k8s API server, so the pod cache is a pluggable `PodSource`:
-`StaticPodSource` (dict/YAML-file backed, used by tests and hermetic
-runs) with the informer variant left as an integration seam — the
-attribute-production contract is identical.
+Preprocess (dispatcher.go:285 → ProcessGenAttrs). The pod cache is a
+pluggable `PodSource`: `StaticPodSource` (dict/YAML-file backed) for
+hermetic runs, and `InformerPodSource` — a live list+watch cache over
+the in-process kube API (istio_tpu/kube/fake.py), the analog of the
+reference's cacheController (kubernetesenv/cache.go) — when the
+adapter runs against a cluster. The attribute-production contract is
+identical for both.
 """
 from __future__ import annotations
 
@@ -46,10 +48,86 @@ class StaticPodSource:
             return self._by_ip.get(ip)
 
 
+class InformerPodSource:
+    """Live pod cache over the in-process kube API server.
+
+    kubernetesenv/cache.go's controller role: list+watch Pods, keep
+    uid- and ip-keyed indexes current, and answer lookups from the
+    local cache (never the API server) on the request path. The
+    canonical workload "service" attribute is derived from the `app`
+    label (kubernetesenv's canonical-service resolution order:
+    explicit annotation → app label → pod name prefix).
+    """
+
+    def __init__(self, cluster) -> None:
+        self._lock = threading.Lock()
+        self._pods: dict[str, dict[str, Any]] = {}     # "<name>.<ns>" →
+        self._by_ip: dict[str, dict[str, Any]] = {}
+        self._cluster = cluster
+        cluster.watch("Pod", self._on_event)
+
+    def close(self) -> None:
+        """Deregister from the cluster — handlers are rebuilt per
+        config signature and stale informers must not keep indexing."""
+        self._cluster.unwatch("Pod", self._on_event)
+
+    @staticmethod
+    def _to_entry(obj: Mapping[str, Any]) -> dict[str, Any]:
+        meta = obj.get("metadata") or {}
+        spec = obj.get("spec") or {}
+        status = obj.get("status") or {}
+        labels = dict(meta.get("labels") or {})
+        entry: dict[str, Any] = {
+            "pod_name": str(meta.get("name", "")),
+            "namespace": str(meta.get("namespace", "")),
+            "labels": labels,
+        }
+        if spec.get("serviceAccountName"):
+            entry["service_account_name"] = str(spec["serviceAccountName"])
+        if status.get("podIP"):
+            entry["pod_ip"] = str(status["podIP"])
+        if status.get("hostIP"):
+            entry["host_ip"] = str(status["hostIP"])
+        service = labels.get("app") or str(meta.get("name", ""))
+        if service:
+            entry["service"] = str(service)
+        return entry
+
+    def _on_event(self, ev) -> None:
+        meta = ev.obj.get("metadata") or {}
+        uid = f"{meta.get('name', '')}.{meta.get('namespace', '')}"
+        with self._lock:
+            old = self._pods.pop(uid, None)
+            if old is not None and "pod_ip" in old:
+                self._by_ip.pop(old["pod_ip"], None)
+            if ev.type != "DELETED":
+                entry = self._to_entry(ev.obj)
+                self._pods[uid] = entry
+                if "pod_ip" in entry:
+                    self._by_ip[entry["pod_ip"]] = entry
+
+    def by_uid(self, uid: str) -> Mapping[str, Any] | None:
+        with self._lock:
+            return self._pods.get(uid)
+
+    def by_ip(self, ip: str) -> Mapping[str, Any] | None:
+        with self._lock:
+            return self._by_ip.get(ip)
+
+
 class KubernetesEnvHandler(Handler):
     def __init__(self, config: Mapping[str, Any], env: Env):
-        self.source: StaticPodSource = config.get("pod_source") \
-            or StaticPodSource(config.get("pods", {}))
+        if config.get("pod_source") is not None:
+            self.source = config["pod_source"]
+        elif config.get("cluster") is not None:
+            self.source = InformerPodSource(config["cluster"])
+        else:
+            self.source = StaticPodSource(config.get("pods", {}))
+
+    def close(self) -> None:
+        source_close = getattr(self.source, "close", None)
+        if source_close is not None:
+            source_close()
 
     def generate_attributes(self, template: str,
                             instance: Mapping[str, Any]) -> dict[str, Any]:

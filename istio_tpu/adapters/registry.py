@@ -33,8 +33,9 @@ adapter_registry = AdapterRegistry()
 
 def load_inventory() -> AdapterRegistry:
     """Import every built-in adapter module (each registers itself)."""
-    from istio_tpu.adapters import (denier, fluentd, kubernetesenv,  # noqa
-                                    list_adapter, memquota, noop, opa,
-                                    prometheus_adapter, rbac, statsd,
-                                    stdio, stubs)
+    from istio_tpu.adapters import (circonus, denier, fluentd,  # noqa
+                                    kubernetesenv, list_adapter, memquota,
+                                    noop, opa, prometheus_adapter, rbac,
+                                    servicecontrol, stackdriver, statsd,
+                                    stdio)
     return adapter_registry

@@ -170,6 +170,14 @@ class FakeKubeCluster:
                                                    copy.deepcopy(obj)))
             self._watchers.setdefault(kind, []).append(handler)
 
+    def unwatch(self, kind: str, handler: WatchHandler) -> None:
+        """Deregister a watcher (informer shutdown); unknown handlers
+        are ignored."""
+        with self._lock:
+            handlers = self._watchers.get(kind)
+            if handlers and handler in handlers:
+                handlers.remove(handler)
+
     def _notify(self, event: WatchEvent) -> None:
         for handler in list(self._watchers.get(event.kind, ())):
             self._safe_call(handler, dataclasses.replace(

@@ -91,6 +91,25 @@ KUBERNETES = registry.register(TemplateInfo(
             Field("origin_ip", V.IP_ADDRESS)),
     description="k8s pod metadata attribute generation"))
 
+# mixer/adapter/servicecontrol/template/servicecontrolreport/
+# template.proto:51-65 — the adapter-private report template
+SERVICECONTROLREPORT = registry.register(TemplateInfo(
+    name="servicecontrolreport", variety=Variety.REPORT,
+    fields=(Field("api_version", V.STRING),
+            Field("api_operation", V.STRING),
+            Field("api_protocol", V.STRING),
+            Field("api_service", V.STRING),
+            Field("api_key", V.STRING),
+            Field("request_time", V.TIMESTAMP),
+            Field("request_method", V.STRING),
+            Field("request_path", V.STRING),
+            Field("request_bytes", V.INT64),
+            Field("response_time", V.TIMESTAMP),
+            Field("response_code", V.INT64),
+            Field("response_bytes", V.INT64),
+            Field("response_latency", V.DURATION)),
+    description="Google Service Control API usage report"))
+
 # mixer/template/tracespan/template.proto
 TRACESPAN = registry.register(TemplateInfo(
     name="tracespan", variety=Variety.REPORT,

@@ -37,7 +37,7 @@ def test_inventory_parity():
     assert registry.names() == ["apikey", "authorization", "checknothing",
                                 "kubernetes", "listentry", "logentry",
                                 "metric", "quota", "reportnothing",
-                                "tracespan"]
+                                "servicecontrolreport", "tracespan"]
     assert sorted(adapter_registry.names()) == [
         "circonus", "denier", "fluentd", "kubernetesenv", "list",
         "memquota", "noop", "opa", "prometheus", "rbac",
@@ -265,13 +265,262 @@ def test_kubernetesenv_apa():
     assert out2["destination_namespace"] == "default"
 
 
-def test_saas_stubs_gated():
-    h = _build("stackdriver", {})
-    with pytest.raises(AdapterUnavailable):
-        h.handle_report("metric", [{"name": "m", "value": 1}])
-    # with an injected transport the stub forwards
-    seen = []
-    h2 = _build("servicecontrol",
-                {"transport": lambda k, t, p: seen.append((k, t))})
-    h2.handle_report("metric", [{"name": "m", "value": 1}])
-    assert seen == [("report", "metric")]
+def test_kubernetesenv_informer_source():
+    """InformerPodSource tracks live pod churn on the in-process API
+    server (kubernetesenv/cache.go contract)."""
+    from istio_tpu.kube.fake import FakeKubeCluster
+
+    cluster = FakeKubeCluster()
+    cluster.create({"kind": "Pod",
+                    "metadata": {"name": "reviews-v2-xyz",
+                                 "namespace": "default",
+                                 "labels": {"app": "reviews"}},
+                    "spec": {"serviceAccountName": "sa-reviews"},
+                    "status": {"podIP": "10.0.0.9",
+                               "hostIP": "172.16.0.2"}})
+    h = _build("kubernetesenv", {"cluster": cluster})
+    out = h.generate_attributes("kubernetes", {
+        "source_uid": "kubernetes://reviews-v2-xyz.default"})
+    assert out["source_pod_name"] == "reviews-v2-xyz"
+    assert out["source_service"] == "reviews"
+    assert out["source_host_ip"] == "172.16.0.2"
+    assert out["source_service_account_name"] == "sa-reviews"
+
+    # pod created AFTER the handler: informer picks it up by watch
+    cluster.create({"kind": "Pod",
+                    "metadata": {"name": "ratings-v1-abc",
+                                 "namespace": "prod",
+                                 "labels": {"app": "ratings"}},
+                    "status": {"podIP": "10.0.0.10"}})
+    out2 = h.generate_attributes("kubernetes",
+                                 {"destination_ip": "10.0.0.10"})
+    assert out2["destination_namespace"] == "prod"
+
+    # deletion evicts both indexes
+    cluster.delete("Pod", "prod", "ratings-v1-abc")
+    assert h.generate_attributes(
+        "kubernetes", {"destination_ip": "10.0.0.10"}) == {}
+
+
+def test_circonus_aggregation_and_flush():
+    """circonus.go HandleMetric semantics: counter increments, gauge
+    last-write, distribution histogram bins; flush produces the
+    httptrap payload via the transport seam."""
+    sent = []
+    h = _build("circonus", {
+        "submission_url": "https://trap.example/module/httptrap/x/y",
+        "submission_interval_s": 3600,    # flush manually
+        "metrics": [{"name": "reqs", "type": "counter"},
+                    {"name": "inflight", "type": "gauge"},
+                    {"name": "latency", "type": "distribution"}],
+        "transport": lambda url, payload: sent.append((url, payload))})
+    try:
+        h.handle_report("metric", [
+            {"name": "reqs", "value": 1}, {"name": "reqs", "value": 1},
+            {"name": "inflight", "value": 3}, {"name": "inflight", "value": 7},
+            {"name": "latency", "value": 0.0034},
+            {"name": "latency", "value": 0.0036},
+            {"name": "unconfigured", "value": 9}])
+        h._flush()
+    finally:
+        h.close()
+    url, payload = sent[0]
+    assert url.startswith("https://trap.example")
+    assert payload["reqs"] == {"_type": "L", "_value": 2}
+    assert payload["inflight"] == {"_type": "n", "_value": 7.0}
+    # both samples land in the same log-linear bin H[+34e-04]..H[+36e-04]
+    assert payload["latency"]["_type"] == "h"
+    assert sum(int(s.split("=")[1])
+               for s in payload["latency"]["_value"]) == 2
+    assert "unconfigured" not in payload
+
+
+def test_circonus_validate():
+    info = adapter_registry.get("circonus")
+    b = info.builder({"submission_url": "not a url",
+                      "submission_interval_s": 0.2}, ENV)
+    errs = b.validate()
+    assert any("submission_url" in e for e in errs)
+    assert any("submission_interval_s" in e for e in errs)
+
+
+def test_stackdriver_metrics_merge_and_distribution():
+    """metric.go + merge.go: per-push-window merge of same-series
+    points; DELTA → CUMULATIVE; distribution bucketing with
+    under/overflow (distribution.go)."""
+    sent = []
+    h = _build("stackdriver", {
+        "project_id": "proj-1",
+        "push_interval_s": 3600,
+        "metric_info": {
+            "request_count": {"kind": "DELTA", "value": "INT64"},
+            "inflight": {"kind": "GAUGE", "value": "INT64"},
+            "latency": {"kind": "DELTA", "value": "DISTRIBUTION",
+                        "buckets": {"explicit":
+                                    {"bounds": [0.01, 0.1, 1.0]}}}},
+        "transport": lambda m, batch: sent.append((m, batch))})
+    try:
+        h.handle_report("metric", [
+            {"name": "request_count", "value": 1,
+             "dimensions": {"svc": "web"}},
+            {"name": "request_count", "value": 1,
+             "dimensions": {"svc": "web"}},
+            {"name": "request_count", "value": 1,
+             "dimensions": {"svc": "db"}},
+            {"name": "latency", "value": 0.05, "dimensions": {}},
+            {"name": "latency", "value": 5.0, "dimensions": {}},
+            {"name": "inflight", "value": 3, "dimensions": {}},
+            {"name": "inflight", "value": 7, "dimensions": {}},
+            {"name": "skipped", "value": 1}])
+        h._metrics.flush()
+    finally:
+        h.close()
+    method, batch = sent[0]
+    assert method == "monitoring.createTimeSeries"
+    by_labels = {ts["metric"]["labels"].get("svc"): ts for ts in batch
+                 if ts["metric"]["type"].endswith("request_count")}
+    assert by_labels["web"]["points"][0]["value"]["int64Value"] == 2
+    assert by_labels["db"]["points"][0]["value"]["int64Value"] == 1
+    assert all(ts["metricKind"] == "CUMULATIVE" for ts in batch
+               if not ts["metric"]["type"].endswith("inflight"))
+    # gauge: last write wins, not summed
+    gauge = next(ts for ts in batch
+                 if ts["metric"]["type"].endswith("inflight"))
+    assert gauge["points"][0]["value"]["int64Value"] == 7
+    dist = [ts for ts in batch if ts["metric"]["type"].endswith("latency")]
+    dv = dist[0]["points"][0]["value"]["distributionValue"]
+    # 0.05 → bucket 1 (between 0.01 and 0.1); 5.0 → overflow bucket 3
+    assert dv["count"] == 2 and dv["bucketCounts"] == [0, 1, 0, 1]
+
+
+def test_stackdriver_logs_and_traces():
+    sent = []
+    h = _build("stackdriver", {
+        "project_id": "proj-1", "push_interval_s": 3600,
+        "log_info": {"accesslog": {
+            "payload_template": "{method} {path}",
+            "http_mapping": {"requestMethod": "method",
+                             "status": "code"}}},
+        "transport": lambda m, batch: sent.append((m, batch))})
+    try:
+        h.handle_report("logentry", [
+            {"name": "accesslog", "severity": "warning",
+             "variables": {"method": "GET", "path": "/x", "code": 200}}])
+        h.handle_report("tracespan", [
+            {"trace_id": "t1", "span_id": "s1", "span_name": "op",
+             "span_tags": {"k": "v"}}])
+        h._logs.flush(); h._traces.flush()
+    finally:
+        h.close()
+    logs = dict(sent)["logging.writeLogEntries"]
+    assert logs[0]["severity"] == "WARNING"
+    assert logs[0]["textPayload"] == "GET /x"
+    assert logs[0]["httpRequest"] == {"requestMethod": "GET",
+                                      "status": 200}
+    spans = dict(sent)["cloudtrace.batchWriteSpans"]
+    assert spans[0]["displayName"] == "op"
+    assert "traces/t1/spans/s1" in spans[0]["name"]
+
+
+SC_CONFIG = {
+    "service_configs": [{"mesh_service_name": "svc.default",
+                         "google_service_name": "api.example.com",
+                         "quotas": [{"name": "ratelimit",
+                                     "expiration_s": 10}]}],
+    "runtime_config": {"check_result_expiration_s": 30}}
+
+
+def test_servicecontrol_check_cache_and_errors():
+    """checkprocessor.go: empty key → INVALID_ARGUMENT; responses
+    cached; CheckError code mapping."""
+    calls = []
+
+    def transport(method, service, payload):
+        calls.append((method, service))
+        if payload["operation"]["consumerId"].endswith("bad"):
+            return {"checkErrors": [{"code": "API_KEY_INVALID",
+                                     "detail": "nope"}]}
+        return {}
+
+    h = _build("servicecontrol", {**SC_CONFIG, "transport": transport})
+    missing = h.handle_check("apikey", {"api_key": "", "api_operation": "op"})
+    assert missing.status_code == 3           # INVALID_ARGUMENT
+    ok = h.handle_check("apikey", {"api_key": "k1", "api_operation": "op"})
+    assert ok.ok and ok.valid_duration_s == 30
+    again = h.handle_check("apikey", {"api_key": "k1", "api_operation": "op"})
+    assert again.ok and len(calls) == 1       # served from cache
+    bad = h.handle_check("apikey", {"api_key": "bad", "api_operation": "op"})
+    assert bad.status_code == 3 and "API_KEY_INVALID" in bad.status_message
+    # no transport → fail closed, not crash
+    h2 = _build("servicecontrol", SC_CONFIG)
+    gated = h2.handle_check("apikey", {"api_key": "k", "api_operation": "op"})
+    assert gated.status_code == 14            # UNAVAILABLE
+
+
+def test_servicecontrol_report_operation():
+    """reportbuilder.go: metric value sets from the supported-metric
+    table + endpoints_log entry."""
+    from istio_tpu.adapters.servicecontrol import build_operation
+    op = build_operation({
+        # servicecontrolreport template field names (builtin.py)
+        "api_operation": "ListShelves", "api_key": "k1",
+        "api_protocol": "http", "response_code": 403,
+        "request_time": 1_700_000_000.0, "response_time": 1_700_000_000.25,
+        "response_latency": datetime.timedelta(milliseconds=250),
+        "request_bytes": 300,
+        "request_method": "GET", "request_path": "/shelves"})
+    names = {m["metricName"] for m in op["metricValueSets"]}
+    assert "serviceruntime.googleapis.com/api/producer/request_count" \
+        in names
+    assert ("serviceruntime.googleapis.com/api/consumer/request_count"
+            in names)                          # api_key present
+    count = next(m for m in op["metricValueSets"]
+                 if m["metricName"].endswith("producer/request_count"))
+    labels = count["metricValues"][0]["labels"]
+    assert labels["/response_code"] == "403"
+    assert labels["/response_code_class"] == "4xx"
+    latencies = next(m for m in op["metricValueSets"]
+                     if m["metricName"].endswith("producer/"
+                                                 "backend_latencies"))
+    assert latencies["metricValues"][0]["distributionValue"]["count"] == 1
+    log = op["logEntries"][0]
+    assert log["severity"] == "ERROR"
+    assert log["structPayload"]["error_cause"] == "AUTH"
+    assert log["structPayload"]["url"] == "/shelves"
+    assert log["structPayload"]["http_method"] == "GET"
+    assert log["structPayload"]["request_latency_in_ms"] == 250
+    assert op["consumerId"] == "api_key:k1"
+
+
+def test_servicecontrol_quota():
+    """quotaprocessor.go: allocate request shape + granted amount from
+    the allocation-result metric; exhaustion → RESOURCE_EXHAUSTED."""
+    requests = []
+
+    def transport(method, service, payload):
+        requests.append((method, payload))
+        op = payload["allocateOperation"]
+        if op["consumerId"].endswith("poor"):
+            return {"allocateErrors": [{"code": "RESOURCE_EXHAUSTED",
+                                        "detail": "out"}]}
+        return {"quotaMetrics": [{
+            "metricName": ("serviceruntime.googleapis.com/api/consumer/"
+                           "quota_used_count"),
+            "metricValues": [{"labels": {"/quota_name": "ratelimit"},
+                              "int64Value": 5}]}]}
+
+    h = _build("servicecontrol", {**SC_CONFIG, "transport": transport})
+    inst = {"name": "ratelimit",
+            "dimensions": {"api_key": "k1", "api_operation": "op"}}
+    res = h.handle_quota("quota", inst, QuotaArgs(quota_amount=10))
+    assert res.granted_amount == 5 and res.valid_duration_s == 10
+    assert requests[0][1]["allocateOperation"]["quotaMode"] == "BEST_EFFORT"
+    poor = {"name": "ratelimit",
+            "dimensions": {"api_key": "poor", "api_operation": "op"}}
+    denied = h.handle_quota("quota", poor,
+                            QuotaArgs(quota_amount=10, best_effort=False))
+    assert denied.granted_amount == 0
+    assert denied.status_code == RESOURCE_EXHAUSTED
+    unknown = h.handle_quota("quota", {"name": "nope", "dimensions": {}},
+                             QuotaArgs())
+    assert unknown.status_code == 3
