@@ -273,11 +273,20 @@ def cmd_istio_ca(args: argparse.Namespace) -> int:
             address=f"{args.address}:{args.port}", insecure_port=True)
     else:
         # onprem flow: callers present an existing cert signed by this
-        # root; they may renew only their own SPIFFE identity
-        from istio_tpu.security.ca_service import cert_authenticator
+        # root; they may renew only their own SPIFFE identity. With
+        # --trusted-tokens-file, gcp/aws bearer credentials map to
+        # identities from the operator-provisioned token table.
+        import json as _json
+        from istio_tpu.security.ca_service import (
+            cert_authenticator, composite_authenticator,
+            token_authenticator)
+        authenticator = cert_authenticator(ca.get_root_certificate())
+        if args.trusted_tokens_file:
+            with open(args.trusted_tokens_file) as f:
+                authenticator = composite_authenticator(
+                    authenticator, token_authenticator(_json.load(f)))
         server = CAGrpcServer(
-            ca, authenticator=cert_authenticator(
-                ca.get_root_certificate()),
+            ca, authenticator=authenticator,
             address=f"{args.address}:{args.port}")
     port = server.start()
     print(f"istio_ca: CSR service on {args.address}:{port}")
@@ -287,23 +296,71 @@ def cmd_istio_ca(args: argparse.Namespace) -> int:
 
 
 def cmd_node_agent(args: argparse.Namespace) -> int:
-    """node_agent (security/cmd/node_agent)."""
+    """node_agent (security/cmd/node_agent): the bootstrap credential
+    comes from a platform fetcher (security/pkg/platform client.go)."""
     import os
     from istio_tpu.security.ca_service import CAClient, NodeAgent
+    from istio_tpu.security.workload import (SecretConfig,
+                                             SecretFileServer)
     os.makedirs(args.cert_dir, exist_ok=True)
+    sink = SecretFileServer(SecretConfig(
+        service_identity_cert_file=os.path.join(args.cert_dir,
+                                                "cert-chain.pem"),
+        service_identity_private_key_file=os.path.join(args.cert_dir,
+                                                       "key.pem")))
 
     def write_certs(key_pem: bytes, cert_pem: bytes, root_pem: bytes):
-        for fname, blob in (("key.pem", key_pem),
-                            ("cert-chain.pem", cert_pem),
-                            ("root-cert.pem", root_pem)):
-            with open(os.path.join(args.cert_dir, fname), "wb") as f:
-                f.write(blob)
+        sink.set_service_identity_private_key(key_pem)
+        sink.set_service_identity_cert(cert_pem)
+        with open(os.path.join(args.cert_dir, "root-cert.pem"),
+                  "wb") as f:
+            f.write(root_pem)
 
     root_pem = None
     credential = b""
-    cred_type = "onprem"
-    if not args.insecure_ca and not (args.root_cert and
-                                     args.bootstrap_cert):
+    cred_type = args.platform
+    if args.platform != "onprem":
+        # gcp/aws need a metadata endpoint; hermetic runs inject one
+        # from a JSON path→value file
+        import json as _json
+        from istio_tpu.security.platform import (PlatformError,
+                                                 new_platform_client)
+
+        class _FileMetadata:
+            def __init__(self, path: str):
+                with open(path) as f:
+                    self._data = _json.load(f)
+
+            def available(self) -> bool:
+                return True
+
+            def fetch(self, path: str, audience: str = "") -> str:
+                value = self._data.get(path, "")
+                if isinstance(value, str):
+                    return value
+                return _json.dumps(value)   # nested docs stay valid JSON
+
+        if not args.platform_metadata_file:
+            print("node_agent: --platform-metadata-file is required for "
+                  f"platform {args.platform} (no metadata service here)")
+            return 2
+        if not args.root_cert and not args.insecure_ca:
+            print("node_agent: --root-cert is required (the bearer "
+                  "credential must not travel in cleartext); pass "
+                  "--insecure-ca only against a test CA")
+            return 2
+        try:
+            pc = new_platform_client(args.platform, {
+                "ca_addr": args.ca_address,
+                "metadata": _FileMetadata(args.platform_metadata_file),
+                "root_ca_cert_file": args.root_cert})
+            credential = pc.get_agent_credential()
+            cred_type = pc.get_credential_type()
+        except (OSError, ValueError, PlatformError) as exc:
+            print(f"node_agent: platform credential fetch failed: {exc}")
+            return 2
+    elif not args.insecure_ca and not (args.root_cert and
+                                       args.bootstrap_cert):
         print("node_agent: --root-cert and --bootstrap-cert are required"
               " (the CA serves TLS and authenticates onprem credentials);"
               " pass --insecure-ca only against a test CA running with"
@@ -312,7 +369,7 @@ def cmd_node_agent(args: argparse.Namespace) -> int:
     if args.root_cert:
         with open(args.root_cert, "rb") as f:
             root_pem = f.read()
-    if args.bootstrap_cert:
+    if args.bootstrap_cert and not credential:
         with open(args.bootstrap_cert, "rb") as f:
             credential = f.read()
     client = CAClient(args.ca_address, root_cert_pem=root_pem)
@@ -411,6 +468,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persist the self-signed root here")
     s.add_argument("--insecure-allow-all", action="store_true",
                    help="TEST ONLY: plaintext port, no authn/authz")
+    s.add_argument("--trusted-tokens-file", default="",
+                   help="JSON token→identity map for gcp/aws bearer "
+                        "credentials")
     s.set_defaults(fn=cmd_istio_ca)
 
     s = sub.add_parser("node-agent", help="workload cert rotation")
@@ -424,6 +484,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="existing cert presented as the onprem credential")
     s.add_argument("--insecure-ca", action="store_true",
                    help="TEST ONLY: plaintext CA without credentials")
+    s.add_argument("--platform", default="onprem",
+                   choices=("onprem", "gcp", "aws"),
+                   help="bootstrap credential fetcher")
+    s.add_argument("--platform-metadata-file", default="",
+                   help="JSON path→value metadata fixture for gcp/aws")
     s.set_defaults(fn=cmd_node_agent)
 
     s = sub.add_parser("brks", help="OSB broker")

@@ -10,7 +10,15 @@ from istio_tpu.security.spiffe import (identity_from_san, spiffe_id,
 from istio_tpu.security.pki import (generate_csr, generate_key,
                                     key_cert_pair_ok, load_cert, san_uris)
 from istio_tpu.security.ca import CertificateAuthority, IstioCA
+from istio_tpu.security.platform import (DialOptions, new_platform_client,
+                                         PlatformError)
+from istio_tpu.security.workload import (FlexVolumeDriver, SecretConfig,
+                                         SecretFileServer,
+                                         new_secret_server)
 
 __all__ = ["identity_from_san", "spiffe_id", "parse_spiffe",
            "generate_csr", "generate_key", "key_cert_pair_ok",
-           "load_cert", "san_uris", "CertificateAuthority", "IstioCA"]
+           "load_cert", "san_uris", "CertificateAuthority", "IstioCA",
+           "DialOptions", "new_platform_client", "PlatformError",
+           "FlexVolumeDriver", "SecretConfig", "SecretFileServer",
+           "new_secret_server"]

@@ -60,6 +60,36 @@ def cert_authenticator(root_cert_pem: bytes) -> Authenticator:
     return auth
 
 
+def token_authenticator(tokens: "Mapping[str, str]",
+                        cred_types: tuple[str, ...] = ("gcp", "aws")
+                        ) -> Authenticator:
+    """Bearer-token platform flows (security/pkg/platform/gcp.go,
+    aws.go): the credential is an opaque token the CA operator trusts —
+    a GCE service-account JWT or a signed EC2 identity document. The
+    reference validates these against the cloud provider; with no
+    egress here, the operator provisions the trusted token → identity
+    map directly (istio-ca --trusted-tokens-file)."""
+    token_map = {str(k): str(v) for k, v in tokens.items()}
+
+    def auth(cred_type: str, cred: bytes) -> str | None:
+        if cred_type not in cred_types:
+            return None
+        return token_map.get(cred.decode("utf-8", "replace"))
+    return auth
+
+
+def composite_authenticator(*auths: Authenticator) -> Authenticator:
+    """First authenticator to produce an identity wins (the reference
+    CA chains client-cert and platform authenticators the same way)."""
+    def auth(cred_type: str, cred: bytes) -> str | None:
+        for candidate in auths:
+            identity = candidate(cred_type, cred)
+            if identity is not None:
+                return identity
+        return None
+    return auth
+
+
 def same_id_authorizer(caller: str, requested: list[str]) -> str | None:
     """Default: a workload may only request certificates for its own
     SPIFFE identity (the reference's per-caller authorization contract,

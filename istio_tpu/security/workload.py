@@ -1,0 +1,195 @@
+"""Workload secret delivery — SecretServer + flexvolume-style mounts.
+
+Reference: security/pkg/workload — `SecretServer` (secretserver.go)
+delivers a workload's identity key/cert over a channel the workload
+can reach: SECRET_FILE mode writes the pair to configured paths with
+0600/0644 permissions (secretfileserver.go); WORKLOAD_API is
+unimplemented in the reference too. The node_agent_k8s flexvolume
+driver (security/cmd/node_agent_k8s/flexvolume/driver/driver.go)
+bridges kubelet to the node agent: Mount(dir, opts) parses the pod's
+uid/name/namespace/serviceAccount from the driver options, provisions
+a per-workload directory under the node-agent home, and binds it into
+the pod; Unmount tears it down.
+
+Here the tmpfs/bind-mount pair is a `mounter` seam (real mounts need
+privileges this build does not assume); the per-workload directory
+lifecycle, the driver's JSON response protocol, and the option
+validation are faithful.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+from pathlib import Path
+from typing import Any, Callable, Mapping
+
+SECRET_FILE = 0
+WORKLOAD_API = 1         # unimplemented, matching the reference
+
+_KEY_MODE = 0o600
+_CERT_MODE = 0o644
+
+
+class WorkloadError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class SecretConfig:
+    """workload/config.go Config."""
+    mode: int = SECRET_FILE
+    service_identity_cert_file: str = ""
+    service_identity_private_key_file: str = ""
+
+
+class SecretServer:
+    """secretserver.go SecretServer interface."""
+
+    def set_service_identity_private_key(self, content: bytes) -> None:
+        raise NotImplementedError
+
+    def set_service_identity_cert(self, content: bytes) -> None:
+        raise NotImplementedError
+
+
+class SecretFileServer(SecretServer):
+    """secretfileserver.go: atomic writes with key 0600 / cert 0644."""
+
+    def __init__(self, config: SecretConfig):
+        self.config = config
+
+    @staticmethod
+    def _write(path: str, content: bytes, mode: int) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        tmp = p.with_suffix(p.suffix + ".tmp")
+        tmp.write_bytes(content)
+        os.chmod(tmp, mode)
+        os.replace(tmp, p)
+
+    def set_service_identity_private_key(self, content: bytes) -> None:
+        self._write(self.config.service_identity_private_key_file,
+                    content, _KEY_MODE)
+
+    def set_service_identity_cert(self, content: bytes) -> None:
+        self._write(self.config.service_identity_cert_file,
+                    content, _CERT_MODE)
+
+
+def new_secret_server(config: SecretConfig) -> SecretServer:
+    """secretserver.go NewSecretServer."""
+    if config.mode == SECRET_FILE:
+        return SecretFileServer(config)
+    if config.mode == WORKLOAD_API:
+        raise WorkloadError("WORKLOAD API is unimplemented")
+    raise WorkloadError(f"mode: {config.mode} is not supported")
+
+
+# ---------------------------------------------------------------------------
+# flexvolume driver (node_agent_k8s/flexvolume/driver/driver.go)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadAttrs:
+    """WorkloadInfo_WorkloadAttributes: who the mount is for."""
+    uid: str
+    workload: str
+    namespace: str
+    service_account: str
+
+
+def parse_mount_opts(opts: str) -> WorkloadAttrs | None:
+    """driver.go checkValidMountOpts: the kubelet passes pod identity
+    as JSON driver options."""
+    try:
+        data = json.loads(opts)
+    except (TypeError, ValueError):
+        return None
+    uid = data.get("kubernetes.io/pod.uid", "")
+    name = data.get("kubernetes.io/pod.name", "")
+    ns = data.get("kubernetes.io/pod.namespace", "")
+    sa = data.get("kubernetes.io/serviceAccount.name", "")
+    if not (uid and name and ns):
+        return None
+    return WorkloadAttrs(uid=uid, workload=name, namespace=ns,
+                         service_account=sa)
+
+
+class FlexVolumeDriver:
+    """The driver's verb surface, each returning the kubelet JSON
+    response shape (driver.go Resp). `mounter(src, dst)` /
+    `unmounter(dst)` inject the privileged tmpfs+bind step; the
+    default copies nothing and relies on the shared directory tree
+    (sufficient for hermetic runs)."""
+
+    def __init__(self, nodeagent_home: str = "/tmp/nodeagent",
+                 mounter: Callable[[str, str], None] | None = None,
+                 unmounter: Callable[[str], None] | None = None):
+        self.home = Path(nodeagent_home)
+        self.mounter = mounter
+        self.unmounter = unmounter
+        # uid → attrs, the node agent's view of live workloads
+        self.workloads: dict[str, WorkloadAttrs] = {}
+
+    @staticmethod
+    def _resp(status: str, message: str, **extra: Any) -> dict:
+        return {"status": status, "message": message, **extra}
+
+    def init(self) -> dict:
+        return self._resp("Success", "Init ok.", attach=False)
+
+    def mount(self, target_dir: str, opts: str) -> dict:
+        attrs = parse_mount_opts(opts)
+        if attrs is None:
+            return self._resp(
+                "Failure",
+                f"Mount failed with dir {target_dir} with incomplete "
+                "inputs")
+        workload_dir = self.home / attrs.uid
+        try:
+            workload_dir.mkdir(parents=True, exist_ok=True)
+            if self.mounter is not None:
+                self.mounter(str(workload_dir),
+                             str(Path(target_dir) / "nodeagent"))
+            (workload_dir / "attrs.json").write_text(json.dumps(
+                dataclasses.asdict(attrs), sort_keys=True))
+        except Exception as exc:
+            shutil.rmtree(workload_dir, ignore_errors=True)
+            return self._resp(
+                "Failure",
+                f"Mount failed with dir {target_dir} with error: {exc}")
+        self.workloads[attrs.uid] = attrs
+        return self._resp("Success", f"Mount ok: {target_dir}")
+
+    def unmount(self, target_dir: str) -> dict:
+        # driver.go Unmount: the pod uid is a fixed path component of
+        # the kubelet's mount dir
+        parts = Path(target_dir).parts
+        if len(parts) < 6:
+            return self._resp("Failure",
+                              f"Unmount failed with dir {target_dir}.")
+        uid = parts[5]
+        if self.unmounter is not None:
+            try:
+                self.unmounter(str(Path(target_dir) / "nodeagent"))
+                self.unmounter(target_dir)
+            except Exception as exc:
+                return self._resp(
+                    "Failure",
+                    f"Unmount failed with dir {target_dir}: {exc}")
+        shutil.rmtree(self.home / uid, ignore_errors=True)
+        self.workloads.pop(uid, None)
+        return self._resp("Success", f"Unmount ok: {target_dir}")
+
+    def secret_server_for(self, uid: str) -> SecretServer:
+        """The node agent drops the rotated pair into the workload's
+        provisioned directory (node_agent_k8s handler role)."""
+        if uid not in self.workloads:
+            raise WorkloadError(f"unknown workload uid {uid}")
+        base = self.home / uid
+        return SecretFileServer(SecretConfig(
+            mode=SECRET_FILE,
+            service_identity_cert_file=str(base / "cert-chain.pem"),
+            service_identity_private_key_file=str(base / "key.pem")))

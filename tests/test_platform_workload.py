@@ -1,0 +1,247 @@
+"""Platform credential fetchers + workload secret delivery.
+
+Mirrors security/pkg/platform/{onprem,gcp,aws}_test.go and the
+flexvolume driver tests (security/cmd/node_agent_k8s)."""
+import base64
+import json
+import stat
+
+import pytest
+
+from istio_tpu.security import pki
+from istio_tpu.security.ca import IstioCA
+from istio_tpu.security.platform import (AwsClient, GcpClient,
+                                         OnPremClient, PlatformError,
+                                         new_platform_client)
+from istio_tpu.security.workload import (SECRET_FILE, WORKLOAD_API,
+                                         FlexVolumeDriver, SecretConfig,
+                                         SecretFileServer, WorkloadError,
+                                         new_secret_server,
+                                         parse_mount_opts)
+
+
+class FakeMetadata:
+    def __init__(self, data, up=True):
+        self.data = dict(data)
+        self.up = up
+        self.audiences = []
+
+    def available(self):
+        return self.up
+
+    def fetch(self, path, audience=""):
+        if audience:
+            self.audiences.append(audience)
+        return self.data.get(path, "")
+
+
+# ---------------------------------------------------------------- onprem
+
+def _workload_cert(tmp_path, identity="spiffe://cluster.local/ns/d/sa/x"):
+    ca = IstioCA.new_self_signed()
+    key = pki.generate_key()
+    csr = pki.generate_csr(key, identity)
+    cert = ca.sign(csr)
+    root = tmp_path / "root.pem"
+    root.write_bytes(ca.get_root_certificate())
+    kf = tmp_path / "key.pem"
+    kf.write_bytes(pki.key_to_pem(key))
+    cf = tmp_path / "cert.pem"
+    cf.write_bytes(cert)
+    return str(root), str(kf), str(cf)
+
+
+def test_onprem_client(tmp_path):
+    root, key, cert = _workload_cert(tmp_path)
+    c = OnPremClient(root, key, cert)
+    assert c.is_proper_platform()
+    assert c.get_credential_type() == "onprem"
+    # identity comes from the cert's single SPIFFE SAN (onprem.go)
+    assert c.get_service_identity() == "spiffe://cluster.local/ns/d/sa/x"
+    assert c.get_agent_credential().startswith(b"-----BEGIN CERTIFICATE")
+    opts = c.get_dial_options()
+    assert opts.secure and opts.client_key_pem and opts.client_cert_pem
+
+
+def test_onprem_client_missing_files(tmp_path):
+    c = OnPremClient(str(tmp_path / "no.pem"), str(tmp_path / "no.key"),
+                     str(tmp_path / "no.crt"))
+    with pytest.raises(PlatformError):
+        c.get_agent_credential()
+    with pytest.raises(PlatformError):
+        c.get_dial_options()
+
+
+# ---------------------------------------------------------------- gcp
+
+def test_gcp_client():
+    md = FakeMetadata({
+        GcpClient.TOKEN_PATH: "jwt-token-abc",
+        GcpClient.SA_PATH: "svc@proj.iam.gserviceaccount.com"})
+    c = GcpClient("ca.example:8060", md)
+    assert c.is_proper_platform()
+    assert c.get_credential_type() == "gcp"
+    assert c.get_agent_credential() == b"jwt-token-abc"
+    # audience is the CA address (gcp.go NewGcpClientImpl)
+    assert md.audiences[-1] == "grpc://ca.example:8060"
+    assert c.get_service_identity() == ("spiffe://cluster.local/ns/"
+                                        "default/sa/"
+                                        "svc@proj.iam.gserviceaccount.com")
+    assert c.get_dial_options().bearer_token == "jwt-token-abc"
+
+
+def test_gcp_client_not_on_gce():
+    md = FakeMetadata({}, up=False)
+    c = GcpClient("ca:1", md)
+    assert not c.is_proper_platform()
+    with pytest.raises(PlatformError):
+        c.get_agent_credential()
+
+
+# ---------------------------------------------------------------- aws
+
+def test_aws_client_identity_document():
+    doc = {"instanceId": "i-0abc", "region": "us-west-2",
+           "accountId": "123"}
+    sig = base64.b64encode(b"pkcs7-blob").decode()
+    md = FakeMetadata({AwsClient.DOC_PATH: json.dumps(doc),
+                       AwsClient.SIG_PATH: sig})
+    seen = []
+    c = AwsClient(md, verify=lambda d, s: seen.append((d, s)) or True)
+    assert c.is_proper_platform()
+    cred = json.loads(c.get_agent_credential())
+    assert cred["document"]["instanceId"] == "i-0abc"
+    assert seen, "verify() must run before the credential is used"
+    assert c.get_service_identity() == ""     # resolved server-side
+    assert c.get_credential_type() == "aws"
+
+
+def test_aws_client_rejects_bad_signature():
+    md = FakeMetadata({AwsClient.DOC_PATH: "{}",
+                       AwsClient.SIG_PATH:
+                       base64.b64encode(b"x").decode()})
+    c = AwsClient(md, verify=lambda d, s: False)
+    with pytest.raises(PlatformError):
+        c.get_agent_credential()
+    md2 = FakeMetadata({AwsClient.DOC_PATH: "{}",
+                        AwsClient.SIG_PATH: "!!! not base64 !!!"})
+    with pytest.raises(PlatformError):
+        AwsClient(md2).get_agent_credential()
+
+
+def test_new_platform_client_factory(tmp_path):
+    root, key, cert = _workload_cert(tmp_path)
+    assert isinstance(new_platform_client("onprem", {
+        "root_ca_cert_file": root, "key_file": key,
+        "cert_chain_file": cert}), OnPremClient)
+    assert isinstance(new_platform_client("gcp", {
+        "ca_addr": "x", "metadata": FakeMetadata({})}), GcpClient)
+    assert isinstance(new_platform_client("aws", {
+        "metadata": FakeMetadata({})}), AwsClient)
+    with pytest.raises(PlatformError):
+        new_platform_client("azure", {})
+
+
+def test_gcp_credential_signs_via_token_authenticator():
+    """A gcp bearer credential must be able to obtain a cert from the
+    secure CA: the operator provisions a trusted token→identity map
+    (token_authenticator), composed with the onprem cert path."""
+    from istio_tpu.security.ca_service import (CAClient, CAGrpcServer,
+                                               NodeAgent,
+                                               cert_authenticator,
+                                               composite_authenticator,
+                                               token_authenticator)
+    ca = IstioCA.new_self_signed()
+    ident = "spiffe://cluster.local/ns/default/sa/gce-sa"
+    auth = composite_authenticator(
+        cert_authenticator(ca.get_root_certificate()),
+        token_authenticator({"jwt-token-abc": ident}))
+    server = CAGrpcServer(ca, authenticator=auth)
+    port = server.start()
+    try:
+        md = FakeMetadata({GcpClient.TOKEN_PATH: "jwt-token-abc",
+                           GcpClient.SA_PATH: "gce-sa"})
+        pc = GcpClient(f"127.0.0.1:{port}", md)
+        client = CAClient(f"127.0.0.1:{port}",
+                          root_cert_pem=ca.get_root_certificate())
+        got = {}
+        agent = NodeAgent(client, ident,
+                          lambda k, c, r: got.update(key=k, cert=c),
+                          credential=pc.get_agent_credential(),
+                          credential_type=pc.get_credential_type())
+        agent.rotate_once()
+        assert pki.key_cert_pair_ok(got["key"], got["cert"])
+        assert pki.verify_chain(got["cert"], ca.get_root_certificate())
+        # an untrusted token is rejected
+        bad = NodeAgent(client, ident, lambda *a: None,
+                        credential=b"forged", credential_type="gcp")
+        with pytest.raises(RuntimeError):
+            bad.rotate_once()
+        client.close()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------- workload
+
+def test_secret_file_server_modes(tmp_path):
+    cfg = SecretConfig(
+        mode=SECRET_FILE,
+        service_identity_cert_file=str(tmp_path / "sub" / "cert.pem"),
+        service_identity_private_key_file=str(tmp_path / "sub" / "key.pem"))
+    server = new_secret_server(cfg)
+    assert isinstance(server, SecretFileServer)
+    server.set_service_identity_private_key(b"KEY")
+    server.set_service_identity_cert(b"CERT")
+    key_path = tmp_path / "sub" / "key.pem"
+    cert_path = tmp_path / "sub" / "cert.pem"
+    assert key_path.read_bytes() == b"KEY"
+    assert cert_path.read_bytes() == b"CERT"
+    # secretfileserver.go: key 0600, cert 0644
+    assert stat.S_IMODE(key_path.stat().st_mode) == 0o600
+    assert stat.S_IMODE(cert_path.stat().st_mode) == 0o644
+    with pytest.raises(WorkloadError):
+        new_secret_server(SecretConfig(mode=WORKLOAD_API))
+    with pytest.raises(WorkloadError):
+        new_secret_server(SecretConfig(mode=42))
+
+
+def test_flexvolume_mount_lifecycle(tmp_path):
+    drv = FlexVolumeDriver(nodeagent_home=str(tmp_path / "nodeagent"))
+    assert drv.init()["status"] == "Success"
+
+    opts = json.dumps({"kubernetes.io/pod.uid": "uid-1",
+                       "kubernetes.io/pod.name": "web-1",
+                       "kubernetes.io/pod.namespace": "default",
+                       "kubernetes.io/serviceAccount.name": "sa-web"})
+    kubelet_dir = ("/var/lib/kubelet/pods/uid-1/volumes/"
+                   "istio~flexvolume/creds")
+    resp = drv.mount(kubelet_dir, opts)
+    assert resp["status"] == "Success", resp
+    attrs = drv.workloads["uid-1"]
+    assert attrs.workload == "web-1" and attrs.service_account == "sa-web"
+    assert (tmp_path / "nodeagent" / "uid-1" / "attrs.json").exists()
+
+    # the node agent delivers rotated credentials into the mount
+    sink = drv.secret_server_for("uid-1")
+    sink.set_service_identity_private_key(b"K")
+    sink.set_service_identity_cert(b"C")
+    assert (tmp_path / "nodeagent" / "uid-1" / "key.pem").read_bytes() \
+        == b"K"
+
+    # unmount (pod uid parsed from the kubelet path, driver.go Unmount)
+    resp = drv.unmount(kubelet_dir)
+    assert resp["status"] == "Success"
+    assert "uid-1" not in drv.workloads
+    assert not (tmp_path / "nodeagent" / "uid-1").exists()
+    with pytest.raises(WorkloadError):
+        drv.secret_server_for("uid-1")
+
+
+def test_flexvolume_bad_inputs(tmp_path):
+    drv = FlexVolumeDriver(nodeagent_home=str(tmp_path))
+    assert drv.mount("/x", "not json")["status"] == "Failure"
+    assert drv.mount("/x", json.dumps({
+        "kubernetes.io/pod.name": "p"}))["status"] == "Failure"
+    assert drv.unmount("/too/short")["status"] == "Failure"
+    assert parse_mount_opts("{}") is None
