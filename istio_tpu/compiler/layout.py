@@ -61,10 +61,45 @@ def _normalize(value: Any) -> tuple[str, Hashable]:
     raise TypeError(f"cannot intern value of type {type(value)}")
 
 
+def canonical_bytes(norm: tuple[str, Hashable]) -> bytes:
+    """_normalize key → canonical byte encoding (shared with the C++
+    shim's intern `Key`; shim.cpp builds the identical bytes)."""
+    import struct
+    tag, v = norm
+    t = tag.encode()
+    if tag == "b":
+        return t + (b"\x01" if v else b"\x00")
+    if tag in ("i", "D", "t"):
+        return t + struct.pack("<q", int(v))
+    if tag == "d":
+        return t + struct.pack("<d", float(v))
+    if tag == "s":
+        return t + str(v).encode("utf-8")
+    if tag == "p":
+        return t + bytes(v)
+    raise ValueError(f"unknown intern tag {tag}")
+
+
+def stable_hash31(value: Any) -> int:
+    """Content-stable 31-bit hash of a value (FNV-1a over the canonical
+    key bytes — the shim computes the identical function). Used for
+    quota bucketing: unlike intern/ephemeral ids it never depends on
+    encounter order or snapshot, so a key maps to the same bucket for
+    the life of the counter window."""
+    h = 0x811C9DC5
+    for b in canonical_bytes(_normalize(value)):
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
 class InternTable:
-    """Grow-only value ↔ int32-id table shared by compile-time constants
-    and the runtime tensorizer. Thread-safe; ids are stable for the life
-    of the table."""
+    """Grow-only value ↔ int32-id table for COMPILE-TIME constants
+    (bounded by config size; shared across snapshots so constant ids
+    stay stable). Runtime-observed values never enter this table — the
+    tensorizer assigns them negative per-batch ephemeral ids
+    (AttributeBatch.ephemeral_values), so a long-running server's
+    memory does not grow with distinct request values. Thread-safe;
+    ids are stable for the life of the table."""
 
     def __init__(self) -> None:
         self._by_key: dict[tuple[str, Hashable], int] = {
@@ -90,6 +125,10 @@ class InternTable:
             return self._by_key.get(key, ID_INVALID)
 
     def value_of(self, idx: int) -> Any:
+        if idx < 0:
+            raise KeyError(
+                f"id {idx} is a per-batch ephemeral id; resolve it via "
+                "AttributeBatch.value_of(id, interner)")
         with self._lock:
             return self._values[idx]
 
@@ -178,14 +217,30 @@ class AttributeBatch:
     map_present: Any
     str_bytes: Any
     str_lens: Any
+    # stable 31-bit content hash per present scalar slot (stable_hash31)
+    # — quota bucketing keys on this, not on ids, because ephemeral ids
+    # vary with encounter order while a quota window outlives batches
+    hash_ids: Any = None
+    # host-only: values behind negative ephemeral ids, index (-1 - id).
+    # Deliberately NOT part of the pytree (neither leaf nor aux): it
+    # must not retrace jits or ride to the device; id -1-k ↔ entry k.
+    ephemeral_values: Any = None
 
     @property
     def batch_size(self) -> int:
         return self.ids.shape[0]
 
+    def value_of(self, vid: int, interner: InternTable) -> Any:
+        """Resolve an id from THIS batch: non-negative ids live in the
+        compile-time intern table, negative ids in the batch's own
+        ephemeral side table."""
+        if vid >= 0:
+            return interner.value_of(vid)
+        return self.ephemeral_values[-1 - vid]
+
     def tree_flatten(self):
         return ((self.ids, self.present, self.map_present,
-                 self.str_bytes, self.str_lens), None)
+                 self.str_bytes, self.str_lens, self.hash_ids), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -209,11 +264,29 @@ class Tensorizer:
         b = len(bags)
         ncol = lay.n_columns
         ids = np.zeros((b, ncol), dtype=np.int32)
+        hash_ids = np.zeros((b, ncol), dtype=np.int32)
         present = np.zeros((b, ncol), dtype=bool)
         map_present = np.zeros((b, max(lay.n_maps, 1)), dtype=bool)
         nbyte = max(lay.n_byte_slots, 1)
         str_bytes = np.zeros((b, nbyte, lay.max_str_len), dtype=np.uint8)
         str_lens = np.zeros((b, nbyte), dtype=np.int32)
+        # values unseen at compile time get negative per-batch ids —
+        # consistent within the batch (slot-vs-slot EQ still works),
+        # never equal to any constant, never retained after the batch
+        eph_ids: dict[tuple[str, Hashable], int] = {}
+        eph_values: list[Any] = []
+
+        def rid(v: Any) -> int:
+            idx = self.interner.lookup(v)
+            if idx != ID_INVALID:
+                return idx
+            key = _normalize(v)
+            neg = eph_ids.get(key)
+            if neg is None:
+                neg = -1 - len(eph_values)
+                eph_ids[key] = neg
+                eph_values.append(v)
+            return neg
 
         for i, bag in enumerate(bags):
             for name, col in lay.slots.items():
@@ -221,7 +294,8 @@ class Tensorizer:
                 if not ok:
                     continue
                 present[i, col] = True
-                ids[i, col] = self.interner.intern(v)
+                ids[i, col] = rid(v)
+                hash_ids[i, col] = stable_hash31(v)
             for name, mcol in lay.map_slots.items():
                 v, ok = bag.get(name)
                 if ok:
@@ -230,7 +304,8 @@ class Tensorizer:
                 m, ok = bag.get(mname)
                 if ok and isinstance(m, Mapping) and key in m:
                     present[i, col] = True
-                    ids[i, col] = self.interner.intern(m[key])
+                    ids[i, col] = rid(m[key])
+                    hash_ids[i, col] = stable_hash31(m[key])
             for src, bcol in lay.byte_slots.items():
                 raw = self._byte_source_value(bag, src)
                 if raw is None:
@@ -242,7 +317,9 @@ class Tensorizer:
 
         return AttributeBatch(ids=ids, present=present,
                               map_present=map_present,
-                              str_bytes=str_bytes, str_lens=str_lens)
+                              str_bytes=str_bytes, str_lens=str_lens,
+                              hash_ids=hash_ids,
+                              ephemeral_values=eph_values)
 
     @staticmethod
     def _byte_source_value(bag: Bag, src: Any) -> str | None:

@@ -419,10 +419,14 @@ class TensorProgram:
     def __call__(self, batch: AttributeBatch) -> tuple[Any, Any]:
         return self.fn(batch)
 
-    def decode_value(self, raw: Any) -> Any:
+    def decode_value(self, raw: Any, batch: AttributeBatch | None = None
+                     ) -> Any:
         if self.result_type == V.BOOL:
             return bool(raw)
-        return self.interner.value_of(int(raw))
+        vid = int(raw)
+        if batch is not None:
+            return batch.value_of(vid, self.interner)
+        return self.interner.value_of(vid)
 
 
 def compile_expression(text: str, finder: AttributeDescriptorFinder,

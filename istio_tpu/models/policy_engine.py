@@ -188,8 +188,10 @@ class PolicyEngine:
         for i, l in enumerate(lists):
             ids = [interner.intern(e) for e in l.entries]
             list_ids[i, :len(ids)] = ids
-            # pad with -1 so absent entries never match a real id
-            list_ids[i, len(ids):] = -1
+            # pad with ID_INVALID: a present slot's id is never 0
+            # (constants ≥ 1, ephemerals ≤ -1), and absent slots are
+            # masked by `present`, so padding can never match
+            list_ids[i, len(ids):] = 0
             list_rule[i] = l.rule
             list_slot[i] = self._slot_for(l.value_attr)
             list_black[i] = l.blacklist
@@ -294,12 +296,15 @@ class PolicyEngine:
             status = jnp.where(cand_rule < BIGI, cand_status, OK)
 
             if self._has_quota:
-                # bucket = interned key id mod hash space; fixed window.
-                # Quota is dispatched only when the precondition check
-                # passed (grpcServer.go:188-230 runs the quota loop
-                # after a successful Check) — denied requests must not
-                # consume tokens.
-                key = batch.ids[:, q_slot_j]              # [B, Q]
+                # bucket = stable content hash mod hash space; fixed
+                # window. Uses hash_ids, not ids: ephemeral ids vary
+                # with encounter order while the counter window
+                # persists across batches. Quota is dispatched only
+                # when the precondition check passed
+                # (grpcServer.go:188-230 runs the quota loop after a
+                # successful Check) — denied requests must not consume
+                # tokens.
+                key = batch.hash_ids[:, q_slot_j]         # [B, Q]
                 key_ok = batch.present[:, q_slot_j]
                 q_active = active[:, q_rule_j] & key_ok & \
                     (status == OK)[:, None]               # [B, Q]

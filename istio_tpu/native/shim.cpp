@@ -219,6 +219,19 @@ int32_t shim_intern_count(void* h) {
   return static_cast<Shim*>(h)->next_id_;
 }
 
+// Drop interned entries with id >= keep_count (runtime-observed
+// values); compile-time seeds stay. Bounds a long-running server's
+// intern memory — Python flushes in lockstep with its remap table.
+void shim_flush_interns(void* h, int32_t keep_count) {
+  auto* sh = static_cast<Shim*>(h);
+  if (keep_count < 3 || keep_count >= sh->next_id_) return;
+  for (int32_t id = keep_count; id < sh->next_id_; id++) {
+    sh->interns.erase(sh->intern_order[id - 3]);
+  }
+  sh->intern_order.resize(keep_count - 3);
+  sh->next_id_ = keep_count;
+}
+
 // Export canonical keys for ids in [from_id, next_id): packed as
 // u32 len + bytes per key. Returns bytes written or -needed.
 int64_t shim_export_interns(void* h, int32_t from_id, uint8_t* buf,
@@ -243,9 +256,20 @@ int64_t shim_export_interns(void* h, int32_t from_id, uint8_t* buf,
   return static_cast<int64_t>(need);
 }
 
+// Stable 31-bit content hash of a canonical key (FNV-1a); must match
+// stable_hash31 in compiler/layout.py — quota buckets key on it.
+static int32_t fnv1a31(const Key& k) {
+  uint32_t h = 0x811C9DC5u;
+  for (unsigned char c : k) {
+    h = (h ^ c) * 0x01000193u;
+  }
+  return static_cast<int32_t>(h & 0x7FFFFFFFu);
+}
+
 // Tensorize a batch of serialized CompressedAttributes.
 // Buffers (caller-allocated, zeroed):
 //   ids        int32 [n, n_columns]
+//   hash_ids   int32 [n, n_columns]   stable content hash per slot
 //   present    uint8 [n, n_columns]
 //   map_present uint8 [n, max(n_maps,1)]
 //   str_bytes  uint8 [n, max(n_byte,1), max_str_len]
@@ -253,7 +277,8 @@ int64_t shim_export_interns(void* h, int32_t from_id, uint8_t* buf,
 // Returns 0 on success, <0 on parse error (row index encoded).
 int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
                        const int64_t* msg_lens, int32_t n,
-                       int32_t* ids, uint8_t* present,
+                       int32_t* ids, int32_t* hash_ids,
+                       uint8_t* present,
                        uint8_t* map_present, uint8_t* str_bytes,
                        int32_t* str_lens) {
   auto* sh = static_cast<Shim*>(h);
@@ -271,6 +296,7 @@ int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
       return -(i + 1);
     }
     int32_t* row_ids = ids + i * ncol;
+    int32_t* row_h = hash_ids + i * ncol;
     uint8_t* row_p = present + i * ncol;
     uint8_t* row_mp = map_present + i * nmap;
     uint8_t* row_sb = str_bytes + i * nbyte * slen;
@@ -280,6 +306,7 @@ int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
       auto it = L.scalar_slots.find(name);
       if (it == L.scalar_slots.end()) return;
       row_ids[it->second] = sh->intern(key);
+      row_h[it->second] = fnv1a31(key);
       row_p[it->second] = 1;
     };
     auto set_bytes_slot = [&](int32_t bcol, const std::string& value) {
@@ -310,6 +337,7 @@ int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
       auto it = L.scalar_slots.find(*name);
       if (it == L.scalar_slots.end()) continue;
       row_ids[it->second] = kv.second ? ID_TRUE : ID_FALSE;
+      row_h[it->second] = fnv1a31(key_bool(kv.second));
       row_p[it->second] = 1;
     }
     for (const auto& kv : msg.bytes()) {
@@ -340,6 +368,7 @@ int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
         auto dit = L.derived.find({*mname, *key});
         if (dit != L.derived.end()) {
           row_ids[dit->second] = sh->intern(key_str(*value));
+          row_h[dit->second] = fnv1a31(key_str(*value));
           row_p[dit->second] = 1;
         }
         auto bit = L.byte_pair.find({*mname, *key});

@@ -18,27 +18,14 @@ import numpy as np
 
 from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
 from istio_tpu.compiler.layout import (AttributeBatch, BatchLayout,
-                                       InternTable, _normalize)
+                                       InternTable, _normalize,
+                                       canonical_bytes)
 from istio_tpu.native.build import ensure_built
 
 _MAGIC = 0x49545031
 
 
-def _canonical_key(norm: tuple[str, Any]) -> bytes:
-    """Python _normalize key → the shim's canonical byte key."""
-    tag, v = norm
-    t = tag.encode()
-    if tag == "b":
-        return t + (b"\x01" if v else b"\x00")
-    if tag in ("i", "D", "t"):
-        return t + struct.pack("<q", int(v))
-    if tag == "d":
-        return t + struct.pack("<d", float(v))
-    if tag == "s":
-        return t + str(v).encode("utf-8")
-    if tag == "p":
-        return t + bytes(v)
-    raise ValueError(f"unknown intern tag {tag}")
+_canonical_key = canonical_bytes     # shared canonical encoding
 
 
 def _decode_key(raw: bytes) -> Any:
@@ -118,24 +105,32 @@ class NativeTensorizer:
         lib.shim_export_interns.argtypes = [
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p,
             ctypes.c_size_t]
+        lib.shim_flush_interns.restype = None
+        lib.shim_flush_interns.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_int32]
         lib.shim_tensorize.restype = ctypes.c_int32
         lib.shim_tensorize.argtypes = [
             ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_char_p),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int32,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_void_p, ctypes.c_void_p]
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         self._lib = lib
         blob = _layout_blob(layout, interner)
         self._h = lib.shim_create(blob, len(blob))
         if not self._h:
             raise RuntimeError("shim_create failed (bad layout blob)")
-        self._known_ids = lib.shim_intern_count(self._h)
-        # shim id → python id. Seeds preserve python id order, so the
-        # initial mapping is the identity; runtime-observed values may
-        # diverge (the python table also interns on the report/quota/
-        # generic paths), so new shim ids are remapped after each batch.
-        self._remap = np.arange(self._known_ids, dtype=np.int32)
+        self._seed_count = lib.shim_intern_count(self._h)
+        self._known_ids = self._seed_count
+        # shim id → python id. Seeds preserve python id order (identity
+        # prefix). Runtime-observed shim ids map to NEGATIVE per-batch
+        # ephemeral ids (-1 - k) indexing `_runtime_values[k]` — they
+        # never enter the python intern table (bounded memory; see
+        # InternTable docstring). `_runtime_values` is replaced, not
+        # mutated, on flush so in-flight batches keep their snapshot.
+        self._remap = np.arange(self._seed_count, dtype=np.int32)
+        self._runtime_values: list = []
+        self._flush_threshold = 1 << 17   # ~131k distinct values
 
     def tensorize_wire(self, records: Sequence[bytes]) -> AttributeBatch:
         # one decode at a time: the shim handle's intern table and the
@@ -153,6 +148,7 @@ class NativeTensorizer:
         nbyte = max(lay.n_byte_slots, 1)
         ids = np.zeros((n, lay.n_columns), np.int32) \
             if lay.n_columns else np.zeros((n, 0), np.int32)
+        hash_ids = np.zeros_like(ids)
         present_u8 = np.zeros((n, max(lay.n_columns, 0)), np.uint8)
         map_present_u8 = np.zeros((n, nmap), np.uint8)
         str_bytes = np.zeros((n, nbyte, lay.max_str_len), np.uint8)
@@ -163,6 +159,7 @@ class NativeTensorizer:
         rc = self._lib.shim_tensorize(
             self._h, bufs, lens, n,
             ids.ctypes.data_as(ctypes.c_void_p),
+            hash_ids.ctypes.data_as(ctypes.c_void_p),
             present_u8.ctypes.data_as(ctypes.c_void_p),
             map_present_u8.ctypes.data_as(ctypes.c_void_p),
             str_bytes.ctypes.data_as(ctypes.c_void_p),
@@ -170,21 +167,32 @@ class NativeTensorizer:
         if rc != 0:
             raise ValueError(self._lib.shim_error(self._h).decode())
         self._sync_interns()
+        ephemeral = self._runtime_values
         if ids.size:
             # translate shim id space → python id space so the ids plane
             # compares equal against compiled constants / list entries
             np.take(self._remap, ids, out=ids)
+        if len(ephemeral) > self._flush_threshold:
+            # bound intern memory: drop runtime entries from the shim
+            # and start a fresh side table; `ephemeral` (this batch's
+            # snapshot) stays alive as long as the batch does
+            self._lib.shim_flush_interns(self._h, self._seed_count)
+            self._known_ids = self._seed_count
+            self._remap = np.arange(self._seed_count, dtype=np.int32)
+            self._runtime_values = []
         return AttributeBatch(ids=ids, present=present_u8.astype(bool),
                               map_present=map_present_u8.astype(bool),
-                              str_bytes=str_bytes, str_lens=str_lens)
+                              str_bytes=str_bytes, str_lens=str_lens,
+                              hash_ids=hash_ids,
+                              ephemeral_values=ephemeral)
 
     def _sync_interns(self) -> None:
-        """Extend the shim→python id remap with newly interned values.
+        """Extend the shim→python id remap with newly observed values.
 
-        The two tables intern independently at runtime (the python one
-        also serves the report/quota/generic paths), so ids are mapped,
-        not assumed equal — compile-time constants were seeded in python
-        id order and stay identity-mapped."""
+        New shim ids are runtime values (every compile-time constant
+        was seeded): each maps to the negative ephemeral id of its
+        slot in `_runtime_values` — stable across batches until the
+        flush replaces the side table."""
         count = self._lib.shim_intern_count(self._h)
         if count == self._known_ids:
             return
@@ -204,7 +212,8 @@ class NativeTensorizer:
             off += 4
             key = raw[off:off + k_len]
             off += k_len
-            new_ids.append(self.interner.intern(_decode_key(key)))
+            new_ids.append(-1 - len(self._runtime_values))
+            self._runtime_values.append(_decode_key(key))
         self._remap = np.concatenate(
             [self._remap, np.asarray(new_ids, np.int32)])
         self._known_ids = count

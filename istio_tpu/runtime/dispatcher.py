@@ -117,7 +117,7 @@ class Dispatcher:
             vid = int(ids[b])
             ns_id = cache.get(vid)
             if ns_id is None:
-                v = interner.value_of(vid)
+                v = batch.value_of(vid, interner)
                 parts = v.split(".") if isinstance(v, str) else []
                 ns = parts[1] if len(parts) >= 2 and parts[1] else ""
                 ns_id = rs.namespace_id(ns)

@@ -88,14 +88,39 @@ def test_wire_conformance_vs_python_tensorizer():
     records = [bag_to_compressed(d).SerializeToString() for d in dicts]
 
     got = native.tensorize_wire(records)
-    # Python oracle AFTER native (its interner now mirrors the shim's
-    # table, so ids must line up exactly)
     oracle = Tensorizer(layout, interner).tensorize(
         [bag_from_mapping(d) for d in dicts])
 
-    np.testing.assert_array_equal(np.asarray(got.ids),
-                                  np.asarray(oracle.ids))
+    # constants share exact non-negative ids; runtime values get
+    # per-batch ephemeral ids whose DECODED values must agree; within
+    # each batch the id ↔ value mapping must be a bijection
+    gi, oi = np.asarray(got.ids), np.asarray(oracle.ids)
+    gp = np.asarray(got.present)
+    assert gi.shape == oi.shape
+    from istio_tpu.compiler.layout import _normalize, stable_hash31
+    id_to_val: dict[int, tuple] = {}
+    val_to_id: dict[tuple, int] = {}
+    for r in range(gi.shape[0]):
+        for c in range(gi.shape[1]):
+            if not gp[r, c]:
+                continue
+            a, b = int(gi[r, c]), int(oi[r, c])
+            va = _normalize(got.value_of(a, interner))
+            if a >= 0 or b >= 0:
+                assert a == b, (r, c, a, b)
+            else:
+                assert va == _normalize(oracle.value_of(b, interner)), \
+                    (r, c)
+            # bijection: same id ⇔ same value across the whole batch
+            assert id_to_val.setdefault(a, va) == va, (r, c, a)
+            assert val_to_id.setdefault(va, a) == a, (r, c, va)
+            # the stable hash plane matches the python formula
+            assert int(np.asarray(got.hash_ids)[r, c]) == \
+                stable_hash31(got.value_of(a, interner)), (r, c)
     np.testing.assert_array_equal(np.asarray(got.present),
+                                  np.asarray(oracle.present))
+    np.testing.assert_array_equal(np.asarray(got.hash_ids) * gp,
+                                  np.asarray(oracle.hash_ids) *
                                   np.asarray(oracle.present))
     np.testing.assert_array_equal(np.asarray(got.map_present),
                                   np.asarray(oracle.map_present))
@@ -116,6 +141,31 @@ def test_repeated_batches_share_interns():
     assert len(interner) == size_after_first
     np.testing.assert_array_equal(np.asarray(b1.ids),
                                   np.asarray(b2.ids))
+
+
+def test_intern_table_bounded_by_flush():
+    """ADVICE r1: distinct runtime values must not grow the shared
+    intern table, and the shim's own table flushes at the threshold
+    while in-flight batches keep resolving their values."""
+    layout, interner = _rig()
+    native = NativeTensorizer(layout, interner)
+    native._flush_threshold = 32
+    size0 = len(interner)
+    batches = []
+    for seed in range(4):
+        dicts = _world(seed=seed, n=32)
+        recs = [bag_to_compressed(d).SerializeToString() for d in dicts]
+        batches.append(native.tensorize_wire(recs))
+    assert len(interner) == size0          # python table: zero growth
+    # shim table flushed at least once (runtime entries dropped)
+    assert len(native._runtime_values) <= 3 * native._flush_threshold
+    # earlier batches still resolve their ephemeral ids
+    first = batches[0]
+    ids = np.asarray(first.ids)
+    present = np.asarray(first.present)
+    r, c = np.argwhere(ids < 0)[0]
+    assert present[r, c]
+    assert first.value_of(int(ids[r, c]), interner) is not None
 
 
 def test_parse_error_reported():

@@ -83,6 +83,25 @@ def test_quota_fixed_window():
     assert v3.status.tolist() == [OK]
 
 
+def test_quota_bucket_stable_across_batches():
+    """Quota buckets key on a stable content hash, not on intern or
+    ephemeral ids: the same runtime key must hit the same bucket no
+    matter what order values were first observed in (a sequential
+    per-batch id would let a consumed window be evaded by reordering)."""
+    rules = [Rule(name="q", match="")]
+    eng = PolicyEngine(rules, FINDER,
+                       quotas=[QuotaSpec(rule=0, key_attr="request.user",
+                                         max_amount=2)])
+    # "u" is first in batch 1...
+    v1 = _run(eng, [{"request.user": "u"}, {"request.user": "u"}])
+    assert v1.status.tolist() == [OK, OK]
+    # ...but second in batch 2, behind two fresh keys: still exhausted
+    v2 = _run(eng, [{"request.user": "a"}, {"request.user": "b"},
+                    {"request.user": "u"}])
+    assert v2.status.tolist()[2] == RESOURCE_EXHAUSTED
+    assert v2.status.tolist()[:2] == [OK, OK]
+
+
 def test_denied_requests_do_not_consume_quota():
     """Quota runs only after a successful precondition check
     (grpcServer.go:188-230): a denied request must not take tokens."""

@@ -49,7 +49,7 @@ def test_corpus_tensor_parity(case: Case):
     assert bool(valid[0]) == want_valid, (
         f"{case.e}: device valid={bool(valid[0])}, oracle valid={want_valid}")
     if want_valid:
-        got = prog.decode_value(np.asarray(val)[0])
+        got = prog.decode_value(np.asarray(val)[0], batch)
         assert got == want, f"{case.e}: device {got!r} != oracle {want!r}"
 
 
