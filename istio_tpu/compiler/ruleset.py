@@ -346,46 +346,71 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
         sorted(reqs.byte_sources, key=str), **kwargs)
 
     # ---- classify atoms into vectorizable tiers ----
-    live_atoms = sorted({i for mn in per_rule if mn
-                         for conj in (mn[0] | mn[1]) for i, _ in conj})
-    eq_cols: list[int] = []; eq_cids: list[int] = []; eq_neg: list[bool] = []
-    eq_atom_idx: list[int] = []
-    ss_a: list[int] = []; ss_b: list[int] = []; ss_neg: list[bool] = []
-    ss_atom_idx: list[int] = []
-    gen_fns: list[Callable] = []
-    gen_atom_idx: list[int] = []
+    # An atom can still refuse to lower here (e.g. STRING_MAP equality
+    # has no device view even though its requirements collected fine);
+    # demote every rule using it to host fallback and reclassify.
     ctx = tensor_expr._Ctx(layout, interner, finder)
+    while True:
+        live_atoms = sorted({i for mn in per_rule if mn
+                             for conj in (mn[0] | mn[1]) for i, _ in conj})
+        eq_cols: list[int] = []; eq_cids: list[int] = []
+        eq_neg: list[bool] = []
+        eq_atom_idx: list[int] = []
+        ss_a: list[int] = []; ss_b: list[int] = []; ss_neg: list[bool] = []
+        ss_atom_idx: list[int] = []
+        gen_fns: list[Callable] = []
+        gen_atom_idx: list[int] = []
+        unlowerable: set[int] = set()
 
-    for aidx in live_atoms:
-        ast = atoms.asts[aidx]
-        done = False
-        f = ast.fn
-        if ast.var is not None and finder.get_attribute(ast.var.name) == V.BOOL:
-            eq_cols.append(layout.slot_of(ast.var.name))
-            eq_cids.append(ID_TRUE); eq_neg.append(False)
-            eq_atom_idx.append(aidx); done = True
-        elif f is not None and f.name in ("EQ", "NEQ") and len(f.args) == 2:
-            neg = f.name == "NEQ"
-            for x, y in ((f.args[0], f.args[1]), (f.args[1], f.args[0])):
-                sref = _slot_ref(x, layout, finder)
-                if sref is None:
-                    continue
-                cid = _const_id(y, interner)
-                if cid is not None:
-                    eq_cols.append(sref.col); eq_cids.append(cid)
-                    eq_neg.append(neg); eq_atom_idx.append(aidx)
-                    done = True
-                    break
+        for aidx in live_atoms:
+            ast = atoms.asts[aidx]
+            done = False
+            f = ast.fn
+            if ast.var is not None \
+                    and finder.get_attribute(ast.var.name) == V.BOOL:
+                eq_cols.append(layout.slot_of(ast.var.name))
+                eq_cids.append(ID_TRUE); eq_neg.append(False)
+                eq_atom_idx.append(aidx); done = True
+            elif f is not None and f.name in ("EQ", "NEQ") \
+                    and len(f.args) == 2:
+                neg = f.name == "NEQ"
+                for x, y in ((f.args[0], f.args[1]),
+                             (f.args[1], f.args[0])):
+                    sref = _slot_ref(x, layout, finder)
+                    if sref is None:
+                        continue
+                    cid = _const_id(y, interner)
+                    if cid is not None:
+                        eq_cols.append(sref.col); eq_cids.append(cid)
+                        eq_neg.append(neg); eq_atom_idx.append(aidx)
+                        done = True
+                        break
+                if not done:
+                    ra = _slot_ref(f.args[0], layout, finder)
+                    rb = _slot_ref(f.args[1], layout, finder)
+                    if ra is not None and rb is not None:
+                        ss_a.append(ra.col); ss_b.append(rb.col)
+                        ss_neg.append(neg); ss_atom_idx.append(aidx)
+                        done = True
             if not done:
-                ra = _slot_ref(f.args[0], layout, finder)
-                rb = _slot_ref(f.args[1], layout, finder)
-                if ra is not None and rb is not None:
-                    ss_a.append(ra.col); ss_b.append(rb.col)
-                    ss_neg.append(neg); ss_atom_idx.append(aidx)
-                    done = True
-        if not done:
-            gen_fns.append(tensor_expr._compile_node(ast, ctx))
-            gen_atom_idx.append(aidx)
+                try:
+                    gen_fns.append(tensor_expr._compile_node(ast, ctx))
+                except HostFallback:
+                    unlowerable.add(aidx)   # keep scanning: one pass
+                    continue                # collects every bad atom
+                gen_atom_idx.append(aidx)
+
+        if not unlowerable:
+            break
+        for ridx, mn in enumerate(per_rule):
+            if mn is None:
+                continue
+            used = {i for conj in (mn[0] | mn[1]) for i, _ in conj}
+            if used & unlowerable:
+                per_rule[ridx] = None
+                host_fallback[ridx] = OracleProgram(
+                    rules[ridx].match.strip() or "true", finder)
+                fallback_reason[ridx] = "atom not lowerable"
 
     n_atoms = len(atoms.asts)
     order = eq_atom_idx + ss_atom_idx + gen_atom_idx

@@ -313,23 +313,58 @@ def _compile_node(e: Expression, ctx: _Ctx) -> NodeFn:
 
 
 def _compile_byte_pred(f: FunctionCall, ctx: _Ctx) -> NodeFn:
+    """Byte predicates with truncation safety.
+
+    Strings longer than max_str_len land truncated in the byte plane
+    (layout.py). Per predicate:
+      * prefix checks (startsWith, `x*` globs, exact globs shorter
+        than the cap) only read the head — always decidable;
+      * suffix/tail checks (endsWith, `*x` globs, cap-length exact
+        globs) are undecidable on a possibly-truncated row → the row
+        is marked err, which the serving path routes to the host
+        oracle (dispatcher._overlay_fallback);
+      * unanchored regex: a hit inside the stored prefix proves a hit
+        in the full string, so only a MISS on a truncated row is
+        undecidable; a `$`-anchored regex could falsely anchor at the
+        truncation point, so every truncated row is undecidable.
+    A pattern longer than the cap can't be represented on device at
+    all → HostFallback at compile time.
+    """
+    max_len = ctx.layout.max_str_len
+    # "safe": truncation can't change the result; "miss": only a False
+    # on a truncated row is unreliable; "all": every truncated row is
     if f.name == "match":
         subject_ast, pattern = f.args[0], f.args[1].const_.value
+        if len(pattern.encode("utf-8")) > max_len:
+            raise HostFallback("glob pattern exceeds byte-slot width")
         op = partial(bytes_ops.glob_match, pattern=pattern)
+        if pattern.endswith("*"):
+            trunc = "safe"                      # prefix glob
+        elif pattern.startswith("*"):
+            trunc = "all"                       # suffix glob
+        else:
+            # exact: safe unless the stored prefix could equal the
+            # pattern while the real string continues past the cap
+            trunc = "safe" if len(pattern.encode()) < max_len else "all"
     elif f.name == "matches":
         subject_ast, pattern = f.args[0], f.target.const_.value
         dfa = compile_regex(pattern)
         trans = jnp.asarray(dfa.transitions)
         accept = jnp.asarray(dfa.accept)
         op = lambda data, lens: bytes_ops.dfa_match(data, lens, trans, accept)
+        trunc = "all" if "$" in pattern else "miss"
     elif f.name == "startsWith":
         subject_ast, pattern = f.target, f.args[0].const_.value
+        if len(pattern.encode("utf-8")) > max_len:
+            raise HostFallback("prefix exceeds byte-slot width")
         op = lambda data, lens: bytes_ops.prefix_match(data, lens,
                                                        pattern.encode())
+        trunc = "safe"
     else:  # endsWith
         subject_ast, pattern = f.target, f.args[0].const_.value
         op = lambda data, lens: bytes_ops.suffix_match(data, lens,
                                                        pattern.encode())
+        trunc = "all"
 
     fsub = _compile_bytes(subject_ast, ctx)
 
@@ -337,6 +372,12 @@ def _compile_byte_pred(f: FunctionCall, ctx: _Ctx) -> NodeFn:
         s = fsub(batch)
         ee = s.err | ~s.ok
         val = op(s.data, s.lens) & ~ee
+        if trunc != "safe":
+            maybe_truncated = s.ok & (s.lens >= max_len)
+            undecidable = maybe_truncated if trunc == "all" \
+                else (maybe_truncated & ~val)
+            ee = ee | undecidable
+            val = val & ~ee
         return TVal(val, ~ee, ee)
     return fn
 

@@ -117,3 +117,76 @@ def test_host_fallback_cases_raise():
                  "ip(as)"]:
         with pytest.raises(HostFallback):
             collect_requirements(parse(text), FINDER)
+
+
+def test_truncation_routes_to_host():
+    """Strings past max_str_len are truncated in the byte plane; a
+    predicate whose answer depends on the missing tail must come back
+    invalid (the serving path then routes the row to the host oracle)
+    rather than silently answering from the truncated prefix."""
+    interner = InternTable()
+    reqs = collect_requirements(parse('as.endsWith("fix")'), FINDER)
+    layout = build_layout(CORPUS_MANIFEST, sorted(reqs.derived_keys),
+                          sorted(reqs.byte_sources, key=str),
+                          max_str_len=16)
+    prog = compile_expression('as.endsWith("fix")', FINDER, layout,
+                              interner, jit=False)
+    tz = Tensorizer(layout, interner)
+    long_hit = "x" * 40 + "fix"          # truncated at 16 bytes
+    short_hit = "prefix"
+    batch = tz.tensorize([DictBag({"as": long_hit}),
+                          DictBag({"as": short_hit}),
+                          DictBag({"as": "nope"})])
+    val, valid = prog(batch)
+    assert not bool(np.asarray(valid)[0])      # undecidable → host
+    assert bool(np.asarray(valid)[1]) and bool(np.asarray(val)[1])
+    assert bool(np.asarray(valid)[2]) and not bool(np.asarray(val)[2])
+    # the oracle (full string) stays the source of truth for row 0
+    assert OracleProgram('as.endsWith("fix")', FINDER).evaluate(
+        DictBag({"as": long_hit})) is True
+
+
+def test_truncation_safe_for_prefix_checks():
+    """startsWith and prefix globs only read the head — truncation
+    never invalidates them."""
+    interner = InternTable()
+    text = 'as.startsWith("xx")'
+    reqs = collect_requirements(parse(text), FINDER)
+    layout = build_layout(CORPUS_MANIFEST, sorted(reqs.derived_keys),
+                          sorted(reqs.byte_sources, key=str),
+                          max_str_len=16)
+    prog = compile_expression(text, FINDER, layout, interner, jit=False)
+    tz = Tensorizer(layout, interner)
+    batch = tz.tensorize([DictBag({"as": "xx" + "y" * 40}),
+                          DictBag({"as": "zz" + "y" * 40})])
+    val, valid = prog(batch)
+    assert bool(np.asarray(valid)[0]) and bool(np.asarray(val)[0])
+    assert bool(np.asarray(valid)[1]) and not bool(np.asarray(val)[1])
+
+
+def test_truncation_regex_hit_is_reliable_miss_is_not():
+    """Unanchored regex: a hit inside the stored prefix proves a hit
+    in the full string; a miss on a truncated row is undecidable; a
+    $-anchored regex is undecidable on every truncated row."""
+    interner = InternTable()
+    text = '"ab".matches(as)'
+    reqs = collect_requirements(parse(text), FINDER)
+    layout = build_layout(CORPUS_MANIFEST, sorted(reqs.derived_keys),
+                          sorted(reqs.byte_sources, key=str),
+                          max_str_len=16)
+    prog = compile_expression(text, FINDER, layout, interner, jit=False)
+    tz = Tensorizer(layout, interner)
+    batch = tz.tensorize([DictBag({"as": "ab" + "z" * 40}),   # hit, trunc
+                          DictBag({"as": "z" * 40}),          # miss, trunc
+                          DictBag({"as": "zz"})])             # miss, short
+    val, valid = prog(batch)
+    assert bool(np.asarray(valid)[0]) and bool(np.asarray(val)[0])
+    assert not bool(np.asarray(valid)[1])
+    assert bool(np.asarray(valid)[2]) and not bool(np.asarray(val)[2])
+
+    anchored = '"ab$".matches(as)'
+    prog2 = compile_expression(anchored, FINDER, layout, interner,
+                               jit=False)
+    batch2 = tz.tensorize([DictBag({"as": "z" * 14 + "ab"})])  # 16 bytes
+    _, valid2 = prog2(batch2)
+    assert not bool(np.asarray(valid2)[0])  # could anchor at trunc point
