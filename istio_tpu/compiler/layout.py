@@ -255,9 +255,22 @@ class Tensorizer:
     straight from the wire format.
     """
 
-    def __init__(self, layout: BatchLayout, interner: InternTable):
+    def __init__(self, layout: BatchLayout, interner: InternTable,
+                 hash_slots: Any = None):
+        """`hash_slots` selects which columns get the stable content
+        hash (quota bucketing): an iterable of column indices, "all",
+        or None (none — hashing every cell in Python costs ~10× the
+        tensorize itself; only quota key slots need it, and
+        PolicyEngine.tensorizer passes exactly those). The C++ shim
+        hashes every cell for free. The plane is always an array so
+        every producer yields the same pytree treedef."""
         self.layout = layout
         self.interner = interner
+        if hash_slots == "all":
+            self.hash_slots: frozenset[int] = frozenset(
+                range(layout.n_columns))
+        else:
+            self.hash_slots = frozenset(hash_slots or ())
 
     def tensorize(self, bags: Sequence[Bag]) -> AttributeBatch:
         lay = self.layout
@@ -288,6 +301,7 @@ class Tensorizer:
                 eph_values.append(v)
             return neg
 
+        hash_slots = self.hash_slots
         for i, bag in enumerate(bags):
             for name, col in lay.slots.items():
                 v, ok = bag.get(name)
@@ -295,7 +309,8 @@ class Tensorizer:
                     continue
                 present[i, col] = True
                 ids[i, col] = rid(v)
-                hash_ids[i, col] = stable_hash31(v)
+                if col in hash_slots:
+                    hash_ids[i, col] = stable_hash31(v)
             for name, mcol in lay.map_slots.items():
                 v, ok = bag.get(name)
                 if ok:
@@ -305,7 +320,8 @@ class Tensorizer:
                 if ok and isinstance(m, Mapping) and key in m:
                     present[i, col] = True
                     ids[i, col] = rid(m[key])
-                    hash_ids[i, col] = stable_hash31(m[key])
+                    if col in hash_slots:
+                        hash_ids[i, col] = stable_hash31(m[key])
             for src, bcol in lay.byte_slots.items():
                 raw = self._byte_source_value(bag, src)
                 if raw is None:

@@ -213,6 +213,8 @@ class PolicyEngine:
                 f"quota hash space too large: {n_quotas} quotas × "
                 f"{n_buckets} buckets must stay below 2^31-1 (int32 "
                 "composite sort keys)")
+        self._quota_slots = frozenset(
+            self._slot_for(q.key_attr) for q in quotas)
         for i, q in enumerate(quotas):
             q_rule[i] = q.rule
             q_slot[i] = self._slot_for(q.key_attr)
@@ -397,4 +399,8 @@ class PolicyEngine:
 
     @property
     def tensorizer(self) -> Tensorizer:
-        return Tensorizer(self.ruleset.layout, self.ruleset.interner)
+        # hash exactly the quota key slots — the only consumers of the
+        # stable-hash plane (hashing every cell costs ~10× the
+        # tensorize itself in Python)
+        return Tensorizer(self.ruleset.layout, self.ruleset.interner,
+                          hash_slots=self._quota_slots)

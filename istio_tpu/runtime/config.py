@@ -264,6 +264,11 @@ class SnapshotBuilder:
                         handlers=handlers, instances=instances,
                         instance_templates=instance_templates,
                         rules=rules, ruleset=ruleset,
+                        # no hash_slots: the serving engine runs
+                        # quotas=() (host adapters own quota state), so
+                        # nothing reads the hash plane. A quota-bearing
+                        # PolicyEngine must tensorize via its own
+                        # .tensorizer, which hashes its key slots.
                         tensorizer=Tensorizer(ruleset.layout,
                                               self.interner),
                         roles=roles, bindings=bindings, errors=errors)

@@ -83,12 +83,21 @@ class Controller:
         if self.fused_enabled:
             from istio_tpu.runtime.fused import build_fused_plan
             plan = build_fused_plan(snapshot)
-            # shadow-compile the serving shapes before the swap when an
-            # old dispatcher is still serving (SURVEY hard-part #5): a
-            # config change must never surface trace time in-band
-            if plan is not None and self.prewarm_buckets \
-                    and self._dispatcher is not None:
-                plan.prewarm(self.prewarm_buckets)
+            if plan is not None and self.prewarm_buckets:
+                if self._dispatcher is not None:
+                    # shadow-compile the serving shapes before the swap
+                    # (SURVEY hard-part #5): a config change must never
+                    # surface trace time in-band
+                    plan.prewarm(self.prewarm_buckets)
+                else:
+                    # first build: serve immediately, warm in the
+                    # background — blocking startup for minutes of
+                    # per-bucket device compiles helps nobody, but
+                    # without ANY warm the first requests serialize
+                    # behind those same compiles
+                    threading.Thread(
+                        target=plan.prewarm, args=(self.prewarm_buckets,),
+                        daemon=True, name="prewarm-initial").start()
         dispatcher = Dispatcher(snapshot, handlers, self.identity_attr,
                                 fused=plan)
         self._dispatcher = dispatcher      # atomic publish (GIL ref swap)

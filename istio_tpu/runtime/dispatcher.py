@@ -193,26 +193,37 @@ class Dispatcher:
             else:
                 batch = snap.tensorizer.tensorize(bags)
                 ns_ids = self._request_ns_ids(bags)
-            verdict = plan.engine.check(batch, ns_ids)
-            status = np.asarray(verdict.status)
-            dur = np.asarray(verdict.valid_duration_s)
-            uses = np.asarray(verdict.valid_use_count)
-            deny_rule = np.asarray(verdict.deny_rule)
+            # ONE device→host pull for the whole verdict: each extra
+            # pull costs a full RTT (~120ms behind the axon tunnel),
+            # and plane-by-plane conversion was 6 RTTs per batch
+            packed = plan.packed_check(batch, ns_ids)
+            status = packed[0]
+            dur = packed[1].view(np.float32)
+            uses = packed[2]
+            deny_rule = packed[3]
         rs = snap.ruleset
-        n_err = int(verdict.err_count)
+        n_err = int(packed[4, 0]) if packed.shape[1] else 0
         if n_err:
             monitor.RESOLVE_ERRORS.inc(n_err)
 
+        # referenced-attribute item bits (rows 5..5+W): the device
+        # computed predicate + instance attr uses per request; the
+        # host just decodes set bits into names
+        n_words = plan.n_ref_words
+        if n_words:
+            ref_bits = np.unpackbits(
+                np.ascontiguousarray(packed[5:5 + n_words].T)
+                .view(np.uint8), axis=1, bitorder="little")
+
         # Only plan.overlay_cols of the [B, R] matched plane are ever
-        # inspected host-side; converting the full plane (16MB/batch at
-        # B=2048, R=10k) was the serving bottleneck. Namespace masking
-        # for the subset happens in numpy; host-fallback rules are
+        # inspected host-side (the rows after the ref bits);
+        # converting the full plane (16MB/batch at B=2048, R=10k) was
+        # the original serving bottleneck. Namespace masking for the
+        # subset happens in numpy; host-fallback rules are
         # oracle-evaluated into their subset positions.
         cols = plan.overlay_cols
         if len(cols):
-            # np.array (not asarray): device→host copies are read-only
-            # and the fallback overlay writes into the subset
-            active_sub = np.array(verdict.matched[:, cols])
+            active_sub = packed[5 + n_words:].T.astype(bool)  # writable
             col_pos = {int(r): i for i, r in enumerate(cols)}
             host_errs = 0
             for ridx in rs.host_fallback:
@@ -270,9 +281,18 @@ class Dispatcher:
             if not dev_applied:
                 self._apply_device_status(resp, plan, dev_rule,
                                           int(status[b]))
-            referenced = set(plan.pred_attrs_for_ns(int(ns_ids[b])))
-            for pos in np.nonzero(active_sub[b])[0]:
-                referenced |= plan.instance_attrs[int(cols[pos])]
+            # referenced = device-computed item bits (predicate attrs
+            # of ns-visible rules + instance attrs of active rules);
+            # only attrs with no layout item need host-side merging
+            if n_words:
+                names = plan.item_names
+                referenced = {names[j] for j in
+                              np.nonzero(ref_bits[b, :len(names)])[0]}
+            else:
+                referenced = set()
+            for ridx, extra in plan.unmapped_instance_attrs.items():
+                if active_sub[b, col_pos[ridx]]:
+                    referenced |= extra
             resp.referenced = tuple(sorted(referenced, key=str))
             # presence from the device planes → the gRPC layer builds
             # ReferencedAttributes without decoding wire bags

@@ -64,7 +64,104 @@ class FusedPlan:
         default_factory=lambda: np.zeros(0, np.int64))
     fused_deny: int = 0
     fused_lists: int = 0
+    # referenced-attribute items: item j < n_columns is the column's
+    # slot/derived attr, item n_columns + m is map slot m's attr name.
+    # The device computes the FULL per-request referenced bitmap
+    # (predicate attrs of ns-visible rules + instance attrs of active
+    # rules) and ships it bitpacked — at 10k rules the host-side
+    # per-request set unions and the [B, R] overlay pull were the
+    # serving bottleneck behind the tunnel (~5MB/batch at ~4MB/s).
+    item_names: list = dataclasses.field(default_factory=list)
+    inst_mask: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0, 0), np.int8))
+    pred_map_mask: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.zeros((0, 0), np.int8))
+    # rule → instance attrs with no layout item (rare); such rules stay
+    # in overlay_cols and their names merge host-side
+    unmapped_instance_attrs: dict = dataclasses.field(default_factory=dict)
     _ns_pred_cache: dict = dataclasses.field(default_factory=dict)
+    _packer: Any = None
+
+    @property
+    def n_ref_words(self) -> int:
+        return (len(self.item_names) + 31) // 32
+
+    def packed_check(self, batch, ns_ids) -> np.ndarray:
+        """engine.check + device-side packing into ONE int32 array
+        [5 + W + C, B] pulled with a single host↔device sync (W =
+        n_ref_words, C = len(overlay_cols)). Pulling plane-by-plane
+        costs one ~100ms tunnel RTT per plane, and the unpacked
+        referenced/overlay planes cost seconds of D2H streaming.
+
+        Rows: 0 status, 1 valid_duration_s (f32 bits), 2
+        valid_use_count, 3 deny_rule, 4 err_count (broadcast),
+        5..5+W referenced-item bits (little-endian within each int32),
+        then matched[:, overlay_cols] (raw, ns-unmasked)."""
+        import jax
+
+        if self._packer is None:
+            import jax.numpy as jnp
+            from jax import lax
+            rs = self.engine.ruleset
+            cols = jnp.asarray(self.overlay_cols, jnp.int32)
+            rule_ns = jnp.asarray(rs.rule_ns)
+            default_ns = rs.ns_ids[""]
+            inst_mask_j = jnp.asarray(self.inst_mask)
+            pred_map_j = jnp.asarray(self.pred_map_mask)
+            n_items = len(self.item_names)
+            n_words = self.n_ref_words
+            n_cols = rs.layout.n_columns
+            n_maps_used = self.pred_map_mask.shape[1]
+            bit_w = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+            dims = (((1,), (0,)), ((), ()))
+
+            def pack(verdict, req_ns):
+                b = verdict.status.shape[0]
+                dur_bits = lax.bitcast_convert_type(
+                    verdict.valid_duration_s, jnp.int32)
+                head = jnp.stack([
+                    verdict.status, dur_bits, verdict.valid_use_count,
+                    verdict.deny_rule,
+                    jnp.broadcast_to(verdict.err_count.astype(jnp.int32),
+                                     (b,))])
+                parts = [head]
+                if n_items:
+                    ns_ok = (rule_ns[None, :] == default_ns) | \
+                            (rule_ns[None, :] == req_ns[:, None])
+                    active = verdict.matched & ns_ok
+                    items = jnp.zeros((b, n_words * 32), bool)
+                    # predicate columns: the engine already ns-masks
+                    # (referenced is [B, max(n_cols, 1)] — slice off
+                    # the 0-column placeholder when the layout is empty)
+                    items = items.at[:, :n_cols].set(
+                        verdict.referenced[:, :n_cols])
+                    if n_maps_used:
+                        pred_maps = lax.dot_general(
+                            ns_ok.astype(jnp.int8), pred_map_j, dims,
+                            preferred_element_type=jnp.int32) > 0
+                        items = items.at[
+                            :, n_cols:n_cols + n_maps_used].set(
+                                items[:, n_cols:n_cols + n_maps_used]
+                                | pred_maps)
+                    inst = lax.dot_general(
+                        active.astype(jnp.int8), inst_mask_j, dims,
+                        preferred_element_type=jnp.int32) > 0
+                    items = items.at[:, :n_items].set(
+                        items[:, :n_items] | inst)
+                    words = jnp.sum(
+                        items.reshape(b, n_words, 32).astype(jnp.uint32)
+                        * bit_w[None, None, :], axis=2)
+                    parts.append(lax.bitcast_convert_type(
+                        words, jnp.int32).T)
+                if cols.size:
+                    parts.append(jnp.take(verdict.matched, cols,
+                                          axis=1).T.astype(jnp.int32))
+                return jnp.concatenate(parts, axis=0) \
+                    if len(parts) > 1 else head
+
+            self._packer = jax.jit(pack)
+        verdict = self.engine.check(batch, ns_ids)
+        return np.asarray(self._packer(verdict, np.asarray(ns_ids)))
 
     def pred_attrs_for_ns(self, ns_id: int) -> frozenset:
         """Union of predicate attr uses over rules visible to ns_id —
@@ -91,11 +188,14 @@ class FusedPlan:
         mixer/pkg/runtime/resolver.go:240-247): the old snapshot keeps
         serving while the new one's jit cache fills, so no request pays
         multi-second trace time in-band after a config change."""
-        import jax
         from istio_tpu.compiler.layout import AttributeBatch
 
         lay = self.engine.ruleset.layout
         for b in sorted(set(buckets)):
+            # the dummy batch MUST flatten to the same pytree treedef
+            # as served batches (hash_ids included) — a treedef
+            # mismatch compiles a cache entry serving never hits,
+            # silently un-doing the prewarm
             batch = AttributeBatch(
                 ids=np.zeros((b, lay.n_columns), np.int32),
                 present=np.zeros((b, lay.n_columns), bool),
@@ -103,9 +203,11 @@ class FusedPlan:
                 str_bytes=np.zeros((b, max(lay.n_byte_slots, 1),
                                     lay.max_str_len), np.uint8),
                 str_lens=np.zeros((b, max(lay.n_byte_slots, 1)),
-                                  np.int32))
-            verdict = self.engine.check(batch, np.zeros(b, np.int32))
-            jax.block_until_ready(verdict.status)
+                                  np.int32),
+                hash_ids=np.zeros((b, lay.n_columns), np.int32))
+            # warm the SERVING entry (engine step + packer), not just
+            # the engine — the packer gather is its own XLA program
+            self.packed_check(batch, np.zeros(b, np.int32))
 
     def message_for(self, rule_idx: int, status: int) -> str:
         """Best-effort status message for a device-produced denial."""
@@ -201,8 +303,53 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
     log.info("fused plan: %d deny rules, %d lists, %d host-overlay rules"
              ", native=%s", len(deny_by_rule), len(lists),
              len(host_actions), native is not None)
-    overlay = set(host_actions) | set(rs.host_fallback) | \
-        {r for r in range(rs.n_rules) if instance_attrs[r]}
+
+    # referenced-attribute item space: every layout column (slot or
+    # derived) plus every map slot. Instance attrs that map to an item
+    # flow through the device bitmap; the rare unmappable ones keep
+    # their rule in the host overlay.
+    n_cols, n_maps = layout.n_columns, layout.n_maps
+    item_names: list = [None] * (n_cols + n_maps)
+    item_of: dict = {}
+    for name, col in layout.slots.items():
+        item_names[col] = name
+        item_of[name] = col
+    for pair, col in layout.derived_slots.items():
+        item_names[col] = pair
+        item_of[pair] = col
+    for name, mcol in layout.map_slots.items():
+        item_names[n_cols + mcol] = name
+        item_of[name] = n_cols + mcol
+    n_items = len(item_names)
+    inst_mask = np.zeros((max(rs.n_rules, 1), n_items), np.int8)
+    unmapped: dict[int, frozenset] = {}
+    for ridx, attrs in enumerate(instance_attrs):
+        if ridx in rs.host_fallback:
+            # the device never knows whether a host-fallback rule
+            # matched — its instance attrs merge host-side from the
+            # oracle-overlaid activity bits
+            if attrs:
+                unmapped[ridx] = attrs
+            continue
+        missing = []
+        for item in attrs:
+            idx = item_of.get(item)
+            if idx is None:
+                missing.append(item)
+            else:
+                inst_mask[ridx, idx] = 1
+        if missing:
+            unmapped[ridx] = frozenset(missing)
+    # predicate MAP-name uses (e.g. `ar["k"]` references "ar" too) —
+    # the engine's referenced plane covers columns only
+    pred_map_mask = np.zeros((max(rs.n_rules, 1), max(n_maps, 1)),
+                             np.int8)
+    for ridx in range(rs.n_rules):
+        for item in rs.attr_names[ridx]:
+            if isinstance(item, str) and item in layout.map_slots:
+                pred_map_mask[ridx, layout.map_slots[item]] = 1
+
+    overlay = set(host_actions) | set(rs.host_fallback) | set(unmapped)
     return FusedPlan(engine=engine, native=native,
                      host_actions=host_actions,
                      host_rule_idx=np.asarray(sorted(host_actions),
@@ -212,7 +359,13 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
                      list_rules=frozenset(list_rules),
                      fused_first_rules=frozenset(fused_first),
                      overlay_cols=np.asarray(sorted(overlay), np.int64),
-                     fused_deny=len(deny_by_rule), fused_lists=len(lists))
+                     fused_deny=len(deny_by_rule), fused_lists=len(lists),
+                     item_names=item_names,
+                     inst_mask=inst_mask,
+                     pred_map_mask=pred_map_mask[:, :n_maps]
+                     if n_maps else np.zeros((max(rs.n_rules, 1), 0),
+                                             np.int8),
+                     unmapped_instance_attrs=unmapped)
 
 
 def _split_list_instances(snapshot: Snapshot, hc, inst_names, layout

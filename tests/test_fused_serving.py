@@ -336,6 +336,35 @@ def test_snapshot_swap_under_load():
         srv.close()
 
 
+def test_prewarm_treedef_matches_serving():
+    """The prewarm dummy batch and every real tensorizer's batches
+    must flatten to the SAME pytree treedef — a mismatch compiles a
+    jit cache entry serving never hits, silently re-introducing
+    in-band compile on the first real request (the exact failure the
+    prewarm exists to prevent)."""
+    import jax
+    import numpy as np
+    from istio_tpu.compiler.layout import AttributeBatch, Tensorizer
+    from istio_tpu.testing import workloads
+
+    eng = workloads.make_engine(n_rules=8, jit=False)
+    lay = eng.ruleset.layout
+    b = 4
+    dummy = AttributeBatch(
+        ids=np.zeros((b, lay.n_columns), np.int32),
+        present=np.zeros((b, lay.n_columns), bool),
+        map_present=np.zeros((b, max(lay.n_maps, 1)), bool),
+        str_bytes=np.zeros((b, max(lay.n_byte_slots, 1),
+                            lay.max_str_len), np.uint8),
+        str_lens=np.zeros((b, max(lay.n_byte_slots, 1)), np.int32),
+        hash_ids=np.zeros((b, lay.n_columns), np.int32))
+    real = eng.tensorizer.tensorize(workloads.make_bags(b))
+    plain = Tensorizer(lay, eng.ruleset.interner).tensorize(
+        workloads.make_bags(b))
+    td = lambda x: jax.tree_util.tree_structure(x)
+    assert td(dummy) == td(real) == td(plain)
+
+
 def test_fused_config_swap(servers):
     """A store change rebuilds the plan (new engine) atomically."""
     fused, _ = servers

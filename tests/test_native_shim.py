@@ -88,7 +88,7 @@ def test_wire_conformance_vs_python_tensorizer():
     records = [bag_to_compressed(d).SerializeToString() for d in dicts]
 
     got = native.tensorize_wire(records)
-    oracle = Tensorizer(layout, interner).tensorize(
+    oracle = Tensorizer(layout, interner, hash_slots="all").tensorize(
         [bag_from_mapping(d) for d in dicts])
 
     # constants share exact non-negative ids; runtime values get
