@@ -91,10 +91,27 @@ def cmd_mixc(args: argparse.Namespace) -> int:
 
 
 def cmd_pilot_discovery(args: argparse.Namespace) -> int:
-    """pilot-discovery (bootstrap/server.go assembly)."""
+    """pilot-discovery (bootstrap/server.go assembly): initMesh →
+    config stores → service registries → discovery."""
     from istio_tpu.pilot import MemoryConfigStore, MemoryRegistry
     from istio_tpu.pilot.discovery import DiscoveryService
+    from istio_tpu.pilot.mesh import init_mesh
     from istio_tpu.pilot.registry import AggregateRegistry
+
+    # initMesh (server.go:245): defaults ← file ← flag overrides
+    mesh = init_mesh(
+        config_file=args.mesh_config,
+        overrides={"mixer_address": args.mixer_address},
+        on_warn=lambda msg: print(f"pilot-discovery: {msg}"))
+    proxy_defaults = mesh["default_config"]
+    # flat view: the envoy config generators read the proxy-level
+    # fields at top level (envoy_config.py)
+    mesh_view = {**mesh,
+                 "discovery_address": proxy_defaults["discovery_address"],
+                 "admin_port": proxy_defaults["proxy_admin_port"],
+                 "zipkin_address": mesh["zipkin_address"] or
+                 proxy_defaults["zipkin_address"]}
+
     memory = MemoryRegistry()
     store = MemoryConfigStore()
     if args.registry_file:
@@ -113,8 +130,7 @@ def cmd_pilot_discovery(args: argparse.Namespace) -> int:
         backends.append(eka)
     registry = backends[0] if len(backends) == 1 \
         else AggregateRegistry(backends)
-    ds = DiscoveryService(registry, store,
-                          {"mixer_address": args.mixer_address})
+    ds = DiscoveryService(registry, store, mesh_view)
     port = ds.start(args.address, args.port)
     print(f"pilot-discovery: v1 xDS on {args.address}:{port}")
     _serve_forever()
@@ -437,6 +453,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--registry-file", default="",
                    help="YAML world file: {services: [], configs: []}")
     s.add_argument("--mixer-address", default="")
+    s.add_argument("--mesh-config", default="",
+                   help="mesh config YAML (defaults applied; bad file "
+                        "falls back to defaults with a warning)")
     s.add_argument("--consul-address", default="",
                    help="consul agent addr (host:port) to federate")
     s.add_argument("--eureka-address", default="",

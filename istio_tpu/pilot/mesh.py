@@ -1,0 +1,221 @@
+"""Mesh-wide configuration: defaults, YAML overlay, validation, watch.
+
+Reference: pilot/pkg/model/context.go DefaultMeshConfig (:163) /
+DefaultProxyConfig (:143), ApplyMeshConfigDefaults (:183), the
+bootstrap initMesh chain (pilot/pkg/bootstrap/server.go:245 — file
+overrides defaults, CLI flags override both), and
+ValidateMeshConfig / ValidateProxyConfig
+(pilot/pkg/model/validation.go). Config here is a plain dict with
+snake_case keys (the shape envoy_config.py / discovery.py consume);
+the value semantics and defaults mirror the reference's protos.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Mapping
+
+INGRESS_MODES = ("OFF", "DEFAULT", "STRICT")
+AUTH_POLICIES = ("NONE", "MUTUAL_TLS")
+
+
+def default_proxy_config() -> dict[str, Any]:
+    """model.DefaultProxyConfig (context.go:143)."""
+    return {
+        "config_path": "/etc/istio/proxy",
+        "binary_path": "/usr/local/bin/envoy",
+        "service_cluster": "istio-proxy",
+        "availability_zone": "",
+        "drain_duration_s": 2.0,
+        "parent_shutdown_duration_s": 3.0,
+        "discovery_address": "istio-pilot:15003",
+        "discovery_refresh_delay_s": 1.0,
+        "zipkin_address": "",
+        "connect_timeout_s": 1.0,
+        "statsd_udp_address": "",
+        "proxy_admin_port": 15000,
+        "control_plane_auth_policy": "NONE",
+        "custom_config_file": "",
+    }
+
+
+def default_mesh_config() -> dict[str, Any]:
+    """model.DefaultMeshConfig (context.go:163)."""
+    return {
+        "egress_proxy_address": "",
+        "mixer_address": "",
+        "disable_policy_checks": False,
+        "proxy_listen_port": 15001,
+        "connect_timeout_s": 1.0,
+        "ingress_class": "istio",
+        "ingress_controller_mode": "STRICT",
+        "ingress_service": "istio-ingress",
+        "auth_policy": "NONE",
+        "rds_refresh_delay_s": 1.0,
+        "enable_tracing": True,
+        "access_log_file": "/dev/stdout",
+        "zipkin_address": "",
+        "default_config": default_proxy_config(),
+    }
+
+
+class MeshConfigError(ValueError):
+    pass
+
+
+def validate_mesh_config(mesh: Mapping[str, Any]) -> list[str]:
+    """ValidateMeshConfig's rejection set (validation.go): ports in
+    range, positive durations, known enum values."""
+    errs: list[str] = []
+
+    def port(key: str) -> None:
+        v = mesh.get(key)
+        if not isinstance(v, int) or not 0 < v <= 65535:
+            errs.append(f"{key}: invalid port {v!r}")
+
+    def duration(cfg: Mapping[str, Any], key: str, lo: float = 0.0) -> None:
+        v = cfg.get(key)
+        if not isinstance(v, (int, float)) or v <= lo:
+            errs.append(f"{key}: invalid duration {v!r}")
+
+    port("proxy_listen_port")
+    duration(mesh, "connect_timeout_s")
+    duration(mesh, "rds_refresh_delay_s")
+    if mesh.get("ingress_controller_mode") not in INGRESS_MODES:
+        errs.append(f"ingress_controller_mode: "
+                    f"{mesh.get('ingress_controller_mode')!r} not in "
+                    f"{INGRESS_MODES}")
+    if mesh.get("auth_policy") not in AUTH_POLICIES:
+        errs.append(f"auth_policy: {mesh.get('auth_policy')!r} not in "
+                    f"{AUTH_POLICIES}")
+    proxy = mesh.get("default_config")
+    if not isinstance(proxy, Mapping):
+        errs.append("default_config: required")
+    else:
+        if not isinstance(proxy.get("proxy_admin_port"), int) or \
+                not 0 < proxy["proxy_admin_port"] <= 65535:
+            errs.append(f"default_config.proxy_admin_port: invalid port "
+                        f"{proxy.get('proxy_admin_port')!r}")
+        duration(proxy, "drain_duration_s")
+        duration(proxy, "parent_shutdown_duration_s")
+        duration(proxy, "discovery_refresh_delay_s")
+        duration(proxy, "connect_timeout_s")
+        if proxy.get("control_plane_auth_policy") not in AUTH_POLICIES:
+            errs.append("default_config.control_plane_auth_policy: "
+                        f"{proxy.get('control_plane_auth_policy')!r}")
+        for key in ("config_path", "binary_path", "service_cluster"):
+            if not proxy.get(key):
+                errs.append(f"default_config.{key}: required")
+    return errs
+
+
+def apply_mesh_config_defaults(text: str) -> dict[str, Any]:
+    """ApplyMeshConfigDefaults (context.go:183): defaults overlaid with
+    the YAML document; unknown keys rejected (jsonpb strict-decode
+    posture); the merged result is validated."""
+    import yaml
+
+    try:
+        overlay = yaml.safe_load(text) or {}
+    except yaml.YAMLError as exc:
+        raise MeshConfigError(f"invalid mesh config YAML: {exc}") from exc
+    if not isinstance(overlay, Mapping):
+        raise MeshConfigError("mesh config must be a YAML mapping")
+    mesh = default_mesh_config()
+    for key, value in overlay.items():
+        if key == "default_config":
+            if not isinstance(value, Mapping):
+                raise MeshConfigError("default_config must be a mapping")
+            proxy = mesh["default_config"]
+            for pk, pv in value.items():
+                if pk not in proxy:
+                    raise MeshConfigError(
+                        f"unknown proxy config field {pk!r}")
+                proxy[pk] = pv
+        elif key not in mesh:
+            raise MeshConfigError(f"unknown mesh config field {key!r}")
+        else:
+            mesh[key] = value
+    errs = validate_mesh_config(mesh)
+    if errs:
+        raise MeshConfigError("; ".join(errs))
+    return mesh
+
+
+def read_mesh_config(path: str) -> dict[str, Any]:
+    """cmd.ReadMeshConfig: file → defaults-applied validated config."""
+    with open(path, encoding="utf-8") as f:
+        return apply_mesh_config_defaults(f.read())
+
+
+def init_mesh(config_file: str = "",
+              overrides: Mapping[str, Any] | None = None,
+              on_warn: Callable[[str], None] | None = None
+              ) -> dict[str, Any]:
+    """The bootstrap initMesh chain (server.go:245): file if given and
+    readable (falling back to defaults with a warning, like the
+    reference), then explicit per-flag overrides."""
+    mesh: dict[str, Any] | None = None
+    if config_file:
+        try:
+            mesh = read_mesh_config(config_file)
+        except (OSError, MeshConfigError) as exc:
+            if on_warn is not None:
+                on_warn(f"failed to read mesh configuration, using "
+                        f"default: {exc}")
+    if mesh is None:
+        mesh = default_mesh_config()
+    for key, value in (overrides or {}).items():
+        if value in ("", None):
+            continue
+        if key not in mesh:
+            raise MeshConfigError(f"unknown mesh override {key!r}")
+        mesh[key] = value
+    return mesh
+
+
+class MeshWatcher:
+    """Polling mesh-config reload: on a content change the callback
+    receives the new validated config (bad edits are reported and the
+    old config stays live — a mesh must not go down on a typo)."""
+
+    def __init__(self, path: str,
+                 on_change: Callable[[dict[str, Any]], None],
+                 poll_s: float = 1.0,
+                 on_error: Callable[[str], None] | None = None):
+        self.path = path
+        self.on_change = on_change
+        self.on_error = on_error
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._last: bytes | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="mesh-watcher")
+
+    def start(self) -> None:
+        try:
+            with open(self.path, "rb") as f:
+                self._last = f.read()
+        except OSError:
+            self._last = None
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                with open(self.path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            if data == self._last:
+                continue
+            self._last = data
+            try:
+                self.on_change(apply_mesh_config_defaults(
+                    data.decode("utf-8")))
+            except (MeshConfigError, UnicodeDecodeError) as exc:
+                if self.on_error is not None:
+                    self.on_error(str(exc))
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -305,3 +305,84 @@ def test_aggregate_registry(world):
     assert len(names) == 4
     assert agg.get_service("external.default.svc.cluster.local")
     assert agg.host_instances({"10.2.0.1"})
+
+
+# ---------------------------------------------------------------------------
+# mesh config bootstrap (model.DefaultMeshConfig + bootstrap initMesh)
+# ---------------------------------------------------------------------------
+
+def test_mesh_defaults_and_yaml_overlay():
+    from istio_tpu.pilot.mesh import (apply_mesh_config_defaults,
+                                      default_mesh_config)
+    mesh = default_mesh_config()
+    assert mesh["proxy_listen_port"] == 15001
+    assert mesh["ingress_controller_mode"] == "STRICT"
+    assert mesh["default_config"]["proxy_admin_port"] == 15000
+
+    overlaid = apply_mesh_config_defaults("""
+mixer_address: mixer:9091
+rds_refresh_delay_s: 10
+default_config:
+  discovery_address: pilot:15003
+  drain_duration_s: 45
+""")
+    assert overlaid["mixer_address"] == "mixer:9091"
+    assert overlaid["rds_refresh_delay_s"] == 10
+    assert overlaid["default_config"]["drain_duration_s"] == 45
+    # untouched fields keep defaults
+    assert overlaid["proxy_listen_port"] == 15001
+    assert overlaid["default_config"]["binary_path"] == \
+        "/usr/local/bin/envoy"
+
+
+def test_mesh_config_rejections():
+    import pytest
+    from istio_tpu.pilot.mesh import (MeshConfigError,
+                                      apply_mesh_config_defaults)
+    with pytest.raises(MeshConfigError, match="unknown mesh config"):
+        apply_mesh_config_defaults("not_a_field: 1")
+    with pytest.raises(MeshConfigError, match="unknown proxy config"):
+        apply_mesh_config_defaults("default_config:\n  nope: 1")
+    with pytest.raises(MeshConfigError, match="invalid port"):
+        apply_mesh_config_defaults("proxy_listen_port: 99999")
+    with pytest.raises(MeshConfigError, match="invalid duration"):
+        apply_mesh_config_defaults("connect_timeout_s: -1")
+    with pytest.raises(MeshConfigError, match="ingress_controller_mode"):
+        apply_mesh_config_defaults("ingress_controller_mode: SOMETIMES")
+    with pytest.raises(MeshConfigError, match="auth_policy"):
+        apply_mesh_config_defaults("auth_policy: MAYBE")
+
+
+def test_mesh_init_chain_and_watch(tmp_path):
+    import time
+    from istio_tpu.pilot.mesh import MeshWatcher, init_mesh
+
+    # missing file → defaults + warning (server.go:250-252)
+    warnings = []
+    mesh = init_mesh(config_file=str(tmp_path / "absent.yaml"),
+                     overrides={"mixer_address": "m:9091"},
+                     on_warn=warnings.append)
+    assert mesh["mixer_address"] == "m:9091"
+    assert warnings and "using default" in warnings[0]
+
+    # live reload: good edit applies, bad edit keeps the old config
+    cfg = tmp_path / "mesh.yaml"
+    cfg.write_text("mixer_address: a:1\n")
+    seen, errors = [], []
+    w = MeshWatcher(str(cfg), seen.append, poll_s=0.05,
+                    on_error=errors.append)
+    w.start()
+    try:
+        cfg.write_text("mixer_address: b:2\n")
+        deadline = time.time() + 5
+        while not seen and time.time() < deadline:
+            time.sleep(0.02)
+        assert seen and seen[-1]["mixer_address"] == "b:2"
+        cfg.write_text("proxy_listen_port: 999999\n")
+        deadline = time.time() + 5
+        while not errors and time.time() < deadline:
+            time.sleep(0.02)
+        assert errors and "invalid port" in errors[0]
+        assert seen[-1]["mixer_address"] == "b:2"   # old config stays
+    finally:
+        w.stop()
