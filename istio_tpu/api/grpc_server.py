@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import datetime
 import logging
+import threading
 from concurrent import futures
 from typing import Any
 
@@ -37,6 +38,12 @@ class MixerGrpcServer:
     def __init__(self, runtime: RuntimeServer, address: str = "127.0.0.1:0",
                  max_workers: int = 16):
         self.runtime = runtime
+        # ReferencedAttributes protos memoized per (referenced,
+        # presence) signature — the fused dispatcher shares those
+        # objects across requests with identical device bitmaps, so
+        # uniform traffic builds the proto once instead of per RPC
+        self._ref_cache: dict = {}
+        self._ref_cache_lock = threading.Lock()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers,
                                        thread_name_prefix="mixer-grpc"))
@@ -72,6 +79,11 @@ class MixerGrpcServer:
 
     def _check(self, request: RawCheckRequest,
                context) -> "pb.CheckResponse":
+        bag = self._check_bag(request)
+        result = self.runtime.check_preprocessed(bag)
+        return self._check_response(request, bag, result)
+
+    def _check_bag(self, request: RawCheckRequest):
         gwc = request.global_word_count
         # a non-default dictionary prefix forces the python wire path —
         # the C++ decoder assumes the full global list
@@ -79,10 +91,11 @@ class MixerGrpcServer:
                           native_ok=gwc in (0, len(GLOBAL_WORD_LIST)))
         # preprocess ONCE; precondition check and quota loop share the
         # bag (a no-op returning the wire bag when no APA is configured)
-        bag = self.runtime.preprocess(bag)
+        return self.runtime.preprocess(bag)
 
+    def _check_response(self, request: RawCheckRequest, bag,
+                        result) -> "pb.CheckResponse":
         resp = pb.CheckResponse()
-        result = self.runtime.check_preprocessed(bag)
         resp.precondition.status.code = result.status_code
         if result.status_message:
             resp.precondition.status.message = result.status_message
@@ -92,8 +105,7 @@ class MixerGrpcServer:
         resp.precondition.valid_use_count = min(result.valid_use_count,
                                                 2**31 - 1)
         resp.precondition.referenced_attributes.CopyFrom(
-            referenced_to_proto(result.referenced, bag,
-                                result.referenced_presence))
+            self._referenced_proto(result, bag))
 
         # quota loop (grpcServer.go:188-230): only on successful check
         if result.status_code == 0:
@@ -111,6 +123,23 @@ class MixerGrpcServer:
                     seconds=min(qr.valid_duration_s, _CLAMP_DURATION_S)))
         return resp
 
+    def _referenced_proto(self, result, bag) -> "pb.ReferencedAttributes":
+        presence = result.referenced_presence
+        if presence is None or len(presence) != len(result.referenced):
+            # presence incomplete → the proto depends on this bag
+            return referenced_to_proto(result.referenced, bag, presence)
+        key = (result.referenced,
+               frozenset(presence.items()) if presence else frozenset())
+        with self._ref_cache_lock:
+            cached = self._ref_cache.get(key)
+        if cached is None:
+            cached = referenced_to_proto(result.referenced, bag, presence)
+            with self._ref_cache_lock:
+                if len(self._ref_cache) > 4096:
+                    self._ref_cache.clear()
+                self._ref_cache[key] = cached
+        return cached
+
     def _report(self, request: "pb.ReportRequest",
                 context) -> "pb.ReportResponse":
         bags = []
@@ -125,3 +154,102 @@ class MixerGrpcServer:
         if bags:
             self.runtime.report(bags)
         return pb.ReportResponse()
+
+
+class MixerAioGrpcServer(MixerGrpcServer):
+    """Asyncio variant of the Mixer front-end.
+
+    The sync server parks one thread-pool thread in `future.result()`
+    for every in-flight Check — with the batcher's round-trip at
+    ~100ms+ behind a remote device transport, throughput caps at
+    workers / round-trip and the thread count itself melts the GIL.
+    Here handlers `await` the batcher future on one event loop, so
+    thousands of checks can be in flight from a single thread (the
+    role grpcServer.go gets for free from goroutines)."""
+
+    def __init__(self, runtime: RuntimeServer,
+                 address: str = "127.0.0.1:0"):
+        # note: deliberately NOT calling super().__init__ — the sync
+        # grpc.server and its thread pool are replaced by an aio
+        # server owned by a loop thread
+        self.runtime = runtime
+        self._ref_cache = {}
+        self._ref_cache_lock = threading.Lock()
+        self._address = address
+        self._loop = None
+        self._server = None
+        self._ready = threading.Event()
+        self._stopped = threading.Event()
+        self.port = 0
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="mixer-aio-grpc")
+
+    async def _acheck(self, request: RawCheckRequest,
+                      context) -> "pb.CheckResponse":
+        import asyncio
+        loop = asyncio.get_running_loop()
+        # preprocess may run an APA device round-trip — off the loop
+        bag = await loop.run_in_executor(None, self._check_bag, request)
+        # shield: a client cancel must cancel THIS handler only, never
+        # the shared batcher future (a cancelled batch-mate would
+        # otherwise poison result distribution for the whole batch)
+        result = await asyncio.shield(asyncio.wrap_future(
+            self.runtime.submit_check_preprocessed(bag)))
+        return self._check_response(request, bag, result)
+
+    async def _areport(self, request: "pb.ReportRequest",
+                       context) -> "pb.ReportResponse":
+        import asyncio
+        # the report pipeline is synchronous host work (decode +
+        # adapter fan-out); never stall in-flight checks on the loop
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._report, request, context)
+
+    def _run(self) -> None:
+        import asyncio
+
+        from grpc import aio
+
+        async def serve():
+            server = aio.server()
+            handlers = {
+                "Check": grpc.unary_unary_rpc_method_handler(
+                    self._acheck,
+                    request_deserializer=RawCheckRequest,
+                    response_serializer=pb.CheckResponse.SerializeToString),
+                "Report": grpc.unary_unary_rpc_method_handler(
+                    self._areport,
+                    request_deserializer=pb.ReportRequest.FromString,
+                    response_serializer=pb.ReportResponse.SerializeToString),
+            }
+            server.add_generic_rpc_handlers((
+                grpc.method_handlers_generic_handler(
+                    "istio.mixer.v1.Mixer", handlers),))
+            self.port = server.add_insecure_port(self._address)
+            await server.start()
+            self._server = server
+            self._ready.set()
+            await server.wait_for_termination()
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(serve())
+        finally:
+            self._loop.close()
+            self._stopped.set()
+
+    def start(self) -> int:
+        self._thread.start()
+        if not self._ready.wait(timeout=30):
+            raise RuntimeError("aio grpc server failed to start")
+        log.info("mixer aio grpc server on port %d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        import asyncio
+        if self._loop is None or self._server is None:
+            return
+        asyncio.run_coroutine_threadsafe(
+            self._server.stop(grace), self._loop)
+        self._stopped.wait(timeout=grace + 10)

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Sequence
 
 from istio_tpu.attribute.bag import Bag
@@ -158,10 +158,20 @@ class CheckBatcher:
                 results = self.run_batch(padded)
             except Exception as exc:
                 for _, fut in batch:
-                    fut.set_exception(exc)
+                    try:
+                        fut.set_exception(exc)
+                    except InvalidStateError:
+                        pass                     # caller cancelled
                 return
+            # a caller may cancel its future mid-batch (an aio client
+            # disconnect) — even between a cancelled() check and the
+            # set; one cancelled future must never abort result
+            # distribution for its batch-mates
             for (_, fut), result in zip(batch, results):
-                fut.set_result(result)
+                try:
+                    fut.set_result(result)
+                except InvalidStateError:
+                    pass
         finally:
             self._inflight.release()
 

@@ -87,6 +87,13 @@ class RuntimeServer:
         (the gRPC server, which reuses the bag for the quota loop)."""
         return self.batcher.check(bag)
 
+    def submit_check_preprocessed(self, bag: Bag):
+        """Non-blocking batcher entry → concurrent.futures.Future.
+        The async gRPC front awaits it so an in-flight check holds no
+        thread (the sync front burns one blocked thread per RPC for
+        the whole batch round-trip)."""
+        return self.batcher.submit(bag)
+
     def check_many(self, bags: Sequence[Bag]) -> list[CheckResponse]:
         """Pre-batched entry (load tests / the C++ shim's batches)."""
         return list(self._run_check_batch(

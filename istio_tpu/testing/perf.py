@@ -52,6 +52,10 @@ class PerfReport:
 
 def _worker(target: str, payloads: list[bytes], duration_s: float,
             concurrency: int, start_at: float, q: "mp.Queue") -> None:
+    """`concurrency` requests in flight via one issuing thread +
+    completion callbacks on grpc's IO threads — a blocked thread per
+    RPC melts the GIL at the depths a ~100ms-RTT device transport
+    needs to stay busy (this rig has ONE core for server AND client)."""
     import threading
 
     import grpc
@@ -67,45 +71,48 @@ def _worker(target: str, payloads: list[bytes], duration_s: float,
     errors = [0]
     first_error: list[str] = []
     lock = threading.Lock()
+    sem = threading.Semaphore(concurrency)
+    deadline = start_at + duration_s
 
-    def run(tid: int) -> None:
-        i = tid
-        my_lat = []
-        my_err = 0
-        deadline = start_at + duration_s
-        # traffic flows immediately (warming jit buckets/caches); only
-        # calls begun inside the measurement window are recorded
-        while True:
-            now = time.time()
-            if now >= deadline:
-                break
-            p = payloads[i % len(payloads)]
-            i += concurrency
-            t0 = time.perf_counter()
-            try:
-                call(p)
-                if now >= start_at:
-                    my_lat.append(time.perf_counter() - t0)
-            except Exception as exc:
-                if now >= start_at:
-                    my_err += 1
+    def on_done(fut, t0: float, measured: bool) -> None:
+        try:
+            fut.result()
+            if measured:
                 with lock:
-                    if not first_error:
-                        first_error.append(f"{type(exc).__name__}: "
-                                           f"{exc}"[:300])
-        with lock:
-            lat.extend(my_lat)
-            errors[0] += my_err
+                    lat.append(time.perf_counter() - t0)
+        except Exception as exc:
+            with lock:
+                if measured:
+                    errors[0] += 1
+                if not first_error:
+                    first_error.append(f"{type(exc).__name__}: "
+                                       f"{exc}"[:300])
+        finally:
+            sem.release()
 
-    threads = [threading.Thread(target=run, args=(t,), daemon=True)
-               for t in range(concurrency)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    i = 0
+    # traffic flows immediately (warming jit buckets/caches); only
+    # calls begun inside the measurement window are recorded
+    while True:
+        now = time.time()
+        if now >= deadline:
+            break
+        sem.acquire()
+        p = payloads[i % len(payloads)]
+        i += 1
+        t0 = time.perf_counter()
+        fut = call.future(p, timeout=60)
+        fut.add_done_callback(
+            lambda f, t0=t0, m=now >= start_at: on_done(f, t0, m))
+    # drain by re-acquiring every permit: all callbacks have run (and
+    # released) once acquisition succeeds, so the snapshot below races
+    # nothing; the per-call 60s deadline bounds the wait
+    for _ in range(concurrency):
+        sem.acquire()
     channel.close()
-    q.put((np.asarray(lat, np.float64), errors[0],
-           first_error[0] if first_error else ""))
+    with lock:
+        q.put((np.asarray(lat, np.float64), errors[0],
+               first_error[0] if first_error else ""))
 
 
 def run_load(target: str, payloads: Sequence[bytes],

@@ -162,3 +162,35 @@ def test_report_delta_coding(rig):
     assert handler.registry.get_sample_value(
         "istio_tpu_bytes_istio_system_total",
         {"dest": "d2.ns.svc"}) == 7.0
+
+
+def test_aio_server_check_parity():
+    """MixerAioGrpcServer serves the same Check semantics as the sync
+    front — handlers await the batcher instead of blocking a thread."""
+    from istio_tpu.api.grpc_server import MixerAioGrpcServer
+    runtime = RuntimeServer(_store(), ServerArgs(batch_window_s=0.001,
+                                                 max_batch=64))
+    server = MixerAioGrpcServer(runtime)
+    port = server.start()
+    client = MixerClient(f"127.0.0.1:{port}", enable_check_cache=False)
+    try:
+        ok = client.check({"source.labels": {"version": "v1"}})
+        assert ok.precondition.status.code == 0
+        assert len(ok.precondition.referenced_attributes
+                   .attribute_matches) >= 1
+        bad = client.check({"source.labels": {"version": "v9"}})
+        assert bad.precondition.status.code == 5      # NOT_FOUND
+        # concurrent checks coalesce without holding handler threads
+        import threading
+        codes = []
+        def call(i):
+            r = client.check({"source.labels": {"version":
+                                                "v1" if i % 2 else "v9"}})
+            codes.append(r.precondition.status.code)
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(16)]
+        for t in threads: t.start()
+        for t in threads: t.join(timeout=30)
+        assert sorted(codes) == [0] * 8 + [5] * 8
+    finally:
+        client.close(); server.stop(); runtime.close()
