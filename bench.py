@@ -154,6 +154,9 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
         # concurrency runs out
         pipeline = max(2, min(16, int(sync_ms / 8) or 2))
         store = workloads.make_store(n_rules)
+        # two buckets: small batches for latency at low load, one big
+        # bucket so heavy load amortizes per-batch host work (measured
+        # better than 256-only on the 1-core rig)
         buckets = (256, 2048)
         srv = RuntimeServer(store, ServerArgs(
             batch_window_s=0.001, max_batch=2048, pipeline=pipeline,

@@ -1,19 +1,25 @@
 """opa — policy-engine authorization adapter.
 
 Reference: mixer/adapter/opa (1,470 LoC) embeds the Open Policy Agent
-Rego evaluator and asks it `checkMethod` over the authorization
-instance. Rego itself is a Go library with no Python/TPU equivalent in
-this image, so this adapter evaluates policies written in the
-framework's OWN expression language over the flattened authorization
-instance — the same attribute-expression dialect used everywhere else
-(a deliberate TPU-native reinterpretation: policies stay compilable to
-the device ruleset path). A policy is a list of allow rules; any rule
-evaluating true allows the action (OPA-style default-deny).
+Rego evaluator and asks it `checkMethod` over an `input` document of
+the authorization instance (opa.go:217-256). Two policy dialects:
 
-Instance fields are exposed as attributes:
-  subject.user, subject.groups, subject.properties[...],
-  action.namespace, action.service, action.method, action.path,
-  action.properties[...]
+  * **Rego** (reference-compatible): policies containing a `package`
+    declaration compile through the native Rego-subset evaluator
+    (adapters/rego.py) — the reference's own test policy corpus
+    (bucket-admins, service-graph + org-chart) runs unmodified. Config
+    keys follow the reference: `policies` (modules), `check_method`
+    ("data.<pkg>.<rule>"), `fail_close`.
+  * **Expression language** (TPU-native reinterpretation): policies
+    without a `package` declaration evaluate in the framework's own
+    attribute-expression dialect over the flattened instance — these
+    stay compilable to the device ruleset path. Any rule evaluating
+    true allows (default-deny).
+
+Instance fields are exposed to expression policies as attributes
+(subject.user, action.method, action.properties[...], ...) and to
+Rego as the reference's input document {subject: {...},
+action: {...}}.
 """
 from __future__ import annotations
 
@@ -51,16 +57,47 @@ def _flatten(instance: Mapping[str, Any]) -> dict[str, Any]:
     return out
 
 
+def _is_rego(policies) -> bool:
+    """Rego modules carry a package declaration (possibly after
+    comments); expression-language policies never contain one."""
+    import re
+    return any(re.search(r"^\s*package\s", p, re.M) for p in policies)
+
+
 class OpaHandler(Handler):
     def __init__(self, config: Mapping[str, Any]):
-        finder = AttributeDescriptorFinder(_POLICY_MANIFEST)
+        from istio_tpu.adapters.rego import RegoEngine, RegoError
         self.fail_close = bool(config.get("fail_close", True))
+        policies = list(config.get("policies", ()))
+        self._rego = None
+        self._rego_error: str | None = None
         self._rules: list[OracleProgram] = []
-        for text in config.get("policies", ()):
-            self._rules.append(OracleProgram(text, finder))
+        if _is_rego(policies):
+            self.check_method = str(config.get("check_method",
+                                               "data.mixerauthz.allow"))
+            try:
+                self._rego = RegoEngine(policies)
+            except RegoError as exc:
+                # the reference keeps serving with hasConfigError set;
+                # every request then routes through handleFailClose
+                # (opa.go:205-221 — denied under fail_close, allowed
+                # under explicit fail-open)
+                self._rego_error = str(exc)
+        else:
+            finder = AttributeDescriptorFinder(_POLICY_MANIFEST)
+            for text in policies:
+                self._rules.append(OracleProgram(text, finder))
+
+    def _fail(self, message: str) -> CheckResult:
+        if self.fail_close:
+            return CheckResult(status_code=PERMISSION_DENIED,
+                               status_message=message)
+        return CheckResult(status_code=OK, status_message="fail-open")
 
     def handle_check(self, template: str,
                      instance: Mapping[str, Any]) -> CheckResult:
+        if self._rego is not None or self._rego_error is not None:
+            return self._check_rego(instance)
         bag = bag_from_mapping(_flatten(instance))
         for prog in self._rules:
             try:
@@ -74,12 +111,62 @@ class OpaHandler(Handler):
         return CheckResult(status_code=PERMISSION_DENIED,
                            status_message="opa: no policy allowed")
 
+    def _check_rego(self, instance: Mapping[str, Any]) -> CheckResult:
+        """opa.go HandleAuthorization: evaluate checkMethod over
+        input={action, subject}; non-bool/undefined → fail-close."""
+        from istio_tpu.adapters.rego import RegoError
+        if self._rego_error is not None:
+            # config error → handleFailClose (opa.go:205-215): denied
+            # under fail_close (the default), allowed when the
+            # operator explicitly configured fail-open
+            return self._fail("opa: request was rejected")
+        input_doc = {
+            "subject": dict(instance.get("subject") or {}),
+            "action": dict(instance.get("action") or {}),
+        }
+        try:
+            result = self._rego.query(self.check_method, input_doc)
+        except RegoError as exc:
+            return self._fail(f"opa: request was rejected. err: {exc}")
+        if not isinstance(result, bool):
+            return self._fail("opa: request was rejected")
+        if not result:
+            return CheckResult(status_code=PERMISSION_DENIED,
+                               status_message="opa: request was rejected")
+        return CheckResult(status_code=OK)
+
 
 class OpaBuilder(Builder):
     def validate(self) -> list[str]:
-        errs = []
+        errs: list[str] = []
+        policies = list(self.config.get("policies", ()))
+        if _is_rego(policies):
+            from istio_tpu.adapters.rego import RegoEngine, RegoError
+            engine = None
+            try:
+                engine = RegoEngine(policies)
+            except RegoError as exc:
+                errs.append(f"Policy: {exc}")
+            method = str(self.config.get("check_method",
+                                         "data.mixerauthz.allow"))
+            parts = method.split(".")
+            if parts[0] != "data" or len(parts) < 3:
+                errs.append(f"check_method: {method!r} must be "
+                            "data.<package>.<rule>")
+            elif engine is not None:
+                # a typo'd package/rule would otherwise only surface
+                # as a runtime deny on every request
+                pkg, rule = ".".join(parts[1:-1]), parts[-1]
+                mod = engine.modules.get(pkg)
+                if mod is None:
+                    errs.append(f"check_method: unknown package "
+                                f"{pkg!r}")
+                elif rule not in mod.rules:
+                    errs.append(f"check_method: package {pkg!r} has "
+                                f"no rule {rule!r}")
+            return errs
         finder = AttributeDescriptorFinder(_POLICY_MANIFEST)
-        for text in self.config.get("policies", ()):
+        for text in policies:
             try:
                 prog = OracleProgram(text, finder)
                 if prog.result_type != V.BOOL:
@@ -96,5 +183,6 @@ INFO = adapter_registry.register(Info(
     name="opa",
     supported_templates=("authorization",),
     builder=OpaBuilder,
-    description="default-deny policy authorization (expression-language "
-                "policies; Rego not embedded)"))
+    description="policy authorization: native Rego-subset evaluator "
+                "(reference corpus compatible) or expression-language "
+                "policies"))

@@ -124,6 +124,15 @@ class InternTable:
         with self._lock:
             return self._by_key.get(key, ID_INVALID)
 
+    def reader(self) -> Mapping[tuple[str, Hashable], int]:
+        """Lock-free read view for hot loops. Sound because the table
+        only ever GROWS (ids are never reassigned or removed) — a
+        reader that misses an in-flight insert sees a strict subset,
+        which callers must tolerate (the tensorizer does: a missed
+        constant becomes a batch ephemeral). This method is the
+        contract; do not reach into _by_key directly."""
+        return self._by_key
+
     def value_of(self, idx: int) -> Any:
         if idx < 0:
             raise KeyError(
@@ -289,15 +298,22 @@ class Tensorizer:
         eph_ids: dict[tuple[str, Hashable], int] = {}
         eph_values: list[Any] = []
 
+        # lock-free constant lookup (see InternTable.reader): a
+        # concurrently-added constant we miss simply becomes a batch
+        # ephemeral, which this snapshot's programs never compare
+        # against anyway
+        by_key = self.interner.reader()
+        eph_get, eph_set = eph_ids.get, eph_ids.__setitem__
+
         def rid(v: Any) -> int:
-            idx = self.interner.lookup(v)
-            if idx != ID_INVALID:
-                return idx
             key = _normalize(v)
-            neg = eph_ids.get(key)
+            idx = by_key.get(key)
+            if idx is not None:
+                return idx
+            neg = eph_get(key)
             if neg is None:
                 neg = -1 - len(eph_values)
-                eph_ids[key] = neg
+                eph_set(key, neg)
                 eph_values.append(v)
             return neg
 

@@ -1,0 +1,292 @@
+"""Rego-subset evaluator vs the reference opa adapter's own policy
+corpus (mixer/adapter/opa/opa_test.go:180-340)."""
+import pytest
+
+from istio_tpu.adapters.rego import RegoEngine, RegoError, parse_module
+
+BUCKET_POLICY = """package mixerauthz
+    policy = [
+      {
+        "rule": {
+          "verbs": [
+            "storage.buckets.get"
+          ],
+          "users": [
+            "bucket-admins"
+          ]
+        }
+      }
+    ]
+
+    default allow = false
+
+    allow = true {
+      rule = policy[_].rule
+      input.subject.user = rule.users[_]
+      input.action.method = rule.verbs[_]
+    }"""
+
+
+EXAMPLE = """
+    package example
+    import data.service_graph
+    import data.org_chart
+
+    # Deny request by default.
+    default allow = false
+
+    # Allow request if...
+    allow {
+        service_graph.allow  # service graph policy allows, and...
+        org_chart.allow      # org chart policy allows.
+    }
+"""
+
+ORG_CHART = """
+    package org_chart
+
+    parsed_path = p {
+        trim(input.action.path, "/", trimmed)
+        split(trimmed, "/", p)
+    }
+
+    employees = {
+        "bob": {"manager": "janet", "roles": ["engineering"]},
+        "alice": {"manager": "janet", "roles": ["engineering"]},
+        "janet": {"roles": ["engineering"]},
+        "ken": {"roles": ["hr"]},
+    }
+
+    # Allow access to non-sensitive APIs.
+    allow { not is_sensitive_api }
+
+    is_sensitive_api {
+        parsed_path[0] = "reviews"
+    }
+
+    allow {
+        parsed_path = ["reviews", user]
+        input.subject.user = user
+    }
+
+    allow {
+        parsed_path = ["reviews", user]
+        input.subject.user = employees[user].manager
+    }
+
+    allow {
+        is_hr
+    }
+
+    is_hr {
+        employees[input.subject.user].roles[_] = "hr"
+    }
+"""
+
+SERVICE_GRAPH = """
+    package service_graph
+
+    service_graph = {
+        "landing_page": ["details", "reviews"],
+        "reviews": ["ratings"],
+    }
+
+    default allow = false
+
+    allow {
+        input.action.properties.target = "landing_page"
+    }
+
+    allow {
+        allowed_targets = service_graph[input.action.properties.source]
+        input.action.properties.target = allowed_targets[_]
+    }
+"""
+
+
+def test_bucket_admin_policy():
+    eng = RegoEngine([BUCKET_POLICY])
+    allow = eng.query("data.mixerauthz.allow", {
+        "subject": {"user": "bucket-admins"},
+        "action": {"method": "storage.buckets.get"}})
+    assert allow is True
+    deny = eng.query("data.mixerauthz.allow", {
+        "subject": {"user": "someone-else"},
+        "action": {"method": "storage.buckets.get"}})
+    assert deny is False
+    deny2 = eng.query("data.mixerauthz.allow", {
+        "subject": {"user": "bucket-admins"},
+        "action": {"method": "storage.buckets.delete"}})
+    assert deny2 is False
+
+
+@pytest.fixture(scope="module")
+def example_engine():
+    return RegoEngine([EXAMPLE, ORG_CHART, SERVICE_GRAPH])
+
+
+def _q(eng, user, source, target, path):
+    return eng.query("data.example.allow", {
+        "subject": {"user": user},
+        "action": {"path": path,
+                   "properties": {"source": source, "target": target}}})
+
+
+def test_example_service_graph_and_org_chart(example_engine):
+    eng = example_engine
+    # landing_page target is always allowed by service graph; /health
+    # is a non-sensitive API
+    assert _q(eng, "bob", "gateway", "landing_page", "/health") is True
+    # landing_page → reviews edge exists; non-sensitive path
+    assert _q(eng, "bob", "landing_page", "reviews", "/health") is True
+    # no edge details → ratings
+    assert _q(eng, "bob", "details", "ratings", "/health") is False
+    # sensitive API: /reviews/bob readable by bob himself
+    assert _q(eng, "bob", "landing_page", "reviews",
+              "/reviews/bob") is True
+    # ...and by bob's manager janet
+    assert _q(eng, "janet", "landing_page", "reviews",
+              "/reviews/bob") is True
+    # ...but not by alice (peer, not manager)
+    assert _q(eng, "alice", "landing_page", "reviews",
+              "/reviews/bob") is False
+    # HR sees everything
+    assert _q(eng, "ken", "landing_page", "reviews",
+              "/reviews/bob") is True
+
+
+def test_parse_errors_reported():
+    with pytest.raises(RegoError, match="rego_parse_error"):
+        parse_module("package p\n@@@")
+    with pytest.raises(RegoError):
+        RegoEngine([""])
+    with pytest.raises(RegoError, match="rego_parse_error"):
+        # the reference's invalid-syntax case: a rule assignment with
+        # a dangling body brace
+        RegoEngine(["package mixerauthz\nallow = true {"])
+
+
+def test_rule_semantics():
+    eng = RegoEngine(["""package t
+        default d = false
+        d { input.x = 1 }
+        const = "k"
+        multi { input.a = 1 }
+        multi { input.b = 2 }
+        val = v { split(input.s, ",", parts); parts[1] = v }
+    """])
+    assert eng.query("data.t.d", {"x": 1}) is True
+    assert eng.query("data.t.d", {"x": 2}) is False
+    assert eng.query("data.t.const", {}) == "k"
+    assert eng.query("data.t.multi", {"b": 2}) is True
+    assert eng.query("data.t.multi", {"c": 3}) is None   # undefined
+    assert eng.query("data.t.val", {"s": "a,b,c"}) == "b"
+
+
+def test_negation_and_builtins():
+    eng = RegoEngine(["""package t
+        allow { not blocked }
+        blocked { input.user = "evil" }
+        pre { startswith(input.path, "/api") }
+        low = out { lower(input.name, out) }
+        n = c { count(input.items, c) }
+    """])
+    assert eng.query("data.t.allow", {"user": "good"}) is True
+    assert eng.query("data.t.allow", {"user": "evil"}) is None
+    assert eng.query("data.t.pre", {"path": "/api/x"}) is True
+    assert eng.query("data.t.low", {"name": "ABC"}) == "abc"
+    assert eng.query("data.t.n", {"items": [1, 2, 3]}) == 3
+
+
+def test_recursion_guard():
+    eng = RegoEngine(["package t\na { b }\nb { a }"])
+    with pytest.raises(RegoError, match="recursion"):
+        eng.query("data.t.a", {})
+
+
+# ---------------------------------------------------------------------------
+# opa adapter integration (opa.go HandleAuthorization semantics)
+# ---------------------------------------------------------------------------
+
+def _opa(config):
+    from istio_tpu.adapters.opa import OpaBuilder
+    from istio_tpu.adapters.sdk import Env
+    b = OpaBuilder(config, Env("test"))
+    errs = b.validate()
+    assert not errs, errs
+    return b.build()
+
+
+def test_opa_adapter_rego_mode():
+    h = _opa({"policies": [BUCKET_POLICY],
+              "check_method": "data.mixerauthz.allow"})
+    ok = h.handle_check("authorization", {
+        "subject": {"user": "bucket-admins"},
+        "action": {"method": "storage.buckets.get"}})
+    assert ok.status_code == 0
+    deny = h.handle_check("authorization", {
+        "subject": {"user": "stranger"},
+        "action": {"method": "storage.buckets.get"}})
+    assert deny.status_code == 7
+    assert "opa: request was rejected" in deny.status_message
+
+
+def test_opa_adapter_example_corpus():
+    h = _opa({"policies": [EXAMPLE, ORG_CHART, SERVICE_GRAPH],
+              "check_method": "data.example.allow"})
+
+    def check(user, source, target, path):
+        return h.handle_check("authorization", {
+            "subject": {"user": user},
+            "action": {"path": path,
+                       "properties": {"source": source,
+                                      "target": target}}}).status_code
+
+    assert check("bob", "gateway", "landing_page", "/health") == 0
+    assert check("janet", "landing_page", "reviews", "/reviews/bob") == 0
+    assert check("alice", "landing_page", "reviews", "/reviews/bob") == 7
+
+
+def test_opa_adapter_bad_policy_fails_closed():
+    """opa.go:218-221: a config error serves fail-close (or fail-open
+    when configured), matching the reference's hasConfigError path."""
+    from istio_tpu.adapters.opa import OpaBuilder, OpaHandler
+    from istio_tpu.adapters.sdk import Env
+    b = OpaBuilder({"policies": ["package p\nallow = true {"]},
+                   Env("test"))
+    errs = b.validate()
+    assert errs and "rego_parse_error" in errs[0]
+    # handler built anyway (runtime keeps serving) → every check denied
+    h = OpaHandler({"policies": ["package p\nallow = true {"]})
+    assert h.handle_check("authorization", {}).status_code == 7
+    h2 = OpaHandler({"policies": ["package p\nallow = true {"],
+                     "fail_close": False})
+    assert h2.handle_check("authorization", {}).status_code == 0
+
+
+def test_opa_adapter_expression_mode_still_works():
+    h = _opa({"policies": ['subject.user == "admin"']})
+    ok = h.handle_check("authorization", {"subject": {"user": "admin"}})
+    assert ok.status_code == 0
+    deny = h.handle_check("authorization", {"subject": {"user": "bob"}})
+    assert deny.status_code == 7
+
+
+def test_opa_rego_detection_and_method_validation():
+    from istio_tpu.adapters.opa import OpaBuilder
+    from istio_tpu.adapters.sdk import Env
+    # comment-leading Rego is still Rego
+    h = _opa({"policies": ["# admins only\n" + BUCKET_POLICY],
+              "check_method": "data.mixerauthz.allow"})
+    assert h.handle_check("authorization", {
+        "subject": {"user": "bucket-admins"},
+        "action": {"method": "storage.buckets.get"}}).status_code == 0
+    # a typo'd check_method is a CONFIG error, not a runtime mystery
+    b = OpaBuilder({"policies": [BUCKET_POLICY],
+                    "check_method": "data.mixerauth.allow"}, Env("t"))
+    errs = b.validate()
+    assert errs and "unknown package" in errs[0]
+    b2 = OpaBuilder({"policies": [BUCKET_POLICY],
+                     "check_method": "data.mixerauthz.alow"}, Env("t"))
+    errs2 = b2.validate()
+    assert errs2 and "no rule" in errs2[0]
