@@ -114,8 +114,13 @@ def cmd_pilot_discovery(args: argparse.Namespace) -> int:
 
     memory = MemoryRegistry()
     store = MemoryConfigStore()
+    reload_stop = None
     if args.registry_file:
         _load_world(memory, store, args.registry_file)
+        # live reload: istioctl register/deregister edits the file and
+        # must take effect without a restart (the reference writes to
+        # the live registry; here the file IS the registry backend)
+        reload_stop = _watch_registry_file(memory, args.registry_file)
     backends = [memory]
     # platform registries (bootstrap/server.go:360 initServiceControllers)
     if args.consul_address:
@@ -134,7 +139,143 @@ def cmd_pilot_discovery(args: argparse.Namespace) -> int:
     port = ds.start(args.address, args.port)
     print(f"pilot-discovery: v1 xDS on {args.address}:{port}")
     _serve_forever()
+    if reload_stop is not None:
+        reload_stop.set()
     ds.stop()
+    return 0
+
+
+def _watch_registry_file(memory, path: str):
+    """Poll the registry YAML's content; on change, rebuild the memory
+    registry's service set (service handlers fire → the discovery
+    cache invalidates)."""
+    import hashlib
+    import threading
+    import yaml
+    from istio_tpu.pilot import Port, Service
+
+    stop = threading.Event()
+
+    def digest() -> bytes:
+        try:
+            with open(path, "rb") as f:
+                return hashlib.sha256(f.read()).digest()
+        except OSError:
+            return b""
+
+    last = digest()
+
+    def loop() -> None:
+        nonlocal last
+        while not stop.wait(1.0):
+            now = digest()
+            if now == last:
+                continue
+            last = now
+            try:
+                with open(path, encoding="utf-8") as f:
+                    world = yaml.safe_load(f) or {}
+            except (OSError, yaml.YAMLError) as exc:
+                print(f"pilot-discovery: registry reload failed: {exc}")
+                continue
+            wanted = {}
+            for s in world.get("services") or ():
+                svc = Service(
+                    hostname=s["hostname"],
+                    address=s.get("address", "0.0.0.0"),
+                    ports=tuple(Port(p["name"], int(p["port"]),
+                                     p.get("protocol", "HTTP"))
+                                for p in s.get("ports") or ()))
+                wanted[svc.hostname] = (svc, [
+                    (e["address"], e.get("labels", {}))
+                    for e in s.get("endpoints") or ()])
+            for host in [svc.hostname for svc in memory.services()]:
+                if host not in wanted:
+                    memory.remove_service(host)
+            for svc, endpoints in wanted.values():
+                memory.add_service(svc, endpoints)
+
+    t = threading.Thread(target=loop, daemon=True,
+                         name="registry-reload")
+    t.start()
+    return stop
+
+
+def _register_endpoint(args: argparse.Namespace) -> int:
+    """istioctl register <svc> <ip> [name:port...] /
+    deregister <svc> <ip> over the registry YAML."""
+    import yaml
+    path = args.registry_file
+    try:
+        with open(path, encoding="utf-8") as f:
+            world = yaml.safe_load(f) or {}
+    except FileNotFoundError:
+        world = {}
+    # normalize null-valued keys (a hand-written "services:" with no
+    # value loads as None)
+    world["services"] = services = list(world.get("services") or ())
+    hostname = args.kind        # positional reuse: <svc> <ip>
+    address = args.name
+    if not hostname or not address:
+        print("usage: istioctl register <service> <ip> [name:port ...]",
+              file=sys.stderr)
+        return 2
+    svc = next((s for s in services if s.get("hostname") == hostname),
+               None)
+    if args.command == "register":
+        ports = []
+        specs = [p for p in (args.ports or "http:80").split(",") if p]
+        for spec in specs:
+            name, sep, num = spec.partition(":")
+            if not sep or not num.isdigit():
+                print(f"bad port spec {spec!r}: expected name:port",
+                      file=sys.stderr)
+                return 2
+            ports.append({"name": name, "port": int(num)})
+        if svc is None:
+            svc = {"hostname": hostname, "ports": ports, "endpoints": []}
+            services.append(svc)
+        else:
+            # reconcile ports on an existing service like the
+            # reference RegisterEndpoint (register.go:126-136)
+            existing = {p.get("name") for p in (svc.get("ports") or ())}
+            svc["ports"] = list(svc.get("ports") or ()) + \
+                [p for p in ports if p["name"] not in existing]
+        svc["endpoints"] = eps = list(svc.get("endpoints") or ())
+        if not any(e.get("address") == address for e in eps):
+            eps.append({"address": address})
+        print(f"registered {address} -> {hostname}")
+    else:
+        if svc is None:
+            print(f"unknown service {hostname}", file=sys.stderr)
+            return 1
+        svc["endpoints"] = [e for e in (svc.get("endpoints") or ())
+                            if e.get("address") != address]
+        print(f"deregistered {address} from {hostname}")
+    with open(path, "w", encoding="utf-8") as f:
+        yaml.safe_dump(world, f, sort_keys=False)
+    return 0
+
+
+def cmd_generate_key_cert(args: argparse.Namespace) -> int:
+    """generate_cert / generate_csr (security/cmd): standalone key +
+    self-signed cert or CSR for an identity."""
+    from istio_tpu.security import pki
+    key = pki.generate_key()
+    key_pem = pki.key_to_pem(key)
+    if args.mode == "csr":
+        out = pki.generate_csr(key, args.identity, org=args.org)
+    else:
+        from istio_tpu.security.ca import IstioCA
+        ca = IstioCA.new_self_signed(org=args.org)
+        out = ca.sign(pki.generate_csr(key, args.identity, org=args.org))
+        with open(args.out_root, "wb") as f:
+            f.write(ca.get_root_certificate())
+    with open(args.out_key, "wb") as f:
+        f.write(key_pem)
+    with open(args.out_cert, "wb") as f:
+        f.write(out)
+    print(f"wrote {args.out_key} + {args.out_cert}")
     return 0
 
 
@@ -201,9 +342,9 @@ def cmd_pilot_agent(args: argparse.Namespace) -> int:
 
 
 def cmd_istioctl(args: argparse.Namespace) -> int:
-    """istioctl create/get/delete/kube-inject over an FsStore-style
-    config dir (the reference talks to k8s CRDs; the file store is this
-    build's durable backend)."""
+    """istioctl create/get/delete/kube-inject/register/deregister over
+    an FsStore-style config dir (the reference talks to k8s CRDs; the
+    file store is this build's durable backend)."""
     import os
     import yaml
     from istio_tpu.pilot.model import IstioConfigTypes, ValidationError
@@ -212,6 +353,12 @@ def cmd_istioctl(args: argparse.Namespace) -> int:
         with open(args.filename, encoding="utf-8") as f:
             print(into_resource_file(InjectParams(), f.read()))
         return 0
+    if args.command in ("register", "deregister"):
+        # VM endpoint (de)registration (serviceregistry/kube/
+        # register.go:120: create the Service if absent, then add or
+        # remove the endpoint address) — against the registry file
+        # pilot-discovery serves from
+        return _register_endpoint(args)
     cfg_dir = args.config_dir
     if args.command in ("create", "replace"):
         with open(args.filename, encoding="utf-8") as f:
@@ -469,16 +616,39 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--drain-duration", type=int, default=45)
     s.set_defaults(fn=cmd_pilot_agent)
 
-    s = sub.add_parser("istioctl", help="config CRUD + kube-inject")
+    s = sub.add_parser("istioctl", help="config CRUD + kube-inject + "
+                                        "VM registration")
     s.add_argument("command",
                    choices=["create", "replace", "get", "delete",
-                            "kube-inject"])
+                            "kube-inject", "register", "deregister"])
     s.add_argument("-f", "--filename", default="")
     s.add_argument("--config-dir", default=".")
-    s.add_argument("kind", nargs="?", default="all")
-    s.add_argument("name", nargs="?", default="")
+    s.add_argument("--registry-file", default="registry.yaml",
+                   help="registry YAML for register/deregister")
+    s.add_argument("--ports", default="",
+                   help="comma-separated name:port pairs for register")
+    s.add_argument("kind", nargs="?", default="all",
+                   help="config kind, or <service> for register")
+    s.add_argument("name", nargs="?", default="",
+                   help="config name, or <ip> for register")
     s.add_argument("-n", "--namespace", default="default")
     s.set_defaults(fn=cmd_istioctl)
+
+    s = sub.add_parser("generate-cert",
+                       help="standalone key + CA-signed cert")
+    s.add_argument("--identity", required=True)
+    s.add_argument("--org", default="istio_tpu")
+    s.add_argument("--out-key", default="key.pem")
+    s.add_argument("--out-cert", default="cert.pem")
+    s.add_argument("--out-root", default="root-cert.pem")
+    s.set_defaults(fn=cmd_generate_key_cert, mode="cert")
+
+    s = sub.add_parser("generate-csr", help="standalone key + CSR")
+    s.add_argument("--identity", required=True)
+    s.add_argument("--org", default="istio_tpu")
+    s.add_argument("--out-key", default="key.pem")
+    s.add_argument("--out-cert", default="csr.pem")
+    s.set_defaults(fn=cmd_generate_key_cert, mode="csr")
 
     s = sub.add_parser("istio-ca", help="certificate authority")
     s.add_argument("--address", default="127.0.0.1")

@@ -148,3 +148,59 @@ def test_istioctl_create_get_delete(tmp_path):
     fb.write_text(yaml.safe_dump(bad))
     assert main(["istioctl", "create", "-f", str(fb),
                  "--config-dir", str(tmp_path)]) == 1
+
+
+def test_istioctl_register_deregister(tmp_path):
+    from istio_tpu.cmd.__main__ import main
+    reg = tmp_path / "registry.yaml"
+    # create-on-register with explicit ports
+    assert main(["istioctl", "register", "--registry-file", str(reg),
+                 "--ports", "http:9080", "reviews.default.svc",
+                 "10.0.0.9"]) == 0
+    world = yaml.safe_load(reg.read_text())
+    svc = world["services"][0]
+    assert svc["hostname"] == "reviews.default.svc"
+    assert svc["ports"] == [{"name": "http", "port": 9080}]
+    assert svc["endpoints"] == [{"address": "10.0.0.9"}]
+    # endpoint dedup + port reconcile on existing service
+    assert main(["istioctl", "register", "--registry-file", str(reg),
+                 "--ports", "grpc:9090", "reviews.default.svc",
+                 "10.0.0.9"]) == 0
+    svc = yaml.safe_load(reg.read_text())["services"][0]
+    assert len(svc["endpoints"]) == 1
+    assert {p["name"] for p in svc["ports"]} == {"http", "grpc"}
+    # deregister removes the endpoint; unknown service errors
+    assert main(["istioctl", "deregister", "--registry-file", str(reg),
+                 "reviews.default.svc", "10.0.0.9"]) == 0
+    assert yaml.safe_load(reg.read_text())["services"][0]["endpoints"] \
+        == []
+    assert main(["istioctl", "deregister", "--registry-file", str(reg),
+                 "nope.svc", "10.0.0.9"]) == 1
+    # malformed port spec is a usage error, not a traceback
+    assert main(["istioctl", "register", "--registry-file", str(reg),
+                 "--ports", "http80", "x.svc", "10.0.0.1"]) == 2
+    # null-valued keys tolerated
+    reg.write_text("services:\n")
+    assert main(["istioctl", "register", "--registry-file", str(reg),
+                 "x.svc", "10.0.0.1"]) == 0
+
+
+def test_generate_cert_and_csr(tmp_path):
+    from istio_tpu.cmd.__main__ import main
+    from istio_tpu.security import pki
+    ident = "spiffe://cluster.local/ns/d/sa/x"
+    assert main(["generate-cert", "--identity", ident,
+                 "--out-key", str(tmp_path / "k.pem"),
+                 "--out-cert", str(tmp_path / "c.pem"),
+                 "--out-root", str(tmp_path / "r.pem")]) == 0
+    key = (tmp_path / "k.pem").read_bytes()
+    cert = (tmp_path / "c.pem").read_bytes()
+    root = (tmp_path / "r.pem").read_bytes()
+    assert pki.key_cert_pair_ok(key, cert)
+    assert pki.verify_chain(cert, root)
+    assert ident in str(pki.san_uris(pki.load_cert(cert)))
+    assert main(["generate-csr", "--identity", ident,
+                 "--out-key", str(tmp_path / "k2.pem"),
+                 "--out-cert", str(tmp_path / "csr.pem")]) == 0
+    csr = pki.load_csr((tmp_path / "csr.pem").read_bytes())
+    assert ident in str(pki.san_uris(csr))
