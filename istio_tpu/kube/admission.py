@@ -12,6 +12,7 @@ from istio_tpu.expr.checker import TypeError_
 from istio_tpu.expr.parser import ParseError, parse
 from istio_tpu.kube.crd import ISTIO_CRD_KINDS
 from istio_tpu.kube.fake import AdmissionDenied, FakeKubeCluster
+from istio_tpu.pilot.inject import InjectParams, inject_pod
 from istio_tpu.pilot.model import IstioConfigTypes, ValidationError
 
 
@@ -55,3 +56,27 @@ def register_istio_admission(cluster: FakeKubeCluster) -> None:
                                kinds=tuple(IstioConfigTypes))
     cluster.register_admission(_validate_mixer_kind,
                                kinds=ISTIO_CRD_KINDS)
+
+
+def register_sidecar_injector(cluster: FakeKubeCluster,
+                              params: "InjectParams | None" = None,
+                              namespaces: "tuple[str, ...] | None" = None
+                              ) -> None:
+    """The MutatingAdmissionWebhook role (pilot/pkg/kube/inject/
+    webhook.go): pods created on the cluster get the sidecar + init
+    containers injected per the annotation policy before commit.
+    `namespaces` limits injection (None = all). CREATE only — real
+    injection webhooks never fire on pod updates (a pod's container
+    list is immutable)."""
+    p = params or InjectParams()
+
+    def mutate(verb: str, obj):
+        if verb != "CREATE":
+            return None
+        if namespaces is not None:
+            ns = str((obj.get("metadata") or {}).get("namespace", ""))
+            if ns not in namespaces:
+                return None
+        return inject_pod(p, obj)
+
+    cluster.register_mutating(mutate, kinds=("Pod",))

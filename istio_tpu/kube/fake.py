@@ -9,6 +9,8 @@ Scope: exactly the API semantics the L2 adapters consume —
     ADDED/MODIFIED/DELETED events, synchronously on the mutator's
     thread (deterministic tests; real informers add a queue, which the
     consumers here already tolerate);
+  * mutating-admission hooks (the MutatingAdmissionWebhook role —
+    sidecar injection) run first and may replace the object;
   * validating-admission hooks invoked before create/update commits
     (pilot/pkg/kube/admit/admit.go's ValidatingAdmissionWebhook role) —
     a hook raising AdmissionDenied rejects the write.
@@ -55,6 +57,9 @@ class WatchEvent:
 
 WatchHandler = Callable[[WatchEvent], None]
 AdmissionHook = Callable[[str, Mapping[str, Any]], None]  # (verb, obj)
+# (verb, obj) → replacement obj or None (unchanged)
+MutatingHook = Callable[[str, Mapping[str, Any]],
+                        "Mapping[str, Any] | None"]
 
 
 class FakeKubeCluster:
@@ -64,6 +69,7 @@ class FakeKubeCluster:
         self._uid = 0
         self._watchers: dict[str, list[WatchHandler]] = {}
         self._admission: list[tuple[frozenset | None, AdmissionHook]] = []
+        self._mutating: list[tuple[frozenset | None, MutatingHook]] = []
         self._lock = threading.RLock()
 
     # -- admission --
@@ -73,6 +79,24 @@ class FakeKubeCluster:
         """Validating hook for `kinds` (None = all); runs pre-commit."""
         self._admission.append(
             (frozenset(kinds) if kinds is not None else None, hook))
+
+    def register_mutating(self, hook: "MutatingHook",
+                          kinds: tuple[str, ...] | None = None) -> None:
+        """Mutating hook (the MutatingAdmissionWebhook role — sidecar
+        injection): runs BEFORE validation, may return a replacement
+        object (None = leave unchanged)."""
+        self._mutating.append(
+            (frozenset(kinds) if kinds is not None else None, hook))
+
+    def _mutate(self, verb: str,
+                obj: Mapping[str, Any]) -> Mapping[str, Any]:
+        kind = str(obj.get("kind", ""))
+        for kinds, hook in self._mutating:
+            if kinds is None or kind in kinds:
+                replaced = hook(verb, obj)
+                if replaced is not None:
+                    obj = replaced
+        return obj
 
     def _admit(self, verb: str, obj: Mapping[str, Any]) -> None:
         kind = str(obj.get("kind", ""))
@@ -90,6 +114,7 @@ class FakeKubeCluster:
         return (kind, str(meta.get("namespace", "")), str(meta["name"]))
 
     def create(self, obj: Mapping[str, Any]) -> dict:
+        obj = self._mutate("CREATE", obj)
         self._admit("CREATE", obj)
         with self._lock:
             key = self._key(obj)
@@ -100,6 +125,7 @@ class FakeKubeCluster:
         return copy.deepcopy(stored)
 
     def update(self, obj: Mapping[str, Any]) -> dict:
+        obj = self._mutate("UPDATE", obj)
         self._admit("UPDATE", obj)
         with self._lock:
             key = self._key(obj)

@@ -312,3 +312,32 @@ def test_pilot_discovery_from_cluster():
     eps2 = json.loads(ds.list_endpoints(
         "details.default.svc.cluster.local|http"))
     assert eps2["hosts"] == []
+
+
+def test_sidecar_injection_webhook():
+    """Mutating admission (inject/webhook.go role): pods created on
+    the cluster come back with the sidecar injected, respecting the
+    per-pod annotation opt-out."""
+    from istio_tpu.kube.admission import register_sidecar_injector
+
+    cluster = FakeKubeCluster()
+    register_sidecar_injector(cluster, namespaces=("default",))
+    created = cluster.create(_pod("web-1", "10.0.0.5"))
+    names = [c["name"] for c in created["spec"]["containers"]]
+    assert "istio-proxy" in names
+    assert created["metadata"]["annotations"][
+        "sidecar.istio.io/status"] == "injected"
+    assert created["spec"]["initContainers"]
+
+    # opt-out annotation wins
+    opt_out = _pod("web-2", "10.0.0.6")
+    opt_out["metadata"]["annotations"] = {
+        "sidecar.istio.io/inject": "false"}
+    created2 = cluster.create(opt_out)
+    assert all(c["name"] != "istio-proxy"
+               for c in created2["spec"].get("containers", ()))
+
+    # other namespaces untouched
+    created3 = cluster.create(_pod("web-3", "10.0.0.7", ns="prod"))
+    assert all(c["name"] != "istio-proxy"
+               for c in created3["spec"].get("containers", ()))
