@@ -1,0 +1,138 @@
+"""Sequence-parallel DFA matching — long strings sharded across chips.
+
+The byte-predicate device path stores at most `max_str_len` bytes per
+slot (layout.py); longer values fall back to the host oracle
+(tensor_expr truncation routing — still the serving behavior). This
+module is the long-context building block for lifting that limit:
+shard the byte axis over a `sp` mesh axis and run the SAME dense DFAs
+(ops/regex_dfa.py) with one collective.
+
+The trick is the associativity of DFA execution (the ring-attention
+analog for byte matching, SURVEY §5.7): a chunk of input induces a
+transition MAP f: S → S ("enter the chunk in state s, leave in
+f[s]"), and maps compose — so each device scans only its local chunk
+(computing the map for every possible entry state at once, a [B, S]
+state matrix through a length-L/C scan), and one `all_gather` of the
+tiny [B, S] maps plus an in-register composition replaces scanning
+the full string anywhere. Acceptance stays a final-state lookup
+because compiled unanchored DFAs make accepting states sticky
+(regex_dfa.py:358).
+
+Cost model: a single device scans L bytes with state width 1; each of
+C devices scans L/C bytes with state width S. Wall-clock wins whenever
+S < C (typical: S ≈ 4-40, C = chip count) and the collective is one
+[C, B, S] int32 all_gather over ICI.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def chunk_transition_map(chunk: jnp.ndarray, chunk_lens: jnp.ndarray,
+                         transitions: jnp.ndarray) -> jnp.ndarray:
+    """Per-row transition map of one chunk: out[b, s] = state after
+    feeding row b's chunk bytes starting from state s.
+
+    chunk [B, L] uint8, chunk_lens [B] int32, transitions [S, 256]
+    int32 → [B, S] int32.
+    """
+    b, l = chunk.shape
+    s = transitions.shape[0]
+    flat = transitions.reshape(-1)
+
+    def step(state, inp):
+        byte, pos = inp                       # [B], scalar-broadcast
+        nxt = flat[state * 256 + byte.astype(jnp.int32)[:, None]]
+        state = jnp.where((pos < chunk_lens)[:, None], nxt, state)
+        return state, None
+
+    # derive the carry from the (possibly device-varying) input so the
+    # scan carry's sharding metadata matches under shard_map
+    zero = chunk[:, :1].astype(jnp.int32) * 0          # [B, 1]
+    init = jnp.arange(s, dtype=jnp.int32)[None, :] + zero
+    positions = jnp.arange(l, dtype=jnp.int32)
+    final, _ = jax.lax.scan(step, init, (chunk.T, positions))
+    return final
+
+
+def compose_maps(maps: jnp.ndarray) -> jnp.ndarray:
+    """Left-to-right composition of per-chunk maps [C, B, S] → [B, S]:
+    out[b, s] = f_{C-1}(... f_1(f_0(s))). An associative_scan would
+    give all prefixes; matching needs only the total, so a fori_loop
+    of gathers (C is the chip count — tiny) is cheaper."""
+    c, b, s = maps.shape
+
+    def body(i, acc):                          # acc [B, S]
+        nxt = maps[i]                          # [B, S]
+        return jnp.take_along_axis(nxt, acc, axis=1)
+
+    init = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
+                            (b, s))
+    return jax.lax.fori_loop(0, c, body, init)
+
+
+_RUN_CACHE: dict = {}
+
+
+def _runner(mesh: Mesh, axis: str, c: int, lc: int):
+    """jitted matcher memoized per (mesh, axis, chunk geometry) —
+    jax.jit caches key on function identity, so a fresh closure per
+    call would recompile the shard_map program every time."""
+    key = (mesh, axis, c, lc)
+    cached = _RUN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    n_shards = mesh.shape[axis]
+    per_dev = c // n_shards
+    chunk_starts = np.arange(c, dtype=np.int32) * lc
+
+    @jax.jit
+    def run(data_j, lens_j, trans_j, accept_j):
+        def local(chunk, starts, lens_all):   # [B, per_dev, Lc], ...
+            # compose this device's chunks left-to-right — a device
+            # may hold several when C > mesh size
+            fmap = None
+            for i in range(per_dev):
+                local_lens = jnp.clip(lens_all - starts[i], 0, lc)
+                m = chunk_transition_map(chunk[:, i, :], local_lens,
+                                         trans_j)
+                fmap = m if fmap is None else \
+                    jnp.take_along_axis(m, fmap, axis=1)
+            return fmap[None]                 # [1, B, S] shard
+
+        maps = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(None, axis, None), P(axis), P()),
+            out_specs=P(axis))(
+                data_j, jnp.asarray(chunk_starts), lens_j)
+        final = compose_maps(maps)[:, 0]      # entry state 0
+        return accept_j[final]
+
+    _RUN_CACHE[key] = run
+    return run
+
+
+def sharded_dfa_match(mesh: Mesh, axis: str,
+                      data: np.ndarray, lens: np.ndarray,
+                      transitions: np.ndarray,
+                      accept: np.ndarray) -> jnp.ndarray:
+    """Match one DFA over rows whose byte axis is sharded over
+    `axis`: data [B, C, Lc] (chunk-major), lens [B] TOTAL lengths.
+
+    Each device computes its chunks' composed [B, S] map; one
+    all_gather + composition yields the final state; accept is a [B]
+    gather. C must be a multiple of the mesh axis size.
+    """
+    c, lc = data.shape[1], data.shape[2]
+    n_shards = mesh.shape[axis]
+    if c % n_shards:
+        raise ValueError(f"chunk count {c} must be a multiple of the "
+                         f"'{axis}' axis size {n_shards}")
+    run = _runner(mesh, axis, c, lc)
+    sharded = jax.device_put(
+        data, NamedSharding(mesh, P(None, axis, None)))
+    return run(sharded, jnp.asarray(lens), jnp.asarray(transitions),
+               jnp.asarray(accept))

@@ -2,6 +2,7 @@
 bit-identical verdicts to the single-device step, on an 8-virtual-device
 CPU mesh (conftest.py forces xla_force_host_platform_device_count=8)."""
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -34,3 +35,80 @@ def test_sharded_check_matches_unsharded(dp, mp):
                                   np.asarray(ref_counts))
     # rules really live on the mp axis
     assert v.matched.sharding.spec == jax.sharding.PartitionSpec("dp", "mp")
+
+
+# ---------------------------------------------------------------------------
+# sequence-parallel DFA matching (long-context byte path)
+# ---------------------------------------------------------------------------
+
+def test_sequence_parallel_dfa_matches_oracle():
+    """A 1KB string sharded over 8 virtual devices must match exactly
+    like the single-device DFA and the host regex."""
+    import re
+    from jax.sharding import Mesh
+    from istio_tpu.ops.bytes_ops import dfa_match
+    from istio_tpu.ops.regex_dfa import compile_regex
+    from istio_tpu.parallel.seq_match import sharded_dfa_match
+
+    devices = np.array(jax.devices("cpu")[:8])
+    mesh = Mesh(devices, ("sp",))
+
+    rng = np.random.default_rng(7)
+    chunk = 128
+    total = 8 * chunk
+    # needle fully inside one chunk, straddling a chunk boundary,
+    # absent, at the very end, and empty-tail rows
+    base = rng.integers(97, 123, total, dtype=np.uint8)
+    s1 = base.copy(); s1[300:309] = np.frombuffer(b"needle-42", np.uint8)
+    s2 = base.copy(); s2[chunk - 4:chunk + 5] = np.frombuffer(
+        b"needle-42", np.uint8)
+    s3 = base.copy()
+    s4 = base.copy(); s4[total - 9:] = np.frombuffer(b"needle-42",
+                                                    np.uint8)
+    subjects = np.stack([s1, s2, s3, s4])
+    lens = np.array([total, total, total, total - 40], np.int32)
+
+    for pattern in ("needle-[0-9]+", "^[a-z]", "xyzzy$"):
+        dfa = compile_regex(pattern)
+        data = subjects.reshape(4, 8, chunk)
+        got = np.asarray(sharded_dfa_match(
+            mesh, "sp", data, lens, dfa.transitions, dfa.accept))
+        # single-device reference over the full rows
+        want_dev = np.asarray(dfa_match(
+            jnp.asarray(subjects), jnp.asarray(lens),
+            jnp.asarray(dfa.transitions), jnp.asarray(dfa.accept)))
+        want_re = np.array([
+            re.search(pattern,
+                      subjects[i, :lens[i]].tobytes().decode("latin1"))
+            is not None for i in range(4)])
+        np.testing.assert_array_equal(got, want_dev)
+        np.testing.assert_array_equal(got, want_re)
+        # several chunks PER DEVICE: 16 chunks over the 8-way axis
+        data16 = subjects.reshape(4, 16, chunk // 2)
+        got16 = np.asarray(sharded_dfa_match(
+            mesh, "sp", data16, lens, dfa.transitions, dfa.accept))
+        np.testing.assert_array_equal(got16, want_re)
+
+
+def test_chunk_transition_map_composes():
+    """Map composition over split halves equals one scan over the
+    whole string (the associativity the sharding relies on)."""
+    from istio_tpu.ops.regex_dfa import compile_regex
+    from istio_tpu.parallel.seq_match import (chunk_transition_map,
+                                              compose_maps)
+
+    dfa = compile_regex("ab+c")
+    text = b"zzabbbczz"
+    row = np.frombuffer(text, np.uint8)[None, :]
+    full = chunk_transition_map(jnp.asarray(row),
+                                jnp.asarray([len(text)], np.int32),
+                                jnp.asarray(dfa.transitions))
+    left, right = row[:, :4], row[:, 4:]
+    m1 = chunk_transition_map(jnp.asarray(left),
+                              jnp.asarray([4], np.int32),
+                              jnp.asarray(dfa.transitions))
+    m2 = chunk_transition_map(jnp.asarray(right),
+                              jnp.asarray([len(text) - 4], np.int32),
+                              jnp.asarray(dfa.transitions))
+    composed = compose_maps(jnp.stack([m1, m2]))
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(composed))
