@@ -138,6 +138,16 @@ class DiscoveryService:
             return {"listeners": listeners}
         return self._cached(f"lds/{cluster}/{node}", "lds", build)
 
+    def availability_zone(self, cluster: str, node: str) -> bytes:
+        """/v1/az/{cluster}/{node} (discovery.go:601): the AZ of the
+        node's instances (all share the node IP, hence the AZ).
+        Plain-text body (the only non-JSON discovery response)."""
+        CALLS.labels(endpoint="az", cache="miss").inc()
+        instances = self._node_instances(node)
+        if not instances:
+            raise KeyError(f"az: no instances for node {node}")
+        return str(instances[0].availability_zone or "").encode()
+
     def _node_instances(self, node: str):
         return self.registry.host_instances(
             {Node.parse(node).ip_address})
@@ -162,7 +172,9 @@ class DiscoveryService:
                     self.send_error(500)
                     return
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                ctype = "text/plain" if self.path.startswith("/v1/az/") \
+                    else "application/json"
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -186,6 +198,8 @@ class DiscoveryService:
                 return self.list_routes(parts[2], parts[3], parts[4])
             if parts[1] == "listeners" and len(parts) == 4:
                 return self.list_listeners(parts[2], parts[3])
+            if parts[1] == "az" and len(parts) == 4:
+                return self.availability_zone(parts[2], parts[3])
         raise KeyError(path)
 
     def stop(self) -> None:

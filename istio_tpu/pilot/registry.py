@@ -56,20 +56,23 @@ class MemoryRegistry(ServiceDiscovery):
     # -- mutation --
 
     def add_service(self, service: Service,
-                    endpoints: Iterable[tuple[str, Mapping[str, str]]] = ()
-                    ) -> None:
-        """Register a service; endpoints = (address, labels) pairs, one
-        instance per (endpoint, service port)."""
+                    endpoints: Iterable[tuple] = ()) -> None:
+        """Register a service; endpoints = (address, labels) pairs or
+        (address, labels, availability_zone) triples, one instance per
+        (endpoint, service port)."""
         with self._lock:
             self._services[service.hostname] = service
             insts = []
-            for addr, labels in endpoints:
+            for ep in endpoints:
+                addr, labels = ep[0], ep[1]
+                az = ep[2] if len(ep) > 2 else ""
                 for port in service.ports:
                     insts.append(ServiceInstance(
                         endpoint=NetworkEndpoint(address=addr,
                                                  port=port.port,
                                                  service_port=port),
                         service=service, labels=dict(labels),
+                        availability_zone=az,
                         service_account=service.service_account))
             self._instances[service.hostname] = insts
         for fn in list(self._svc_handlers):

@@ -46,7 +46,8 @@ def world():
     reviews = _svc("reviews", (HTTP, GRPC))
     ratings = _svc("ratings", addr="10.1.0.2")
     db = _svc("db", (MONGO,), addr="10.1.0.3")
-    registry.add_service(reviews, [("10.0.0.1", {"version": "v1"}),
+    registry.add_service(reviews, [("10.0.0.1", {"version": "v1"},
+                                    "us-central1-a"),
                                    ("10.0.0.2", {"version": "v2"})])
     registry.add_service(ratings, [("10.0.0.3", {})])
     registry.add_service(db, [("10.0.0.4", {})])
@@ -233,6 +234,11 @@ def test_discovery_rest_and_cache(world):
         vh = next(v for v in rds2["virtual_hosts"]
                   if v["name"].startswith("reviews"))
         assert "version=v1" in vh["routes"][0]["cluster"]
+        # /v1/az/{cluster}/{node} (discovery.go:601)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/az/istio-proxy/{node}",
+                timeout=5) as r:
+            assert r.read() == b"us-central1-a"
     finally:
         ds.stop()
 
