@@ -73,17 +73,22 @@ def main() -> None:
     trace_s = time.perf_counter() - t0
 
     def timed(n: int, bsz_batch, bsz_ns, c):
-        """n chained steps, one sync: excludes per-call host↔device
-        round-trip latency (the axon tunnel adds ~110ms per sync; a
-        colocated server syncs via queues, not per-step RPC). The quota
-        buffer is donated through the chain — returns the live one."""
+        """Best of two n-step chained windows, one sync each: excludes
+        per-call host↔device round-trip latency (the axon tunnel adds
+        ~110ms per sync; a colocated server syncs via queues, not
+        per-step RPC) and shields the recorded number from transient
+        tunnel load. The quota buffer is donated through the chain —
+        returns the live one."""
         v, c = step(params, bsz_batch, bsz_ns, c)   # warm shape
         jax.block_until_ready(v.status)
-        t0 = time.perf_counter()
-        for _ in range(n):
-            v, c = step(params, bsz_batch, bsz_ns, c)
-        jax.block_until_ready(v.status)
-        return (time.perf_counter() - t0) / n, c
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                v, c = step(params, bsz_batch, bsz_ns, c)
+            jax.block_until_ready(v.status)
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best, c
 
     sync_overhead = _roundtrip_s()
     t_step, counts = timed(steps, ab, req_ns, counts)
@@ -100,6 +105,7 @@ def main() -> None:
     small_ms = float(t_small * 1e3)
 
     served = _served_bench(n_rules, on_tpu)
+    route = _route_bench(on_tpu)
 
     baseline_cps = 1e9 / (PER_PREDICATE_NS * n_rules)
     out = {
@@ -126,7 +132,65 @@ def main() -> None:
     if "served_checks_per_sec" in served:
         out["served_vs_baseline"] = round(
             served["served_checks_per_sec"] / baseline_cps, 2)
+    out.update(route)
     print(json.dumps(out))
+
+
+def _route_bench(on_tpu: bool) -> dict:
+    """The shared-automaton north star's second face: VirtualService
+    route matching (pilot/pkg/proxy/envoy/route.go's per-request host
+    loop) compiled through the SAME ruleset engine — one device step
+    selects winning routes for a whole batch."""
+    try:
+        from istio_tpu.pilot.route_nfa import RouteTable
+        from istio_tpu.testing import workloads
+
+        n_routes = 1000 if on_tpu else 200
+        batch = 2048 if on_tpu else 256
+        services, rules = workloads.make_route_world(n_routes)
+        rt = RouteTable(services, rules)
+        reqs = workloads.make_route_requests(batch,
+                                             n_services=len(services))
+        bags = [workloads.bag_from_mapping(r) for r in reqs]
+        sync_s = _roundtrip_s()
+
+        # device step alone (sync-subtracted, like step_ms above)
+        ab = jax.device_put(rt.tensorizer.tensorize(bags))
+        params = jax.device_put(rt.program.params)
+        fn = rt.program.fn
+        m, _, _ = fn(params, ab)
+        jax.block_until_ready(m)
+        dev_best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                m, _, _ = fn(params, ab)
+            jax.block_until_ready(m)
+            dev_best = min(dev_best,
+                           (time.perf_counter() - t0 - sync_s) / 10)
+
+        # FULL selection (tensorize + device + host-fallback overlay +
+        # argmax) — regex rules that don't lower run host-side, so the
+        # throughput number must include them, not hide them
+        rt.select(bags)   # warm
+        full_best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            rt.select(bags)
+            full_best = min(full_best,
+                            time.perf_counter() - t0 - sync_s)
+        t0 = time.perf_counter()
+        rt.tensorizer.tensorize(bags)
+        tensorize_s = time.perf_counter() - t0
+        return {"route_rules": n_routes,
+                "route_host_fallback_rules":
+                    len(rt.program.host_fallback),
+                "route_match_per_sec": round(batch / full_best, 1),
+                "route_select_ms": round(full_best * 1e3, 3),
+                "route_tensorize_ms": round(tensorize_s * 1e3, 3),
+                "route_device_step_ms": round(dev_best * 1e3, 3)}
+    except Exception as exc:
+        return {"route_error": f"{type(exc).__name__}: {exc}"}
 
 
 def _served_bench(n_rules: int, on_tpu: bool) -> dict:

@@ -98,6 +98,15 @@ def match_to_predicate(hostname: str, match: Mapping[str, Any] | None,
 
 
 def _anchor(pattern: str) -> str:
+    """Force full-match semantics. A pattern that is already anchored
+    on both ends AND safe to use bare (no top-level alternation that
+    the anchors wouldn't distribute over) stays as-is — wrapping it
+    would nest anchors inside the group, which the device DFA compiler
+    rejects (regex_dfa: no inner anchors) and needlessly sends the
+    rule to the host oracle."""
+    if (pattern.startswith("^") and pattern.endswith("$")
+            and not pattern.endswith("\\$") and "|" not in pattern):
+        return pattern
     return f"^({pattern})$"
 
 

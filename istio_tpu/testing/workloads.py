@@ -151,3 +151,64 @@ def make_request_ns(engine: PolicyEngine, batch: int,
     ids = [engine.ruleset.namespace_id(f"ns{rng.integers(0, 25)}")
            for _ in range(batch)]
     return np.asarray(ids, np.int32)
+
+
+def make_route_world(n_routes: int = 1000, n_services: int | None = None,
+                     seed: int = 3):
+    """Synthetic mesh routing world for the route-NFA bench: services
+    with v1alpha1 route rules mixing URI prefix/regex, header exact
+    matches, and source-label constraints (the VirtualService diet
+    route.go compiles)."""
+    from istio_tpu.pilot.model import (Config, ConfigMeta, Port, Service)
+
+    rng = np.random.default_rng(seed)
+    n_services = n_services or max(8, n_routes // 10)
+    services = [Service(hostname=f"svc{i}.default.svc.cluster.local",
+                        address=f"10.2.{i // 250}.{i % 250}",
+                        ports=(Port("http", 9080, "HTTP"),))
+                for i in range(n_services)]
+    rules_by_host: dict = {}
+    for r in range(n_routes):
+        svc = services[int(rng.integers(n_services))]
+        kind = int(rng.integers(4))
+        match: dict = {"request": {"headers": {}}}
+        headers = match["request"]["headers"]
+        if kind == 0:
+            headers["uri"] = {"prefix": f"/api/v{r % 7}/"}
+        elif kind == 1:
+            headers["uri"] = {"regex": f"^/items/[0-9]+/r{r % 11}$"}
+        elif kind == 2:
+            headers["cookie"] = {"exact": f"user=group{r % 13}"}
+        else:
+            headers["uri"] = {"prefix": f"/svc/{r % 17}/"}
+            match["source"] = (f"svc{int(rng.integers(n_services))}"
+                               ".default.svc.cluster.local")
+        cfg = Config(ConfigMeta(type="route-rule", name=f"rr{r}",
+                                namespace="default"),
+                     {"destination": {"name": svc.hostname.split(".")[0]},
+                      "precedence": int(rng.integers(4)),
+                      "match": match,
+                      "route": [{"labels": {"version": "v1"}}]})
+        rules_by_host.setdefault(svc.hostname, []).append(cfg)
+    return services, rules_by_host
+
+
+def make_route_requests(batch: int, n_services: int | None = None,
+                        seed: int = 4) -> list[dict]:
+    """Route-manifest-shaped requests (destination.service +
+    request.path/headers + source.service)."""
+    rng = np.random.default_rng(seed)
+    n_services = n_services or 100
+    out = []
+    for i in range(batch):
+        out.append({
+            "destination.service": f"svc{int(rng.integers(n_services))}"
+                                   ".default.svc.cluster.local",
+            "request.path": f"/api/v{int(rng.integers(9))}/x{i}"
+            if i % 2 == 0 else f"/items/{int(rng.integers(1e6))}/r3",
+            "request.headers": {"cookie":
+                                f"user=group{int(rng.integers(15))}"},
+            "source.service": f"svc{int(rng.integers(n_services))}"
+                              ".default.svc.cluster.local",
+        })
+    return out

@@ -392,3 +392,17 @@ def test_mesh_init_chain_and_watch(tmp_path):
         assert seen[-1]["mixer_address"] == "b:2"   # old config stays
     finally:
         w.stop()
+
+
+def test_route_nfa_synthetic_world_parity():
+    """1k-ish synthetic route rules: the device NFA and host oracle
+    must select identical winning routes for a request batch (the
+    bench workload is conformance-tested, not just timed)."""
+    from istio_tpu.testing import workloads
+    services, rules = workloads.make_route_world(300)
+    rt = RouteTable(services, rules)
+    reqs = workloads.make_route_requests(128, n_services=len(services))
+    sel = rt.select(reqs)
+    assert (sel != rt.default_index).sum() > 10   # workload exercises it
+    for i, req in enumerate(reqs):
+        assert rt.select_host(req) == sel[i], i
