@@ -19,6 +19,7 @@ identical semantics sequentially and is the conformance oracle.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import re
 from typing import Any, Mapping, Sequence
 
@@ -87,11 +88,12 @@ def match_to_predicate(hostname: str, match: Mapping[str, Any] | None,
         elif "regex" in cond:
             # Envoy route regexes are FULL match; `matches` is an
             # unanchored search (Go regexp.MatchString parity), so
-            # ALWAYS wrap — `^(pat)$` forces full-match semantics even
-            # for alternations like `a|b`, and already-anchored
-            # patterns stay correct (the group's anchors nest). NOTE:
-            # the RECEIVER of .matches() is the PATTERN (see
-            # testing/corpus.py).
+            # _anchor forces full-match semantics — wrapping `^(pat)$`
+            # for unanchored/alternation patterns, passing
+            # already-anchored pipe-free patterns through bare so they
+            # keep lowering to the device DFA (which rejects nested
+            # inner anchors). NOTE: the RECEIVER of .matches() is the
+            # PATTERN (see testing/corpus.py).
             parts.append(f"{_quote(_anchor(cond['regex']))}"
                          f".matches({probe})")
     return " && ".join(parts)
@@ -164,6 +166,12 @@ class RouteTable:
         if not self.entries:
             return np.full(len(bags), self.default_index, np.int64)
         batch = self.tensorizer.tensorize(bags)
+        if not self.program.host_fallback:
+            # argmax on device: pulling the [B, R] matched plane costs
+            # R/64 times the bytes of the [B] winner indices (megabytes
+            # per batch at 10k routes behind a high-RTT transport)
+            return np.asarray(self._select_on_device(
+                self.program.params, batch), dtype=np.int64)
         matched, _, _ = self.program(batch)
         matched = np.array(matched)
         for ridx in self.program.host_fallback:
@@ -173,6 +181,23 @@ class RouteTable:
         best = scores.argmax(axis=1)
         hit = scores.max(axis=1) > 0
         return np.where(hit, best, self.default_index)
+
+    @functools.cached_property
+    def _select_on_device(self):
+        import jax
+        import jax.numpy as jnp
+        weight = jnp.asarray(self._weight)
+        default = self.default_index
+        raw = self.program.fn          # fn(params, batch)
+
+        def run(params, batch):
+            matched, _, _ = raw(params, batch)
+            scores = matched * weight[None, :]
+            best = jnp.argmax(scores, axis=1)
+            hit = jnp.max(scores, axis=1) > 0
+            return jnp.where(hit, best, default)
+
+        return jax.jit(run)
 
     # -- host oracle --
 
