@@ -134,9 +134,13 @@ class Rule:
 
 @dataclasses.dataclass
 class _AtomTable:
-    """Deduplicated primitive predicates across all rules."""
+    """Deduplicated primitive predicates across all rules. Append-only
+    with O(added) rollback: mark() before a speculative decompose,
+    revert(mark) drops only the atoms added since — copying the whole
+    table per rule made snapshot compile quadratic in rule count."""
     asts: list[Expression] = dataclasses.field(default_factory=list)
     by_key: dict[str, int] = dataclasses.field(default_factory=dict)
+    _keys: list[str] = dataclasses.field(default_factory=list)
 
     def index_of(self, e: Expression) -> int:
         key = str(e)
@@ -145,7 +149,17 @@ class _AtomTable:
             idx = len(self.asts)
             self.by_key[key] = idx
             self.asts.append(e)
+            self._keys.append(key)
         return idx
+
+    def mark(self) -> int:
+        return len(self.asts)
+
+    def revert(self, mark: int) -> None:
+        for key in self._keys[mark:]:
+            del self.by_key[key]
+        del self._keys[mark:]
+        del self.asts[mark:]
 
 
 def _decompose(e: Expression, atoms: _AtomTable, cap: int) -> tuple[Dnf, Dnf]:
@@ -306,11 +320,11 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                 f"rule {rule.name}: match must be BOOL, got {rtype.name}")
         parsed.append(ast)
         try:
-            snapshot = (list(atoms.asts), dict(atoms.by_key))
+            mark = atoms.mark()
             mn = _decompose(ast, atoms, dnf_cap)
             per_rule.append(mn)
         except HostFallback as exc:
-            atoms.asts, atoms.by_key = snapshot  # undo partial atom adds
+            atoms.revert(mark)              # undo partial atom adds
             per_rule.append(None)
             host_fallback[ridx] = OracleProgram(text, finder)
             fallback_reason[ridx] = str(exc)
