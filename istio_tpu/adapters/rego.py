@@ -23,8 +23,11 @@ implements the Rego subset the reference's own policy corpus
 
 Evaluation is top-down with backtracking over generator-yielded
 binding environments; rule dependencies memoize per query with a
-cycle guard. Enough to run the reference's service-graph/org-chart/
-bucket-admin policies byte-for-byte.
+cycle guard, and complete-rule definitions that succeed with
+disagreeing values raise eval_conflict_error (OPA semantics — the
+opa adapter turns that into a fail-closed deny). Enough to run the
+reference's service-graph/org-chart/bucket-admin policies
+byte-for-byte.
 """
 from __future__ import annotations
 
@@ -368,6 +371,26 @@ class _Env(dict):
         return e
 
 
+class _QueryState:
+    """Per-query evaluation state threaded through the evaluator in
+    place of the bare `seen` frozenset: the cycle-guard set (immutable,
+    grows down the call tree) plus the rule-value memo (shared across
+    the whole query, never across queries/threads)."""
+
+    __slots__ = ("seen", "memo")
+
+    def __init__(self, seen: frozenset = frozenset(),
+                 memo: dict | None = None):
+        self.seen = seen
+        self.memo: dict = {} if memo is None else memo
+
+    def __contains__(self, key) -> bool:
+        return key in self.seen
+
+    def __or__(self, keys) -> "_QueryState":
+        return _QueryState(self.seen | keys, self.memo)
+
+
 class RegoEngine:
     """Compiled policy set: modules indexed by package path."""
 
@@ -397,7 +420,11 @@ class RegoEngine:
             raise RegoError(f"check method must be data.<pkg>.<rule>, "
                             f"got {method!r}")
         pkg, rule = ".".join(parts[1:-1]), parts[-1]
-        return self._rule_value(pkg, rule, input_doc, frozenset())
+        # the memo is per-query local state carried on the threaded
+        # `seen` object: the engine is shared across server threads, so
+        # storing it on self would leak one request's memoized
+        # decisions into another's
+        return self._rule_value(pkg, rule, input_doc, _QueryState())
 
     # -- rule resolution --
 
@@ -405,6 +432,9 @@ class RegoEngine:
         key = (pkg, name)
         if key in seen:
             raise RegoError(f"rego_recursion_error: {pkg}.{name}")
+        memo = seen.memo if isinstance(seen, _QueryState) else None
+        if memo is not None and key in memo:
+            return memo[key]
         mod = self.modules.get(pkg)
         if mod is None:
             raise RegoError(f"unknown package {pkg!r}")
@@ -416,6 +446,22 @@ class RegoEngine:
         for d in defs:
             if d.default:
                 default_value = self._ground(d.value)
+        # OPA complete-rule semantics: EVERY successful evaluation —
+        # across definitions AND across bindings within one body — must
+        # agree on the value; disagreement is eval_conflict_error
+        # (which the opa adapter fails closed on), never a silent
+        # first-wins (ADVICE r2)
+        result: Any = None
+        have_result = False
+
+        def absorb(value: Any) -> None:
+            nonlocal result, have_result
+            if have_result and value != result:
+                raise RegoError(
+                    f"eval_conflict_error: complete rule {pkg}.{name} "
+                    f"defined with conflicting values")
+            result, have_result = value, True
+
         for d in defs:
             if d.default:
                 continue
@@ -423,14 +469,17 @@ class RegoEngine:
                 # constant: name = literal
                 for env, value in self._eval_term(
                         d.value, _Env(), mod, input_doc, seen):
-                    return value
+                    absorb(value)
                 continue
             for env in self._eval_body(list(d.body), _Env(), mod,
                                        input_doc, seen):
                 for env2, value in self._eval_term(d.value, env, mod,
                                                    input_doc, seen):
-                    return value
-        return default_value
+                    absorb(value)
+        out = result if have_result else default_value
+        if memo is not None:
+            memo[key] = out
+        return out
 
     @staticmethod
     def _ground(term: Any) -> Any:

@@ -513,10 +513,22 @@ def cmd_node_agent(args: argparse.Namespace) -> int:
                   "--insecure-ca only against a test CA")
             return 2
         try:
-            pc = new_platform_client(args.platform, {
+            cfg = {
                 "ca_addr": args.ca_address,
                 "metadata": _FileMetadata(args.platform_metadata_file),
-                "root_ca_cert_file": args.root_cert})
+                "root_ca_cert_file": args.root_cert}
+            if args.platform == "aws":
+                # AwsClient fails closed without a PKCS7 verifier and
+                # none ships in this build — the operator must opt out
+                # explicitly (mirrors --insecure-ca's posture)
+                if not args.skip_identity_verify:
+                    print("node_agent: --platform aws requires "
+                          "--skip-identity-verify (no PKCS7 verifier "
+                          "in this build; identity signature would "
+                          "fail closed)")
+                    return 2
+                cfg["verify"] = False
+            pc = new_platform_client(args.platform, cfg)
             credential = pc.get_agent_credential()
             cred_type = pc.get_credential_type()
         except (OSError, ValueError, PlatformError) as exc:
@@ -678,6 +690,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bootstrap credential fetcher")
     s.add_argument("--platform-metadata-file", default="",
                    help="JSON path→value metadata fixture for gcp/aws")
+    s.add_argument("--skip-identity-verify", action="store_true",
+                   help="INSECURE: accept the aws instance-identity "
+                        "document without PKCS7 signature verification "
+                        "(no verifier is available in this build; "
+                        "required for --platform aws)")
     s.set_defaults(fn=cmd_node_agent)
 
     s = sub.add_parser("brks", help="OSB broker")

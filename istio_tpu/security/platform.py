@@ -181,9 +181,13 @@ class AwsClient:
 
     def __init__(self, metadata: MetadataSource,
                  root_ca_cert_file: str = "",
-                 verify: Callable[[bytes, bytes], bool] | None = None):
+                 verify: Callable[[bytes, bytes], bool] | bool | None
+                 = None):
         self.metadata = metadata
         self.root_ca_cert_file = root_ca_cert_file
+        # aws.go always verifies the PKCS7 signature before trusting the
+        # document — absence of a verifier fails CLOSED; skipping
+        # verification requires the explicit opt-out verify=False
         self._verify = verify
 
     def is_proper_platform(self) -> bool:
@@ -203,8 +207,15 @@ class AwsClient:
         except Exception as exc:
             raise PlatformError(
                 f"failed to decode PKCS7 signature: {exc}") from exc
-        if self._verify is not None and not self._verify(doc, sig):
-            raise PlatformError("instance identity signature rejected")
+        if callable(self._verify):
+            if not self._verify(doc, sig):
+                raise PlatformError("instance identity signature rejected")
+        elif self._verify is not False:
+            # None (and any other non-callable, e.g. a mistaken
+            # verify=True) fails closed; ONLY the literal False opts out
+            raise PlatformError(
+                "no PKCS7 verifier configured; pass verify=False to "
+                "explicitly skip signature verification")
         return doc, base64.b64encode(sig)
 
     def get_service_identity(self) -> str:

@@ -65,8 +65,18 @@ class SecretFileServer(SecretServer):
         p = Path(path)
         p.parent.mkdir(parents=True, exist_ok=True)
         tmp = p.with_suffix(p.suffix + ".tmp")
-        tmp.write_bytes(content)
-        os.chmod(tmp, mode)
+        # the temp file carries the final mode from creation — key
+        # material must never exist world-readable, even briefly.
+        # O_EXCL (after clearing any stale leftover from a crashed run)
+        # guarantees the mode applies: O_CREAT alone would silently
+        # reuse an existing tmp file's old permissions
+        tmp.unlink(missing_ok=True)
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, mode)
+        try:
+            os.write(fd, content)
+        finally:
+            os.close(fd)
+        os.chmod(tmp, mode)   # mode arg is masked by umask at open
         os.replace(tmp, p)
 
     def set_service_identity_private_key(self, content: bytes) -> None:

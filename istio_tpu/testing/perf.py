@@ -50,29 +50,46 @@ class PerfReport:
     first_error: str = ""
 
 
+class PerfError(RuntimeError):
+    """The rig failed to measure — NEVER reported as a zero result."""
+
+
 def _worker(target: str, payloads: list[bytes], duration_s: float,
-            concurrency: int, start_at: float, q: "mp.Queue") -> None:
+            concurrency: int, start_val, ready_q: "mp.Queue",
+            q: "mp.Queue") -> None:
     """`concurrency` requests in flight via one issuing thread +
     completion callbacks on grpc's IO threads — a blocked thread per
     RPC melts the GIL at the depths a ~100ms-RTT device transport
-    needs to stay busy (this rig has ONE core for server AND client)."""
+    needs to stay busy (this rig has ONE core for server AND client).
+
+    Readiness handshake (the mixer/pkg/perf/clientserver.go:30-90
+    attach pattern): the worker connects AND completes one full RPC
+    before reporting ready; the parent opens the measurement window —
+    by writing the shared `start_val` — only once every worker has
+    attached, so a slow spawn/import can never eat the window."""
     import threading
 
     import grpc
 
-    channel = grpc.insecure_channel(target)
-    call = channel.unary_unary(
-        "/istio.mixer.v1.Mixer/Check",
-        request_serializer=lambda b: b,       # already serialized
-        response_deserializer=lambda b: b)    # latency only; skip parse
-    grpc.channel_ready_future(channel).result(timeout=30)
+    try:
+        channel = grpc.insecure_channel(target)
+        call = channel.unary_unary(
+            "/istio.mixer.v1.Mixer/Check",
+            request_serializer=lambda b: b,    # already serialized
+            response_deserializer=lambda b: b)  # latency only; no parse
+        grpc.channel_ready_future(channel).result(timeout=30)
+        call(payloads[0], timeout=60)   # one full round-trip = attached
+    except Exception as exc:
+        ready_q.put(f"{type(exc).__name__}: {exc}"[:300])
+        return
+    ready_q.put("")
 
     lat: list[float] = []
     errors = [0]
     first_error: list[str] = []
     lock = threading.Lock()
     sem = threading.Semaphore(concurrency)
-    deadline = start_at + duration_s
+    hard_stop = time.time() + 600.0   # parent died without a go signal
 
     def on_done(fut, t0: float, measured: bool) -> None:
         try:
@@ -92,10 +109,12 @@ def _worker(target: str, payloads: list[bytes], duration_s: float,
 
     i = 0
     # traffic flows immediately (warming jit buckets/caches); only
-    # calls begun inside the measurement window are recorded
+    # calls begun inside the [start_at, start_at+duration) window are
+    # recorded. start_val is 0 until the parent opens the window.
     while True:
+        start_at = start_val.value
         now = time.time()
-        if now >= deadline:
+        if (start_at and now >= start_at + duration_s) or now >= hard_stop:
             break
         sem.acquire()
         p = payloads[i % len(payloads)]
@@ -103,7 +122,8 @@ def _worker(target: str, payloads: list[bytes], duration_s: float,
         t0 = time.perf_counter()
         fut = call.future(p, timeout=60)
         fut.add_done_callback(
-            lambda f, t0=t0, m=now >= start_at: on_done(f, t0, m))
+            lambda f, t0=t0, m=bool(start_at) and now >= start_at:
+                on_done(f, t0, m))
     # drain by re-acquiring every permit: all callbacks have run (and
     # released) once acquisition succeeds, so the snapshot below races
     # nothing; the per-call 60s deadline bounds the wait
@@ -120,38 +140,70 @@ def run_load(target: str, payloads: Sequence[bytes],
              concurrency: int = 32, warmup_s: float = 2.0) -> PerfReport:
     """Fire Check load at `target` and report client-side numbers.
 
-    A shared start timestamp aligns the measurement window across
-    workers; `warmup_s` of pre-traffic warms the server's jit buckets
-    before the window opens."""
+    Three phases: (1) workers spawn, connect, and each completes one
+    RPC, then reports ready; (2) the parent opens a shared measurement
+    window `warmup_s` in the future (pre-window traffic warms the
+    server's jit buckets); (3) only calls issued inside the window are
+    recorded. Raises PerfError if attachment fails or the measured
+    window contains zero requests — a rig that can report a plausible
+    zero without failing is worse than no rig (VERDICT r2 weak #1)."""
     # spawn, not fork: grpc's internal threads/state do not survive a
     # fork once the parent has created a server/channel
     ctx = mp.get_context("spawn")
     q: "mp.Queue" = ctx.Queue()
-    start_at = time.time() + warmup_s
+    ready_q: "mp.Queue" = ctx.Queue()
+    start_val = ctx.Value("d", 0.0)   # 0 = window not yet open
     procs = [ctx.Process(target=_worker,
                          args=(target, list(payloads), duration_s,
-                               concurrency, start_at, q), daemon=True)
+                               concurrency, start_val, ready_q, q),
+                         daemon=True)
              for _ in range(n_procs)]
     for p in procs:
         p.start()
-    all_lat: list[np.ndarray] = []
-    n_err = 0
-    first_error = ""
-    for _ in procs:
-        lat, errs, err_msg = q.get(timeout=duration_s + warmup_s + 120)
-        all_lat.append(lat)
-        n_err += errs
-        first_error = first_error or err_msg
-    for p in procs:
-        p.join(timeout=10)
+    try:
+        try:
+            for _ in procs:
+                err = ready_q.get(timeout=300)
+                if err:
+                    raise PerfError(f"worker failed to attach: {err}")
+        except PerfError:
+            raise
+        except Exception as exc:
+            raise PerfError(f"worker never reported ready: "
+                            f"{type(exc).__name__}: {exc}") from exc
+        # every worker is connected and has a response in hand — NOW
+        # the clock starts
+        start_val.value = time.time() + warmup_s
+        all_lat: list[np.ndarray] = []
+        n_err = 0
+        first_error = ""
+        for _ in procs:
+            lat, errs, err_msg = q.get(
+                timeout=duration_s + warmup_s + 120)
+            all_lat.append(lat)
+            n_err += errs
+            first_error = first_error or err_msg
+        for p in procs:
+            p.join(timeout=10)
+    except Exception:
+        # attached workers would otherwise keep firing warmup traffic
+        # until their 600s hard stop, polluting everything after us
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        raise
     lat = np.concatenate(all_lat) if all_lat else np.zeros(0)
     n = int(lat.size)
+    if n == 0:
+        raise PerfError(
+            "measurement window closed with zero recorded requests "
+            f"(errors={n_err}, first_error={first_error!r})")
     wall = duration_s
     return PerfReport(
         checks_per_sec=n / wall if wall > 0 else 0.0,
-        p50_ms=float(np.percentile(lat, 50) * 1e3) if n else 0.0,
-        p99_ms=float(np.percentile(lat, 99) * 1e3) if n else 0.0,
-        mean_ms=float(lat.mean() * 1e3) if n else 0.0,
+        p50_ms=float(np.percentile(lat, 50) * 1e3),
+        p99_ms=float(np.percentile(lat, 99) * 1e3),
+        mean_ms=float(lat.mean() * 1e3),
         n_requests=n, n_errors=n_err, duration_s=wall,
         n_procs=len(procs), concurrency=concurrency,
         first_error=first_error)

@@ -196,6 +196,8 @@ def test_throughput_exceeds_python():
     py = Tensorizer(layout, interner)
     t_py = min(_timed(lambda: py.tensorize(bags)) for _ in range(5))
     speedup = t_py / t_native
-    # conservatively require 3×; typically far higher — and the python
-    # figure EXCLUDES its share of wire decode
-    assert speedup > 3, f"native only {speedup:.1f}× python"
+    # require 2×; typically far higher — and the python figure EXCLUDES
+    # its share of wire decode. (3× flaked at 2.78× under full-suite
+    # load on a 1-core box after the python tensorizer got faster —
+    # ADVICE r2; the margin guards "native is pointless", not a perf SLO)
+    assert speedup > 2, f"native only {speedup:.1f}× python"

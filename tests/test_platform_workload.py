@@ -126,7 +126,21 @@ def test_aws_client_rejects_bad_signature():
     md2 = FakeMetadata({AwsClient.DOC_PATH: "{}",
                         AwsClient.SIG_PATH: "!!! not base64 !!!"})
     with pytest.raises(PlatformError):
-        AwsClient(md2).get_agent_credential()
+        AwsClient(md2, verify=False).get_agent_credential()
+
+
+def test_aws_client_fails_closed_without_verifier():
+    """aws.go always verifies the PKCS7 signature; no verifier must
+    mean rejection, not silent acceptance (ADVICE r2). Skipping takes
+    the explicit opt-out verify=False."""
+    sig = base64.b64encode(b"pkcs7-blob").decode()
+    md = FakeMetadata({AwsClient.DOC_PATH: "{}",
+                       AwsClient.SIG_PATH: sig})
+    with pytest.raises(PlatformError, match="verify"):
+        AwsClient(md).get_agent_credential()
+    # explicit opt-out still works (tests/fakes, airgapped rigs)
+    assert json.loads(
+        AwsClient(md, verify=False).get_agent_credential())
 
 
 def test_new_platform_client_factory(tmp_path):

@@ -204,6 +204,46 @@ def test_recursion_guard():
         eng.query("data.t.a", {})
 
 
+def test_complete_rule_conflict_raises():
+    """OPA eval_conflict_error semantics (ADVICE r2): two successful
+    definitions with disagreeing values must error (fail-closed in the
+    opa adapter), never silently return the first."""
+    eng = RegoEngine(["""package t
+        v = 1 { input.x = 1 }
+        v = 2 { input.x = 1 }
+        agree = 1 { input.x = 1 }
+        agree = 1 { input.y = 2 }
+    """])
+    with pytest.raises(RegoError, match="conflict"):
+        eng.query("data.t.v", {"x": 1})
+    # conflicts ACROSS BINDINGS of one body are conflicts too:
+    # p = x { x = input.arr[_] } over [1, 2] has two values in OPA
+    eng_b = RegoEngine(["package t\np = x { x = input.arr[_] }"])
+    with pytest.raises(RegoError, match="conflict"):
+        eng_b.query("data.t.p", {"arr": [1, 2]})
+    assert eng_b.query("data.t.p", {"arr": [3, 3]}) == 3
+    # agreeing values are not a conflict
+    assert eng.query("data.t.agree", {"x": 1, "y": 2}) == 1
+    # only one definition fires: no conflict either
+    eng2 = RegoEngine(["""package t
+        v = 1 { input.x = 1 }
+        v = 2 { input.x = 2 }
+    """])
+    assert eng2.query("data.t.v", {"x": 2}) == 2
+
+
+def test_rule_memoization_is_per_query():
+    """Cross-rule references re-use the memoized value inside one query
+    but never leak it across queries with different inputs."""
+    eng = RegoEngine(["""package t
+        base = v { split(input.s, ",", parts); parts[0] = v }
+        a { base = "x" }
+        b { base = "x"; a }
+    """])
+    assert eng.query("data.t.b", {"s": "x,y"}) is True
+    assert eng.query("data.t.b", {"s": "z,y"}) is None
+
+
 # ---------------------------------------------------------------------------
 # opa adapter integration (opa.go HandleAuthorization semantics)
 # ---------------------------------------------------------------------------
