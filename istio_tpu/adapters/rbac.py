@@ -10,8 +10,13 @@ stringMatch. Config kinds arrive via the runtime config store
 of a private k8s watcher — the runtime controller feeds `set_policies`
 on snapshot swaps.
 
-This host adapter is also the semantics oracle for the fused NFA authz
-showcase (rules compile to ruleset predicates on device).
+This host adapter is the semantics oracle for the fused NFA authz
+path: compiler/rbac_lower.py compiles the same roles/bindings into
+device pseudo-rule predicates (one row per binding-subject-rolerule
+triple, OR-reduced by models/policy_engine.RbacSpec), and
+tests/test_rbac_lower.py holds the two paths to field-by-field
+agreement. Policies outside the lowerable subset stay here, on the
+host overlay (snapshot.rbac_groups[...].lowered == False).
 """
 from __future__ import annotations
 

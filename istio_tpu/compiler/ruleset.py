@@ -130,6 +130,22 @@ class Rule:
     name: str
     match: str = ""          # empty = always matches (resolver.go:219)
     namespace: str = ""
+    # pre-built predicate AST (synthesized pseudo-rules, e.g. the rbac
+    # lowering compiler/rbac_lower.py) — used instead of parsing `match`
+    ast: Expression | None = None
+
+
+def _rule_ast(rule: Rule) -> Expression:
+    if rule.ast is not None:
+        return rule.ast
+    return parse(rule.match.strip() or "true")
+
+
+def _rule_oracle(rule: Rule,
+                 finder: AttributeDescriptorFinder) -> OracleProgram:
+    if rule.ast is not None:
+        return OracleProgram.from_ast(rule.ast, finder)
+    return OracleProgram(rule.match.strip() or "true", finder)
 
 
 @dataclasses.dataclass
@@ -312,8 +328,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     parsed: list[Expression] = []
 
     for ridx, rule in enumerate(rules):
-        text = rule.match.strip() or "true"
-        ast = parse(text)
+        ast = _rule_ast(rule)
         rtype = eval_type(ast, finder, DEFAULT_FUNCS)
         if rtype != V.BOOL:
             raise TypeError_(
@@ -326,7 +341,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
         except HostFallback as exc:
             atoms.revert(mark)              # undo partial atom adds
             per_rule.append(None)
-            host_fallback[ridx] = OracleProgram(text, finder)
+            host_fallback[ridx] = _rule_oracle(rule, finder)
             fallback_reason[ridx] = str(exc)
 
     # Requirements for every device atom; atoms that cannot lower demote
@@ -348,8 +363,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
             used = {i for conj in (mn[0] | mn[1]) for i, _ in conj}
             if used & bad_atoms:
                 per_rule[ridx] = None
-                host_fallback[ridx] = OracleProgram(
-                    rules[ridx].match.strip() or "true", finder)
+                host_fallback[ridx] = _rule_oracle(rules[ridx], finder)
                 fallback_reason[ridx] = "atom not lowerable"
 
     manifest = {n: finder.get_attribute(n) for n in finder.names()}
@@ -422,8 +436,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
             used = {i for conj in (mn[0] | mn[1]) for i, _ in conj}
             if used & unlowerable:
                 per_rule[ridx] = None
-                host_fallback[ridx] = OracleProgram(
-                    rules[ridx].match.strip() or "true", finder)
+                host_fallback[ridx] = _rule_oracle(rules[ridx], finder)
                 fallback_reason[ridx] = "atom not lowerable"
 
     n_atoms = len(atoms.asts)

@@ -98,6 +98,22 @@ class ListEntrySpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class RbacSpec:
+    """rbac adapter wiring for one rule (mixer/adapter/rbac rbac.go:181
+    HandleAuthorization): the policy's (binding, subject, role-rule)
+    triples were lowered to pseudo-rule rows (compiler/rbac_lower.py);
+    the request is allowed iff ANY `allow_rows` row matched. The
+    `guard_row` tracks host instance-evaluation errors: when it is not
+    definitely-true, the host path would have failed the instance build
+    with INTERNAL (dispatcher _safe_check), so the device reports the
+    same."""
+    rule: int
+    allow_rows: tuple[int, ...]
+    guard_row: int = -1            # -1: instance can never error
+    valid_duration_s: float = 60.0  # handler caching_ttl_s
+
+
+@dataclasses.dataclass(frozen=True)
 class QuotaSpec:
     """memquota wiring for one rule: fixed-window rate limit keyed by an
     attribute's interned id (memquota.go rolling window simplified to
@@ -149,10 +165,12 @@ class PolicyEngine:
                  deny: Sequence[DenySpec] = (),
                  lists: Sequence[ListEntrySpec] = (),
                  quotas: Sequence[QuotaSpec] = (),
+                 rbacs: Sequence[RbacSpec] = (),
                  interner: InternTable | None = None,
                  max_str_len: int | None = None,
                  jit: bool = True,
-                 ruleset: RuleSetProgram | None = None):
+                 ruleset: RuleSetProgram | None = None,
+                 count_rules: int | None = None):
         if ruleset is None:
             assert rules is not None and finder is not None
             ruleset = compile_ruleset(
@@ -163,6 +181,15 @@ class PolicyEngine:
         lay = self.ruleset.layout
         interner = self.ruleset.interner
         R = max(self.ruleset.n_rules, 1)
+        # err accounting covers only real config rules: pseudo-rule rows
+        # (rbac lowering) err routinely on requests missing instance
+        # attrs, which maps to adapter-level INTERNAL, not a predicate
+        # resolve error (host parity: RESOLVE_ERRORS vs DISPATCH_ERRORS)
+        if count_rules is None or count_rules >= R:
+            err_rule_mask = None
+        else:
+            err_rule_mask = np.zeros(R, bool)
+            err_rule_mask[:count_rules] = True
 
         # --- denier tensors ---
         deny_mask = np.zeros(R, bool)
@@ -200,6 +227,27 @@ class PolicyEngine:
             list_code[i] = PERMISSION_DENIED if l.blacklist else NOT_FOUND
             list_dur[i] = l.valid_duration_s
             list_uses[i] = l.valid_use_count
+
+        # --- rbac tensors ---
+        n_rbac = len(rbacs)
+        k_allow = max((len(r.allow_rows) for r in rbacs), default=1) or 1
+        # indices into m_ext = [matched | FALSE col | TRUE col]:
+        # padding of allow rows points at FALSE (OR identity), a missing
+        # guard points at TRUE (instance can never error)
+        FALSE_COL = R
+        TRUE_COL = R + 1
+        rb_rule = np.zeros(max(n_rbac, 1), np.int32)
+        rb_dur = np.full(max(n_rbac, 1), _BIG, np.float32)
+        rb_guard = np.full(max(n_rbac, 1), TRUE_COL, np.int32)
+        rb_allow = np.full((max(n_rbac, 1), k_allow), FALSE_COL,
+                           np.int32)
+        for i, r in enumerate(rbacs):
+            rb_rule[i] = r.rule
+            rb_dur[i] = r.valid_duration_s
+            if r.guard_row >= 0:
+                rb_guard[i] = r.guard_row
+            for s, row in enumerate(r.allow_rows):
+                rb_allow[i, s] = row
 
         # --- quota tensors ---
         n_quotas = len(quotas)
@@ -246,6 +294,13 @@ class PolicyEngine:
         q_slot_j = jnp.asarray(q_slot)
         q_max_j = jnp.asarray(q_max)
         q_nb_j = jnp.asarray(q_nb)
+        has_rbac = n_rbac > 0
+        rb_rule_j = jnp.asarray(rb_rule)
+        rb_dur_j = jnp.asarray(rb_dur)
+        rb_guard_j = jnp.asarray(rb_guard)
+        rb_allow_j = jnp.asarray(rb_allow)
+        err_rule_mask_j = None if err_rule_mask is None \
+            else jnp.asarray(err_rule_mask)
         dims = (((1,), (0,)), ((), ()))
 
         def step(params: Any, batch: AttributeBatch, req_ns: Any,
@@ -295,6 +350,36 @@ class PolicyEngine:
                 uses = jnp.minimum(uses, jnp.min(
                     jnp.where(l_active, list_uses_j[None, :],
                               np.iinfo(np.int32).max), axis=1))
+
+            if has_rbac:
+                # allowed iff ANY lowered (binding, subject, role-rule)
+                # pseudo-rule matched; guard row not definitely-true →
+                # the host instance build would have errored → INTERNAL
+                # (rbac.go:181 + dispatcher _safe_check parity)
+                m_ext = jnp.concatenate(
+                    [matched, jnp.zeros((b, 1), bool),
+                     jnp.ones((b, 1), bool)], axis=1)
+                allow = jnp.any(m_ext[:, rb_allow_j], axis=2)
+                guard_ok = m_ext[:, rb_guard_j]
+                r_active = active[:, rb_rule_j]
+                r_deny = r_active & guard_ok & ~allow
+                r_bad = r_deny | (r_active & ~guard_ok)
+                rb_key = jnp.where(r_bad, rb_rule_j[None, :], BIGI)
+                rb_arg = jnp.argmin(rb_key, axis=1)
+                rb_rule_min = jnp.min(rb_key, axis=1)
+                rb_status = jnp.where(
+                    jnp.take_along_axis(r_deny, rb_arg[:, None],
+                                        axis=1)[:, 0],
+                    PERMISSION_DENIED, INTERNAL)
+                take_rb = rb_rule_min < cand_rule   # deny/list win ties
+                cand_status = jnp.where(take_rb, rb_status, cand_status)
+                cand_rule = jnp.minimum(cand_rule, rb_rule_min)
+                # the handler returns caching_ttl on allow AND deny
+                # verdicts alike; on INTERNAL the host CheckResult
+                # carries only defaults (no-op under min) — skip it
+                dur = jnp.minimum(dur, jnp.min(
+                    jnp.where(r_active & guard_ok, rb_dur_j[None, :],
+                              _BIG), axis=1))
             status = jnp.where(cand_rule < BIGI, cand_status, OK)
 
             if self._has_quota:
@@ -359,7 +444,11 @@ class PolicyEngine:
                                    deny_rule=jnp.where(
                                        status == OK, BIGI, cand_rule),
                                    err_count=jnp.sum(
-                                       (err & ns_ok).astype(jnp.int32)))
+                                       ((err & ns_ok) if err_rule_mask_j
+                                        is None else
+                                        (err & ns_ok &
+                                         err_rule_mask_j[None, :]))
+                                       .astype(jnp.int32)))
             return verdict, quota_counts
 
         self.raw_step = step   # unjitted: for entry()/sharded wrappers

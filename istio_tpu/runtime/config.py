@@ -69,6 +69,21 @@ class RuleConfig:
 
 
 @dataclasses.dataclass
+class RbacGroup:
+    """Device-lowered rbac policy for one (handler, authorization
+    instance) pair: pseudo-rule rows appended to the ruleset
+    (compiler/rbac_lower.py). `lowered` is False when any row fell back
+    to the host oracle — the action then stays on the host adapter."""
+    handler: str
+    instance: str
+    allow_rows: tuple[int, ...]        # OR of these rows = allowed
+    guard_row: int = -1                # -1: instance can never error
+    n_triples: int = 0
+    lowered: bool = True
+    reason: str = ""
+
+
+@dataclasses.dataclass
 class Snapshot:
     """Validated, compiled config generation (runtime2 Snapshot)."""
     revision: int
@@ -77,11 +92,18 @@ class Snapshot:
     instances: dict[str, InstanceBuilder]
     instance_templates: dict[str, str]
     rules: list[RuleConfig]
-    ruleset: RuleSetProgram            # one predicate row per rule
+    ruleset: RuleSetProgram            # one predicate row per rule,
+    #                                    then rbac pseudo-rule rows
     tensorizer: Tensorizer
     roles: list[Mapping[str, Any]]
     bindings: list[Mapping[str, Any]]
     errors: list[str]                  # per-resource soft errors
+    # ruleset rows [n_config_rules:] are synthesized pseudo-rules (no
+    # config rule / actions behind them — only the fused engine and the
+    # RbacGroups below may reference them)
+    n_config_rules: int = 0
+    rbac_groups: dict[tuple[str, str], RbacGroup] = \
+        dataclasses.field(default_factory=dict)
 
     def rule_index(self, name: str, namespace: str) -> int:
         for i, r in enumerate(self.rules):
@@ -131,11 +153,16 @@ class SnapshotBuilder:
                  | None = None,
                  interner: InternTable | None = None,
                  max_str_len: int | None = None,
-                 config_namespace: str = DEFAULT_CONFIG_NAMESPACE):
+                 config_namespace: str = DEFAULT_CONFIG_NAMESPACE,
+                 lower_rbac: bool = True):
         self.default_manifest = dict(default_manifest or {})
         self.interner = interner or InternTable()
         self.max_str_len = max_str_len
         self.config_namespace = config_namespace
+        # False for non-fused servers: only the fused engine reads the
+        # synthesized pseudo-rule rows — compiling them into a snapshot
+        # the generic dispatcher serves would be pure compile/step waste
+        self.lower_rbac = lower_rbac
         self._revision = 0
 
     def build(self, store: Store) -> Snapshot:
@@ -235,6 +262,22 @@ class SnapshotBuilder:
             if isinstance(ref, tuple):
                 derived.add(ref)
         kwargs["extra_derived_keys"] = sorted(derived)
+
+        roles = [dict(spec, name=k[2], namespace=k[1])
+                 for k, spec in store.list(KIND_SERVICE_ROLE).items()]
+        bindings = [dict(spec, name=k[2], namespace=k[1])
+                    for k, spec in store.list(
+                        KIND_SERVICE_ROLE_BINDING).items()]
+
+        # rbac device lowering: synthesize pseudo-rule rows per
+        # (handler, authorization instance) pair so the fused engine
+        # can compute allow/deny on device (compiler/rbac_lower.py;
+        # reference host loop: mixer/adapter/rbac/rbac.go:181)
+        n_config_rules = len(preds)
+        rbac_groups = self._lower_rbac_groups(
+            rules, handlers, instances, instance_templates,
+            roles, bindings, finder, preds) if self.lower_rbac else {}
+
         try:
             ruleset = compile_ruleset(preds, finder,
                                       interner=self.interner, **kwargs)
@@ -254,11 +297,26 @@ class SnapshotBuilder:
             ruleset = compile_ruleset(safe_preds, finder,
                                       interner=self.interner, **kwargs)
 
-        roles = [dict(spec, name=k[2], namespace=k[1])
-                 for k, spec in store.list(KIND_SERVICE_ROLE).items()]
-        bindings = [dict(spec, name=k[2], namespace=k[1])
-                    for k, spec in store.list(
-                        KIND_SERVICE_ROLE_BINDING).items()]
+        # pseudo-rules are implementation detail, not policy: their
+        # predicate attrs must not leak into ReferencedAttributes (the
+        # host path only evaluates rbac instance exprs when the parent
+        # rule matched — instance_attrs cover that, runtime/fused.py)
+        if len(preds) > n_config_rules:
+            ruleset.attr_mask[n_config_rules:, :] = False
+            for i in range(n_config_rules, len(preds)):
+                ruleset.attr_names[i] = set()
+            for g in rbac_groups.values():
+                if not g.lowered:
+                    continue
+                rows = set(g.allow_rows)
+                if g.guard_row >= 0:
+                    rows.add(g.guard_row)
+                bad_rows = rows & set(ruleset.host_fallback)
+                if bad_rows:
+                    g.lowered = False
+                    g.reason = "; ".join(sorted(
+                        ruleset.fallback_reason.get(r, "host fallback")
+                        for r in bad_rows))
 
         return Snapshot(revision=self._revision, finder=finder,
                         handlers=handlers, instances=instances,
@@ -271,4 +329,81 @@ class SnapshotBuilder:
                         # .tensorizer, which hashes its key slots.
                         tensorizer=Tensorizer(ruleset.layout,
                                               self.interner),
-                        roles=roles, bindings=bindings, errors=errors)
+                        roles=roles, bindings=bindings, errors=errors,
+                        n_config_rules=n_config_rules,
+                        rbac_groups=rbac_groups)
+
+    @staticmethod
+    def _lower_rbac_groups(rules, handlers, instances,
+                           instance_templates, roles, bindings, finder,
+                           preds):
+        """Synthesize rbac pseudo-rule predicates, appending to `preds`.
+
+        Every synthesized AST is pre-validated (eval_type == BOOL) so
+        the whole-ruleset compile can never fail because of a pseudo
+        rule — an unfusable policy shape keeps its action on the host
+        adapter (group.lowered=False, logged), never changes
+        semantics."""
+        import logging
+
+        from istio_tpu.compiler.rbac_lower import (RbacLowerError,
+                                                   lower_rbac)
+        from istio_tpu.expr.checker import DEFAULT_FUNCS, eval_type
+        from istio_tpu.attribute.types import ValueType
+
+        log = logging.getLogger("istio_tpu.runtime.config")
+
+        groups: dict[tuple[str, str], RbacGroup] = {}
+        for rc in rules:
+            for action in rc.actions:
+                hc = handlers.get(action.handler)
+                if hc is None or hc.adapter != "rbac":
+                    continue
+                for inst in action.instances:
+                    if instance_templates.get(inst) != "authorization" \
+                            or (action.handler, inst) in groups:
+                        continue
+                    key = (action.handler, inst)
+                    # handler params override store kinds, matching the
+                    # host build (runtime/handler_table.py setdefault —
+                    # an explicit empty list in params stays empty)
+                    eff_roles = hc.params["roles"] \
+                        if "roles" in hc.params else roles
+                    eff_bindings = hc.params["bindings"] \
+                        if "bindings" in hc.params else bindings
+                    try:
+                        low = lower_rbac(eff_roles, eff_bindings,
+                                         instances[inst].expr_tree(),
+                                         finder)
+                        for ast in low.allow_asts + (
+                                [low.guard_ast] if low.guard_ast
+                                is not None else []):
+                            t = eval_type(ast, finder, DEFAULT_FUNCS)
+                            if t != ValueType.BOOL:
+                                raise RbacLowerError(
+                                    f"pseudo-rule type {t.name}")
+                    except Exception as exc:
+                        reason = f"{type(exc).__name__}: {exc}"
+                        log.info("rbac policy for %s not device-"
+                                 "lowerable, serving via host adapter:"
+                                 " %s", inst, reason)
+                        groups[key] = RbacGroup(
+                            handler=action.handler, instance=inst,
+                            allow_rows=(), lowered=False, reason=reason)
+                        continue
+                    base = len(preds)
+                    for i, ast in enumerate(low.allow_asts):
+                        preds.append(RulePred(
+                            name=f"~rbac/{inst}/{i}", ast=ast))
+                    guard_row = -1
+                    if low.guard_ast is not None:
+                        guard_row = len(preds)
+                        preds.append(RulePred(
+                            name=f"~rbac/{inst}/guard",
+                            ast=low.guard_ast))
+                    groups[key] = RbacGroup(
+                        handler=action.handler, instance=inst,
+                        allow_rows=tuple(
+                            range(base, base + len(low.allow_asts))),
+                        guard_row=guard_row, n_triples=low.n_triples)
+        return groups

@@ -40,7 +40,8 @@ class Controller:
         self.fused_enabled = fused
         self.prewarm_buckets = tuple(prewarm_buckets)
         self._builder = SnapshotBuilder(default_manifest,
-                                        InternTable(), max_str_len)
+                                        InternTable(), max_str_len,
+                                        lower_rbac=fused)
         self._handler_table = HandlerTable()
         self._lock = threading.Lock()
         self._rebuild_serial = threading.Lock()   # one rebuild at a time

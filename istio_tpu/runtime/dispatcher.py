@@ -126,14 +126,22 @@ class Dispatcher:
                           ns_ids: np.ndarray, bags: Sequence[Bag]
                           ) -> tuple[np.ndarray, np.ndarray]:
         """Patch host-fallback rules' verdicts into the device output and
-        account namespace-visible errors; returns (active, ns_ok)."""
+        account namespace-visible errors; returns (active, ns_ok),
+        clipped to config rules (ruleset rows past len(snapshot.rules)
+        are rbac pseudo-rules — no actions behind them, and their errs
+        are adapter-level, not resolve-level)."""
         rs = self.snapshot.ruleset
+        n_cfg = len(self.snapshot.rules)
         for ridx in rs.host_fallback:
+            if ridx >= n_cfg:
+                continue
             for b, bag in enumerate(bags):
                 m, _, e = rs.host_eval(ridx, bag)
                 matched[b, ridx] = m
                 err[b, ridx] = e
-        ns_ok = np.asarray(rs.namespace_mask(ns_ids))
+        matched = matched[:, :n_cfg]
+        err = err[:, :n_cfg]
+        ns_ok = np.asarray(rs.namespace_mask(ns_ids))[:, :n_cfg]
         n_err = int((err & ns_ok).sum())
         if n_err:
             monitor.RESOLVE_ERRORS.inc(n_err)
@@ -236,7 +244,9 @@ class Dispatcher:
             col_pos = {int(r): i for i, r in enumerate(cols)}
             host_errs = 0
             for ridx in rs.host_fallback:
-                pos = col_pos[ridx]
+                pos = col_pos.get(ridx)
+                if pos is None:   # rbac pseudo-rule row: no overlay col
+                    continue
                 for b, bag in enumerate(bags):
                     m, _, e = rs.host_eval(ridx, bag)
                     active_sub[b, pos] = m

@@ -24,9 +24,10 @@ from typing import Any, Mapping
 
 import numpy as np
 
-from istio_tpu.models.policy_engine import (DenySpec, ListEntrySpec,
-                                            PolicyEngine, OK,
-                                            PERMISSION_DENIED)
+from istio_tpu.models.policy_engine import (DenySpec, INTERNAL,
+                                            ListEntrySpec, PolicyEngine,
+                                            OK, PERMISSION_DENIED,
+                                            RbacSpec)
 from istio_tpu.runtime.config import Snapshot
 from istio_tpu.templates import Variety
 from istio_tpu.utils.log import scope
@@ -49,6 +50,9 @@ class FusedPlan:
     instance_attrs: list[frozenset]
     deny_info: dict[int, tuple[int, str]]   # rule → (code, message)
     list_rules: frozenset
+    # rules whose rbac action is fused (device pseudo-rule NFA,
+    # compiler/rbac_lower.py) — for status messages + diagnostics
+    rbac_rules: frozenset = frozenset()
     # C++ wire→tensor decoder (istio_tpu/native); None when the
     # toolchain is unavailable — python Tensorizer serves instead
     native: Any = None
@@ -214,6 +218,11 @@ class FusedPlan:
         info = self.deny_info.get(rule_idx)
         if info is not None and info[0] == status:
             return info[1]
+        if rule_idx in self.rbac_rules:
+            if status == PERMISSION_DENIED:
+                return "RBAC: permission denied"   # rbac.go:241
+            if status == INTERNAL:
+                return "authorization instance evaluation failed"
         if rule_idx in self.list_rules:
             name = self.engine.ruleset.rules[rule_idx].name
             return f"rejected by list check (rule {name})"
@@ -231,14 +240,19 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
     deny_info: dict[int, tuple[int, str]] = {}
     lists: list[ListEntrySpec] = []
     list_rules: set[int] = set()
+    rbacs: list[RbacSpec] = []
+    rbac_rules: set[int] = set()
     host_actions: dict[int, list] = {}
     instance_attrs: list[frozenset] = []
+    # ruleset rows beyond the config rules are rbac pseudo-rules — they
+    # carry no actions and never appear in overlays or host fallbacks
+    n_real = len(snapshot.rules)
 
     def add_host(ridx: int, action) -> None:
         host_actions.setdefault(ridx, []).append(action)
 
     fused_first: set[int] = set()
-    for ridx in range(rs.n_rules):
+    for ridx in range(n_real):
         attrs: set = set()
         for pos, action in enumerate(
                 snapshot.actions_for(ridx, Variety.CHECK)):
@@ -249,6 +263,28 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
                 # device matched==False for fallback rules; their fused
                 # contributions would be inert — run everything on host
                 add_host(ridx, action)
+                continue
+            if hc.adapter == "rbac" and template == "authorization":
+                from istio_tpu.runtime.config import _qualify
+                handler_ref = _qualify(hc.name, hc.namespace)
+                fused_insts, host_insts = [], []
+                for iname in inst_names:
+                    g = snapshot.rbac_groups.get((handler_ref, iname))
+                    if g is not None and g.lowered:
+                        fused_insts.append((iname, g))
+                    else:
+                        host_insts.append(iname)
+                if fused_insts and pos == 0 and not host_insts:
+                    fused_first.add(ridx)
+                for iname, g in fused_insts:
+                    rbacs.append(RbacSpec(
+                        rule=ridx, allow_rows=g.allow_rows,
+                        guard_row=g.guard_row,
+                        valid_duration_s=float(
+                            hc.params.get("caching_ttl_s", 60.0))))
+                    rbac_rules.add(ridx)
+                if host_insts:
+                    add_host(ridx, (hc, template, host_insts))
                 continue
             if hc.adapter == "denier":
                 if pos == 0:
@@ -292,7 +328,8 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
 
     engine = PolicyEngine(ruleset=rs, finder=snapshot.finder,
                           deny=list(deny_by_rule.values()), lists=lists,
-                          quotas=(), jit=True)
+                          quotas=(), rbacs=rbacs, jit=True,
+                          count_rules=n_real)
     native = None
     try:
         from istio_tpu.native.tensorizer import NativeTensorizer
@@ -300,9 +337,10 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
     except Exception as exc:   # toolchain missing → python tensorize
         log.warning("native tensorizer unavailable, serving with the "
                     "python wire decoder: %s", exc)
-    log.info("fused plan: %d deny rules, %d lists, %d host-overlay rules"
-             ", native=%s", len(deny_by_rule), len(lists),
-             len(host_actions), native is not None)
+    log.info("fused plan: %d deny rules, %d lists, %d rbac actions "
+             "(%d pseudo-rules), %d host-overlay rules, native=%s",
+             len(deny_by_rule), len(lists), len(rbacs),
+             rs.n_rules - n_real, len(host_actions), native is not None)
 
     # referenced-attribute item space: every layout column (slot or
     # derived) plus every map slot. Instance attrs that map to an item
@@ -349,7 +387,8 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
             if isinstance(item, str) and item in layout.map_slots:
                 pred_map_mask[ridx, layout.map_slots[item]] = 1
 
-    overlay = set(host_actions) | set(rs.host_fallback) | set(unmapped)
+    real_fallback = {r for r in rs.host_fallback if r < n_real}
+    overlay = set(host_actions) | real_fallback | set(unmapped)
     return FusedPlan(engine=engine, native=native,
                      host_actions=host_actions,
                      host_rule_idx=np.asarray(sorted(host_actions),
@@ -357,6 +396,7 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
                      instance_attrs=instance_attrs,
                      deny_info=deny_info,
                      list_rules=frozenset(list_rules),
+                     rbac_rules=frozenset(rbac_rules),
                      fused_first_rules=frozenset(fused_first),
                      overlay_cols=np.asarray(sorted(overlay), np.int64),
                      fused_deny=len(deny_by_rule), fused_lists=len(lists),

@@ -225,6 +225,24 @@ class InstanceBuilder:
     def build(self, bag: Bag) -> dict[str, Any]:
         return self._run(self._plan, bag)
 
+    def expr_tree(self) -> dict[str, Any]:
+        """{field: Expression | {key: Expression} | nested dict} — the
+        instance's raw expression ASTs. The rbac device lowering
+        (compiler/rbac_lower.py) substitutes these into synthesized
+        pseudo-rule predicates; constants are omitted (they never error
+        and the lowering folds them separately)."""
+        def walk(plan: list[tuple]) -> dict[str, Any]:
+            out: dict[str, Any] = {}
+            for fname, kind, payload in plan:
+                if kind == "sub":
+                    out[fname] = walk(payload)
+                elif kind == "map":
+                    out[fname] = {k: p.ast for k, p in payload.items()}
+                elif kind == "expr":
+                    out[fname] = payload.ast
+            return out
+        return walk(self._plan)
+
     def value_attr_ref(self) -> Any | None:
         """attr name / (map, key) when the instance's `value` field is a
         bare attribute read — the fusability probe shared by the layout

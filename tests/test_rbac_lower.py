@@ -1,0 +1,313 @@
+"""RBAC device lowering conformance — fused NFA vs the host adapter.
+
+The rbac policy compiles to pseudo-rule rows in the device ruleset
+(compiler/rbac_lower.py → models/policy_engine.RbacSpec); the host
+adapter (adapters/rbac.py, mirroring mixer/adapter/rbac/rbac.go:181)
+is the semantics oracle. Device and host verdicts must agree
+field-by-field over a property-rich corpus, including instance
+evaluation errors (missing attributes → INTERNAL on both paths).
+"""
+import pytest
+
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.models.policy_engine import (INTERNAL, OK,
+                                            PERMISSION_DENIED)
+from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+from istio_tpu.runtime.config import SnapshotBuilder
+
+
+def _store() -> MemStore:
+    s = MemStore()
+    s.set(("handler", "istio-system", "authzh"), {
+        "adapter": "rbac", "params": {"caching_ttl_s": 42.0}})
+    s.set(("instance", "istio-system", "authz"), {
+        "template": "authorization",
+        "params": {
+            "subject": {
+                "user": "source.user",
+                "groups": 'source.labels["group"] | ""',
+                "properties": {
+                    "version": 'source.labels["version"] | "none"'}},
+            "action": {
+                "namespace": "destination.namespace",
+                "service": "destination.service",
+                "method": "request.method",
+                "path": "request.path",
+                "properties": {
+                    "version": 'request.headers["version"] | ""'}}}})
+    s.set(("rule", "istio-system", "authz-rule"), {
+        "match": "",    # always matches
+        "actions": [{"handler": "authzh", "instances": ["authz"]}]})
+
+    # ServiceRoles (namespace "default")
+    s.set(("servicerole", "default", "viewer"), {"rules": [
+        {"services": ["*"], "methods": ["GET"], "paths": ["/data/*"]}]})
+    s.set(("servicerole", "default", "admin"), {"rules": [
+        {"services": ["books.default.svc.cluster.local"],
+         "constraints": [{"key": "version", "values": ["v1", "v2"]}]}]})
+    s.set(("servicerole", "prod", "prodview"), {"rules": [
+        {"services": ["*.prod.svc.cluster.local"], "methods": ["GET"],
+         "paths": []}]})
+
+    # ServiceRoleBindings
+    s.set(("servicerolebinding", "default", "viewer-b"), {
+        "roleRef": {"kind": "ServiceRole", "name": "viewer"},
+        "subjects": [{"user": "alice"}, {"group": "eng"}]})
+    s.set(("servicerolebinding", "default", "admin-b"), {
+        "roleRef": {"kind": "ServiceRole", "name": "admin"},
+        "subjects": [{"user": "bob",
+                      "properties": {"version": "v1"}}]})
+    s.set(("servicerolebinding", "prod", "prod-b"), {
+        "roleRef": {"kind": "ServiceRole", "name": "prodview"},
+        "subjects": [{"user": "*"}]})
+    return s
+
+
+def _bags():
+    cases = [
+        # 1: alice GET /data/1 in default → viewer allow
+        {"source.user": "alice", "destination.namespace": "default",
+         "destination.service": "books.default.svc.cluster.local",
+         "request.method": "GET", "request.path": "/data/1"},
+        # 2: alice POST → method miss → deny
+        {"source.user": "alice", "destination.namespace": "default",
+         "destination.service": "books.default.svc.cluster.local",
+         "request.method": "POST", "request.path": "/data/1"},
+        # 3: group eng via subject.groups → allow
+        {"source.user": "zed", "source.labels": {"group": "eng"},
+         "destination.namespace": "default",
+         "destination.service": "x.default.svc.cluster.local",
+         "request.method": "GET", "request.path": "/data/zz"},
+        # 4: bob admin with property v1 + constraint header v2 → allow
+        {"source.user": "bob", "source.labels": {"version": "v1"},
+         "destination.namespace": "default",
+         "destination.service": "books.default.svc.cluster.local",
+         "request.method": "DELETE", "request.path": "/any",
+         "request.headers": {"version": "v2"}},
+        # 5: bob wrong subject property → deny
+        {"source.user": "bob", "source.labels": {"version": "v9"},
+         "destination.namespace": "default",
+         "destination.service": "books.default.svc.cluster.local",
+         "request.method": "DELETE", "request.path": "/any",
+         "request.headers": {"version": "v2"}},
+        # 6: bob right property, constraint value miss → deny
+        {"source.user": "bob", "source.labels": {"version": "v1"},
+         "destination.namespace": "default",
+         "destination.service": "books.default.svc.cluster.local",
+         "request.method": "DELETE", "request.path": "/any",
+         "request.headers": {"version": "v9"}},
+        # 7: prod namespace wildcard-user suffix-service → allow
+        {"source.user": "nobody", "destination.namespace": "prod",
+         "destination.service": "api.prod.svc.cluster.local",
+         "request.method": "GET", "request.path": "/x"},
+        # 8: prod suffix miss → deny
+        {"source.user": "nobody", "destination.namespace": "prod",
+         "destination.service": "api.staging.svc.cluster.local",
+         "request.method": "GET", "request.path": "/x"},
+        # 9: unknown namespace → no bindings → deny
+        {"source.user": "alice", "destination.namespace": "nowhere",
+         "destination.service": "x.y.svc.cluster.local",
+         "request.method": "GET", "request.path": "/data/1"},
+        # 10: missing source.user (no fallback) → instance error →
+        #     INTERNAL on both paths
+        {"destination.namespace": "default",
+         "destination.service": "books.default.svc.cluster.local",
+         "request.method": "GET", "request.path": "/data/1"},
+        # 11: missing destination.namespace → instance error
+        {"source.user": "alice",
+         "destination.service": "books.default.svc.cluster.local",
+         "request.method": "GET", "request.path": "/data/1"},
+        # 12: path prefix boundary: /data exactly (prefix "/data/"
+        #     requires the slash) → deny
+        {"source.user": "alice", "destination.namespace": "default",
+         "destination.service": "books.default.svc.cluster.local",
+         "request.method": "GET", "request.path": "/data"},
+    ]
+    return [bag_from_mapping(c) for c in cases]
+
+
+@pytest.fixture(scope="module")
+def servers():
+    fused = RuntimeServer(_store(), ServerArgs(batch_window_s=0.001,
+                                               fused=True))
+    generic = RuntimeServer(_store(), ServerArgs(batch_window_s=0.001,
+                                                 fused=False))
+    yield fused, generic
+    fused.close()
+    generic.close()
+
+
+def test_policy_fully_lowered(servers):
+    fused, _ = servers
+    plan = fused.controller.dispatcher.fused
+    snap = fused.controller.dispatcher.snapshot
+    assert plan is not None
+    assert plan.rbac_rules, "rbac action did not fuse"
+    assert not plan.host_actions, f"host overlay: {plan.host_actions}"
+    groups = list(snap.rbac_groups.values())
+    assert len(groups) == 1
+    g = groups[0]
+    assert g.lowered, g.reason
+    # viewer(1 role rule × 2 subjects) + admin(1×1) + prod(1×1)
+    assert len(g.allow_rows) == 4
+    assert g.guard_row >= 0
+    # pseudo-rules live past the config rules
+    assert snap.n_config_rules == 1
+    assert snap.ruleset.n_rules == 1 + 4 + 1
+
+
+def test_fused_matches_host_adapter(servers):
+    fused, generic = servers
+    bags = _bags()
+    rf = fused.check_many(bags)
+    rg = generic.check_many(bags)
+    for i, (a, b) in enumerate(zip(rf, rg)):
+        assert a.status_code == b.status_code, \
+            f"case {i + 1}: fused={a.status_code} host={b.status_code}" \
+            f" ({b.status_message})"
+        assert a.valid_duration_s == pytest.approx(b.valid_duration_s), \
+            f"case {i + 1}"
+        assert a.valid_use_count == b.valid_use_count, f"case {i + 1}"
+        assert a.referenced == b.referenced, f"case {i + 1}"
+
+
+def test_expected_statuses(servers):
+    fused, _ = servers
+    r = fused.check_many(_bags())
+    expect = [OK, PERMISSION_DENIED, OK, OK, PERMISSION_DENIED,
+              PERMISSION_DENIED, OK, PERMISSION_DENIED,
+              PERMISSION_DENIED, INTERNAL, INTERNAL, PERMISSION_DENIED]
+    got = [x.status_code for x in r]
+    assert got == expect
+    # denial message parity with rbac.go:241
+    assert r[1].status_message == "RBAC: permission denied"
+    # handler caching_ttl_s rides the verdict
+    assert r[0].valid_duration_s == pytest.approx(5.0)  # min(default 5, 42)
+
+
+def test_unfusable_policy_stays_on_host():
+    """A non-STRING property expression is outside the lowerable subset
+    — the group must fall back to the host adapter, not diverge."""
+    s = _store()
+    s.set(("instance", "istio-system", "authz"), {
+        "template": "authorization",
+        "params": {
+            "subject": {"user": "source.user",
+                        "properties": {"size": "request.size"}},
+            "action": {"namespace": "destination.namespace",
+                       "service": "destination.service",
+                       "method": "request.method",
+                       "path": "request.path"}}})
+    s.set(("servicerolebinding", "default", "viewer-b"), {
+        "roleRef": {"kind": "ServiceRole", "name": "viewer"},
+        "subjects": [{"user": "alice",
+                      "properties": {"size": "100"}}]})
+    srv = RuntimeServer(s, ServerArgs(batch_window_s=0.001, fused=True))
+    try:
+        snap = srv.controller.dispatcher.snapshot
+        plan = srv.controller.dispatcher.fused
+        g = list(snap.rbac_groups.values())[0]
+        assert not g.lowered
+        assert "STRING" in g.reason
+        assert plan.host_actions, "unfusable rbac must host-overlay"
+        # and the host path still serves it: alice with size=100 allowed
+        resp = srv.check_many([bag_from_mapping(
+            {"source.user": "alice", "request.size": 100,
+             "destination.namespace": "default",
+             "destination.service": "b.default.svc.cluster.local",
+             "request.method": "GET", "request.path": "/data/1"})])[0]
+        assert resp.status_code == OK
+    finally:
+        srv.close()
+
+
+def test_non_string_config_values_keep_host_parity():
+    """Raw-compare parity (review r3): a non-string binding user
+    (unquoted YAML number) never binds on the host — the lowering must
+    not stringify it into a match; non-string role patterns would
+    adapter-panic on the host, so they refuse to lower entirely."""
+    s = _store()
+    s.set(("servicerolebinding", "default", "intuser-b"), {
+        "roleRef": {"kind": "ServiceRole", "name": "viewer"},
+        "subjects": [{"user": 123}]})
+    fused = RuntimeServer(s, ServerArgs(batch_window_s=0.001,
+                                        fused=True))
+    generic = RuntimeServer(s, ServerArgs(batch_window_s=0.001,
+                                          fused=False))
+    try:
+        bag = bag_from_mapping(
+            {"source.user": "123", "destination.namespace": "default",
+             "destination.service": "b.default.svc.cluster.local",
+             "request.method": "GET", "request.path": "/data/1"})
+        a = fused.check_many([bag])[0]
+        b = generic.check_many([bag])[0]
+        assert a.status_code == b.status_code == PERMISSION_DENIED
+    finally:
+        fused.close()
+        generic.close()
+    # non-string role pattern → whole group stays on the host overlay
+    s2 = _store()
+    s2.set(("servicerole", "default", "viewer"), {"rules": [
+        {"services": [42], "methods": ["GET"], "paths": []}]})
+    srv = RuntimeServer(s2, ServerArgs(batch_window_s=0.001,
+                                       fused=True))
+    try:
+        g = list(srv.controller.dispatcher.snapshot
+                 .rbac_groups.values())[0]
+        assert not g.lowered and "pattern" in g.reason
+    finally:
+        srv.close()
+
+
+def test_non_fused_builder_skips_pseudo_rules():
+    """fused=False servers never pay for pseudo-rule compilation."""
+    srv = RuntimeServer(_store(), ServerArgs(batch_window_s=0.001,
+                                             fused=False))
+    try:
+        snap = srv.controller.dispatcher.snapshot
+        assert snap.rbac_groups == {}
+        assert snap.ruleset.n_rules == len(snap.rules)
+    finally:
+        srv.close()
+
+
+def test_lowering_shapes_directly():
+    """Unit: pattern forms + constant folding in the synthesized ASTs."""
+    from istio_tpu.compiler.rbac_lower import lower_rbac
+    from istio_tpu.expr.checker import AttributeDescriptorFinder
+    from istio_tpu.attribute.types import ValueType as V
+    from istio_tpu.expr.parser import parse
+
+    finder = AttributeDescriptorFinder({
+        "source.user": V.STRING, "destination.service": V.STRING,
+        "destination.namespace": V.STRING, "request.method": V.STRING,
+        "request.path": V.STRING})
+    inst = {"subject": {"user": parse("source.user")},
+            "action": {"namespace": parse("destination.namespace"),
+                       "service": parse("destination.service"),
+                       "method": parse("request.method"),
+                       "path": parse("request.path")}}
+    roles = [{"namespace": "ns1", "name": "r",
+              "rules": [{"services": ["*"], "methods": ["GET", "POST"],
+                         "paths": ["/api/*", "*.html"]}]}]
+    bindings = [{"namespace": "ns1", "name": "b",
+                 "roleRef": {"name": "r"},
+                 "subjects": [{"user": "u1"}, {"user": "*"}]}]
+    low = lower_rbac(roles, bindings, inst, finder)
+    assert low.n_triples == 2
+    assert len(low.allow_asts) == 2
+    assert low.guard_ast is not None
+    text = str(low.allow_asts[0])
+    # services ["*"] folds away; methods/paths stay as LORs
+    assert "LOR" in text and "startsWith" in text and "endsWith" in text
+
+    # an omitted instance field folds the clause against ""
+    inst_no_user = {"subject": {},
+                    "action": {"namespace": parse(
+                        "destination.namespace"),
+                        "service": parse("destination.service"),
+                        "method": parse("request.method"),
+                        "path": parse("request.path")}}
+    low2 = lower_rbac(roles, bindings, inst_no_user, finder)
+    # subject user "u1" vs constant "" → triple dropped; "*" stays
+    assert len(low2.allow_asts) == 1
