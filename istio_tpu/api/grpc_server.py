@@ -107,16 +107,26 @@ class MixerGrpcServer:
         resp.precondition.referenced_attributes.CopyFrom(
             self._referenced_proto(result, bag))
 
-        # quota loop (grpcServer.go:188-230): only on successful check
+        # quota loop (grpcServer.go:188-230): only on successful check.
+        # Fused path: device quota pools + the check step's activity
+        # bits (no re-resolve); pending futures are collected first so
+        # multiple quotas in one request share a device batch.
         if result.status_code == 0:
+            pending = []
             for name, params in request.quotas.items():
                 args = QuotaArgs(quota_amount=params.amount,
                                  best_effort=params.best_effort,
                                  dedup_id=request.deduplication_id +
                                  ":" + name if request.deduplication_id
                                  else "")
-                qr = self.runtime.quota(bag, name, args,
-                                        preprocessed=True)
+                qr = self.runtime.quota_fused(bag, name, args, result)
+                if qr is None:   # generic path / non-device handler
+                    qr = self.runtime.quota(bag, name, args,
+                                            preprocessed=True)
+                pending.append((name, qr))
+            for name, qr in pending:
+                if hasattr(qr, "result"):   # QuotaFuture
+                    qr = qr.result()
                 out = resp.quotas[name]
                 out.granted_amount = qr.granted_amount
                 out.valid_duration.FromTimedelta(datetime.timedelta(
@@ -195,6 +205,11 @@ class MixerAioGrpcServer(MixerGrpcServer):
         # otherwise poison result distribution for the whole batch)
         result = await asyncio.shield(asyncio.wrap_future(
             self.runtime.submit_check_preprocessed(bag)))
+        if request.quotas and result.status_code == 0:
+            # the quota loop may block on a device batch window — keep
+            # it off the event loop
+            return await loop.run_in_executor(
+                None, self._check_response, request, bag, result)
         return self._check_response(request, bag, result)
 
     async def _areport(self, request: "pb.ReportRequest",

@@ -254,8 +254,11 @@ def _const_id(e: Expression, interner: InternTable) -> int | None:
 @dataclasses.dataclass
 class RuleSetProgram:
     """The compiled snapshot. `fn(batch)` → (matched, not_matched, err)
-    each bool[B, n_rules]. Host-fallback rules read False/False/True on
-    device; overlay with `host_eval`."""
+    each bool[B, n_rows], where n_rows = n_rules rounded up to
+    `rule_pad` (mp-sharding padding; pad rows read False/True/False and
+    belong to an unmatchable namespace — size consumers off
+    rule_ns.shape[0], NOT n_rules). Host-fallback rules read
+    False/False/True on device; overlay with `host_eval`."""
     rules: list[Rule]
     layout: BatchLayout
     interner: InternTable
@@ -265,9 +268,9 @@ class RuleSetProgram:
     n_conjs: int
     host_fallback: dict[int, OracleProgram]   # rule idx → oracle
     fallback_reason: dict[int, str]
-    attr_mask: np.ndarray                     # bool [n_rules, n_columns]
-    attr_names: list[set]                     # per rule: names + (map,key)
-    rule_ns: np.ndarray                       # int32 [n_rules]
+    attr_mask: np.ndarray                     # bool [n_rows, n_columns]
+    attr_names: list[set]                     # per REAL rule (n_rules)
+    rule_ns: np.ndarray                       # int32 [n_rows]
     ns_ids: dict[str, int]
     # ---- debugging surface (compiler/disasm.py — the il/text +
     #      Stepper role). Retained source structure, not device state:
@@ -310,7 +313,8 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                     max_str_len: int | None = None,
                     dnf_cap: int = DEFAULT_DNF_CAP,
                     jit: bool = True,
-                    extra_derived_keys: Sequence[tuple[str, str]] = ()
+                    extra_derived_keys: Sequence[tuple[str, str]] = (),
+                    rule_pad: int = 1
                     ) -> RuleSetProgram:
     """Compile a rule snapshot. Never raises for individual bad rules —
     un-lowerable predicates fall back to the oracle; predicates that do
@@ -319,7 +323,14 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
 
     `extra_derived_keys` adds (map, key) columns consumers outside the
     predicates need — e.g. listentry instances the fused engine turns
-    into id-membership scans (runtime/fused.py)."""
+    into id-membership scans (runtime/fused.py).
+
+    `rule_pad` rounds the RULE-AXIS arrays (conj index matrices,
+    rule_ns, attr_mask — and therefore the matched/err planes) up to a
+    multiple, so the axis can shard evenly over an mp mesh dimension
+    (parallel/mesh.py). Pad rows are definitely-not-matched, never
+    error, and belong to an unmatchable namespace; `n_rules` still
+    counts real rules only."""
     interner = interner or InternTable()
     atoms = _AtomTable()
     per_rule: list[tuple[Dnf, Dnf] | None] = []   # None = host fallback
@@ -478,6 +489,8 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
 
     n_conjs = len(conj_list)
     n_rules = len(rules)
+    # rule-axis padding for even mp sharding (see docstring)
+    n_rows = max(-(-max(n_rules, 1) // rule_pad) * rule_pad, 1)
     l_max = max((len(c) for c in conj_list), default=1) or 1
     k_max = max((max(len(m), len(n)) for m, n in
                  ((rule_m_cols[r], rule_n_cols[r]) for r in range(n_rules))),
@@ -492,12 +505,16 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     # index n_conjs is always-FALSE (OR identity).
     LIT_TRUE = 2 * n_live
     CONJ_FALSE = max(n_conjs, 1)   # sat has max(n_conjs,1) real columns
+    CONJ_TRUE = CONJ_FALSE + 1     # pad rows: definitely-not-matched
     lit_idx = np.full((max(n_conjs, 1), l_max), LIT_TRUE, np.int32)
     for j, conj in enumerate(conj_list):
         for s, (aidx, kind) in enumerate(sorted(conj)):
             lit_idx[j, s] = pos_of[aidx] + (0 if kind == "m" else n_live)
-    conj_m_idx = np.full((max(n_rules, 1), k_max), CONJ_FALSE, np.int32)
-    conj_n_idx = np.full((max(n_rules, 1), k_max), CONJ_FALSE, np.int32)
+    conj_m_idx = np.full((n_rows, k_max), CONJ_FALSE, np.int32)
+    conj_n_idx = np.full((n_rows, k_max), CONJ_FALSE, np.int32)
+    # padding rows read not_matched=True (never "err"): their N gather
+    # points at the always-TRUE sentinel column
+    conj_n_idx[n_rules:, 0] = CONJ_TRUE
     for ridx in range(n_rules):
         for s, j in enumerate(rule_m_cols[ridx]):
             conj_m_idx[ridx, s] = j
@@ -541,9 +558,12 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
         lit = jnp.concatenate(
             [m_all, n_all, jnp.ones((b, 1), bool)], axis=1)
         sat = jnp.all(lit[:, params["lit_idx"]], axis=2)     # [B, n_conjs]
-        # sat[:, CONJ_FALSE] is the OR-identity sentinel
+        # sat[:, CONJ_FALSE] is the OR-identity sentinel;
+        # sat[:, CONJ_TRUE] the always-true column rule-axis padding
+        # points its N gather at
         sat_ext = jnp.concatenate(
-            [sat, jnp.zeros((b, 1), bool)], axis=1)
+            [sat, jnp.zeros((b, 1), bool), jnp.ones((b, 1), bool)],
+            axis=1)
         matched = jnp.any(sat_ext[:, params["conj_m_idx"]], axis=2)
         not_matched = jnp.any(sat_ext[:, params["conj_n_idx"]], axis=2)
         # empty-M rules (incl. host fallback): matched stays False; the
@@ -553,7 +573,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
         return matched, not_matched, err
 
     # ---- per-rule attribute bitmaps (compile-time ReferencedAttributes) ----
-    attr_mask = np.zeros((max(n_rules, 1), max(layout.n_columns, 1)), bool)
+    attr_mask = np.zeros((n_rows, max(layout.n_columns, 1)), bool)
     attr_names: list[set] = []
     for ridx in range(n_rules):
         names: set = set()
@@ -567,7 +587,11 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                 attr_mask[ridx, layout.slots[item]] = True
 
     ns_ids: dict[str, int] = {"": 0}
-    rule_ns = np.zeros(max(n_rules, 1), np.int32)
+    # pad rows carry an unmatchable namespace (ids are ≥ 0, unknown
+    # request namespaces are -1) so they are invisible everywhere
+    rule_ns = np.full(n_rows, -7, np.int32)
+    if n_rules == 0:
+        rule_ns[:] = 0   # placeholder row of an empty ruleset
     for ridx, rule in enumerate(rules):
         ns = rule.namespace
         if ns not in ns_ids:

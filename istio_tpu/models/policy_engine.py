@@ -180,7 +180,10 @@ class PolicyEngine:
         self.finder = finder
         lay = self.ruleset.layout
         interner = self.ruleset.interner
-        R = max(self.ruleset.n_rules, 1)
+        # rule-axis width INCLUDING mp-sharding padding (ruleset
+        # rule_pad) — every per-rule tensor and the matched/err planes
+        # share it; rs.n_rules counts real rules only
+        R = int(self.ruleset.rule_ns.shape[0])
         # err accounting covers only real config rules: pseudo-rule rows
         # (rbac lowering) err routinely on requests missing instance
         # attrs, which maps to adapter-level INTERNAL, not a predicate

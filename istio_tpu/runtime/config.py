@@ -262,6 +262,9 @@ class SnapshotBuilder:
             if isinstance(ref, tuple):
                 derived.add(ref)
         kwargs["extra_derived_keys"] = sorted(derived)
+        # rule-axis padded to 8 so the matched/err planes shard evenly
+        # over any mp ∈ {1,2,4,8} serving mesh (parallel/mesh.py)
+        kwargs["rule_pad"] = 8
 
         roles = [dict(spec, name=k[2], namespace=k[1])
                  for k, spec in store.list(KIND_SERVICE_ROLE).items()]

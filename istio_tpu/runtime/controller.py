@@ -32,17 +32,26 @@ class Controller:
                  max_str_len: int | None = None,
                  on_publish: Callable[[Dispatcher], None] | None = None,
                  fused: bool = True,
-                 prewarm_buckets: tuple[int, ...] = ()):
+                 prewarm_buckets: tuple[int, ...] = (),
+                 mesh=None):
         self.store = store
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
         self.on_publish = on_publish
         self.fused_enabled = fused
+        self.mesh = mesh    # jax.sharding.Mesh for multi-chip serving
         self.prewarm_buckets = tuple(prewarm_buckets)
         self._builder = SnapshotBuilder(default_manifest,
                                         InternTable(), max_str_len,
                                         lower_rbac=fused)
         self._handler_table = HandlerTable()
+        # device-backed served quota (runtime/device_quota.py); pools
+        # keep counters across snapshot swaps via signature reuse
+        self._quota_table = None
+        if fused:
+            from istio_tpu.runtime.device_quota import DeviceQuotaTable
+            self._quota_table = DeviceQuotaTable()
+        self.device_quotas: dict = {}
         self._lock = threading.Lock()
         self._rebuild_serial = threading.Lock()   # one rebuild at a time
         self._timer: threading.Timer | None = None
@@ -83,7 +92,7 @@ class Controller:
         plan = None
         if self.fused_enabled:
             from istio_tpu.runtime.fused import build_fused_plan
-            plan = build_fused_plan(snapshot)
+            plan = build_fused_plan(snapshot, mesh=self.mesh)
             if plan is not None and self.prewarm_buckets:
                 if self._dispatcher is not None:
                     # shadow-compile the serving shapes before the swap
@@ -99,9 +108,22 @@ class Controller:
                     threading.Thread(
                         target=plan.prewarm, args=(self.prewarm_buckets,),
                         daemon=True, name="prewarm-initial").start()
+        quota_orphans: list = []
+        if self._quota_table is not None:
+            self.device_quotas, quota_orphans = \
+                self._quota_table.rebuild(snapshot)
         dispatcher = Dispatcher(snapshot, handlers, self.identity_attr,
                                 fused=plan)
         self._dispatcher = dispatcher      # atomic publish (GIL ref swap)
+        if quota_orphans:
+            # same delayed drain as handler orphans: in-flight quota
+            # loops may still hold the old pool (alloc() on a closed
+            # pool fails fast, but draining avoids spurious UNAVAILABLE)
+            t = threading.Timer(
+                self.ORPHAN_DRAIN_S,
+                lambda: [p.close() for p in quota_orphans])
+            t.daemon = True
+            t.start()
         if orphans:
             t = threading.Timer(
                 self.ORPHAN_DRAIN_S,
@@ -122,3 +144,5 @@ class Controller:
             if self._timer is not None:
                 self._timer.cancel()
         self._handler_table.close()
+        if self._quota_table is not None:
+            self._quota_table.close()

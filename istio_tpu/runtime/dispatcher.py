@@ -52,6 +52,14 @@ class CheckResponse:
     # ReferencedAttributes needs no host-side bag decode (None → the
     # gRPC layer falls back to bag lookups)
     referenced_presence: dict | None = None
+    # QUOTA-variety rules active for this request (fused path only) —
+    # lets the served quota loop skip the per-quota re-resolve
+    # (runtime/device_quota.py). None → caller must resolve.
+    active_quota_rules: tuple | None = None
+    # the dispatcher that produced those indices: rule indices are
+    # positional within ONE snapshot, so the quota loop must read the
+    # same plan even if a config swap republished mid-request
+    quota_context: Any = None
 
 
 def _namespace_of(bag: Bag, identity_attr: str) -> str:
@@ -266,6 +274,8 @@ class Dispatcher:
 
         ha = plan.host_rule_idx
         ha_pos = np.asarray([col_pos[int(r)] for r in ha], np.int64)
+        qa_rules = sorted({qa[0] for qa in plan.quota_actions})
+        qa_pos = [col_pos[r] for r in qa_rules]
 
         # Referenced/presence construction deduplicated across the
         # batch: uniform traffic produces a handful of distinct
@@ -364,6 +374,13 @@ class Dispatcher:
             # referenced/presence: precomputed per unique signature
             if ref_of is not None:
                 resp.referenced, resp.referenced_presence = ref_of[b]
+            if qa_rules:
+                resp.active_quota_rules = tuple(
+                    r for r, p in zip(qa_rules, qa_pos)
+                    if active_sub[b, p])
+                resp.quota_context = self
+            else:
+                resp.active_quota_rules = ()
             out.append(resp)
         return out
 

@@ -53,6 +53,12 @@ class FusedPlan:
     # rules whose rbac action is fused (device pseudo-rule NFA,
     # compiler/rbac_lower.py) — for status messages + diagnostics
     rbac_rules: frozenset = frozenset()
+    # QUOTA-variety wiring for the served quota loop
+    # (grpcServer.go:188-230): [(rule idx, handler qname, instance
+    # qname, accepted quota names)] in rule order. The rules' activity
+    # bits ride overlay_cols so the gRPC quota loop never re-resolves
+    # (runtime/device_quota.py).
+    quota_actions: tuple = ()
     # C++ wire→tensor decoder (istio_tpu/native); None when the
     # toolchain is unavailable — python Tensorizer serves instead
     native: Any = None
@@ -229,8 +235,14 @@ class FusedPlan:
         return "denied by policy"
 
 
-def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
-    """Extract fusable CHECK actions and build the snapshot's engine."""
+def build_fused_plan(snapshot: Snapshot,
+                     mesh=None) -> FusedPlan | None:
+    """Extract fusable CHECK actions and build the snapshot's engine.
+
+    `mesh` (jax.sharding.Mesh, dp×mp) re-jits the engine step under the
+    multi-chip serving layout (parallel/mesh.py shard_engine_check):
+    requests shard over dp, rule rows over mp, one psum on the verdict
+    fold — the SAME serving path, scaled across chips."""
     rs = snapshot.ruleset
     if rs.n_rules == 0:
         return None
@@ -326,10 +338,30 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
             add_host(ridx, action)
         instance_attrs.append(frozenset(attrs))
 
+    # QUOTA-variety actions: recorded (in rule order) so the served
+    # quota loop can reuse the check step's activity bits instead of
+    # re-resolving (dispatcher.quota dispatches to at most ONE handler,
+    # matching by instance name — dispatcher.go:242-260)
+    quota_actions: list = []
+    quota_rules: set[int] = set()
+    for ridx in range(n_real):
+        for hc, template, inst_names in snapshot.actions_for(
+                ridx, Variety.QUOTA):
+            from istio_tpu.runtime.config import _qualify
+            for iname in inst_names:
+                names = frozenset({iname, iname.split(".")[0]})
+                quota_actions.append(
+                    (ridx, _qualify(hc.name, hc.namespace), iname,
+                     names))
+                quota_rules.add(ridx)
+
     engine = PolicyEngine(ruleset=rs, finder=snapshot.finder,
                           deny=list(deny_by_rule.values()), lists=lists,
                           quotas=(), rbacs=rbacs, jit=True,
                           count_rules=n_real)
+    if mesh is not None:
+        from istio_tpu.parallel.mesh import shard_engine_check
+        engine._step = shard_engine_check(mesh, engine)
     native = None
     try:
         from istio_tpu.native.tensorizer import NativeTensorizer
@@ -359,7 +391,8 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
         item_names[n_cols + mcol] = name
         item_of[name] = n_cols + mcol
     n_items = len(item_names)
-    inst_mask = np.zeros((max(rs.n_rules, 1), n_items), np.int8)
+    n_rows = int(rs.rule_ns.shape[0])   # incl. mp-sharding padding
+    inst_mask = np.zeros((n_rows, n_items), np.int8)
     unmapped: dict[int, frozenset] = {}
     for ridx, attrs in enumerate(instance_attrs):
         if ridx in rs.host_fallback:
@@ -380,15 +413,15 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
             unmapped[ridx] = frozenset(missing)
     # predicate MAP-name uses (e.g. `ar["k"]` references "ar" too) —
     # the engine's referenced plane covers columns only
-    pred_map_mask = np.zeros((max(rs.n_rules, 1), max(n_maps, 1)),
-                             np.int8)
+    pred_map_mask = np.zeros((n_rows, max(n_maps, 1)), np.int8)
     for ridx in range(rs.n_rules):
         for item in rs.attr_names[ridx]:
             if isinstance(item, str) and item in layout.map_slots:
                 pred_map_mask[ridx, layout.map_slots[item]] = 1
 
     real_fallback = {r for r in rs.host_fallback if r < n_real}
-    overlay = set(host_actions) | real_fallback | set(unmapped)
+    overlay = set(host_actions) | real_fallback | set(unmapped) \
+        | quota_rules
     return FusedPlan(engine=engine, native=native,
                      host_actions=host_actions,
                      host_rule_idx=np.asarray(sorted(host_actions),
@@ -397,14 +430,14 @@ def build_fused_plan(snapshot: Snapshot) -> FusedPlan | None:
                      deny_info=deny_info,
                      list_rules=frozenset(list_rules),
                      rbac_rules=frozenset(rbac_rules),
+                     quota_actions=tuple(quota_actions),
                      fused_first_rules=frozenset(fused_first),
                      overlay_cols=np.asarray(sorted(overlay), np.int64),
                      fused_deny=len(deny_by_rule), fused_lists=len(lists),
                      item_names=item_names,
                      inst_mask=inst_mask,
                      pred_map_mask=pred_map_mask[:, :n_maps]
-                     if n_maps else np.zeros((max(rs.n_rules, 1), 0),
-                                             np.int8),
+                     if n_maps else np.zeros((n_rows, 0), np.int8),
                      unmapped_instance_attrs=unmapped)
 
 

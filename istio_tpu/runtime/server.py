@@ -38,6 +38,12 @@ class ServerArgs:
     # serve checks through the fused device engine (runtime/fused.py);
     # False falls back to the generic host-adapter dispatch path
     fused: bool = True
+    # multi-chip serving: (dp, mp) factorization of jax.devices() — the
+    # snapshot engine jits under shard_engine_check (batch over dp,
+    # rules over mp, one psum on the verdict fold; parallel/mesh.py).
+    # None = single device. Requires fused=True and every serving
+    # bucket divisible by dp.
+    mesh_shape: tuple[int, int] | None = None
 
 
 class RuntimeServer:
@@ -49,12 +55,24 @@ class RuntimeServer:
         from istio_tpu.runtime.batcher import default_buckets
         buckets = tuple(sorted(self.args.buckets)) if self.args.buckets \
             else default_buckets(self.args.max_batch)
+        mesh = None
+        if self.args.mesh_shape is not None:
+            if not self.args.fused:
+                raise ValueError("mesh serving requires fused=True")
+            from istio_tpu.parallel.mesh import MeshSpec
+            dp, mp = self.args.mesh_shape
+            bad = [b for b in buckets if b % dp]
+            if bad:
+                raise ValueError(
+                    f"serving buckets {bad} not divisible by dp={dp}")
+            mesh = MeshSpec(dp=dp, mp=mp).build()
         self.controller = Controller(
             store, default_manifest=manifest,
             identity_attr=self.args.identity_attr,
             max_str_len=self.args.max_str_len,
             fused=self.args.fused,
-            prewarm_buckets=buckets)
+            prewarm_buckets=buckets,
+            mesh=mesh)
         self.batcher = CheckBatcher(self._run_check_batch,
                                     window_s=self.args.batch_window_s,
                                     max_batch=self.args.max_batch,
@@ -110,6 +128,56 @@ class RuntimeServer:
         if not preprocessed:
             bag = self.preprocess(bag)
         return d.quota(bag, quota_name, args or QuotaArgs())
+
+    def quota_fused(self, bag: Bag, quota_name: str, args: QuotaArgs,
+                    check_result):
+        """Served quota via the device pools (runtime/device_quota.py):
+        reuses the CHECK step's activity bits instead of re-resolving.
+        Returns a QuotaFuture, a final QuotaResult (no device work
+        needed), or None → the caller must take the dispatcher.quota
+        fallback (generic path / non-memquota quota handler)."""
+        from istio_tpu.adapters.sdk import QuotaResult
+        from istio_tpu.expr.oracle import EvalError
+        from istio_tpu.models.policy_engine import INTERNAL
+
+        if check_result.active_quota_rules is None:
+            return None
+        # rule indices are positional within the snapshot that served
+        # the check — use THAT dispatcher, not the current one (a config
+        # swap mid-request would renumber rules under us)
+        d = check_result.quota_context
+        if d is None:
+            # no quota actions existed at check time: grant freely
+            # (dispatcher.quota tail — the reference returns empty)
+            return QuotaResult(granted_amount=args.quota_amount)
+        plan = d.fused
+        if plan is None:
+            return None
+        active = set(check_result.active_quota_rules)
+        snap = d.snapshot
+        for ridx, handler_q, inst_q, names in plan.quota_actions:
+            if ridx not in active or quota_name not in names:
+                continue
+            pool = self.controller.device_quotas.get(handler_q)
+            # limits are keyed by the handler config's quota names,
+            # which match QUALIFIED instance names (memquota looks up
+            # instance["name"] — see tests/test_runtime.py convention)
+            if pool is None or not pool.knows(inst_q):
+                return None   # non-memquota quota handler → host path
+            try:
+                instance = snap.instances[inst_q].build(bag)
+            except EvalError as exc:   # dispatcher.quota parity
+                return QuotaResult(granted_amount=0,
+                                   status_code=INTERNAL,
+                                   status_message=str(exc))
+            except Exception as exc:   # safeDispatch parity
+                return QuotaResult(granted_amount=0,
+                                   status_code=INTERNAL,
+                                   status_message=f"instance build: "
+                                                  f"{exc}")
+            return pool.alloc(inst_q, instance, args)
+        # no matching active quota rule: grant freely
+        return QuotaResult(granted_amount=args.quota_amount)
 
     def close(self) -> None:
         self.batcher.close()

@@ -22,16 +22,23 @@ from typing import Any, Mapping, Sequence
 import numpy as np
 
 
-def make_check_payloads(dicts: Sequence[Mapping[str, Any]]) -> list[bytes]:
-    """Pre-serialized CheckRequest bytes for the worker processes."""
+def make_check_payloads(dicts: Sequence[Mapping[str, Any]],
+                        quota_every: int = 0,
+                        quota_name: str = "rq") -> list[bytes]:
+    """Pre-serialized CheckRequest bytes for the worker processes.
+    `quota_every` > 0 attaches a quota request (amount 1, no dedup) to
+    every Nth payload — served quota traffic rides the e2e number."""
     from istio_tpu.api import mixer_pb2 as pb
     from istio_tpu.api.wire import bag_to_compressed
     from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
 
     out = []
-    for values in dicts:
+    for i, values in enumerate(dicts):
         req = pb.CheckRequest(global_word_count=len(GLOBAL_WORD_LIST))
         bag_to_compressed(values, msg=req.attributes)
+        if quota_every and i % quota_every == 0:
+            req.quotas[quota_name].amount = 1
+            req.quotas[quota_name].best_effort = True
         out.append(req.SerializeToString())
     return out
 

@@ -100,6 +100,19 @@ def make_store(n_rules: int, n_services: int | None = None,
         "adapter": "list",
         "params": {"overrides": [f"ns{j}" for j in range(0, 23, 2)],
                    "blacklist": False}})
+    # served quota traffic (grpcServer.go:188-230 loop → device pools,
+    # runtime/device_quota.py): per-user rate limit, requested by the
+    # perf rig on a fraction of payloads
+    s.set(("handler", "istio-system", "mq"), {
+        "adapter": "memquota",
+        "params": {"quotas": [{"name": "rq.istio-system",
+                               "max_amount": 1 << 30}]}})
+    s.set(("instance", "istio-system", "rq"), {
+        "template": "quota",
+        "params": {"dimensions": {"user": 'source.user | "anon"'}}})
+    s.set(("rule", "istio-system", "quota-rule"), {
+        "match": "",
+        "actions": [{"handler": "mq", "instances": ["rq"]}]})
     s.set(("instance", "istio-system", "nothing"), {
         "template": "checknothing", "params": {}})
     s.set(("instance", "istio-system", "srcns"), {
