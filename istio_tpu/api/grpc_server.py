@@ -94,7 +94,8 @@ class MixerGrpcServer:
         return self.runtime.preprocess(bag)
 
     def _check_response(self, request: RawCheckRequest, bag,
-                        result) -> "pb.CheckResponse":
+                        result, quotas: list | None = None
+                        ) -> "pb.CheckResponse":
         resp = pb.CheckResponse()
         resp.precondition.status.code = result.status_code
         if result.status_message:
@@ -112,26 +113,39 @@ class MixerGrpcServer:
         # bits (no re-resolve); pending futures are collected first so
         # multiple quotas in one request share a device batch.
         if result.status_code == 0:
-            pending = []
-            for name, params in request.quotas.items():
-                args = QuotaArgs(quota_amount=params.amount,
-                                 best_effort=params.best_effort,
-                                 dedup_id=request.deduplication_id +
-                                 ":" + name if request.deduplication_id
-                                 else "")
-                qr = self.runtime.quota_fused(bag, name, args, result)
-                if qr is None:   # generic path / non-device handler
-                    qr = self.runtime.quota(bag, name, args,
-                                            preprocessed=True)
-                pending.append((name, qr))
-            for name, qr in pending:
-                if hasattr(qr, "result"):   # QuotaFuture
+            if quotas is None:
+                quotas = self._submit_quotas(request, bag, result)
+            for name, qr in quotas:
+                if hasattr(qr, "result"):   # QuotaFuture (sync front)
                     qr = qr.result()
                 out = resp.quotas[name]
                 out.granted_amount = qr.granted_amount
                 out.valid_duration.FromTimedelta(datetime.timedelta(
                     seconds=min(qr.valid_duration_s, _CLAMP_DURATION_S)))
         return resp
+
+    @staticmethod
+    def _quota_args(request: RawCheckRequest, name: str,
+                    params) -> QuotaArgs:
+        return QuotaArgs(quota_amount=params.amount,
+                         best_effort=params.best_effort,
+                         dedup_id=request.deduplication_id + ":" + name
+                         if request.deduplication_id else "")
+
+    def _submit_quotas(self, request: RawCheckRequest, bag,
+                       result) -> list:
+        """→ [(name, QuotaResult | QuotaFuture)] — non-blocking on the
+        fused path (pool futures); the dispatcher fallback (generic
+        path / non-device quota handler) resolves inline."""
+        pending = []
+        for name, params in request.quotas.items():
+            args = self._quota_args(request, name, params)
+            qr = self.runtime.quota_fused(bag, name, args, result)
+            if qr is None:   # generic path / non-device handler
+                qr = self.runtime.quota(bag, name, args,
+                                        preprocessed=True)
+            pending.append((name, qr))
+        return pending
 
     def _referenced_proto(self, result, bag) -> "pb.ReferencedAttributes":
         presence = result.referenced_presence
@@ -206,10 +220,37 @@ class MixerAioGrpcServer(MixerGrpcServer):
         result = await asyncio.shield(asyncio.wrap_future(
             self.runtime.submit_check_preprocessed(bag)))
         if request.quotas and result.status_code == 0:
-            # the quota loop may block on a device batch window — keep
-            # it off the event loop
-            return await loop.run_in_executor(
-                None, self._check_response, request, bag, result)
+            # fused-path quota futures bridge to the loop via
+            # callbacks — an in-flight quota holds NO thread (an
+            # executor thread per pending device batch serialized the
+            # whole server behind ~5 threads × an RTT)
+            # submit EVERY quota first so they share a device batch
+            # window, then await — a per-quota await would serialize k
+            # quotas into k windows
+            pending = []
+            for name, params in request.quotas.items():
+                args = self._quota_args(request, name, params)
+                qr = self.runtime.quota_fused(bag, name, args, result)
+                if qr is None:
+                    # dispatcher fallback re-resolves (device RTT) —
+                    # off the loop
+                    qr = loop.run_in_executor(
+                        None, self.runtime.quota, bag, name, args,
+                        True)
+                elif hasattr(qr, "add_done_callback"):
+                    af = loop.create_future()
+                    qr.add_done_callback(
+                        lambda v, af=af: loop.call_soon_threadsafe(
+                            af.set_result, v))
+                    qr = af
+                pending.append((name, qr))
+            quotas = []
+            for name, qr in pending:
+                if asyncio.isfuture(qr):
+                    qr = await qr
+                quotas.append((name, qr))
+            return self._check_response(request, bag, result,
+                                        quotas=quotas)
         return self._check_response(request, bag, result)
 
     async def _areport(self, request: "pb.ReportRequest",

@@ -5,12 +5,16 @@ the wire protocol already dictionary-codes attribute names and string
 values as int32 indices, so a batch of requests tensorizes naturally into
 dense int32 arrays.
 
-Key design decision — IDENTITY SEMANTICS: the expression language has no
-ordering or arithmetic over attribute values (intrinsics are only
-EQ/NEQ/OR/LOR/LAND/INDEX, reference func.go:39-72), so every non-boolean
-scalar value is interned into one opaque int32 id space and equality
-becomes id comparison. Byte tensors exist ONLY for string slots consumed
-by byte-level predicates (glob/regex/prefix/suffix). IP addresses are
+Key design decision — IDENTITY SEMANTICS: the expression language has
+no arithmetic over attribute values (intrinsics: EQ/NEQ/OR/LOR/LAND/
+INDEX plus the ordered comparisons, reference func.go:39-72), so every
+non-boolean scalar value is interned into one opaque int32 id space and
+equality becomes id comparison. Byte tensors serve string slots
+consumed by byte-level predicates (glob/regex/prefix/suffix) AND
+ordered comparisons: numeric slots (INT64/DOUBLE/DURATION/TIMESTAMP)
+store an 8-byte ORDER-PRESERVING key (sign-flipped big-endian; IEEE
+bit-trick for doubles), so `<`/`>` lower to the same lexicographic
+byte compare as strings (bytes_ops.lex_cmp). IP addresses are
 normalized to 16-byte form before interning so `ip_equal` semantics
 (v4 == v4-in-v6, externs.go:88) hold under id equality; timestamps and
 durations normalize to epoch-/total-nanoseconds.
@@ -38,6 +42,62 @@ ID_FALSE = 1
 ID_TRUE = 2
 
 DEFAULT_MAX_STR_LEN = 128
+
+# types whose byte slots carry order-preserving keys (BOOL is NOT
+# orderable — the oracle raises on it, expr/oracle.py _ordered)
+ORDER_KEY_TYPES = frozenset({ValueType.INT64, ValueType.DOUBLE,
+                             ValueType.DURATION, ValueType.TIMESTAMP})
+
+_EPOCH = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+_I64_FLIP = 0x8000_0000_0000_0000
+_U64_MASK = 0xFFFF_FFFF_FFFF_FFFF
+# 1-byte marker for a numeric slot whose value could not be encoded
+# (wrong wire type): real keys are 8 bytes, NaN is 0 bytes, this is 1
+ORDER_KEY_ERROR = b"\x00"
+
+
+def order_key_bytes(v: Any, vtype: ValueType) -> bytes:
+    """8-byte big-endian key whose unsigned lexicographic order equals
+    the value order — `<` on device is then bytes_ops.lex_cmp over the
+    same planes string predicates use. Returns b"" (present-but-empty =
+    undecidable marker) for values with no total-order embedding (NaN:
+    every ordered comparison is False in the reference, which no key
+    can encode)."""
+    import struct
+
+    if vtype == ValueType.INT64:
+        if isinstance(v, (str, bytes)):
+            raise ValueError("non-numeric INT64 payload")
+        return struct.pack(">Q", (int(v) ^ _I64_FLIP) & _U64_MASK)
+    if vtype == ValueType.DOUBLE:
+        if isinstance(v, (str, bytes)):
+            raise ValueError("non-numeric DOUBLE payload")
+        d = float(v)
+        if d != d:   # NaN
+            return b""
+        if d == 0.0:
+            d = 0.0   # -0.0 == +0.0 must share one key (IEEE order)
+        bits = struct.unpack(">Q", struct.pack(">d", d))[0]
+        bits = (bits ^ _U64_MASK) if (bits >> 63) else (bits | _I64_FLIP)
+        return struct.pack(">Q", bits)
+    if vtype == ValueType.DURATION:
+        if isinstance(v, (str, bytes)):
+            raise ValueError("non-duration payload")
+        ns = (v // datetime.timedelta(microseconds=1)) * 1000 \
+            if isinstance(v, datetime.timedelta) else int(v)
+        return struct.pack(">Q", (ns ^ _I64_FLIP) & _U64_MASK)
+    if vtype == ValueType.TIMESTAMP:
+        if isinstance(v, datetime.datetime):
+            if v.tzinfo is None:
+                v = v.replace(tzinfo=datetime.timezone.utc)
+            ns = int((v - _EPOCH) // datetime.timedelta(microseconds=1)
+                     ) * 1000
+        elif isinstance(v, (str, bytes)):
+            raise ValueError("non-timestamp payload")
+        else:
+            ns = int(v)
+        return struct.pack(">Q", (ns ^ _I64_FLIP) & _U64_MASK)
+    raise ValueError(f"no order key for {vtype}")
 
 
 def _normalize(value: Any) -> tuple[str, Hashable]:
@@ -161,10 +221,21 @@ class BatchLayout:
     map_slots: Mapping[str, int]                   # map attr → map column
     byte_slots: Mapping[Any, int]                  # attr | (map,key) → byte col
     max_str_len: int = DEFAULT_MAX_STR_LEN
+    # extern-converted columns: ("ip"|"timestamp", operand-key) → id
+    # column. The TENSORIZER runs the conversion at ingest (normalize
+    # at the edge — the TPU-native home for string parsing) and interns
+    # the result; id ID_INVALID with present=True marks a conversion/
+    # lookup error (tensor_expr reads it back as err).
+    extern_slots: Mapping[tuple[str, str], int] = \
+        dataclasses.field(default_factory=dict)
+    # operand ASTs per extern slot key (for the tensorizer's oracle)
+    extern_defs: Mapping[tuple[str, str], Any] = \
+        dataclasses.field(default_factory=dict)
 
     @property
     def n_columns(self) -> int:
-        return len(self.slots) + len(self.derived_slots)
+        return (len(self.slots) + len(self.derived_slots)
+                + len(self.extern_slots))
 
     @property
     def n_maps(self) -> int:
@@ -184,10 +255,14 @@ class BatchLayout:
 def build_layout(manifest: Mapping[str, ValueType],
                  derived_keys: Sequence[tuple[str, str]] = (),
                  byte_sources: Sequence[Any] = (),
-                 max_str_len: int = DEFAULT_MAX_STR_LEN) -> BatchLayout:
-    """Assign columns. `derived_keys` and `byte_sources` are collected by
-    the expression/ruleset compilers (a compile → layout → recompile
-    fixpoint is avoided by collecting requirements in a pre-pass)."""
+                 max_str_len: int = DEFAULT_MAX_STR_LEN,
+                 extern_sources: Sequence[tuple[str, str, Any]] = ()
+                 ) -> BatchLayout:
+    """Assign columns. `derived_keys`, `byte_sources` and
+    `extern_sources` ((extern name, operand key, operand AST) triples)
+    are collected by the expression/ruleset compilers (a compile →
+    layout → recompile fixpoint is avoided by collecting requirements
+    in a pre-pass)."""
     slots: dict[str, int] = {}
     map_slots: dict[str, int] = {}
     for name in sorted(manifest):
@@ -201,13 +276,23 @@ def build_layout(manifest: Mapping[str, ValueType],
         if mk not in derived:
             derived[mk] = col
             col += 1
+    externs: dict[tuple[str, str], int] = {}
+    defs: dict[tuple[str, str], Any] = {}
+    for name, key, ast in sorted(extern_sources,
+                                 key=lambda t: (t[0], t[1])):
+        k = (name, key)
+        if k not in externs:
+            externs[k] = col
+            defs[k] = ast
+            col += 1
     bytes_: dict[Any, int] = {}
     for src in byte_sources:
         if src not in bytes_:
             bytes_[src] = len(bytes_)
     return BatchLayout(manifest=dict(manifest), slots=slots,
                        derived_slots=derived, map_slots=map_slots,
-                       byte_slots=dict(bytes_), max_str_len=max_str_len)
+                       byte_slots=dict(bytes_), max_str_len=max_str_len,
+                       extern_slots=externs, extern_defs=defs)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -280,6 +365,20 @@ class Tensorizer:
                 range(layout.n_columns))
         else:
             self.hash_slots = frozenset(hash_slots or ())
+        # extern-converted columns: operand oracle + converter, built
+        # once (layout.extern_defs carries the operand ASTs)
+        self._externs: list[tuple[int, Any, Any]] = []
+        if layout.extern_slots:
+            from istio_tpu.expr.checker import AttributeDescriptorFinder
+            from istio_tpu.expr.externs import (extern_ip,
+                                                extern_timestamp)
+            from istio_tpu.expr.oracle import OracleProgram
+            finder = AttributeDescriptorFinder(dict(layout.manifest))
+            conv = {"ip": extern_ip, "timestamp": extern_timestamp}
+            for (name, key), col in layout.extern_slots.items():
+                prog = OracleProgram.from_ast(
+                    layout.extern_defs[(name, key)], finder)
+                self._externs.append((col, prog, conv[name]))
 
     def tensorize(self, bags: Sequence[Bag]) -> AttributeBatch:
         lay = self.layout
@@ -342,10 +441,26 @@ class Tensorizer:
                 raw = self._byte_source_value(bag, src)
                 if raw is None:
                     continue
-                enc = raw.encode("utf-8")[:lay.max_str_len]
-                str_bytes[i, bcol, :len(enc)] = np.frombuffer(
-                    enc, dtype=np.uint8)
+                enc = raw[:lay.max_str_len]
+                if enc:
+                    str_bytes[i, bcol, :len(enc)] = np.frombuffer(
+                        enc, dtype=np.uint8)
                 str_lens[i, bcol] = len(enc)
+            for col, prog, convert in self._externs:
+                # normalize-at-ingest: run the extern over the operand
+                # oracle; a lookup or conversion error marks the column
+                # present-with-ID_INVALID (read back as err on device —
+                # externs are hard contexts, oracle.py)
+                try:
+                    converted = convert(prog.evaluate(bag))
+                except Exception:
+                    present[i, col] = True
+                    ids[i, col] = ID_INVALID
+                    continue
+                present[i, col] = True
+                ids[i, col] = rid(converted)
+                if col in hash_slots:
+                    hash_ids[i, col] = stable_hash31(converted)
 
         return AttributeBatch(ids=ids, present=present,
                               map_present=map_present,
@@ -353,14 +468,28 @@ class Tensorizer:
                               hash_ids=hash_ids,
                               ephemeral_values=eph_values)
 
-    @staticmethod
-    def _byte_source_value(bag: Bag, src: Any) -> str | None:
+    def _byte_source_value(self, bag: Bag, src: Any) -> bytes | None:
         if isinstance(src, tuple):
             mname, key = src
             m, ok = bag.get(mname)
             if ok and isinstance(m, Mapping) and key in m:
                 v = m[key]
-                return v if isinstance(v, str) else None
+                return v.encode("utf-8") if isinstance(v, str) else None
             return None
         v, ok = bag.get(src)
-        return v if ok and isinstance(v, str) else None
+        if not ok:
+            return None
+        vt = self.layout.manifest.get(src)
+        if vt is not None and vt in ORDER_KEY_TYPES:
+            # numeric slots carry the 8-byte order-preserving key so
+            # ordered comparisons ride the SAME lexicographic compare
+            # as strings (bytes_ops.lex_cmp). Markers (tensor_expr
+            # _compile_cmp): b"" = NaN (compares False, never err);
+            # b"\x00" = malformed value (bags are untyped wire data —
+            # the oracle raises per row, so the device reads err;
+            # raising here would poison the whole batch)
+            try:
+                return order_key_bytes(v, vt)
+            except Exception:
+                return ORDER_KEY_ERROR
+        return v.encode("utf-8") if isinstance(v, str) else None

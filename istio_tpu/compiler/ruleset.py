@@ -382,7 +382,9 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     layout = build_layout(
         manifest,
         sorted(set(reqs.derived_keys) | set(extra_derived_keys)),
-        sorted(reqs.byte_sources, key=str), **kwargs)
+        sorted(reqs.byte_sources, key=str),
+        extern_sources=[(n, k, ast) for (n, k), ast
+                        in reqs.extern_sources.items()], **kwargs)
 
     # ---- classify atoms into vectorizable tiers ----
     # An atom can still refuse to lower here (e.g. STRING_MAP equality
@@ -397,6 +399,10 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
         eq_atom_idx: list[int] = []
         ss_a: list[int] = []; ss_b: list[int] = []; ss_neg: list[bool] = []
         ss_atom_idx: list[int] = []
+        # constant-pattern regex atoms grouped by subject: one packed
+        # multi-DFA scan per subject instead of one scan per atom
+        # (tensor_expr.compile_dfa_group)
+        dfa_groups: dict[str, dict] = {}
         gen_fns: list[Callable] = []
         gen_atom_idx: list[int] = []
         unlowerable: set[int] = set()
@@ -431,6 +437,27 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                         ss_a.append(ra.col); ss_b.append(rb.col)
                         ss_neg.append(neg); ss_atom_idx.append(aidx)
                         done = True
+            if not done and f is not None and f.name == "matches" \
+                    and f.target is not None \
+                    and f.target.const_ is not None:
+                try:
+                    from istio_tpu.ops.regex_dfa import compile_regex
+                    pattern = f.target.const_.value
+                    dfa = compile_regex(pattern)
+                    # probe the subject NOW so an un-viewable subject
+                    # falls through to the generic path's fallback
+                    tensor_expr._compile_bytes(f.args[0], ctx)
+                except Exception:
+                    dfa = None
+                if dfa is not None:
+                    g = dfa_groups.setdefault(
+                        str(f.args[0]),
+                        {"subject": f.args[0], "atoms": [],
+                         "patterns": [], "dfas": []})
+                    g["atoms"].append(aidx)
+                    g["patterns"].append(pattern)
+                    g["dfas"].append(dfa)
+                    done = True
             if not done:
                 try:
                     gen_fns.append(tensor_expr._compile_node(ast, ctx))
@@ -450,8 +477,13 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                 host_fallback[ridx] = _rule_oracle(rules[ridx], finder)
                 fallback_reason[ridx] = "atom not lowerable"
 
+    dfa_group_fns = [tensor_expr.compile_dfa_group(
+        g["subject"], g["patterns"], g["dfas"], ctx)
+        for g in dfa_groups.values()]
+    dfa_atom_idx = [a for g in dfa_groups.values() for a in g["atoms"]]
+
     n_atoms = len(atoms.asts)
-    order = eq_atom_idx + ss_atom_idx + gen_atom_idx
+    order = eq_atom_idx + ss_atom_idx + dfa_atom_idx + gen_atom_idx
     n_live = max(len(order), 1)   # width of the m/n literal blocks
     # inverse permutation: position of atom i in the concatenated output
     pos_of = np.full(max(n_atoms, 1), 0, dtype=np.int32)
@@ -543,6 +575,10 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
             cmp = (batch.ids[:, ss_a_a] == batch.ids[:, ss_b_a]) ^ ss_neg_a[None, :]
             parts_m.append(cmp & pres)
             parts_n.append(~cmp & pres)
+        for gfn in dfa_group_fns:
+            gval, gee = gfn(batch)
+            parts_m.append(gval)               # already masked by ~ee
+            parts_n.append(~gval & ~gee)
         for fn in gen_fns:
             t = fn(batch)
             ee = t.err | ~t.ok
@@ -600,6 +636,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
 
     atom_tier = {aidx: "id-eq" for aidx in eq_atom_idx}
     atom_tier.update({aidx: "slot-eq" for aidx in ss_atom_idx})
+    atom_tier.update({aidx: "dfa-pack" for aidx in dfa_atom_idx})
     atom_tier.update({aidx: "tensor" for aidx in gen_atom_idx})
 
     return RuleSetProgram(

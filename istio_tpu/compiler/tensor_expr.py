@@ -48,7 +48,8 @@ import numpy as np
 
 from istio_tpu.attribute.types import ValueType
 from istio_tpu.compiler.layout import (AttributeBatch, BatchLayout,
-                                       ID_TRUE, InternTable)
+                                       ID_TRUE, InternTable,
+                                       ORDER_KEY_TYPES, order_key_bytes)
 from istio_tpu.expr.checker import (AttributeDescriptorFinder, DEFAULT_FUNCS,
                                     eval_type)
 from istio_tpu.expr.exprs import Expression, FunctionCall
@@ -59,6 +60,7 @@ from istio_tpu.ops.regex_dfa import UnsupportedRegex, compile_regex
 
 V = ValueType
 _BYTE_PREDS = ("match", "matches", "startsWith", "endsWith")
+_CMP_FUNCS = ("LSS", "LEQ", "GTR", "GEQ")
 
 
 class HostFallback(Exception):
@@ -94,10 +96,31 @@ class Requirements:
     """What the layout must provide for a set of expressions."""
     derived_keys: set[tuple[str, str]] = dataclasses.field(default_factory=set)
     byte_sources: set[Any] = dataclasses.field(default_factory=set)
+    # (extern name, operand key) → operand AST: runtime ip()/
+    # timestamp() conversions the tensorizer runs at ingest
+    extern_sources: dict[tuple[str, str], Any] = \
+        dataclasses.field(default_factory=dict)
 
     def merge(self, other: "Requirements") -> None:
         self.derived_keys |= other.derived_keys
         self.byte_sources |= other.byte_sources
+        self.extern_sources.update(other.extern_sources)
+
+
+def _extern_operand_ok(e: Expression) -> bool:
+    """Shapes the tensorizer's ingest oracle may evaluate: constants,
+    variables, constant-key INDEX, and `|` fallbacks over those."""
+    if e.const_ is not None or e.var is not None:
+        return True
+    f = e.fn
+    if f is None:
+        return False
+    if f.name == "INDEX":
+        return (f.args[0].var is not None
+                and f.args[1].const_ is not None)
+    if f.name == "OR":
+        return all(_extern_operand_ok(a) for a in f.args)
+    return False
 
 
 def collect_requirements(ast: Expression, finder: AttributeDescriptorFinder,
@@ -124,17 +147,27 @@ def _collect(e: Expression, finder: AttributeDescriptorFinder,
     f = e.fn
     assert f is not None
     if f.name == "INDEX":
-        if f.args[0].var is None:
+        tgt = f.args[0]
+        if tgt.var is not None:
+            map_vars = [tgt.var.name]
+        elif (tgt.fn is not None and tgt.fn.name == "OR"
+              and all(a.var is not None for a in tgt.fn.args)
+              and not as_bytes):
+            # (mapA | mapB)[key]: both maps' derived slots + presence
+            map_vars = [a.var.name for a in tgt.fn.args]
+        else:
             raise HostFallback("INDEX over non-variable map")
         if f.args[1].const_ is None:
             raise HostFallback("dynamic string-map key")
         key = f.args[1].const_.value
         if not isinstance(key, str):
             raise HostFallback("non-string map key")
-        pair = (f.args[0].var.name, key)
-        reqs.derived_keys.add(pair)
+        for m in map_vars:
+            if finder.get_attribute(m) != ValueType.STRING_MAP:
+                raise HostFallback(f"INDEX over non-map {m}")
+            reqs.derived_keys.add((m, key))
         if as_bytes:
-            reqs.byte_sources.add(pair)
+            reqs.byte_sources.add((map_vars[0], key))
         return
     if f.name == "OR":
         _collect(f.args[0], finder, reqs, as_bytes)
@@ -149,17 +182,54 @@ def _collect(e: Expression, finder: AttributeDescriptorFinder,
             subject, pattern = f.target, f.args[0]
         if pattern is None or pattern.const_ is None or \
                 not isinstance(pattern.const_.value, str):
-            raise HostFallback(f"non-constant pattern for {f.name}")
+            if f.name == "matches":
+                # runtime regex compilation has no device analog
+                raise HostFallback("non-constant pattern for matches")
+            # dynamic prefix/suffix/glob: BOTH sides ride byte planes
+            # (bytes_ops.dyn_*_match)
+            _collect(pattern, finder, reqs, as_bytes=True)
+            _collect(subject, finder, reqs, as_bytes=True)
+            return
         if f.name == "matches":
             try:
                 compile_regex(pattern.const_.value)
             except UnsupportedRegex as exc:
+                import re as _re
+                try:
+                    _re.compile(pattern.const_.value)
+                except _re.error:
+                    # invalid pattern: the oracle errors on EVERY
+                    # evaluation → lowers to a constant-error atom,
+                    # no requirements needed
+                    return
                 raise HostFallback(str(exc))
         _collect(subject, finder, reqs, as_bytes=True)
         return
     if f.name in ("ip", "timestamp"):
-        if f.args[0].const_ is None:
-            raise HostFallback(f"{f.name}() over a runtime value")
+        arg = f.args[0]
+        if arg.const_ is None:
+            # runtime conversion: the TENSORIZER runs it at ingest into
+            # an extern column (layout.extern_slots) — string parsing
+            # has no device form, so it happens at the edge, once per
+            # request, not per rule
+            if not _extern_operand_ok(arg):
+                raise HostFallback(
+                    f"{f.name}() over an un-ingestable operand")
+            _collect(arg, finder, reqs, as_bytes=False)
+            reqs.extern_sources[(f.name, str(arg))] = arg
+        return
+    if f.name in _CMP_FUNCS:
+        # ordered comparisons ride the byte planes: strings as utf-8,
+        # numerics as 8-byte order keys (layout.order_key_bytes).
+        # Unorderable operand types (BOOL/IP/BYTES) make the oracle
+        # raise on EVERY evaluation — a constant-error atom, no
+        # requirements needed
+        types = [eval_type(a, finder, DEFAULT_FUNCS) for a in f.args]
+        if any(t != V.STRING and t not in ORDER_KEY_TYPES
+               for t in types):
+            return
+        for a in f.args:
+            _collect(a, finder, reqs, as_bytes=True)
         return
     if f.name in ("EQ", "NEQ", "LAND", "LOR"):
         for a in f.args:
@@ -238,12 +308,33 @@ def _compile_node(e: Expression, ctx: _Ctx) -> NodeFn:
     name = f.name
 
     if name == "INDEX":
-        col = ctx.layout.derived_slot_of(f.args[0].var.name,
-                                         f.args[1].const_.value)
+        key = f.args[1].const_.value
+        tgt = f.args[0]
+        if tgt.var is not None:
+            col = ctx.layout.derived_slot_of(tgt.var.name, key)
+
+            def fn(batch: AttributeBatch) -> TVal:
+                ok = batch.present[:, col]
+                return TVal(batch.ids[:, col], ok, jnp.zeros_like(ok))
+            return fn
+        # (mapA | mapB)[key] — _collect validated the OR-of-vars shape:
+        # soft map fallback selects by MAP presence, then the chosen
+        # map's derived slot supplies value/presence (oracle: `|` soft
+        # mode over map variables, then the usual INDEX encoding)
+        m1 = tgt.fn.args[0].var.name
+        m2 = tgt.fn.args[1].var.name
+        c1 = ctx.layout.derived_slot_of(m1, key)
+        c2 = ctx.layout.derived_slot_of(m2, key)
+        mp1 = ctx.layout.map_slots[m1]
+        mp2 = ctx.layout.map_slots[m2]
 
         def fn(batch: AttributeBatch) -> TVal:
-            ok = batch.present[:, col]
-            return TVal(batch.ids[:, col], ok, jnp.zeros_like(ok))
+            sel = batch.map_present[:, mp1]
+            val = jnp.where(sel, batch.ids[:, c1], batch.ids[:, c2])
+            ok = jnp.where(sel, batch.present[:, c1],
+                           batch.map_present[:, mp2]
+                           & batch.present[:, c2])
+            return TVal(val, ok, jnp.zeros_like(ok))
         return fn
 
     if name == "OR":
@@ -299,8 +390,27 @@ def _compile_node(e: Expression, ctx: _Ctx) -> NodeFn:
     if name in _BYTE_PREDS:
         return _compile_byte_pred(f, ctx)
 
+    if name in _CMP_FUNCS:
+        return _compile_cmp(f, ctx)
+
     if name in ("ip", "timestamp"):
-        raw = f.args[0].const_.value
+        arg = f.args[0]
+        if arg.const_ is None:
+            # ingest-converted extern column (layout.extern_slots):
+            # ID_INVALID marks a conversion/lookup error
+            col = ctx.layout.extern_slots.get((name, str(arg)))
+            if col is None:
+                raise HostFallback(
+                    f"{name}() operand missing an extern slot")
+
+            def fn(batch: AttributeBatch) -> TVal:
+                ids = batch.ids[:, col]
+                pres = batch.present[:, col]
+                err = pres & (ids == 0)
+                ok = pres & ~err
+                return TVal(ids, ok, err)
+            return fn
+        raw = arg.const_.value
         try:
             value = (extern_ip(raw) if name == "ip"
                      else extern_timestamp(raw))
@@ -310,6 +420,57 @@ def _compile_node(e: Expression, ctx: _Ctx) -> NodeFn:
                            else V.TIMESTAMP, ctx)
 
     raise HostFallback(f"unsupported function on device: {name}")
+
+
+def _compile_cmp(f: FunctionCall, ctx: _Ctx) -> NodeFn:
+    """Ordered comparison (expr LSS/LEQ/GTR/GEQ, reference func.go's
+    ordered intrinsics) over the byte planes.
+
+    Strings compare as raw utf-8 (Go string order); numerics compare by
+    their 8-byte order keys (layout.order_key_bytes) — both reduce to
+    one lex_cmp. NaN operands arrive as present-but-EMPTY numeric rows
+    and read False under every comparison (IEEE semantics, oracle
+    parity). String rows at the byte-slot cap may be truncated, making
+    the comparison undecidable → err, routed to the host oracle."""
+    name = f.name
+    ta = ctx.type_of(f.args[0])
+    tb = ctx.type_of(f.args[1])
+    if any(t != V.STRING and t not in ORDER_KEY_TYPES
+           for t in (ta, tb)):
+        # the oracle raises "unordered operand" on every evaluation
+        return _error_tval()
+    numeric = ta in ORDER_KEY_TYPES
+    fa = _compile_bytes(f.args[0], ctx)
+    fb = _compile_bytes(f.args[1], ctx)
+    max_len = ctx.layout.max_str_len
+
+    def fn(batch: AttributeBatch) -> TVal:
+        a, b = fa(batch), fb(batch)
+        ee = (a.err | ~a.ok) | (b.err | ~b.ok)
+        c = bytes_ops.lex_cmp(a.data, a.lens, b.data, b.lens)
+        if name == "LSS":
+            val = c < 0
+        elif name == "LEQ":
+            val = c <= 0
+        elif name == "GTR":
+            val = c > 0
+        else:
+            val = c >= 0
+        if numeric:
+            # NaN marker (empty key): all four comparisons read False,
+            # never err. Malformed-payload marker (1-byte key,
+            # layout.ORDER_KEY_ERROR): the oracle raises per row → err
+            nan = (a.ok & (a.lens == 0)) | (b.ok & (b.lens == 0))
+            bad = (a.ok & (a.lens == 1)) | (b.ok & (b.lens == 1))
+            ee = ee | bad
+            val = val & ~nan
+        else:
+            # either side possibly truncated → order undecidable
+            ee = ee | (a.ok & (a.lens >= max_len)) \
+                    | (b.ok & (b.lens >= max_len))
+        val = val & ~ee
+        return TVal(val, ~ee, ee)
+    return fn
 
 
 def _compile_byte_pred(f: FunctionCall, ctx: _Ctx) -> NodeFn:
@@ -331,6 +492,14 @@ def _compile_byte_pred(f: FunctionCall, ctx: _Ctx) -> NodeFn:
     all → HostFallback at compile time.
     """
     max_len = ctx.layout.max_str_len
+    if f.name == "match":
+        pattern_ast = f.args[1]
+    elif f.name == "matches":
+        pattern_ast = f.target
+    else:
+        pattern_ast = f.args[0]
+    if pattern_ast.const_ is None and f.name != "matches":
+        return _compile_dyn_byte_pred(f, ctx)
     # "safe": truncation can't change the result; "miss": only a False
     # on a truncated row is unreliable; "all": every truncated row is
     if f.name == "match":
@@ -348,7 +517,15 @@ def _compile_byte_pred(f: FunctionCall, ctx: _Ctx) -> NodeFn:
             trunc = "safe" if len(pattern.encode()) < max_len else "all"
     elif f.name == "matches":
         subject_ast, pattern = f.args[0], f.target.const_.value
-        dfa = compile_regex(pattern)
+        try:
+            dfa = compile_regex(pattern)
+        except UnsupportedRegex:
+            import re as _re
+            try:
+                _re.compile(pattern)
+            except _re.error:
+                return _error_tval()   # invalid pattern: always errors
+            raise
         trans = jnp.asarray(dfa.transitions)
         accept = jnp.asarray(dfa.accept)
         op = lambda data, lens: bytes_ops.dfa_match(data, lens, trans, accept)
@@ -382,13 +559,99 @@ def _compile_byte_pred(f: FunctionCall, ctx: _Ctx) -> NodeFn:
     return fn
 
 
+def compile_dfa_group(subject_ast: Expression, patterns: list[str],
+                      dfas: list, ctx: "_Ctx") -> Callable:
+    """ALL constant-pattern `matches` atoms over ONE subject, evaluated
+    in a single packed scan (ops/bytes_ops.dfa_match_many).
+
+    Per-atom DFA scans are latency-bound: each of the L scan steps is a
+    tiny [B] gather, so k separate atoms cost k·L sequential steps
+    (~40 ms for the 1k-route table, VERDICT r2 weak #3). Packing turns
+    that into ONE L-step scan with [B, k] gathers — the batched-NFA
+    shape SURVEY §7 hard-part 1 calls for.
+
+    Returns fn(batch) → (val [B, k], ee [B, k]) with exactly
+    _compile_byte_pred's semantics per column: subject absence/error
+    masks the row; truncated rows are fully undecidable for $-anchored
+    patterns and miss-undecidable otherwise."""
+    from istio_tpu.ops.regex_dfa import pack_dfas, pack_dfas_onehot
+
+    max_len = ctx.layout.max_str_len
+    fsub = _compile_bytes(subject_ast, ctx)
+    packed = pack_dfas_onehot(dfas)
+    # MXU formulation when the per-step matmul stays reasonable
+    # (B·S²·C flops/step); huge banks take the flat-gather scan
+    use_onehot = (packed["n_states"] ** 2 * packed["n_classes"]
+                  <= 4_000_000)
+    if not use_onehot:
+        trans, accept = pack_dfas(dfas)
+        trans_j = jnp.asarray(trans)
+        accept_j = jnp.asarray(accept)
+    trunc_all = jnp.asarray(np.array(["$" in p for p in patterns]))
+
+    def fn(batch: AttributeBatch):
+        s = fsub(batch)
+        if use_onehot:
+            m = bytes_ops.dfa_match_many_onehot(s.data, s.lens, packed)
+        else:
+            m = bytes_ops.dfa_match_many(s.data, s.lens, trans_j,
+                                         accept_j)
+        ee = (s.err | ~s.ok)[:, None] & jnp.ones_like(m)
+        val = m & ~ee
+        maybe = (s.ok & (s.lens >= max_len))[:, None]
+        undecidable = jnp.where(trunc_all[None, :], maybe, maybe & ~val)
+        ee = ee | undecidable
+        val = val & ~ee
+        return val, ee
+    return fn
+
+
+def _compile_dyn_byte_pred(f: FunctionCall, ctx: _Ctx) -> NodeFn:
+    """Byte predicates whose PATTERN is itself a runtime string
+    (`as.startsWith(as2)`, `match(as, as2)`): both operands ride byte
+    planes and bytes_ops.dyn_*_match compares them row-wise.
+
+    Truncation: the subject's stored prefix decides a prefix check iff
+    the pattern fits under the cap; suffix/exact/glob verdicts on a
+    possibly-truncated subject, and any possibly-truncated pattern,
+    are undecidable → err (host oracle takes the row)."""
+    max_len = ctx.layout.max_str_len
+    if f.name == "match":
+        subject_ast, pattern_ast = f.args[0], f.args[1]
+        op, trunc_subject = bytes_ops.dyn_glob_match, "all"
+    elif f.name == "startsWith":
+        subject_ast, pattern_ast = f.target, f.args[0]
+        op, trunc_subject = bytes_ops.dyn_prefix_match, "safe"
+    else:   # endsWith
+        subject_ast, pattern_ast = f.target, f.args[0]
+        op, trunc_subject = bytes_ops.dyn_suffix_match, "all"
+    fsub = _compile_bytes(subject_ast, ctx)
+    fpat = _compile_bytes(pattern_ast, ctx)
+
+    def fn(batch: AttributeBatch) -> TVal:
+        s, p = fsub(batch), fpat(batch)
+        ee = (s.err | ~s.ok) | (p.err | ~p.ok)
+        val = op(s.data, s.lens, p.data, p.lens)
+        undecidable = p.ok & (p.lens >= max_len)
+        if trunc_subject == "all":
+            undecidable = undecidable | (s.ok & (s.lens >= max_len))
+        ee = ee | undecidable
+        val = val & ~ee
+        return TVal(val, ~ee, ee)
+    return fn
+
+
 def _compile_bytes(e: Expression, ctx: _Ctx) -> ByteFn:
     """Compile a STRING-typed subtree to its byte-tensor view."""
     lay = ctx.layout
     if e.const_ is not None:
-        raw = str(e.const_.value).encode("utf-8")[:lay.max_str_len]
+        if e.const_.vtype in ORDER_KEY_TYPES:
+            raw = order_key_bytes(e.const_.value, e.const_.vtype)
+        else:
+            raw = str(e.const_.value).encode("utf-8")[:lay.max_str_len]
         row = np.zeros(lay.max_str_len, dtype=np.uint8)
-        row[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+        if raw:
+            row[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
         n = len(raw)
 
         def fn(batch: AttributeBatch) -> BVal:

@@ -116,6 +116,23 @@ class NativeTensorizer:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         self._lib = lib
+        # the C++ decoder fills byte slots with utf-8 string payloads
+        # only; numeric byte sources carry order keys
+        # (layout.order_key_bytes) it does not produce — serving for
+        # such layouts stays on the python wire decoder
+        from istio_tpu.compiler.layout import ORDER_KEY_TYPES
+        for src in layout.byte_slots:
+            vt = layout.manifest.get(src) \
+                if not isinstance(src, tuple) else None
+            if vt in ORDER_KEY_TYPES:
+                raise RuntimeError(
+                    f"byte source {src!r} needs a numeric order key; "
+                    "the native shim only fills string slots")
+        if layout.extern_slots:
+            raise RuntimeError(
+                "layout has ingest-converted extern columns "
+                f"({sorted(layout.extern_slots)}); the native shim "
+                "cannot run extern conversions")
         blob = _layout_blob(layout, interner)
         self._h = lib.shim_create(blob, len(blob))
         if not self._h:

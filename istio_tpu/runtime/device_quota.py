@@ -203,16 +203,33 @@ class DeviceQuotaPool:
         # the first outcome without consuming (buildWithDedup :259)
         first_of: dict[str, int] = {}
         replay_items: list[tuple[Any, int]] = []   # (item, kept index)
+        cache_replays: list = []   # (item, cached granted)
         kept: list = []
-        for item in batch:
-            dedup_id = item[5]
-            if dedup_id and dedup_id in first_of:
-                replay_items.append((item, first_of[dedup_id]))
-                continue
-            if dedup_id:
-                first_of[dedup_id] = len(kept)
-            kept.append(item)
+        with self._lock:
+            for item in batch:
+                dedup_id = item[5]
+                if dedup_id:
+                    # re-check the cache under the lock: a
+                    # retransmission that raced the ORIGINAL's flush
+                    # (alloc() checked before the cache was written)
+                    # must replay, not re-consume
+                    hit = self._dedup.get(dedup_id)
+                    if hit is not None and hit[1] > now:
+                        cache_replays.append((item, hit[0]))
+                        continue
+                    if dedup_id in first_of:
+                        replay_items.append((item, first_of[dedup_id]))
+                        continue
+                    first_of[dedup_id] = len(kept)
+                kept.append(item)
+        for (_, amount, _, _, duration, _, fut), g in cache_replays:
+            status = 0 if g > 0 or amount == 0 else RESOURCE_EXHAUSTED
+            fut.set(QuotaResult(granted_amount=g,
+                                valid_duration_s=duration,
+                                status_code=status))
         batch = kept
+        if not batch:
+            return
         n = len(batch)
         self._roll_windows(now, [b for b, *_ in batch])
         # pad to the next power of two: every distinct shape is its own
@@ -275,17 +292,38 @@ class DeviceQuotaPool:
 
 
 class QuotaFuture:
-    """Tiny thread-safe future (concurrent.futures-compatible enough
-    for asyncio.wrap_future is NOT needed — the gRPC layer polls via
-    result() on the sync front and via an executor on the aio front)."""
+    """Tiny thread-safe future. The sync gRPC front blocks in
+    result(); the aio front registers a callback via add_done_callback
+    and awaits — holding an executor thread per in-flight quota would
+    serialize the event loop behind ~5 threads × a device RTT each
+    (observed: served throughput collapsed 6× when it did)."""
 
     def __init__(self) -> None:
         self._ev = threading.Event()
         self._value: QuotaResult | None = None
+        self._cbs: list = []
+        self._lock = threading.Lock()
 
     def set(self, value: QuotaResult) -> None:
-        self._value = value
-        self._ev.set()
+        with self._lock:
+            self._value = value
+            self._ev.set()
+            cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            try:
+                cb(value)
+            except Exception:   # callbacks must not kill the worker
+                log.exception("quota future callback failed")
+
+    def add_done_callback(self, cb) -> None:
+        """cb(QuotaResult) — fires immediately if already resolved,
+        else from the pool worker thread on set()."""
+        with self._lock:
+            if not self._ev.is_set():
+                self._cbs.append(cb)
+                return
+            value = self._value
+        cb(value)
 
     def result(self, timeout: float | None = 30.0) -> QuotaResult:
         if not self._ev.wait(timeout):

@@ -224,6 +224,160 @@ def make_rbac_request_dicts(batch: int, n_users: int = 200,
     return out
 
 
+def make_full_mesh(n_services: int = 5000, n_roles: int = 1000,
+                   n_routes: int | None = None, seed: int = 11):
+    """BASELINE config 5: the 5k-service full-mesh fused step — mTLS
+    SAN whitelist + RBAC authz + quota + route NFA compiled into ONE
+    ruleset/engine, evaluated in ONE device program per batch.
+
+    → (engine, route_lo, route_hi, route_weights, meta dict).
+    Row layout: [SAN rules | quota rule | authz rule | route rows |
+    rbac pseudo-rows]. The full step wrapper (bench.py) computes check
+    verdicts AND winning routes from the same matched plane.
+    """
+    from istio_tpu.compiler.rbac_lower import lower_rbac
+    from istio_tpu.expr.parser import parse
+    from istio_tpu.pilot.route_nfa import match_to_predicate
+    from istio_tpu.models.policy_engine import RbacSpec
+
+    n_routes = n_routes if n_routes is not None else n_services
+    rng = np.random.default_rng(seed)
+    preds: list[Rule] = []
+    lists: list[ListEntrySpec] = []
+
+    # 1. mTLS SAN whitelist per service (security/spiffe identities on
+    #    source.user, the v0.4-era SAN attribute)
+    for i in range(n_services):
+        svc = f"svc{i}.ns{i % 41}.svc.cluster.local"
+        preds.append(Rule(
+            name=f"san{i}",
+            match=f'destination.service == "{svc}" && connection.mtls'))
+        sans = [f"spiffe://cluster.local/ns/ns{i % 41}/sa/sa{j}"
+                for j in range(3)]
+        lists.append(ListEntrySpec(rule=i, value_attr="source.user",
+                                   entries=sans, blacklist=False))
+
+    # 2. one mesh-wide per-user quota (device scatter-add counters)
+    quota_rule = len(preds)
+    preds.append(Rule(name="quota-all", match="connection.mtls"))
+    quotas = [QuotaSpec(rule=quota_rule, key_attr="source.user",
+                        max_amount=1 << 24, n_buckets=131_072)]
+
+    # 3. RBAC authz over generated roles/bindings → pseudo-rules
+    authz_rule = len(preds)
+    preds.append(Rule(name="authz", match=""))
+    roles, bindings = [], []
+    for i in range(n_roles):
+        roles.append({"namespace": "default", "name": f"role{i}",
+                      "rules": [{
+                          "services": [f"svc{i % n_services}.*"],
+                          "methods": (["GET"], ["GET", "POST"],
+                                      ["*"])[i % 3],
+                          "paths": [f"/api/v{i % 9}/*"]}]})
+        bindings.append({"namespace": "default", "name": f"bind{i}",
+                         "roleRef": {"name": f"role{i}"},
+                         "subjects": [{
+                             "user": f"spiffe://cluster.local/ns/"
+                                     f"ns{i % 41}/sa/sa{i % 3}"}]})
+    inst_exprs = {
+        "subject": {"user": parse("source.user")},
+        "action": {"namespace": parse('destination.namespace | ""'),
+                   "service": parse("destination.service"),
+                   "method": parse("request.method"),
+                   "path": parse("request.path")}}
+    lowered = lower_rbac(roles, bindings, inst_exprs, MESH_FINDER)
+
+    # 4. route NFA rows (VirtualService-style match blocks)
+    route_lo = len(preds)
+    services, rules_by_host = make_route_world(n_routes, n_services,
+                                               seed=seed + 1)
+    route_entries = []
+    for hostname in sorted(rules_by_host):
+        for cfg in rules_by_host[hostname]:
+            src = cfg.spec.get("match", {}).get("source")
+            pred = match_to_predicate(hostname, cfg.spec.get("match"),
+                                      src)
+            route_entries.append(
+                (pred, int(cfg.spec.get("precedence", 0))))
+    for j, (pred, _prec) in enumerate(route_entries):
+        preds.append(Rule(name=f"route{j}", match=pred))
+    route_hi = len(preds)
+
+    # 5. rbac pseudo-rows at the tail
+    allow_lo = len(preds)
+    for k, ast in enumerate(lowered.allow_asts):
+        preds.append(Rule(name=f"~rbac/{k}", ast=ast))
+    allow_rows = tuple(range(allow_lo, allow_lo +
+                             len(lowered.allow_asts)))
+    guard_row = -1
+    if lowered.guard_ast is not None:
+        guard_row = len(preds)
+        preds.append(Rule(name="~rbac/guard", ast=lowered.guard_ast))
+    rbacs = [RbacSpec(rule=authz_rule, allow_rows=allow_rows,
+                      guard_row=guard_row, valid_duration_s=60.0)]
+
+    engine = PolicyEngine(preds, MESH_FINDER, deny=(), lists=lists,
+                          quotas=quotas, rbacs=rbacs, jit=False)
+
+    n_r = route_hi - route_lo
+    order = sorted(range(n_r),
+                   key=lambda i: (-route_entries[i][1], i))
+    weights = np.zeros(max(n_r, 1), np.int32)
+    for rank, idx in enumerate(order):
+        weights[idx] = n_r - rank
+    meta = {"n_services": n_services, "n_roles": n_roles,
+            "n_routes": n_r, "n_rows": len(preds),
+            "n_triples": lowered.n_triples,
+            "host_fallback": len(engine.ruleset.host_fallback)}
+    return engine, route_lo, route_hi, weights, meta
+
+
+def make_full_mesh_requests(batch: int, n_services: int = 5000,
+                            seed: int = 12,
+                            n_roles: int = 1000) -> list[dict]:
+    """Half the traffic follows the generated role structure (an
+    authorized SAN calling an allowed method/path on a role-covered
+    service), half is random — the fused step must exercise allow AND
+    deny outcomes, not a rigged all-deny stream."""
+    rng = np.random.default_rng(seed)
+    covered = max(1, min(n_roles, n_services))
+    out = []
+    for i in range(batch):
+        conformant = rng.random() < 0.5
+        svc = int(rng.integers(covered if conformant else n_services))
+        ns = svc % 41
+        if conformant:
+            user_sa = svc % 3                   # bind{svc}'s subject
+            method = "GET"                      # allowed by every role
+            path = f"/api/v{svc % 9}/items"     # role's path prefix
+        else:
+            user_sa = int(rng.integers(4))
+            method = ("GET", "POST", "DELETE")[int(rng.integers(3))]
+            path = (f"/api/v{int(rng.integers(10))}/items",
+                    f"/items/{int(rng.integers(1e6))}/r3",
+                    f"/svc/{int(rng.integers(20))}/x")[i % 3]
+        out.append({
+            # conformant traffic hits the SAN/authz world (ns-form
+            # hostnames); half the random remainder hits the route
+            # world's default-form hostnames
+            "destination.service":
+                f"svc{svc}.ns{ns}.svc.cluster.local"
+                if conformant or rng.random() < 0.5 else
+                f"svc{svc}.default.svc.cluster.local",
+            "destination.namespace": "default",
+            "source.user": f"spiffe://cluster.local/ns/ns{ns}/sa/"
+                           f"sa{user_sa}",
+            "source.service": f"svc{int(rng.integers(n_services))}"
+                              ".default.svc.cluster.local",
+            "connection.mtls": bool(conformant or rng.random() < 0.8),
+            "request.method": method,
+            "request.path": path,
+            "request.headers": {"cookie":
+                                f"user=group{int(rng.integers(15))}"},
+        })
+    return out
+
+
 def make_request_dicts(batch: int, seed: int = 1) -> list[dict]:
     rng = np.random.default_rng(seed)
     dicts = []

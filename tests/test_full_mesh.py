@@ -1,0 +1,118 @@
+"""Full-mesh fused step (BASELINE config 5) — small-scale conformance.
+
+One ruleset carries mTLS SAN whitelists + RBAC pseudo-rules + a device
+quota + route-NFA rows; one device program computes check verdicts AND
+winning routes. Verified against the independent oracles: the rbac
+host adapter semantics ride the pseudo-rules (tests/test_rbac_lower.py
+covers that pairing); here the composition is checked — SAN whitelist
+verdicts, quota consumption, and route winners vs RouteTable's host
+selector over the same generated route world.
+"""
+import numpy as np
+import pytest
+
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.models.policy_engine import (NOT_FOUND, OK,
+                                            RESOURCE_EXHAUSTED)
+from istio_tpu.testing import workloads
+
+
+@pytest.fixture(scope="module")
+def world():
+    engine, lo, hi, weights, meta = workloads.make_full_mesh(
+        n_services=64, n_roles=16)
+    return engine, lo, hi, weights, meta
+
+
+def _run(engine, bags, ns=None):
+    batch = engine.tensorizer.tensorize(bags)
+    n = len(bags)
+    req_ns = np.zeros(n, np.int32) if ns is None else ns
+    return engine.check(batch, req_ns)
+
+
+def test_everything_lowers(world):
+    engine, lo, hi, weights, meta = world
+    assert meta["host_fallback"] == 0, \
+        engine.ruleset.fallback_reason
+    assert meta["n_rows"] == engine.ruleset.n_rules
+    assert meta["n_routes"] > 0 and meta["n_triples"] == 16
+
+
+def test_san_whitelist_and_authz_verdicts(world):
+    engine, *_ = world
+    svc = "svc3.ns3.svc.cluster.local"
+    good_user = "spiffe://cluster.local/ns/ns3/sa/sa1"
+    bags = [
+        # mTLS + whitelisted SAN + authz role allows GET /api/v3/*
+        bag_from_mapping({"destination.service": svc,
+                          "connection.mtls": True,
+                          "source.user": good_user,
+                          "destination.namespace": "default",
+                          "request.method": "GET",
+                          "request.path": "/api/v3/x"}),
+        # SAN not in the service's whitelist → NOT_FOUND
+        bag_from_mapping({"destination.service": svc,
+                          "connection.mtls": True,
+                          "source.user":
+                              "spiffe://cluster.local/ns/ns9/sa/sa1",
+                          "destination.namespace": "default",
+                          "request.method": "GET",
+                          "request.path": "/api/v3/x"}),
+        # plaintext: SAN rule inert; authz still applies
+        bag_from_mapping({"destination.service": svc,
+                          "connection.mtls": False,
+                          "source.user": good_user,
+                          "destination.namespace": "default",
+                          "request.method": "GET",
+                          "request.path": "/api/v3/x"}),
+    ]
+    v = _run(engine, bags)
+    status = np.asarray(v.status)
+    # bag 0: whitelisted + authz rule for role3 (user sa… in ns3)
+    # may allow or deny depending on binding — just require it is not
+    # a whitelist miss; bag 1 must be the whitelist NOT_FOUND
+    assert status[1] == NOT_FOUND
+    assert status[0] != NOT_FOUND
+
+
+def test_quota_consumes_on_device(world):
+    engine, *_ = world
+    engine.reset_quota()
+    # role1/bind1: user ns1/sa1 may GET svc1.* on /api/v1/* — the
+    # request must pass SAN + authz for quota to run (status==OK gate)
+    bag = bag_from_mapping({"destination.service":
+                            "svc1.ns1.svc.cluster.local",
+                            "connection.mtls": True,
+                            "source.user":
+                                "spiffe://cluster.local/ns/ns1/sa/sa1",
+                            "destination.namespace": "default",
+                            "request.method": "GET",
+                            "request.path": "/api/v1/x"})
+    before = int(np.asarray(engine.quota_counts).sum())
+    _run(engine, [bag] * 4)
+    after = int(np.asarray(engine.quota_counts).sum())
+    assert after - before > 0, "device quota did not consume"
+
+
+def test_route_winner_matches_host_selector(world):
+    """The fused step's route argmax must agree with RouteTable's
+    sequential host selector over the same route world."""
+    from istio_tpu.pilot.route_nfa import RouteTable
+
+    engine, lo, hi, weights, meta = world
+    n_services = meta["n_services"]
+    services, rules_by_host = workloads.make_route_world(
+        meta["n_routes"], n_services, seed=11 + 1)
+    rt = RouteTable(services, rules_by_host)
+
+    reqs = workloads.make_full_mesh_requests(32, n_services, seed=5)
+    bags = [bag_from_mapping(r) for r in reqs]
+    v = _run(engine, bags)
+    matched = np.asarray(v.matched)[:, lo:hi]
+    scores = matched * np.asarray(weights)[None, :]
+    best = scores.argmax(axis=1)
+    hit = scores.max(axis=1) > 0
+    got = np.where(hit, best, hi - lo)
+    want = np.asarray([rt.select_host(r) for r in reqs])
+    np.testing.assert_array_equal(got, want)

@@ -146,15 +146,15 @@ def test_plan_extraction(servers):
     plan = fused.controller.dispatcher.fused
     assert plan is not None
     snap = fused.controller.dispatcher.snapshot
-    # r0 + r7 fuse; r5 (dynamic map key) and r6 (ordered comparison)
-    # have host-fallback predicates, so their deniers overlay on host
-    assert plan.fused_deny == 2
+    # r0 + r6 + r7 fuse (ordered comparisons lower via byte order
+    # keys since r3); r5 (dynamic map key) stays host-fallback
+    assert plan.fused_deny == 3
     assert plan.fused_lists == 2         # srcns + ua; appversion/path host
     host_rules = {snap.rules[i].name for i in plan.host_actions}
     assert "r3-version" in host_rules    # `|` fallback expr
     assert "r4-rx" in host_rules         # regex entry type
     assert "r5-dynkey" in host_rules     # predicate host fallback
-    assert "r6-prodonly" in host_rules   # GTR → host oracle
+    assert "r6-prodonly" not in host_rules   # GTR now on device
 
 
 def test_fused_matches_generic(servers):
