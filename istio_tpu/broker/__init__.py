@@ -2,6 +2,10 @@
 SURVEY.md §2.8, 3,371 LoC embryonic): catalog listing plus service
 instance/binding CRUD over a config store, served as OSB v2 REST.
 """
+from istio_tpu.broker.model import (BrokerConfigStore, Catalog,
+                                    Service, ServiceBinding,
+                                    ServiceInstance, ServicePlan)
 from istio_tpu.broker.server import BrokerServer
 
-__all__ = ["BrokerServer"]
+__all__ = ["BrokerServer", "BrokerConfigStore", "Catalog", "Service",
+           "ServicePlan", "ServiceInstance", "ServiceBinding"]

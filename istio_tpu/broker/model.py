@@ -1,0 +1,291 @@
+"""Broker data model: OSB v2 types + the broker config store.
+
+References:
+  * `broker/pkg/model/osb/{catalog,service,servicePlan,serviceInstance,
+    serviceBinding}.go` — the wire dataclasses with their exact JSON
+    field names (the OSB v2 contract with cloud-controller clients);
+  * `broker/pkg/model/config/{schema,store}.go` — the config schema
+    pair (service-class / service-plan, group config.istio.io,
+    version v1alpha2, DNS-1123 names) and the BrokerConfigStore
+    adapter over the generic config registry. Here the generic
+    registry is the SAME runtime Store the mixer/pilot layers use
+    (runtime/store.py MemStore / kube CRD store), so broker config
+    rides etcd/CRDs exactly like every other kind.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Mapping
+
+from istio_tpu.runtime.store import Store
+
+# config.istio.io/v1alpha2 (model/config/store.go:106-111)
+ISTIO_API_GROUP = "config.istio.io"
+ISTIO_API_VERSION = "v1alpha2"
+KIND_SERVICE_CLASS = "service-class"
+KIND_SERVICE_PLAN = "service-plan"
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_MAX_LABEL = 63
+
+
+class BrokerConfigError(ValueError):
+    """Schema validation failure (model/config/schema.go Validate)."""
+
+
+def validate_config_name(name: str) -> None:
+    """DNS-1123 label rule (schema.go dns1123LabelRex)."""
+    if len(name) > _MAX_LABEL or not _DNS1123.match(name):
+        raise BrokerConfigError(f"invalid config name {name!r} "
+                                "(must be a DNS-1123 label)")
+
+
+# ---------------------------------------------------------------------------
+# OSB wire types (osb/*.go — field names are the OSB v2 contract)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ServicePlan:
+    """osb/servicePlan.go ServicePlan."""
+    name: str = ""
+    id: str = ""
+    description: str = ""
+    metadata: Any = None
+    free: bool = False
+
+    def to_wire(self) -> dict:
+        out = {"name": self.name, "id": self.id,
+               "description": self.description}
+        if self.metadata is not None:
+            out["metadata"] = self.metadata
+        if self.free:
+            out["free"] = self.free
+        return out
+
+    @classmethod
+    def from_config(cls, spec: Mapping[str, Any]) -> "ServicePlan":
+        """osb/servicePlan.go NewServicePlan: reads the nested
+        `plan` CatalogPlan entry."""
+        p = spec.get("plan") or {}
+        return cls(name=str(p.get("name", "")), id=str(p.get("id", "")),
+                   description=str(p.get("description", "")))
+
+
+@dataclasses.dataclass
+class Service:
+    """osb/service.go Service."""
+    name: str = ""
+    id: str = ""
+    description: str = ""
+    bindable: bool = False
+    plan_updateable: bool = False
+    tags: tuple = ()
+    requires: tuple = ()
+    metadata: Any = None
+    plans: list = dataclasses.field(default_factory=list)
+    dashboard_client: Any = None
+
+    def add_plan(self, plan: ServicePlan) -> None:
+        self.plans.append(plan)
+
+    def to_wire(self) -> dict:
+        out = {"name": self.name, "id": self.id,
+               "description": self.description,
+               "bindable": self.bindable,
+               "plans": [p.to_wire() for p in self.plans],
+               "dashboard_client": self.dashboard_client}
+        if self.plan_updateable:
+            out["plan_updateable"] = True
+        if self.tags:
+            out["tags"] = list(self.tags)
+        if self.requires:
+            out["requires"] = list(self.requires)
+        if self.metadata is not None:
+            out["metadata"] = self.metadata
+        return out
+
+    @classmethod
+    def from_config(cls, spec: Mapping[str, Any]) -> "Service":
+        """osb/service.go NewService: reads the `entry` CatalogEntry."""
+        e = spec.get("entry") or {}
+        return cls(name=str(e.get("name", "")), id=str(e.get("id", "")),
+                   description=str(e.get("description", "")))
+
+
+@dataclasses.dataclass
+class Catalog:
+    """osb/catalog.go Catalog."""
+    services: list = dataclasses.field(default_factory=list)
+
+    def add_service(self, service: Service) -> None:
+        self.services.append(service)
+
+    def to_wire(self) -> dict:
+        return {"services": [s.to_wire() for s in self.services]}
+
+
+@dataclasses.dataclass
+class LastOperation:
+    """osb/serviceInstance.go LastOperation."""
+    state: str = ""
+    description: str = ""
+    async_poll_interval_seconds: int = 0
+
+    def to_wire(self) -> dict:
+        out = {"state": self.state, "description": self.description}
+        if self.async_poll_interval_seconds:
+            out["async_poll_interval_seconds"] = \
+                self.async_poll_interval_seconds
+        return out
+
+
+@dataclasses.dataclass
+class ServiceInstance:
+    """osb/serviceInstance.go ServiceInstance."""
+    id: str = ""
+    dashboard_url: str = ""
+    internal_id: str = ""
+    service_id: str = ""
+    plan_id: str = ""
+    organization_guid: str = ""
+    space_guid: str = ""
+    last_operation: LastOperation | None = None
+    parameters: Any = None
+
+    @classmethod
+    def from_request(cls, instance_id: str,
+                     body: Mapping[str, Any]) -> "ServiceInstance":
+        return cls(id=instance_id,
+                   service_id=str(body.get("service_id", "")),
+                   plan_id=str(body.get("plan_id", "")),
+                   organization_guid=str(
+                       body.get("organization_guid", "")),
+                   space_guid=str(body.get("space_guid", "")),
+                   parameters=body.get("parameters"))
+
+    def to_wire(self) -> dict:
+        out = {"id": self.id, "dashboard_url": self.dashboard_url,
+               "service_id": self.service_id, "plan_id": self.plan_id,
+               "organization_guid": self.organization_guid,
+               "space_guid": self.space_guid}
+        if self.internal_id:
+            out["internalId"] = self.internal_id
+        if self.last_operation is not None:
+            out["last_operation"] = self.last_operation.to_wire()
+        if self.parameters is not None:
+            out["parameters"] = self.parameters
+        return out
+
+    def provision_response(self) -> dict:
+        """osb/serviceInstance.go CreateServiceInstanceResponse."""
+        out = {"dashboard_url": self.dashboard_url}
+        if self.last_operation is not None:
+            out["last_operation"] = self.last_operation.to_wire()
+        return out
+
+
+@dataclasses.dataclass
+class ServiceBinding:
+    """osb/serviceBinding.go ServiceBinding."""
+    id: str = ""
+    service_id: str = ""
+    app_id: str = ""
+    service_plan_id: str = ""
+    private_key: str = ""
+    service_instance_id: str = ""
+
+    @classmethod
+    def from_request(cls, instance_id: str, binding_id: str,
+                     body: Mapping[str, Any]) -> "ServiceBinding":
+        return cls(id=binding_id,
+                   service_id=str(body.get("service_id", "")),
+                   app_id=str(body.get("app_guid",
+                                       body.get("app_id", ""))),
+                   service_plan_id=str(body.get("plan_id", "")),
+                   service_instance_id=instance_id)
+
+    def to_wire(self) -> dict:
+        return {"id": self.id, "service_id": self.service_id,
+                "app_id": self.app_id,
+                "service_plan_id": self.service_plan_id,
+                "private_key": self.private_key,
+                "service_instance_id": self.service_instance_id}
+
+    def bind_response(self, credentials: Any = None) -> dict:
+        """osb/serviceBinding.go CreateServiceBindingResponse."""
+        return {"credentials": credentials or {}}
+
+
+# ---------------------------------------------------------------------------
+# Broker config store (model/config/store.go BrokerConfigStore)
+# ---------------------------------------------------------------------------
+
+def validate_service_class(spec: Mapping[str, Any]) -> None:
+    e = spec.get("entry") or {}
+    if not e.get("name") or not e.get("id"):
+        raise BrokerConfigError("service-class: entry.name and "
+                                "entry.id are required")
+
+
+def validate_service_plan(spec: Mapping[str, Any]) -> None:
+    p = spec.get("plan") or {}
+    if not p.get("name") or not p.get("id"):
+        raise BrokerConfigError("service-plan: plan.name and plan.id "
+                                "are required")
+    svcs = spec.get("services")
+    if svcs is not None and not isinstance(svcs, (list, tuple)):
+        raise BrokerConfigError("service-plan: services must be a list")
+
+
+_VALIDATORS = {KIND_SERVICE_CLASS: validate_service_class,
+               KIND_SERVICE_PLAN: validate_service_plan}
+
+
+class BrokerConfigStore:
+    """Typed accessors over the generic runtime Store
+    (model/config/store.go MakeBrokerConfigStore). Keys are
+    (kind, namespace, name); `set` validates against the kind schema
+    like schema.go Validate."""
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    def set(self, kind: str, namespace: str, name: str,
+            spec: Mapping[str, Any]) -> None:
+        if kind not in _VALIDATORS:
+            raise BrokerConfigError(f"unknown broker kind {kind!r}")
+        validate_config_name(name)
+        _VALIDATORS[kind](spec)
+        self.store.set((kind, namespace, name), dict(spec))
+
+    def service_classes(self) -> dict[str, Mapping[str, Any]]:
+        return {f"{k[1]}/{k[2]}": v
+                for k, v in self.store.list(KIND_SERVICE_CLASS).items()}
+
+    def service_plans(self) -> dict[str, Mapping[str, Any]]:
+        return {f"{k[1]}/{k[2]}": v
+                for k, v in self.store.list(KIND_SERVICE_PLAN).items()}
+
+    def service_plans_by_service(self, service_key: str
+                                 ) -> dict[str, Mapping[str, Any]]:
+        """Plans whose `services` list names the class key
+        (store.go ServicePlansByService)."""
+        out = {}
+        for key, plan in self.service_plans().items():
+            for s in plan.get("services") or ():
+                if s == service_key or s == service_key.split("/")[-1]:
+                    out[key] = plan
+                    break
+        return out
+
+    def catalog(self) -> Catalog:
+        """controller.go:48 — classes + their plans → OSB catalog."""
+        cat = Catalog()
+        for key, cls_spec in sorted(self.service_classes().items()):
+            svc = Service.from_config(cls_spec)
+            for _, plan_spec in sorted(
+                    self.service_plans_by_service(key).items()):
+                svc.add_plan(ServicePlan.from_config(plan_spec))
+            cat.add_service(svc)
+        return cat

@@ -23,56 +23,117 @@ log = logging.getLogger("istio_tpu.broker")
 
 
 class BrokerServer:
-    def __init__(self, services: list[Mapping[str, Any]] | None = None):
-        """`services` is the catalog: [{id, name, description, plans:
-        [{id, name, description}], bindable}] (osb/catalog.go)."""
-        self.catalog = {"services": list(services or [])}
-        self._instances: dict[str, dict] = {}
-        self._bindings: dict[tuple[str, str], dict] = {}
+    def __init__(self, services: list[Mapping[str, Any]] | None = None,
+                 config_store=None):
+        """Catalog sources, either of:
+          * `config_store`: a BrokerConfigStore (broker/model.py) over
+            the CRD/runtime config registry — service-class +
+            service-plan kinds build the catalog per controller.go:48;
+          * `services`: a literal catalog list (tests/CLI fixtures).
+        Instances/bindings are typed OSB records (model.py
+        ServiceInstance/ServiceBinding) persisted back into the config
+        store when one is given."""
+        from istio_tpu.broker.model import BrokerConfigStore
+
+        self.config: BrokerConfigStore | None = config_store
+        self._static_services = list(services or [])
+        self._instances: dict[str, Any] = {}
+        self._bindings: dict[tuple[str, str], Any] = {}
         self._lock = threading.Lock()
         self._server: ThreadingHTTPServer | None = None
+        if config_store is not None:
+            # rehydrate persisted instances/bindings — a restarted
+            # broker must keep serving (and correctly 409/200-ing)
+            # records provisioned by its predecessor
+            from istio_tpu.broker.model import (ServiceBinding,
+                                                ServiceInstance)
+            for (_, _, name), spec in config_store.store.list(
+                    "service-instance").items():
+                self._instances[name] = ServiceInstance.from_request(
+                    name, spec)
+            for (_, _, name), spec in config_store.store.list(
+                    "service-binding").items():
+                iid = str(spec.get("service_instance_id", ""))
+                bid = str(spec.get("id", ""))
+                self._bindings[(iid, bid)] = ServiceBinding(
+                    id=bid,
+                    service_id=str(spec.get("service_id", "")),
+                    app_id=str(spec.get("app_id", "")),
+                    service_plan_id=str(
+                        spec.get("service_plan_id", "")),
+                    service_instance_id=iid)
 
     # -- operations (controller.go) --
 
     def get_catalog(self) -> dict:
-        return self.catalog
+        if self.config is not None:
+            return self.config.catalog().to_wire()
+        return {"services": self._static_services}
+
+    def _known_services(self) -> set[str]:
+        return {s["id"] for s in self.get_catalog()["services"]}
 
     def provision(self, instance_id: str, body: Mapping[str, Any]
                   ) -> tuple[int, dict]:
+        from istio_tpu.broker.model import ServiceInstance
+
+        inst = ServiceInstance.from_request(instance_id, body)
         with self._lock:
-            if instance_id in self._instances:
-                if self._instances[instance_id] == dict(body):
-                    return 200, {}
+            prev = self._instances.get(instance_id)
+            if prev is not None:
+                if prev.to_wire() == inst.to_wire():
+                    return 200, prev.provision_response()
                 return 409, {"description": "instance exists"}
-            known = {s["id"] for s in self.catalog["services"]}
-            if body.get("service_id") not in known:
+            if inst.service_id not in self._known_services():
                 return 400, {"description": "unknown service_id"}
-            self._instances[instance_id] = dict(body)
-        return 201, {}
+            self._instances[instance_id] = inst
+            if self.config is not None:
+                self.config.store.set(
+                    ("service-instance", "", instance_id),
+                    inst.to_wire())
+        return 201, inst.provision_response()
 
     def deprovision(self, instance_id: str) -> tuple[int, dict]:
         with self._lock:
             if instance_id not in self._instances:
                 return 410, {}
             del self._instances[instance_id]
+            if self.config is not None:
+                self.config.store.delete(
+                    ("service-instance", "", instance_id))
             for key in [k for k in self._bindings
                         if k[0] == instance_id]:
                 del self._bindings[key]
+                if self.config is not None:
+                    self.config.store.delete(
+                        ("service-binding", "", f"{key[0]}.{key[1]}"))
         return 200, {}
 
     def bind(self, instance_id: str, binding_id: str,
              body: Mapping[str, Any]) -> tuple[int, dict]:
+        from istio_tpu.broker.model import ServiceBinding
+
         with self._lock:
             if instance_id not in self._instances:
                 return 404, {"description": "no such instance"}
-            self._bindings[(instance_id, binding_id)] = dict(body)
-        return 201, {"credentials": {}}
+            binding = ServiceBinding.from_request(instance_id,
+                                                 binding_id, body)
+            self._bindings[(instance_id, binding_id)] = binding
+            if self.config is not None:
+                self.config.store.set(
+                    ("service-binding", "",
+                     f"{instance_id}.{binding_id}"), binding.to_wire())
+        return 201, binding.bind_response()
 
     def unbind(self, instance_id: str, binding_id: str) -> tuple[int, dict]:
         with self._lock:
             if (instance_id, binding_id) not in self._bindings:
                 return 410, {}
             del self._bindings[(instance_id, binding_id)]
+            if self.config is not None:
+                self.config.store.delete(
+                    ("service-binding", "",
+                     f"{instance_id}.{binding_id}"))
         return 200, {}
 
     # -- HTTP --
@@ -103,7 +164,8 @@ class BrokerServer:
                 elif len(parts) == 3 and parts[:2] == \
                         ["v2", "service_instances"]:
                     inst = broker._instances.get(parts[2])
-                    self._reply(200 if inst else 404, inst or {})
+                    self._reply(200 if inst else 404,
+                                inst.to_wire() if inst else {})
                 else:
                     self._reply(404, {})
 

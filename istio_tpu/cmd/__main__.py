@@ -22,6 +22,12 @@ def cmd_mixs(args: argparse.Namespace) -> int:
     from istio_tpu.api import MixerGrpcServer
     from istio_tpu.runtime import FsStore, MemStore, RuntimeServer, \
         ServerArgs
+    if args.trace_zipkin_url or args.trace_log_spans:
+        # pkg/tracing/config.go:87 Configure — spans cover the serving
+        # pipeline stages (batch/queue-wait/tensorize/device/overlay)
+        from istio_tpu.utils import tracing
+        tracing.configure("mixs", zipkin_url=args.trace_zipkin_url,
+                          log_spans=args.trace_log_spans)
     store = FsStore(args.config_store) if args.config_store else MemStore()
     runtime = RuntimeServer(store, ServerArgs(
         batch_window_s=args.batch_window_us / 1e6,
@@ -588,6 +594,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="YAML config dir (FsStore); empty = memory")
     s.add_argument("--batch-window-us", type=int, default=300)
     s.add_argument("--max-batch", type=int, default=1024)
+    s.add_argument("--trace-zipkin-url", default="",
+                   help="zipkin v2 collector (POST /api/v2/spans)")
+    s.add_argument("--trace-log-spans", action="store_true",
+                   help="log every span (pkg/tracing LogTraceSpans)")
     s.set_defaults(fn=cmd_mixs)
 
     s = sub.add_parser("rule-dump",

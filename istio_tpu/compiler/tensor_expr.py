@@ -220,14 +220,19 @@ def _collect(e: Expression, finder: AttributeDescriptorFinder,
         return
     if f.name in _CMP_FUNCS:
         # ordered comparisons ride the byte planes: strings as utf-8,
-        # numerics as 8-byte order keys (layout.order_key_bytes).
-        # Unorderable operand types (BOOL/IP/BYTES) make the oracle
-        # raise on EVERY evaluation — a constant-error atom, no
-        # requirements needed
-        types = [eval_type(a, finder, DEFAULT_FUNCS) for a in f.args]
-        if any(t != V.STRING and t not in ORDER_KEY_TYPES
-               for t in types):
-            return
+        # numerics as 8-byte order keys (layout.order_key_bytes) —
+        # keys of DIFFERENT types are not mutually comparable, so only
+        # same-type pairs lower. INT64-vs-DOUBLE is a real comparison
+        # on the oracle (python int<float) → host fallback; every
+        # other mixed/unorderable pair makes the oracle raise on EVERY
+        # evaluation → a constant-error atom, no requirements needed.
+        ta, tb = (eval_type(a, finder, DEFAULT_FUNCS) for a in f.args)
+        if ta != tb:
+            if {ta, tb} <= {V.INT64, V.DOUBLE}:
+                raise HostFallback("mixed numeric comparison")
+            return   # oracle type error every row
+        if ta != V.STRING and ta not in ORDER_KEY_TYPES:
+            return   # unorderable (BOOL/IP/BYTES): oracle error
         for a in f.args:
             _collect(a, finder, reqs, as_bytes=True)
         return
@@ -435,8 +440,11 @@ def _compile_cmp(f: FunctionCall, ctx: _Ctx) -> NodeFn:
     name = f.name
     ta = ctx.type_of(f.args[0])
     tb = ctx.type_of(f.args[1])
-    if any(t != V.STRING and t not in ORDER_KEY_TYPES
-           for t in (ta, tb)):
+    if ta != tb:
+        if {ta, tb} <= {V.INT64, V.DOUBLE}:
+            raise HostFallback("mixed numeric comparison")
+        return _error_tval()   # oracle type error on every row
+    if ta != V.STRING and ta not in ORDER_KEY_TYPES:
         # the oracle raises "unordered operand" on every evaluation
         return _error_tval()
     numeric = ta in ORDER_KEY_TYPES
@@ -574,16 +582,21 @@ def compile_dfa_group(subject_ast: Expression, patterns: list[str],
     _compile_byte_pred's semantics per column: subject absence/error
     masks the row; truncated rows are fully undecidable for $-anchored
     patterns and miss-undecidable otherwise."""
-    from istio_tpu.ops.regex_dfa import pack_dfas, pack_dfas_onehot
+    from istio_tpu.ops.regex_dfa import (pack_dfas, pack_dfas_classes,
+                                         pack_dfas_onehot)
 
     max_len = ctx.layout.max_str_len
     fsub = _compile_bytes(subject_ast, ctx)
-    packed = pack_dfas_onehot(dfas)
     # MXU formulation when the per-step matmul stays reasonable
-    # (B·S²·C flops/step); huge banks take the flat-gather scan
-    use_onehot = (packed["n_states"] ** 2 * packed["n_classes"]
+    # (B·S²·C flops/step); huge banks take the flat-gather scan. The
+    # size gate runs on the CHEAP class pass — the O(S²·C) step matrix
+    # is only materialized for banks that pass.
+    classes = pack_dfas_classes(dfas)
+    use_onehot = (classes["n_states"] ** 2 * classes["n_classes"]
                   <= 4_000_000)
-    if not use_onehot:
+    if use_onehot:
+        packed = pack_dfas_onehot(dfas, classes)
+    else:
         trans, accept = pack_dfas(dfas)
         trans_j = jnp.asarray(trans)
         accept_j = jnp.asarray(accept)

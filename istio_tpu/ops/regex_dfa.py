@@ -478,41 +478,52 @@ def pack_dfas(dfas: list[DFA]) -> tuple[np.ndarray, np.ndarray]:
     return trans, accept
 
 
-def pack_dfas_onehot(dfas: list[DFA]) -> dict:
-    """Pack several DFAs for the MXU (one-hot matmul) device kernel
-    (bytes_ops.dfa_match_many_onehot).
-
-    All automata are renumbered into one global state space; bytes are
-    reduced to the bank-wide EQUIVALENCE CLASSES (bytes with identical
-    transition columns across every state — a handful of classes for
-    typical route/header patterns, vs the 256-wide raw alphabet).
-
-    Returns {"step": [S·C, S] bf16-safe one-hot transition matrix
-    (row s·C+c → one-hot of next state), "cls": [256, C] one-hot
-    byte→class matrix, "starts": [N] int32 global start states,
-    "accept": [S, N] pattern acceptance matrix}."""
+def pack_dfas_classes(dfas: list[DFA]) -> dict:
+    """CHEAP phase of the one-hot packing: renumber all automata into
+    one global state space and compute the bank-wide byte EQUIVALENCE
+    CLASSES (bytes with identical transition columns across every
+    state). O(S·256) numpy work — callers size-gate on
+    n_states/n_classes BEFORE paying for the step matrix
+    (pack_dfas_onehot)."""
     n = len(dfas)
     offs = np.cumsum([0] + [d.n_states for d in dfas])
     s_tot = int(offs[-1])
-    # global transition table [S, 256]
     gt = np.zeros((s_tot, ALPHABET), np.int32)
     accept = np.zeros((s_tot, n), np.float32)
     for i, d in enumerate(dfas):
         gt[offs[i]:offs[i + 1]] = d.transitions + offs[i]
         accept[offs[i]:offs[i + 1], i] = d.accept
-    # byte equivalence classes: identical [S] columns collapse
     _, class_of = np.unique(gt, axis=1, return_inverse=True)
     class_of = class_of.reshape(-1)
     n_cls = int(class_of.max()) + 1
     rep = np.zeros(n_cls, np.int64)   # a representative byte per class
     for byte in range(ALPHABET - 1, -1, -1):
         rep[class_of[byte]] = byte
+    return {"gt": gt, "class_of": class_of, "rep": rep,
+            "starts": offs[:-1].astype(np.int32), "accept": accept,
+            "n_states": s_tot, "n_classes": n_cls}
+
+
+def pack_dfas_onehot(dfas: list[DFA],
+                     classes: dict | None = None) -> dict:
+    """Pack several DFAs for the MXU (one-hot matmul) device kernel
+    (bytes_ops.dfa_match_many_onehot).
+
+    Returns {"step": [S·C, S] bf16-safe one-hot transition matrix
+    (row s·C+c → one-hot of next state), "cls": [256, C] one-hot
+    byte→class matrix, "starts": [N] int32 global start states,
+    "accept": [S, N] pattern acceptance matrix}. The step matrix is
+    O(S²·C) memory — size-gate via pack_dfas_classes first."""
+    k = classes if classes is not None else pack_dfas_classes(dfas)
+    s_tot, n_cls = k["n_states"], k["n_classes"]
+    gt, class_of, rep = k["gt"], k["class_of"], k["rep"]
     step = np.zeros((s_tot * n_cls, s_tot), np.float32)
-    for s in range(s_tot):
-        for c in range(n_cls):
-            step[s * n_cls + c, gt[s, rep[c]]] = 1.0
+    rows = (np.arange(s_tot)[:, None] * n_cls
+            + np.arange(n_cls)[None, :]).reshape(-1)
+    cols = gt[:, rep].reshape(-1)          # [S, C] next states
+    step[rows, cols] = 1.0
     cls = np.zeros((ALPHABET, n_cls), np.float32)
     cls[np.arange(ALPHABET), class_of] = 1.0
     return {"step": step, "cls": cls,
-            "starts": offs[:-1].astype(np.int32), "accept": accept,
+            "starts": k["starts"], "accept": k["accept"],
             "n_states": s_tot, "n_classes": n_cls}

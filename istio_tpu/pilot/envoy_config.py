@@ -262,6 +262,7 @@ def build_outbound_listeners(services: Sequence[Service],
         return {
             "address": f"tcp://0.0.0.0:{port_num}",
             "name": f"http_0.0.0.0_{port_num}",
+            "bind_to_port": True,
             "filters": [{
                 "type": "read", "name": "http_connection_manager",
                 "config": {
@@ -293,6 +294,7 @@ def build_outbound_listeners(services: Sequence[Service],
                 listeners[port.port] = {
                     "address": f"tcp://0.0.0.0:{port.port}",
                     "name": f"redis_0.0.0.0_{port.port}",
+                    "bind_to_port": True,
                     "filters": [{
                         "type": "read", "name": "redis_proxy",
                         "config": {"cluster_name":
@@ -309,6 +311,7 @@ def build_outbound_listeners(services: Sequence[Service],
                 entry = listeners.setdefault(port.port, {
                     "address": f"tcp://0.0.0.0:{port.port}",
                     "name": f"tcp_0.0.0.0_{port.port}",
+                    "bind_to_port": True,
                     "filters": [{"type": "read", "name": "tcp_proxy",
                                  "config": {"stat_prefix": "tcp",
                                             "route_config":
@@ -338,6 +341,7 @@ def build_outbound_listeners(services: Sequence[Service],
                 entry = listeners.setdefault(pnum, {
                     "address": f"tcp://0.0.0.0:{pnum}",
                     "name": f"tcp_0.0.0.0_{pnum}",
+                    "bind_to_port": True,
                     "filters": [{"type": "read", "name": "tcp_proxy",
                                  "config": {"stat_prefix": "tcp",
                                             "route_config":
@@ -364,6 +368,7 @@ def build_inbound_listeners(instances: Sequence[ServiceInstance],
             listeners[port] = {
                 "address": f"tcp://{inst.endpoint.address}:{port}",
                 "name": f"http_{inst.endpoint.address}_{port}",
+                "bind_to_port": True,
                 "filters": [{
                     "type": "read", "name": "http_connection_manager",
                     "config": {"codec_type": "auto",
@@ -375,6 +380,7 @@ def build_inbound_listeners(instances: Sequence[ServiceInstance],
             listeners[port] = {
                 "address": f"tcp://{inst.endpoint.address}:{port}",
                 "name": f"tcp_{inst.endpoint.address}_{port}",
+                "bind_to_port": True,
                 "filters": [{"type": "read", "name": "tcp_proxy",
                              "config": {"stat_prefix": "tcp",
                                         "route_config": {"routes": [
@@ -397,6 +403,7 @@ def build_ingress_listeners(config_store: IstioConfigStore, registry,
         listener = {
             "address": f"tcp://0.0.0.0:{port}",
             "name": f"ingress_{port}",
+            "bind_to_port": True,
             "filters": [{
                 "type": "read", "name": "http_connection_manager",
                 "config": {"codec_type": "auto",
@@ -405,7 +412,11 @@ def build_ingress_listeners(config_store: IstioConfigStore, registry,
                            "filters": _http_filters(mesh)}}],
         }
         if secure:
-            listener["ssl_context"] = dict(tls_context)
+            ctx = dict(tls_context)
+            # always-serialized in resources.go SSLContext — terminating
+            # TLS at ingress does not demand client certs by default
+            ctx.setdefault("require_client_certificate", False)
+            listener["ssl_context"] = ctx
         out.append(listener)
     return out
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future, InvalidStateError
 from typing import Any, Callable, Sequence
 
@@ -100,6 +101,7 @@ class CheckBatcher:
         if self._closed:
             raise RuntimeError("batcher is closed")
         fut: Future = Future()
+        fut._t_enq = time.perf_counter()   # queue-wait span tag
         self._queue.put((bag, fut))
         return fut
 
@@ -112,7 +114,6 @@ class CheckBatcher:
             batch = [item]
             deadline = None
             while len(batch) < self.max_batch:
-                import time
                 if deadline is None:
                     deadline = time.perf_counter() + self.window_s
                 timeout = deadline - time.perf_counter()
@@ -154,8 +155,19 @@ class CheckBatcher:
             bags = [bag for bag, _ in batch]
             target = bucket_size(len(bags), self.buckets)
             padded = bags + [PadBag()] * (target - len(bags))
+            # queue-wait = oldest enqueue -> batch start (decomposable
+            # served latency; pkg/tracing interceptor role)
+            from istio_tpu.utils import tracing
+            now = time.perf_counter()
+            waits = [now - t for t in
+                     (getattr(f, "_t_enq", None) for _, f in batch)
+                     if t is not None]
+            span_ctx = tracing.get_tracer().span(
+                "serve.batch", size=len(batch), bucket=target,
+                queue_wait_ms=round(max(waits, default=0.0) * 1e3, 3))
             try:
-                results = self.run_batch(padded)
+                with span_ctx:
+                    results = self.run_batch(padded)
             except Exception as exc:
                 for _, fut in batch:
                     try:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Any, Mapping, Sequence
 
 import numpy as np
@@ -195,25 +196,31 @@ class Dispatcher:
         lowest-rule-index-wins on both sides, so host results from a
         lower rule index override the device candidate and vice versa —
         the two paths provably pick the same rule's status."""
+        from istio_tpu.utils import tracing
+
         snap, plan = self.snapshot, self.fused
+        tr = tracing.get_tracer()
         with monitor.resolve_timer():
-            wires = [getattr(bag, "wire", None) for bag in bags]
-            if plan.native is not None and all(
-                    w is not None for w in wires):
-                # C++ wire→tensor decode: no per-request python work
-                batch = plan.native.tensorize_wire(wires)
-                ns_ids = self._ns_ids_from_batch(batch)
-            else:
-                batch = snap.tensorizer.tensorize(bags)
-                ns_ids = self._request_ns_ids(bags)
+            with tr.span("serve.tensorize", batch=len(bags)):
+                wires = [getattr(bag, "wire", None) for bag in bags]
+                if plan.native is not None and all(
+                        w is not None for w in wires):
+                    # C++ wire→tensor decode: no per-request python work
+                    batch = plan.native.tensorize_wire(wires)
+                    ns_ids = self._ns_ids_from_batch(batch)
+                else:
+                    batch = snap.tensorizer.tensorize(bags)
+                    ns_ids = self._request_ns_ids(bags)
             # ONE device→host pull for the whole verdict: each extra
             # pull costs a full RTT (~120ms behind the axon tunnel),
             # and plane-by-plane conversion was 6 RTTs per batch
-            packed = plan.packed_check(batch, ns_ids)
+            with tr.span("serve.device"):
+                packed = plan.packed_check(batch, ns_ids)
             status = packed[0]
             dur = packed[1].view(np.float32)
             uses = packed[2]
             deny_rule = packed[3]
+        t_overlay = time.perf_counter()
         rs = snap.ruleset
         n_err = int(packed[4, 0]) if packed.shape[1] else 0
         if n_err:
@@ -382,6 +389,8 @@ class Dispatcher:
             else:
                 resp.active_quota_rules = ()
             out.append(resp)
+        tr.emit("serve.overlay", time.perf_counter() - t_overlay,
+                batch=len(bags))
         return out
 
     @staticmethod

@@ -1,16 +1,25 @@
-"""Control-plane tracing (reference: pkg/tracing/config.go:87
-Configure — zipkin HTTP / log-only span reporters wired into gRPC
-servers). Spans are zipkin-v2-shaped dicts; reporters are pluggable:
-LogReporter (the reference's log-span option) and MemoryReporter
-(tests). A zipkin HTTP reporter is a seam — this image has no egress.
+"""Control-plane tracing (reference: pkg/tracing/config.go:87-135
+Configure — zipkin HTTP / log-only span reporters composed and wired
+into the servers). Spans are zipkin-v2-shaped dicts; reporters are
+pluggable: log_reporter (the reference's LogTraceSpans option),
+MemoryReporter (tests), and ZipkinReporter — the v2 wire format
+(JSON array POSTed to /api/v2/spans) over an injectable transport
+(this image has no egress; tests drive a local HTTP sink).
+
+The serving pipeline emits per-BATCH stage spans (queue-wait /
+tensorize / device / overlay — runtime/dispatcher.py), so a served
+check's latency is decomposable the way the reference's interceptor
+chain makes its RPCs (mixer/pkg/server/server.go).
 """
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import json
 import logging
 import threading
 import time
+import urllib.request
 import uuid
 from typing import Any, Callable
 
@@ -35,10 +44,89 @@ class MemoryReporter:
             self.spans.append(span)
 
 
+def _http_post_json(url: str, payload: bytes,
+                    timeout_s: float = 5.0) -> int:
+    req = urllib.request.Request(
+        url, data=payload, method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        return r.status
+
+
+class ZipkinReporter:
+    """zipkin-v2 HTTP reporter: spans buffer and flush as a JSON array
+    to `url` (POST /api/v2/spans — the wire format
+    zipkin.NewHTTPTransport speaks in pkg/tracing/config.go:99).
+
+    `post` is injectable (default urllib); flushing happens on a
+    background thread every `flush_interval_s` or `max_batch` spans,
+    and close() drains. Failures drop the batch with a log line —
+    tracing must never stall serving."""
+
+    def __init__(self, url: str,
+                 post: Callable[[str, bytes], Any] | None = None,
+                 flush_interval_s: float = 1.0, max_batch: int = 100):
+        self.url = url
+        self._post = post or _http_post_json
+        self._buf: list[dict] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        self._interval = flush_interval_s
+        self._max = max_batch
+        self._wake = threading.Condition(self._lock)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="zipkin-reporter")
+        self._thread.start()
+
+    def __call__(self, span: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._buf.append(span)
+            if len(self._buf) >= self._max:
+                self._wake.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                self._wake.wait(timeout=self._interval)
+                batch, self._buf = self._buf, []
+                closed = self._closed
+            if batch:
+                try:
+                    self._post(self.url, json.dumps(batch).encode())
+                except Exception as exc:
+                    log.warning("zipkin flush of %d spans failed: %s",
+                                len(batch), exc)
+            if closed:
+                return
+
+    def flush(self) -> None:
+        with self._lock:
+            self._wake.notify()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._wake.notify()
+        self._thread.join(timeout=self._interval + 6)
+
+
+def composite_reporter(*reporters: Reporter) -> Reporter:
+    """jaeger.NewCompositeReporter analog (config.go:120)."""
+    def report(span: dict) -> None:
+        for r in reporters:
+            try:
+                r(span)
+            except Exception:
+                log.exception("span reporter failed")
+    return report
+
+
 @dataclasses.dataclass
 class Tracer:
     service_name: str = "istio-tpu"
-    reporter: Reporter = log_reporter
+    reporter: Reporter | None = log_reporter   # None → disabled (noop)
     _local: threading.local = dataclasses.field(
         default_factory=threading.local)
 
@@ -47,6 +135,9 @@ class Tracer:
 
     @contextlib.contextmanager
     def span(self, name: str, **tags: Any):
+        if self.reporter is None:   # disabled: zero hot-path work
+            yield None
+            return
         parent = self._current()
         span = {
             "traceId": parent["traceId"] if parent
@@ -73,3 +164,83 @@ class Tracer:
                 self.reporter(span)
             except Exception:
                 log.exception("span reporter failed")
+
+    def emit(self, name: str, duration_s: float, **tags: Any) -> None:
+        """Fire-and-forget span for an already-measured interval —
+        exception-safe instrumentation of code that cannot nest in a
+        `with` block (multiple exits, hot paths)."""
+        if self.reporter is None:
+            return
+        parent = self._current()
+        span = {
+            "traceId": parent["traceId"] if parent
+            else uuid.uuid4().hex[:16],
+            "id": uuid.uuid4().hex[:16],
+            "name": name,
+            "localEndpoint": {"serviceName": self.service_name},
+            "timestamp": int((time.time() - duration_s) * 1e6),
+            "duration": int(duration_s * 1e6),
+            "tags": {k: str(v) for k, v in tags.items()},
+        }
+        if parent:
+            span["parentId"] = parent["id"]
+        try:
+            self.reporter(span)
+        except Exception:
+            log.exception("span reporter failed")
+
+
+# -- global tracer (pkg/tracing's ot.SetGlobalTracer side effect) -----
+
+NOOP_TRACER = Tracer(reporter=None)
+_global = NOOP_TRACER
+_closers: list = []
+
+
+def configure(service_name: str, zipkin_url: str = "",
+              log_spans: bool = False,
+              post: Callable[[str, bytes], Any] | None = None) -> Tracer:
+    """pkg/tracing/config.go:87 Configure: compose zipkin/log
+    reporters (none configured → noop tracer), install globally.
+    Reconfiguring closes the reporters it replaces (the reference's
+    io.Closer contract) — otherwise every reload leaks a flush
+    thread."""
+    global _global
+    for c in _closers:
+        try:
+            c.close()
+        except Exception:
+            log.exception("reporter close failed")
+    _closers.clear()
+    reporters: list[Reporter] = []
+    if zipkin_url:
+        zr = ZipkinReporter(zipkin_url, post=post)
+        _closers.append(zr)
+        reporters.append(zr)
+    if log_spans:
+        reporters.append(log_reporter)
+    if not reporters:
+        tracer = Tracer(service_name=service_name, reporter=None)
+    elif len(reporters) == 1:
+        tracer = Tracer(service_name=service_name,
+                        reporter=reporters[0])
+    else:
+        tracer = Tracer(service_name=service_name,
+                        reporter=composite_reporter(*reporters))
+    _global = tracer
+    return tracer
+
+
+def get_tracer() -> Tracer:
+    return _global
+
+
+def shutdown() -> None:
+    global _global
+    for c in _closers:
+        try:
+            c.close()
+        except Exception:
+            log.exception("reporter close failed")
+    _closers.clear()
+    _global = NOOP_TRACER

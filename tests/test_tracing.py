@@ -1,0 +1,127 @@
+"""Tracing: zipkin-v2 wire export + serving-stage span decomposition.
+
+Reference: pkg/tracing/config.go:87-135 (Configure composes zipkin/log
+reporters, installs a global tracer); the serving pipeline emits
+per-batch stage spans so a served check's latency decomposes into
+queue-wait / tensorize / device / overlay (VERDICT r2 item 9).
+"""
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from istio_tpu.utils import tracing
+
+
+def test_zipkin_reporter_posts_v2_json():
+    posts = []
+    rep = tracing.ZipkinReporter(
+        "http://collector/api/v2/spans",
+        post=lambda url, payload: posts.append((url, payload)),
+        flush_interval_s=0.02, max_batch=10)
+    tr = tracing.Tracer(service_name="svc", reporter=rep)
+    with tr.span("outer", k="v"):
+        with tr.span("inner"):
+            pass
+    rep.flush()
+    rep.close()
+    assert posts, "no flush happened"
+    url, payload = posts[0]
+    spans = json.loads(payload)
+    assert url.endswith("/api/v2/spans")
+    by_name = {s["name"]: s for s in spans}
+    assert set(by_name) == {"outer", "inner"}
+    outer, inner = by_name["outer"], by_name["inner"]
+    # zipkin v2 wire fields
+    assert outer["localEndpoint"]["serviceName"] == "svc"
+    assert outer["tags"] == {"k": "v"}
+    assert isinstance(outer["duration"], int)
+    # parentage: inner under outer, one trace
+    assert inner["parentId"] == outer["id"]
+    assert inner["traceId"] == outer["traceId"]
+
+
+def test_zipkin_reporter_against_real_http_sink():
+    got = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            got.append((self.path, self.rfile.read(n)))
+            self.send_response(202)
+            self.end_headers()
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/api/v2/spans"
+        rep = tracing.ZipkinReporter(url, flush_interval_s=0.02)
+        tr = tracing.Tracer(reporter=rep)
+        with tr.span("hello"):
+            pass
+        rep.flush()
+        rep.close()
+        assert got and got[0][0] == "/api/v2/spans"
+        assert json.loads(got[0][1])[0]["name"] == "hello"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_configure_composes_and_noop_default():
+    mem: list = []
+    tr = tracing.configure("t", zipkin_url="http://x/api/v2/spans",
+                           log_spans=True,
+                           post=lambda u, p: mem.append(p))
+    try:
+        assert tr.reporter is not None
+        with tr.span("s"):
+            pass
+    finally:
+        tracing.shutdown()
+    assert tracing.get_tracer().reporter is None   # back to noop
+    # noop tracer yields None and records nothing
+    with tracing.get_tracer().span("ignored") as s:
+        assert s is None
+
+
+def test_serving_pipeline_stage_spans():
+    """Served checks decompose: batch → queue-wait tag + tensorize /
+    device / overlay child spans from the fused dispatcher."""
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+
+    mem = tracing.MemoryReporter()
+    tracing._global = tracing.Tracer(reporter=mem)
+    try:
+        s = MemStore()
+        s.set(("handler", "istio-system", "deny"), {
+            "adapter": "denier", "params": {"status_code": 7}})
+        s.set(("instance", "istio-system", "nothing"), {
+            "template": "checknothing", "params": {}})
+        s.set(("rule", "istio-system", "r0"), {
+            "match": 'request.path.startsWith("/admin")',
+            "actions": [{"handler": "deny", "instances": ["nothing"]}]})
+        srv = RuntimeServer(s, ServerArgs(batch_window_s=0.001))
+        try:
+            r = srv.check(bag_from_mapping({"request.path": "/admin/x"}))
+            assert r.status_code == 7
+        finally:
+            srv.close()
+        names = {s["name"] for s in mem.spans}
+        assert {"serve.batch", "serve.tensorize", "serve.device",
+                "serve.overlay"} <= names, names
+        batch_span = next(s for s in mem.spans
+                          if s["name"] == "serve.batch")
+        assert "queue_wait_ms" in batch_span["tags"]
+        # stage spans parent under the batch span
+        tens = next(s for s in mem.spans
+                    if s["name"] == "serve.tensorize")
+        assert tens["parentId"] == batch_span["id"]
+        assert tens["traceId"] == batch_span["traceId"]
+    finally:
+        tracing._global = tracing.NOOP_TRACER
