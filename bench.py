@@ -439,19 +439,20 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
         from istio_tpu.testing import perf, workloads
 
         sync_ms = _roundtrip_s() * 1e3
-        # deep pipeline when each sync is expensive (tunnel), shallow
-        # when colocated: concurrent device round-trips overlap almost
-        # perfectly (measured: 8 concurrent pulls ≈ 1 pull wall-clock),
-        # so throughput scales with in-flight batches until client
-        # concurrency runs out
-        pipeline = max(2, min(16, int(sync_ms / 8) or 2))
+        # SHALLOW pipeline behind the tunnel: device trips serialize on
+        # the transport (profiled r3: 14 slots fragmented arrivals into
+        # ~12-request batches and collapsed throughput 5×; 1-2 slots
+        # let the batcher accumulate trip-sized batches — fewer, fatter
+        # trips win when trips can't overlap). Colocated chips sync in
+        # µs and can go deeper.
+        pipeline = 2 if sync_ms > 20 else 8
         store = workloads.make_store(n_rules)
-        # two buckets: small batches for latency at low load, one big
-        # bucket so heavy load amortizes per-batch host work (measured
-        # better than 256-only on the 1-core rig)
-        buckets = (256, 2048)
+        # bucket ladder sized to the closed-loop equilibrium batch
+        # (~cps × trip time): mid buckets avoid both tiny trips and
+        # padding a 300-row batch to 2048
+        buckets = (256, 512, 1024)
         srv = RuntimeServer(store, ServerArgs(
-            batch_window_s=0.001, max_batch=2048, pipeline=pipeline,
+            batch_window_s=0.002, max_batch=1024, pipeline=pipeline,
             buckets=buckets,
             default_manifest=workloads.MESH_MANIFEST))
         n_cores = mp.cpu_count() or 4
@@ -481,10 +482,14 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             # pipeline futures, so concurrency is cheap; on a 1-core
             # box extra client processes just steal the server's CPU.
             n_procs = 1 if n_cores <= 2 else min(4, n_cores - 2)
+            # closed-loop: cps ≈ concurrency / latency, and behind the
+            # serialized tunnel latency ≈ 1-2 trips regardless of
+            # depth, so offered load must be deep to fill trip-sized
+            # batches (profiled knee ~2k in flight on this rig)
             report = perf.run_load(
                 f"127.0.0.1:{port}", payloads,
                 duration_s=8.0 if on_tpu else 4.0,
-                n_procs=n_procs, concurrency=512 if on_tpu else 32,
+                n_procs=n_procs, concurrency=2048 if on_tpu else 32,
                 warmup_s=10.0 if on_tpu else 5.0)
         finally:
             g.stop()
