@@ -594,17 +594,19 @@ def compile_dfa_group(subject_ast: Expression, patterns: list[str],
     classes = pack_dfas_classes(dfas)
     use_onehot = (classes["n_states"] ** 2 * classes["n_classes"]
                   <= 4_000_000)
-    if use_onehot:
-        packed = pack_dfas_onehot(dfas, classes)
-    else:
-        trans, accept = pack_dfas(dfas)
-        trans_j = jnp.asarray(trans)
-        accept_j = jnp.asarray(accept)
+    packed = pack_dfas_onehot(dfas, classes) if use_onehot else None
+    trans, accept = pack_dfas(dfas)
+    trans_j = jnp.asarray(trans)
+    accept_j = jnp.asarray(accept)
     trunc_all = jnp.asarray(np.array(["$" in p for p in patterns]))
 
     def fn(batch: AttributeBatch):
         s = fsub(batch)
-        if use_onehot:
+        # batch size is STATIC under jit — small batches take the
+        # flat-gather scan (lower fixed latency per step), big batches
+        # amortize the MXU matmul formulation
+        b = batch.ids.shape[0]
+        if packed is not None and b > 512:
             m = bytes_ops.dfa_match_many_onehot(s.data, s.lens, packed)
         else:
             m = bytes_ops.dfa_match_many(s.data, s.lens, trans_j,
