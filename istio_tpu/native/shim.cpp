@@ -85,6 +85,11 @@ struct Layout {
   std::map<std::string, int32_t> map_slots;             // map attr → mcol
   std::map<std::pair<std::string, std::string>, int32_t> derived;  // (map,key)→col
   std::map<std::string, int32_t> byte_attr;             // attr → bcol
+  // encoding per attr byte slot: 0 utf-8, 2 int64 / 3 double /
+  // 4 duration-ns / 5 timestamp-ns ORDER KEYS (the 8-byte
+  // order-preserving encodings of layout.order_key_bytes — ordered
+  // comparisons on device read these planes)
+  std::map<std::string, uint8_t> byte_kind;
   std::map<std::pair<std::string, std::string>, int32_t> byte_pair;
   uint32_t n_columns = 0, n_maps = 0, n_byte = 0;
 };
@@ -152,7 +157,7 @@ void* shim_create(const uint8_t* blob, size_t len) {
   auto* sh = new Shim();
   Reader r{blob, blob + len};
   uint32_t magic = r.u32();
-  if (magic != 0x49545031) {  // "ITP1"
+  if (magic != 0x49545032) {  // "ITP2": byte slots carry a kind
     delete sh;
     return nullptr;
   }
@@ -179,13 +184,14 @@ void* shim_create(const uint8_t* blob, size_t len) {
   n = r.u32();
   for (uint32_t i = 0; i < n; i++) {
     int32_t bcol = static_cast<int32_t>(r.u32());
-    uint8_t is_pair = r.u8();
+    uint8_t kind = r.u8();
     std::string a = r.str();
-    if (is_pair) {
+    if (kind == 1) {
       std::string k = r.str();
       L.byte_pair[{a, k}] = bcol;
     } else {
       L.byte_attr[a] = bcol;
+      L.byte_kind[a] = kind;
     }
   }
   L.n_columns = r.u32();
@@ -314,6 +320,41 @@ int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
       memcpy(row_sb + bcol * slen, value.data(), m);
       row_sl[bcol] = static_cast<int32_t>(m);
     };
+    // 8-byte big-endian order key (layout.order_key_bytes parity)
+    auto set_key8 = [&](int32_t bcol, uint64_t bits) {
+      uint8_t* p = row_sb + bcol * slen;
+      for (int b = 0; b < 8; b++)
+        p[b] = static_cast<uint8_t>(bits >> (56 - 8 * b));
+      row_sl[bcol] = 8;
+    };
+    // len-1 marker: value not encodable for this slot's kind (the
+    // python tensorizer's ORDER_KEY_ERROR; device reads it as err)
+    auto set_key_error = [&](int32_t bcol) {
+      row_sb[bcol * slen] = 0;
+      row_sl[bcol] = 1;
+    };
+    auto i64_bits = [](int64_t v) {
+      return static_cast<uint64_t>(v) ^ 0x8000000000000000ull;
+    };
+    // numeric value → key by SLOT kind; returns false for NaN (slot
+    // stays len-0: the "compares False" marker)
+    auto set_numeric_key = [&](int32_t bcol, uint8_t kind, double dv,
+                               int64_t iv, bool from_double) {
+      if (kind == 3) {                       // double order key
+        double d = from_double ? dv : static_cast<double>(iv);
+        if (d != d) { row_sl[bcol] = 0; return; }   // NaN
+        if (d == 0.0) d = 0.0;               // -0.0 == +0.0
+        uint64_t bits;
+        memcpy(&bits, &d, 8);
+        bits = (bits >> 63) ? ~bits : (bits | 0x8000000000000000ull);
+        set_key8(bcol, bits);
+        return;
+      }
+      // int64 / duration-ns / timestamp-ns all key the integer value
+      int64_t v = from_double ? static_cast<int64_t>(dv) : iv;
+      if (from_double && dv != dv) { row_sl[bcol] = 0; return; }
+      set_key8(bcol, i64_bits(v));
+    };
 
     for (const auto& kv : msg.strings()) {
       const std::string* name = resolve_word(*sh, msg, kv.first);
@@ -321,15 +362,33 @@ int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
       if (!name || !value) continue;
       set_scalar(*name, key_str(*value));
       auto bit = L.byte_attr.find(*name);
-      if (bit != L.byte_attr.end()) set_bytes_slot(bit->second, *value);
+      if (bit != L.byte_attr.end()) {
+        uint8_t kind = L.byte_kind.at(*name);
+        if (kind == 0) set_bytes_slot(bit->second, *value);
+        else set_key_error(bit->second);   // string under numeric slot
+      }
     }
     for (const auto& kv : msg.int64s()) {
       const std::string* name = resolve_word(*sh, msg, kv.first);
-      if (name) set_scalar(*name, key_i64(kv.second));
+      if (!name) continue;
+      set_scalar(*name, key_i64(kv.second));
+      auto bit = L.byte_attr.find(*name);
+      if (bit != L.byte_attr.end()) {
+        uint8_t kind = L.byte_kind.at(*name);
+        if (kind == 0) continue;           // int under string slot
+        set_numeric_key(bit->second, kind, 0.0, kv.second, false);
+      }
     }
     for (const auto& kv : msg.doubles()) {
       const std::string* name = resolve_word(*sh, msg, kv.first);
-      if (name) set_scalar(*name, key_f64(kv.second));
+      if (!name) continue;
+      set_scalar(*name, key_f64(kv.second));
+      auto bit = L.byte_attr.find(*name);
+      if (bit != L.byte_attr.end()) {
+        uint8_t kind = L.byte_kind.at(*name);
+        if (kind == 0) continue;
+        set_numeric_key(bit->second, kind, kv.second, 0, true);
+      }
     }
     for (const auto& kv : msg.bools()) {
       const std::string* name = resolve_word(*sh, msg, kv.first);
@@ -346,15 +405,25 @@ int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
     }
     for (const auto& kv : msg.timestamps()) {
       const std::string* name = resolve_word(*sh, msg, kv.first);
-      if (name)
-        set_scalar(*name, key_ts_ns(ts_ns_like_python(
-                              kv.second.seconds(), kv.second.nanos())));
+      if (!name) continue;
+      int64_t ns = ts_ns_like_python(kv.second.seconds(),
+                                     kv.second.nanos());
+      set_scalar(*name, key_ts_ns(ns));
+      auto bit = L.byte_attr.find(*name);
+      if (bit != L.byte_attr.end() && L.byte_kind.at(*name) != 0)
+        set_numeric_key(bit->second, L.byte_kind.at(*name), 0.0, ns,
+                        false);
     }
     for (const auto& kv : msg.durations()) {
       const std::string* name = resolve_word(*sh, msg, kv.first);
-      if (name)
-        set_scalar(*name, key_dur_ns(dur_ns_like_python(
-                              kv.second.seconds(), kv.second.nanos())));
+      if (!name) continue;
+      int64_t ns = dur_ns_like_python(kv.second.seconds(),
+                                      kv.second.nanos());
+      set_scalar(*name, key_dur_ns(ns));
+      auto bit = L.byte_attr.find(*name);
+      if (bit != L.byte_attr.end() && L.byte_kind.at(*name) != 0)
+        set_numeric_key(bit->second, L.byte_kind.at(*name), 0.0, ns,
+                        false);
     }
     for (const auto& kv : msg.string_maps()) {
       const std::string* mname = resolve_word(*sh, msg, kv.first);

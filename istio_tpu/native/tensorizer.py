@@ -17,12 +17,18 @@ from typing import Any, Sequence
 import numpy as np
 
 from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
+from istio_tpu.attribute.types import ValueType
 from istio_tpu.compiler.layout import (AttributeBatch, BatchLayout,
                                        InternTable, _normalize,
                                        canonical_bytes)
 from istio_tpu.native.build import ensure_built
 
-_MAGIC = 0x49545031
+_MAGIC = 0x49545032   # v2: byte-slot records carry an encoding kind
+
+# byte-slot encoding kinds (shim.cpp must mirror): 0 utf-8 attr,
+# 1 utf-8 (map,key), then numeric order-key slots
+_BYTE_KINDS = {ValueType.INT64: 2, ValueType.DOUBLE: 3,
+               ValueType.DURATION: 4, ValueType.TIMESTAMP: 5}
 
 
 _canonical_key = canonical_bytes     # shared canonical encoding
@@ -71,10 +77,15 @@ def _layout_blob(layout: BatchLayout, interner: InternTable) -> bytes:
     out.append(struct.pack("<I", len(layout.byte_slots)))
     for src, bcol in layout.byte_slots.items():
         if isinstance(src, tuple):
+            # kind 1: (map, key) utf-8 slot
             out.append(struct.pack("<IB", bcol, 1) + _pack_str(src[0]) +
                        _pack_str(src[1]))
         else:
-            out.append(struct.pack("<IB", bcol, 0) + _pack_str(src))
+            # kind 0: utf-8 attr; kinds 2-5: numeric slots carrying the
+            # 8-byte order key (layout.order_key_bytes — the shim must
+            # produce IDENTICAL bytes so ordered comparisons agree)
+            kind = _BYTE_KINDS.get(layout.manifest.get(src), 0)
+            out.append(struct.pack("<IB", bcol, kind) + _pack_str(src))
     out.append(struct.pack("<III", layout.n_columns, layout.n_maps,
                            layout.n_byte_slots))
     # seed interns in id order (ids 3..)
@@ -116,18 +127,6 @@ class NativeTensorizer:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
         self._lib = lib
-        # the C++ decoder fills byte slots with utf-8 string payloads
-        # only; numeric byte sources carry order keys
-        # (layout.order_key_bytes) it does not produce — serving for
-        # such layouts stays on the python wire decoder
-        from istio_tpu.compiler.layout import ORDER_KEY_TYPES
-        for src in layout.byte_slots:
-            vt = layout.manifest.get(src) \
-                if not isinstance(src, tuple) else None
-            if vt in ORDER_KEY_TYPES:
-                raise RuntimeError(
-                    f"byte source {src!r} needs a numeric order key; "
-                    "the native shim only fills string slots")
         if layout.extern_slots:
             raise RuntimeError(
                 "layout has ingest-converted extern columns "
