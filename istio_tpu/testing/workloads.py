@@ -85,12 +85,18 @@ def make_engine(n_rules: int = 1024,
 
 
 def make_store(n_rules: int, n_services: int | None = None,
-               with_regex: bool = True):
+               with_regex: bool = True,
+               host_overlay_every: int | None = None):
     """A MemStore carrying the make_rules() workload as REAL config
     kinds (handlers/instances/rules), for serving-path benches and the
     perf rig: every 3rd rule deny + every 97th a whitelist, mirroring
     make_engine()'s fused-action mix. Rules live in their own
-    namespaces (namespace targeting identical to make_rules)."""
+    namespaces (namespace targeting identical to make_rules).
+
+    `host_overlay_every`: every Nth rule additionally carries a
+    REGEX-entry list action the device cannot absorb — the
+    host-overlay-heavy shape (VERDICT r2 weak #4) whose per-request
+    python cost the overlay bench measures."""
     from istio_tpu.runtime.store import MemStore
 
     s = MemStore()
@@ -117,6 +123,16 @@ def make_store(n_rules: int, n_services: int | None = None,
         "template": "checknothing", "params": {}})
     s.set(("instance", "istio-system", "srcns"), {
         "template": "listentry", "params": {"value": "source.namespace"}})
+    if host_overlay_every:
+        # REGEX entry type keeps list.go's host semantics — the fused
+        # plan must overlay these rules per request (runtime/fused.py)
+        s.set(("handler", "istio-system", "rxpath"), {
+            "adapter": "list",
+            "params": {"overrides": ["^/api/v[0-3]/"],
+                       "entry_type": "REGEX", "blacklist": True}})
+        s.set(("instance", "istio-system", "pathinst"), {
+            "template": "listentry",
+            "params": {"value": "request.path"}})
     for i, rule in enumerate(make_rules(n_rules, n_services, with_regex)):
         actions = []
         if i % 3 == 0:
@@ -125,6 +141,9 @@ def make_store(n_rules: int, n_services: int | None = None,
         if i % 97 == 1:
             actions.append({"handler": "nswhitelist.istio-system",
                             "instances": ["srcns.istio-system"]})
+        if host_overlay_every and i % host_overlay_every == 2:
+            actions.append({"handler": "rxpath.istio-system",
+                            "instances": ["pathinst.istio-system"]})
         if not actions:   # every rule carries at least a no-op check
             actions.append({"handler": "denyall.istio-system",
                             "instances": []})
