@@ -255,7 +255,14 @@ class Dispatcher:
         # oracle-evaluated into their subset positions.
         cols = plan.overlay_cols
         if len(cols):
-            active_sub = packed[5 + n_words:, :n_real].T.astype(bool)
+            # overlay activity bits ride bitpacked (same layout as the
+            # referenced-item words above)
+            n_ov_words = plan.n_overlay_words
+            active_sub = np.unpackbits(
+                np.ascontiguousarray(
+                    packed[5 + n_words:5 + n_words + n_ov_words,
+                           :n_real].T).view(np.uint8),
+                axis=1, bitorder="little")[:, :len(cols)].astype(bool)
             col_pos = {int(r): i for i, r in enumerate(cols)}
             host_errs = 0
             for ridx in rs.host_fallback:

@@ -96,6 +96,10 @@ class FusedPlan:
     def n_ref_words(self) -> int:
         return (len(self.item_names) + 31) // 32
 
+    @property
+    def n_overlay_words(self) -> int:
+        return (len(self.overlay_cols) + 31) // 32
+
     def packed_check(self, batch, ns_ids) -> np.ndarray:
         """engine.check + device-side packing into ONE int32 array
         [5 + W + C, B] pulled with a single host↔device sync (W =
@@ -106,7 +110,9 @@ class FusedPlan:
         Rows: 0 status, 1 valid_duration_s (f32 bits), 2
         valid_use_count, 3 deny_rule, 4 err_count (broadcast),
         5..5+W referenced-item bits (little-endian within each int32),
-        then matched[:, overlay_cols] (raw, ns-unmasked)."""
+        then matched[:, overlay_cols] BITPACKED the same way (raw,
+        ns-unmasked) — a 1k-column overlay plane shipped as int32 was
+        8 MB/batch of D2H, ~1.6 s behind the tunnel."""
         import jax
 
         if self._packer is None:
@@ -164,8 +170,16 @@ class FusedPlan:
                     parts.append(lax.bitcast_convert_type(
                         words, jnp.int32).T)
                 if cols.size:
-                    parts.append(jnp.take(verdict.matched, cols,
-                                          axis=1).T.astype(jnp.int32))
+                    ov = jnp.take(verdict.matched, cols, axis=1)
+                    n_ov_words = (cols.shape[0] + 31) // 32
+                    ov_pad = jnp.zeros((b, n_ov_words * 32), bool)
+                    ov_pad = ov_pad.at[:, :cols.shape[0]].set(ov)
+                    ov_words = jnp.sum(
+                        ov_pad.reshape(b, n_ov_words, 32)
+                        .astype(jnp.uint32) * bit_w[None, None, :],
+                        axis=2)
+                    parts.append(lax.bitcast_convert_type(
+                        ov_words, jnp.int32).T)
                 return jnp.concatenate(parts, axis=0) \
                     if len(parts) > 1 else head
 
