@@ -234,7 +234,7 @@ def _rbac_bench(on_tpu: bool) -> dict:
 
         n_roles = 1000 if on_tpu else 100
         batch = 2048 if on_tpu else 256
-        steps = 20 if on_tpu else 5
+        steps = 40 if on_tpu else 5   # window ≫ tunnel sync jitter
         store = workloads.make_rbac_store(n_roles)
         t0 = time.perf_counter()
         snap = SnapshotBuilder(
@@ -424,8 +424,10 @@ def _quota_bench(on_tpu: bool) -> dict:
         n_buckets = 131_072 if on_tpu else 8_192
         batch = 2_048 if on_tpu else 256
         # deep windows: the alloc step is sub-ms, so tunnel sync noise
-        # (±ms) must amortize over many steps to keep the number stable
-        steps = 60 if on_tpu else 5
+        # (±20ms per window) must amortize over many steps — at 60 the
+        # number still swung 2×; 200 × ~0.3ms ≈ 60ms of real work per
+        # window, noise ±0.1ms
+        steps = 200 if on_tpu else 5
         rng = np.random.default_rng(5)
         scan, fast = make_alloc_step(n_buckets)
         counts = jax.device_put(
