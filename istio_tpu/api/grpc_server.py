@@ -21,10 +21,13 @@ import grpc
 
 from istio_tpu.adapters.sdk import QuotaArgs
 from istio_tpu.api import mixer_pb2 as pb
-from istio_tpu.api.wire import (LazyWireBag, RawCheckRequest,
+from istio_tpu.api.wire import (LazyWireBag, RawBatchCheckRequest,
+                                RawCheckRequest,
+                                encode_batch_check_response,
                                 referenced_to_proto, update_dict_from_proto)
 from istio_tpu.attribute.bag import bag_from_mapping
 from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
+from istio_tpu.runtime import monitor
 from istio_tpu.runtime.server import RuntimeServer
 
 log = logging.getLogger("istio_tpu.api")
@@ -59,6 +62,12 @@ class MixerGrpcServer:
                 self._report,
                 request_deserializer=pb.ReportRequest.FromString,
                 response_serializer=pb.ReportResponse.SerializeToString),
+            # shim protocol (mixer.proto BatchCheck): raw in, raw out —
+            # per-item protos are built once and hand-framed
+            "BatchCheck": grpc.unary_unary_rpc_method_handler(
+                self._batch_check,
+                request_deserializer=RawBatchCheckRequest,
+                response_serializer=lambda b: b),
         }
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler("istio.mixer.v1.Mixer",
@@ -83,7 +92,42 @@ class MixerGrpcServer:
         result = self.runtime.check_preprocessed(bag)
         return self._check_response(request, bag, result)
 
+    def _batch_check(self, request: RawBatchCheckRequest,
+                     context) -> bytes:
+        """One RPC, many independent Check bags (the data-plane shim's
+        amortized front; mixer.proto BatchCheck). Per-item semantics =
+        unary Check without quotas/dedup. The batch is padded to the
+        server's prewarmed bucket shapes so arbitrary client batch
+        sizes never re-trace."""
+        from istio_tpu.runtime.batcher import PadBag, bucket_size
+
+        gwc = request.global_word_count
+        native = gwc in (0, len(GLOBAL_WORD_LIST))
+        bags = [self.runtime.preprocess(
+                    LazyWireBag(raw, gwc or None, native_ok=native))
+                for raw in request.attributes_raw]
+        if not bags:
+            return b""
+        monitor.CHECK_REQUESTS.inc(len(bags))
+        buckets = self.runtime.batcher.buckets
+        results: list = []
+        # oversize requests run in largest-bucket chunks — an arbitrary
+        # over-bucket shape would force a fresh device compile per
+        # distinct size (client-controlled stalls)
+        for lo in range(0, len(bags), buckets[-1]):
+            chunk = bags[lo:lo + buckets[-1]]
+            target = bucket_size(len(chunk), buckets)
+            padded = chunk + [PadBag()] * (target - len(chunk))
+            results.extend(
+                self.runtime.check_batch_preprocessed(padded)[:len(chunk)])
+        blobs = [
+            self._check_response(None, bag, result,
+                                 quotas=[]).SerializeToString()
+            for bag, result in zip(bags, results)]
+        return encode_batch_check_response(blobs)
+
     def _check_bag(self, request: RawCheckRequest):
+        monitor.CHECK_REQUESTS.inc()
         gwc = request.global_word_count
         # a non-default dictionary prefix forces the python wire path —
         # the C++ decoder assumes the full global list
@@ -122,6 +166,7 @@ class MixerGrpcServer:
                 out.granted_amount = qr.granted_amount
                 out.valid_duration.FromTimedelta(datetime.timedelta(
                     seconds=min(qr.valid_duration_s, _CLAMP_DURATION_S)))
+        monitor.CHECK_RESPONSES.inc()
         return resp
 
     @staticmethod
@@ -208,12 +253,26 @@ class MixerAioGrpcServer(MixerGrpcServer):
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="mixer-aio-grpc")
 
+    async def _abatch_check(self, request: RawBatchCheckRequest,
+                            context) -> bytes:
+        import asyncio
+        # tensorize + device step block — off the loop
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._batch_check, request, context)
+
     async def _acheck(self, request: RawCheckRequest,
                       context) -> "pb.CheckResponse":
         import asyncio
         loop = asyncio.get_running_loop()
-        # preprocess may run an APA device round-trip — off the loop
-        bag = await loop.run_in_executor(None, self._check_bag, request)
+        d = self.runtime.controller.dispatcher
+        if self.runtime.args.preprocess and d.has_apa:
+            # preprocess runs an APA device round-trip — off the loop
+            bag = await loop.run_in_executor(None, self._check_bag,
+                                             request)
+        else:
+            # identity preprocess: the executor hop would cost more
+            # than the work
+            bag = self._check_bag(request)
         # shield: a client cancel must cancel THIS handler only, never
         # the shared batcher future (a cancelled batch-mate would
         # otherwise poison result distribution for the whole batch)
@@ -239,9 +298,16 @@ class MixerAioGrpcServer(MixerGrpcServer):
                         True)
                 elif hasattr(qr, "add_done_callback"):
                     af = loop.create_future()
+
+                    def _resolve(v, af=af):
+                        # a client cancel mid-quota marks af done —
+                        # setting a result then raises InvalidStateError
+                        # inside a loop callback (observed r4)
+                        if not af.done():
+                            af.set_result(v)
                     qr.add_done_callback(
-                        lambda v, af=af: loop.call_soon_threadsafe(
-                            af.set_result, v))
+                        lambda v, _r=_resolve: loop.call_soon_threadsafe(
+                            _r, v))
                     qr = af
                 pending.append((name, qr))
             quotas = []
@@ -277,6 +343,10 @@ class MixerAioGrpcServer(MixerGrpcServer):
                     self._areport,
                     request_deserializer=pb.ReportRequest.FromString,
                     response_serializer=pb.ReportResponse.SerializeToString),
+                "BatchCheck": grpc.unary_unary_rpc_method_handler(
+                    self._abatch_check,
+                    request_deserializer=RawBatchCheckRequest,
+                    response_serializer=lambda b: b),
             }
             server.add_generic_rpc_handlers((
                 grpc.method_handlers_generic_handler(

@@ -187,6 +187,85 @@ def _read_varint(data: bytes, off: int) -> tuple[int, int]:
             raise WireError("varint overflow")
 
 
+def _write_varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class RawBatchCheckRequest:
+    """BatchCheckRequest split at the top level (mixer.proto
+    BatchCheckRequest): each repeated `attributes` entry stays raw
+    bytes for the native tensorizer. Unlike CheckRequest.attributes
+    (a singular message field, where repeats MERGE by concatenation),
+    entries here are independent bags — one per check."""
+
+    __slots__ = ("attributes_raw", "global_word_count")
+
+    def __init__(self, data: bytes):
+        self.attributes_raw: list[bytes] = []
+        self.global_word_count = 0
+        off, n = 0, len(data)
+        while off < n:
+            tag, off = _read_varint(data, off)
+            field, wt = tag >> 3, tag & 7
+            if wt == 2:
+                ln, off = _read_varint(data, off)
+                if field == 1:
+                    self.attributes_raw.append(data[off:off + ln])
+                off += ln
+            elif wt == 0:
+                v, off = _read_varint(data, off)
+                if field == 2:
+                    self.global_word_count = v
+            elif wt == 1:
+                off += 8
+            elif wt == 5:
+                off += 4
+            else:
+                raise WireError(f"bad wire type {wt}")
+
+
+def encode_batch_check_request(attribute_blobs: "list[bytes]",
+                               global_word_count: int) -> bytes:
+    """Serialize a BatchCheckRequest from pre-serialized
+    CompressedAttributes blobs (client/shim side)."""
+    parts = []
+    for blob in attribute_blobs:
+        parts.append(b"\x0a" + _write_varint(len(blob)) + blob)
+    if global_word_count:
+        parts.append(b"\x10" + _write_varint(global_word_count))
+    return b"".join(parts)
+
+
+def encode_batch_check_response(response_blobs: "list[bytes]") -> bytes:
+    """Serialize a BatchCheckResponse from serialized CheckResponse
+    blobs (server side)."""
+    return b"".join(b"\x0a" + _write_varint(len(b_)) + b_
+                    for b_ in response_blobs)
+
+
+def decode_batch_check_response(data: bytes) -> "list[bytes]":
+    """→ serialized CheckResponse blobs (client side)."""
+    out, off, n = [], 0, len(data)
+    while off < n:
+        tag, off = _read_varint(data, off)
+        field, wt = tag >> 3, tag & 7
+        if wt != 2:
+            raise WireError(f"bad wire type {wt} in BatchCheckResponse")
+        ln, off = _read_varint(data, off)
+        if field == 1:
+            out.append(data[off:off + ln])
+        off += ln
+    return out
+
+
 class RawCheckRequest:
     """A CheckRequest split at the top level WITHOUT full protobuf
     parsing: the `attributes` submessage stays raw bytes for the native

@@ -93,8 +93,18 @@ class DeviceQuotaPool:
         self._pending: list = []
         self._window_s = batch_window_s
         self._max_batch = max_batch
+        self._small_batch = min(64, max_batch)
         self._wake = threading.Condition(self._lock)
         self._closed = False
+        # compile every program the serving path can hit (both pad
+        # shapes × both alloc variants + the window-reset scatter)
+        # BEFORE the worker starts — a first-quota-batch compile
+        # mid-serve stalls every pending quota future behind it for
+        # seconds behind a device tunnel (observed r4: 60s quota waits
+        # from variable-shape compiles). Running here, pre-thread,
+        # also keeps `counts` single-owner: only __init__ and the
+        # worker ever touch it.
+        self._prewarm()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="device-quota")
         self._thread.start()
@@ -162,6 +172,18 @@ class DeviceQuotaPool:
                                 status_message="quota pool closed"))
 
     # -- internals ------------------------------------------------------
+
+    def _prewarm(self) -> None:
+        for pn in {self._small_batch, self._max_batch}:
+            zeros_i = jnp.zeros(pn, jnp.int32)
+            zeros_b = jnp.zeros(pn, bool)
+            for fn in (self._alloc_scan, self._alloc_fast):
+                # all-inactive batch: grants nothing, counters unchanged
+                _, self.counts = fn(self.counts, zeros_i, zeros_i,
+                                    zeros_b, zeros_i, zeros_b)
+        drop = jnp.full(self._small_batch, self.n_buckets, jnp.int32)
+        self.counts = self.counts.at[drop].set(0, mode="drop")
+        jax.block_until_ready(self.counts)
 
     def _bucket_for(self, key: str, lim: Mapping[str, Any],
                     now: float) -> int:
@@ -238,9 +260,13 @@ class DeviceQuotaPool:
             return
         n = len(batch)
         self._roll_windows(now, [b for b, *_ in batch])
-        # pad to the next power of two: every distinct shape is its own
-        # XLA compile — varying arrival batches must share traces
-        pn = max(16, 1 << (n - 1).bit_length())
+        # pad to one of TWO fixed shapes: every distinct shape is its
+        # own XLA compile (multi-second behind a device tunnel), and a
+        # mid-serve compile stalls every quota future behind it past
+        # client deadlines (observed r4: variable pow-2 pads produced a
+        # fresh compile per arrival-burst size and 60s quota waits)
+        pn = self._small_batch if n <= self._small_batch \
+            else self._max_batch
         buckets = np.zeros(pn, np.int32)
         amounts = np.zeros(pn, np.int32)
         be = np.zeros(pn, bool)
@@ -285,8 +311,16 @@ class DeviceQuotaPool:
                and now - self._window_start[b] >= self._bucket_duration[b]]
         if not idx:
             return
-        arr = np.asarray(idx, np.int32)
-        self.counts = self.counts.at[jnp.asarray(arr)].set(0)
+        # fixed-shape scatter (pad with an out-of-range row + drop):
+        # a per-count shape would re-trace on every distinct number of
+        # expired buckets
+        pad = self._small_batch
+        for i in range(0, len(idx), pad):
+            chunk = idx[i:i + pad]
+            arr = np.full(pad, self.n_buckets, np.int32)
+            arr[:len(chunk)] = chunk
+            self.counts = self.counts.at[jnp.asarray(arr)].set(
+                0, mode="drop")
         for b in idx:
             self._window_start[b] = now
 

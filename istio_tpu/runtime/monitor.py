@@ -33,6 +33,36 @@ CHECK_BATCH_SIZE = prometheus_client.Histogram(
     "mixer_runtime_check_batch_size", "coalesced check batch sizes",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048),
     registry=REGISTRY)
+# gRPC serving-path counters (grpcServer.go's monitoring role): a
+# failed perf run must be diagnosable from these alone — how many
+# requests were decoded vs answered, and how batch formation went.
+CHECK_REQUESTS = prometheus_client.Counter(
+    "mixer_grpc_check_requests", "Check RPCs decoded", registry=REGISTRY)
+CHECK_RESPONSES = prometheus_client.Counter(
+    "mixer_grpc_check_responses", "Check responses sent",
+    registry=REGISTRY)
+
+
+def serving_counters() -> dict:
+    """Snapshot of the serving-path counters as a plain dict (emitted
+    into bench artifacts on success AND failure)."""
+    hist: dict[str, int] = {}
+    for i, b in enumerate(CHECK_BATCH_SIZE._upper_bounds):
+        # prometheus_client stores per-bucket (non-cumulative) counts
+        cur = int(CHECK_BATCH_SIZE._buckets[i].get())
+        label = "inf" if b == float("inf") else str(int(b))
+        if cur:
+            hist[label] = cur
+    decoded = int(CHECK_REQUESTS._value.get())
+    sent = int(CHECK_RESPONSES._value.get())
+    return {
+        "requests_decoded": decoded,
+        "responses_sent": sent,
+        "in_flight": decoded - sent,
+        "batches_formed": sum(hist.values()),
+        "batch_rows": int(CHECK_BATCH_SIZE._sum.get()),
+        "batch_size_hist": hist,
+    }
 
 
 @contextlib.contextmanager

@@ -117,6 +117,13 @@ class RuntimeServer:
         return list(self._run_check_batch(
             [self.preprocess(b) for b in bags]))
 
+    def check_batch_preprocessed(self,
+                                 bags: Sequence[Bag]
+                                 ) -> list[CheckResponse]:
+        """Pre-batched entry for callers that already ran preprocess()
+        and padded to a bucket shape (the BatchCheck gRPC front)."""
+        return list(self._run_check_batch(bags))
+
     def report(self, bags: Sequence[Bag]) -> None:
         d = self.controller.dispatcher
         d.report([self.preprocess(b) for b in bags])

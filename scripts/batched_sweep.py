@@ -1,0 +1,43 @@
+"""Sweep BatchCheck batch sizes / concurrency against the real device
+transport to pick the served-bench knobs."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    import bench  # noqa: F401  (jax cache config)
+    from istio_tpu.api.grpc_server import MixerAioGrpcServer
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.testing import perf, workloads
+
+    store = workloads.make_store(1000)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.002, max_batch=2048, pipeline=2,
+        buckets=(256, 1024, 2048),
+        default_manifest=workloads.MESH_MANIFEST))
+    plan = srv.controller.dispatcher.fused
+    if plan is not None:
+        plan.prewarm((256, 1024, 2048))
+    g = MixerAioGrpcServer(srv)
+    port = g.start()
+    dicts = workloads.make_request_dicts(512)
+    try:
+        for bsz, conc in ((1024, 2), (1024, 3), (2048, 2), (2048, 3)):
+            payloads = perf.make_batch_check_payloads(dicts, bsz)
+            t0 = time.time()
+            rep = perf.run_load(
+                f"127.0.0.1:{port}", payloads, n_record=40,
+                n_procs=1, concurrency=conc, warmup_s=2.0,
+                method="/istio.mixer.v1.Mixer/BatchCheck",
+                checks_per_payload=bsz)
+            print(f"bsz={bsz} conc={conc}: "
+                  f"{rep.checks_per_sec:.0f} checks/s "
+                  f"rpc_p50={rep.p50_ms:.0f}ms "
+                  f"rpc_p99={rep.p99_ms:.0f}ms err={rep.n_errors} "
+                  f"wall={time.time() - t0:.0f}s", flush=True)
+    finally:
+        g.stop()
+        srv.close()

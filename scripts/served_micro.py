@@ -1,0 +1,84 @@
+"""Per-request host-cost decomposition for the served path.
+
+Times each stage of one Check RPC's server-side Python work in
+isolation (no device, no grpc): top-level request split, response
+proto build + serialize, quota instance build (with its lazy wire
+decode), and payload issue cost on the client side.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+N = 3000
+
+
+def timeit(label, fn, n=N):
+    fn()   # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    dt = (time.perf_counter() - t0) / n
+    print(f"{label:45s} {dt * 1e6:9.1f} us/req  ({1 / dt:9.0f}/s)")
+    return dt
+
+
+if __name__ == "__main__":
+    from istio_tpu.api.wire import LazyWireBag, RawCheckRequest, \
+        referenced_to_proto
+    from istio_tpu.api import mixer_pb2 as pb
+    from istio_tpu.testing import perf, workloads
+
+    dicts = workloads.make_request_dicts(512)
+    payloads = perf.make_check_payloads(dicts, quota_every=4)
+    pq = payloads[0]      # has quota
+    pn = payloads[1]      # no quota
+
+    timeit("RawCheckRequest parse (no quota)", lambda: RawCheckRequest(pn))
+    timeit("RawCheckRequest parse (with quota)",
+           lambda: RawCheckRequest(pq))
+
+    req = RawCheckRequest(pn)
+    timeit("LazyWireBag construct", lambda: LazyWireBag(
+        req.attributes_raw, None, native_ok=True))
+    timeit("LazyWireBag full decode", lambda: LazyWireBag(
+        req.attributes_raw, None, native_ok=True)._decode())
+
+    # response build + serialize (the no-quota common case)
+    import datetime
+
+    ref = pb.ReferencedAttributes()
+
+    def build_resp():
+        resp = pb.CheckResponse()
+        resp.precondition.status.code = 0
+        resp.precondition.valid_duration.FromTimedelta(
+            datetime.timedelta(seconds=60))
+        resp.precondition.valid_use_count = 10000
+        resp.precondition.referenced_attributes.CopyFrom(ref)
+        return resp.SerializeToString()
+    timeit("CheckResponse build+serialize", build_resp)
+
+    # quota instance build over a lazy bag (the 25% path)
+    store = workloads.make_store(200)
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    srv = RuntimeServer(store, ServerArgs(
+        default_manifest=workloads.MESH_MANIFEST, fused=False))
+    snap = srv.controller.dispatcher.snapshot
+    inst_q = [k for k in snap.instances if k.startswith("rq")]
+    if not inst_q:
+        inst_q = list(snap.instances)
+    inst = snap.instances[inst_q[0]]
+
+    def build_inst():
+        bag = LazyWireBag(req.attributes_raw, None, native_ok=True)
+        return inst.build(bag)
+    timeit("quota instance build (lazy bag, cold)", build_inst)
+
+    bag_warm = LazyWireBag(req.attributes_raw, None, native_ok=True)
+    bag_warm._decode()
+    timeit("quota instance build (decoded bag)",
+           lambda: inst.build(bag_warm))
+    srv.close()

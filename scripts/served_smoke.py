@@ -1,0 +1,69 @@
+"""Smoke-run the served bench section (optionally under a CPU hog) to
+prove the completion-counted rig cannot report an empty window, and to
+tune serving knobs against the real device transport.
+
+Usage: /opt/venv/bin/python scripts/served_smoke.py \
+           [--hog] [--rules N] [--conc N] [--n N]
+"""
+import argparse
+import json
+import multiprocessing
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _hog(stop_t: float) -> None:
+    x = 1.0
+    while time.time() < stop_t:
+        x = x * 1.0000001 + 1e-9
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hog", action="store_true")
+    ap.add_argument("--rules", type=int, default=200)
+    ap.add_argument("--conc", type=int, default=0,
+                    help="override client concurrency")
+    ap.add_argument("--n", type=int, default=0,
+                    help="override n_record")
+    ap.add_argument("--tpu-shape", action="store_true",
+                    help="use the on_tpu knob values")
+    args = ap.parse_args()
+
+    import bench
+    from istio_tpu.testing import perf
+
+    if args.conc or args.n:
+        # patch the knobs run_load is called with
+        orig = perf.run_load
+
+        def patched(target, payloads, n_record=2000, n_procs=4,
+                    concurrency=32, warmup_s=2.0, **kw):
+            if kw.get("method", "").endswith("BatchCheck"):
+                # batched phase: knobs are its own; pass through
+                return orig(target, payloads, n_record=n_record,
+                            n_procs=n_procs, concurrency=concurrency,
+                            warmup_s=warmup_s, **kw)
+            return orig(target, payloads,
+                        n_record=args.n or n_record,
+                        n_procs=n_procs,
+                        concurrency=args.conc or concurrency,
+                        warmup_s=warmup_s, **kw)
+        perf.run_load = patched
+
+    hog_proc = None
+    if args.hog:
+        hog_proc = multiprocessing.get_context("spawn").Process(
+            target=_hog, args=(time.time() + 600,), daemon=True)
+        hog_proc.start()
+        print("cpu hog running", file=sys.stderr)
+    t0 = time.time()
+    out = bench._served_bench(n_rules=args.rules, on_tpu=args.tpu_shape)
+    out["smoke_wall_s"] = round(time.time() - t0, 1)
+    if hog_proc is not None:
+        hog_proc.terminate()
+    print(json.dumps(out, indent=1))

@@ -44,9 +44,10 @@ def test_run_load_measures_real_requests(aio_server):
     payloads = perf.make_check_payloads(
         [{"request.path": "/ok"}, {"request.path": "/admin/x"}])
     report = perf.run_load(f"127.0.0.1:{aio_server}", payloads,
-                           duration_s=1.0, n_procs=1, concurrency=4,
+                           n_record=200, n_procs=1, concurrency=4,
                            warmup_s=0.2)
     assert report.n_requests > 0
+    assert report.n_requests + report.n_errors == 200
     assert report.checks_per_sec > 0
     assert report.p99_ms >= report.p50_ms > 0
 
@@ -57,5 +58,5 @@ def test_run_load_raises_when_attach_fails(aio_server):
     with pytest.raises(perf.PerfError):
         perf.run_load(f"127.0.0.1:{aio_server}",
                       [b"\xff\xff\xff\xff garbage protobuf"],
-                      duration_s=0.5, n_procs=1, concurrency=2,
+                      n_record=20, n_procs=1, concurrency=2,
                       warmup_s=0.1)
