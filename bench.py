@@ -397,11 +397,15 @@ def _overlay_bench(on_tpu: bool) -> dict:
                 t0 = time.perf_counter()
                 srv.check_many(bags)
                 best = min(best, time.perf_counter() - t0)
+            fused_lists = plan.fused_lists
+            unfused = list(plan.unfused_list_kinds)
         finally:
             srv.close()
         cps = batch / best
         baseline = 1e9 / (PER_PREDICATE_NS * n_rules)
         return {"overlay_rules": n_overlay,
+                "overlay_fused_lists": fused_lists,
+                "overlay_unfused_kinds": unfused,
                 "overlay_checks_per_sec": round(cps, 1),
                 "overlay_batch_ms": round(best * 1e3, 1),
                 "overlay_vs_baseline": round(cps / baseline, 2)}
@@ -637,53 +641,19 @@ def _grpc_ceiling_fields() -> dict:
     policy work) with the same client rig — served numbers are bounded
     by this structurally; reporting it keeps 'transport-bound' an
     evidenced claim instead of an excuse."""
-    import threading
-
     try:
-        import asyncio
-
-        import grpc
-        from grpc import aio
-
         from istio_tpu.testing import perf, workloads
+        from istio_tpu.testing.echo import start_echo_server
 
-        ready = threading.Event()
-        stop_box: list = [None, None]   # loop, server
-        port_box = [0]
-        resp = b"\x0a\x02\x08\x00"
-
-        def run_server() -> None:
-            async def echo(request, context):
-                return resp
-
-            async def serve():
-                server = aio.server()
-                handlers = {"Check": grpc.unary_unary_rpc_method_handler(
-                    echo, request_deserializer=lambda b: b,
-                    response_serializer=lambda b: b)}
-                server.add_generic_rpc_handlers((
-                    grpc.method_handlers_generic_handler(
-                        "istio.mixer.v1.Mixer", handlers),))
-                port_box[0] = server.add_insecure_port("127.0.0.1:0")
-                await server.start()
-                stop_box[0] = asyncio.get_running_loop()
-                stop_box[1] = server
-                ready.set()
-                await server.wait_for_termination()
-
-            asyncio.run(serve())
-
-        t = threading.Thread(target=run_server, daemon=True)
-        t.start()
-        if not ready.wait(30):
-            return {}
-        payloads = perf.make_check_payloads(
-            workloads.make_request_dicts(64))
-        rep = perf.run_load(f"127.0.0.1:{port_box[0]}", payloads,
-                            n_record=3000, n_procs=1, concurrency=256,
-                            warmup_s=1.0)
-        asyncio.run_coroutine_threadsafe(stop_box[1].stop(0.2),
-                                         stop_box[0])
+        port, stop = start_echo_server()
+        try:
+            payloads = perf.make_check_payloads(
+                workloads.make_request_dicts(64))
+            rep = perf.run_load(f"127.0.0.1:{port}", payloads,
+                                n_record=3000, n_procs=1,
+                                concurrency=256, warmup_s=1.0)
+        finally:
+            stop()
         return {"served_grpc_ceiling_per_sec": round(
             rep.checks_per_sec, 1)}
     except Exception as exc:

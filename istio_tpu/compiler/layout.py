@@ -492,4 +492,10 @@ class Tensorizer:
                 return order_key_bytes(v, vt)
             except Exception:
                 return ORDER_KEY_ERROR
-        return v.encode("utf-8") if isinstance(v, str) else None
+        if isinstance(v, str):
+            return v.encode("utf-8")
+        if isinstance(v, (bytes, bytearray)):
+            # IP/bytes values ride their raw bytes (CIDR list lowering
+            # compares them in v6-mapped space, models/policy_engine)
+            return bytes(v)
+        return None

@@ -314,6 +314,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                     dnf_cap: int = DEFAULT_DNF_CAP,
                     jit: bool = True,
                     extra_derived_keys: Sequence[tuple[str, str]] = (),
+                    extra_byte_sources: Sequence[Any] = (),
                     rule_pad: int = 1
                     ) -> RuleSetProgram:
     """Compile a rule snapshot. Never raises for individual bad rules —
@@ -323,7 +324,10 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
 
     `extra_derived_keys` adds (map, key) columns consumers outside the
     predicates need — e.g. listentry instances the fused engine turns
-    into id-membership scans (runtime/fused.py).
+    into id-membership scans (runtime/fused.py). `extra_byte_sources`
+    likewise adds byte slots (attr name or (map, key)) for consumers
+    that match VALUE BYTES rather than interned ids — REGEX/CIDR list
+    entries lowered to device DFA/prefix scans.
 
     `rule_pad` rounds the RULE-AXIS arrays (conj index matrices,
     rule_ns, attr_mask — and therefore the matched/err planes) up to a
@@ -382,7 +386,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     layout = build_layout(
         manifest,
         sorted(set(reqs.derived_keys) | set(extra_derived_keys)),
-        sorted(reqs.byte_sources, key=str),
+        sorted(set(reqs.byte_sources) | set(extra_byte_sources), key=str),
         extern_sources=[(n, k, ast) for (n, k), ast
                         in reqs.extern_sources.items()], **kwargs)
 

@@ -46,6 +46,7 @@ from istio_tpu.attribute.types import ValueType
 from istio_tpu.compiler.layout import (AttributeBatch, InternTable, Tensorizer)
 from istio_tpu.compiler.ruleset import Rule, RuleSetProgram, compile_ruleset
 from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.ops import bytes_ops
 from istio_tpu.utils.log import scope
 
 log = scope("models.policy_engine")
@@ -88,13 +89,28 @@ class DenySpec:
 @dataclasses.dataclass(frozen=True)
 class ListEntrySpec:
     """list adapter wiring for one rule (listentry template +
-    mixer/adapter/list): check `value_attr`'s id against a fixed list."""
+    mixer/adapter/list): check `value_attr`'s membership in a fixed
+    list. Three device lowerings by entry_type (list.go ListEntryType):
+
+      STRINGS       — interned-id equality scan (exact match)
+      REGEX         — packed byte-DFA bank over the value's byte slot
+                      (Go regexp search semantics, ops/regex_dfa);
+                      truncated values with no definitive prefix hit
+                      mark the rule's err bit (the byte-predicate
+                      truncation contract) and suppress the deny
+      IP_ADDRESSES  — CIDR prefix compare over the value's IP bytes in
+                      v6-mapped space, with v4/v6 version matching
+                      (host parity: list_adapter._member)
+
+    CASE_INSENSITIVE_STRINGS and provider-refreshed lists stay host-
+    side (runtime/fused.py enumerates them as unfusable)."""
     rule: int
     value_attr: str                # attribute (or (map,key)) whose value is checked
-    entries: Sequence[Any]         # list payload (strings/ints — interned)
+    entries: Sequence[Any]         # list payload per entry_type
     blacklist: bool = False       # True: member → deny; False: non-member → deny
     valid_duration_s: float = 5.0
     valid_use_count: int = 10000
+    entry_type: str = "STRINGS"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -173,9 +189,17 @@ class PolicyEngine:
                  count_rules: int | None = None):
         if ruleset is None:
             assert rules is not None and finder is not None
+            # REGEX/CIDR lists match value BYTES — their value attrs
+            # need byte (and, for map reads, derived) layout slots
+            # (the snapshot builder does the same, runtime/config.py)
+            lsrcs = [l.value_attr for l in lists
+                     if l.entry_type in ("REGEX", "IP_ADDRESSES")]
             ruleset = compile_ruleset(
                 rules, finder, interner=interner, max_str_len=max_str_len,
-                jit=False)
+                jit=False,
+                extra_derived_keys=[r for r in lsrcs
+                                    if isinstance(r, tuple)],
+                extra_byte_sources=sorted(set(lsrcs), key=str))
         self.ruleset = ruleset
         self.finder = finder
         lay = self.ruleset.layout
@@ -207,7 +231,8 @@ class PolicyEngine:
 
         # --- list tensors ---
         n_lists = len(lists)
-        max_entries = max((len(l.entries) for l in lists), default=1) or 1
+        max_entries = max((len(l.entries) for l in lists
+                           if l.entry_type == "STRINGS"), default=1) or 1
         list_ids = np.zeros((max(n_lists, 1), max_entries), np.int64)
         list_rule = np.zeros(max(n_lists, 1), np.int32)
         list_slot = np.zeros(max(n_lists, 1), np.int32)
@@ -216,12 +241,16 @@ class PolicyEngine:
         list_dur = np.full(max(n_lists, 1), _BIG, np.float32)
         list_uses = np.full(max(n_lists, 1), np.iinfo(np.int32).max, np.int32)
         for i, l in enumerate(lists):
-            ids = [interner.intern(e) for e in l.entries]
-            list_ids[i, :len(ids)] = ids
-            # pad with ID_INVALID: a present slot's id is never 0
-            # (constants ≥ 1, ephemerals ≤ -1), and absent slots are
-            # masked by `present`, so padding can never match
-            list_ids[i, len(ids):] = 0
+            if l.entry_type == "STRINGS":
+                ids = [interner.intern(e) for e in l.entries]
+                list_ids[i, :len(ids)] = ids
+                # pad with ID_INVALID: a present slot's id is never 0
+                # (constants ≥ 1, ephemerals ≤ -1), and absent slots are
+                # masked by `present`, so padding can never match
+                list_ids[i, len(ids):] = 0
+            # REGEX/IP rows keep all-zero id entries (member False from
+            # the id scan; their member columns are overwritten by the
+            # byte-level paths below)
             list_rule[i] = l.rule
             list_slot[i] = self._slot_for(l.value_attr)
             list_black[i] = l.blacklist
@@ -230,6 +259,8 @@ class PolicyEngine:
             list_code[i] = PERMISSION_DENIED if l.blacklist else NOT_FOUND
             list_dur[i] = l.valid_duration_s
             list_uses[i] = l.valid_use_count
+        rx_banks = self._build_regex_banks(lists)
+        cidr_bank = self._build_cidr_bank(lists)
 
         # --- rbac tensors ---
         n_rbac = len(rbacs)
@@ -286,6 +317,7 @@ class PolicyEngine:
         deny_dur_j = jnp.asarray(deny_dur)
         deny_uses_j = jnp.asarray(deny_uses)
         has_lists = n_lists > 0
+        max_len = self.ruleset.layout.max_str_len
         list_ids_j = jnp.asarray(list_ids)
         list_rule_j = jnp.asarray(list_rule)
         list_slot_j = jnp.asarray(list_slot)
@@ -339,7 +371,59 @@ class PolicyEngine:
                 sym_ok = batch.present[:, list_slot_j]
                 member = jnp.any(
                     sym[:, :, None] == list_ids_j[None, :, :], axis=2)
-                l_active = active[:, list_rule_j] & sym_ok
+                und = jnp.zeros_like(member)
+                for bank in rx_banks:
+                    # one packed DFA scan per value byte slot answers
+                    # every REGEX list over that subject
+                    s_data = batch.str_bytes[:, bank["bslot"]]
+                    s_lens = batch.str_lens[:, bank["bslot"]]
+                    if bank["packed"] is not None and b > 512:
+                        m = bytes_ops.dfa_match_many_onehot(
+                            s_data, s_lens, bank["packed"])
+                    else:
+                        m = bytes_ops.dfa_match_many(
+                            s_data, s_lens, bank["trans"],
+                            bank["accept"])
+                    m8 = m.astype(jnp.int8)
+                    hit = lax.dot_general(
+                        m8, bank["M"], dims,
+                        preferred_element_type=jnp.int32) > 0
+                    dec = lax.dot_general(
+                        m8, bank["M_def"], dims,
+                        preferred_element_type=jnp.int32) > 0
+                    # truncation contract (= byte predicates): a $-free
+                    # prefix hit is definitive; anything else on a
+                    # truncated value is undecidable → err the rule's
+                    # row, suppress the deny (fail-open, counted)
+                    trunc = (s_lens >= max_len)[:, None]
+                    member = member.at[:, bank["pos"]].set(
+                        jnp.where(trunc, dec, hit))
+                    und = und.at[:, bank["pos"]].set(trunc & ~dec)
+                if cidr_bank is not None:
+                    vb = batch.str_bytes[:, cidr_bank["bslots"], :16]
+                    vl = batch.str_lens[:, cidr_bank["bslots"]]
+                    mapped = jnp.zeros_like(vb)
+                    mapped = mapped.at[:, :, 10:12].set(255)
+                    mapped = mapped.at[:, :, 12:16].set(vb[:, :, 0:4])
+                    is4 = vl == 4
+                    v6m_pre = jnp.concatenate(
+                        [jnp.zeros(10, jnp.uint8),
+                         jnp.full(2, 255, jnp.uint8)])
+                    val_mapped = jnp.all(
+                        vb[:, :, :12] == v6m_pre[None, None, :], axis=2)
+                    v = jnp.where(is4[:, :, None], mapped, vb)
+                    val_ok = is4 | (vl == 16)
+                    val_v4 = is4 | ((vl == 16) & val_mapped)
+                    hit_e = jnp.all(
+                        (v[:, :, None, :] & cidr_bank["mask"][None]) ==
+                        cidr_bank["prefix"][None], axis=3)
+                    hit_e &= cidr_bank["valid"][None]
+                    hit_e &= (val_v4[:, :, None] ==
+                              cidr_bank["ent_v4"][None])
+                    member = member.at[:, cidr_bank["pos"]].set(
+                        jnp.any(hit_e, axis=2) & val_ok)
+                l_active = active[:, list_rule_j] & sym_ok & ~und
+                err = err.at[:, list_rule_j].max(und)
                 l_deny = l_active & (member == list_black_j[None, :])
                 l_key = jnp.where(l_deny, list_rule_j[None, :], BIGI)
                 l_arg = jnp.argmin(l_key, axis=1)
@@ -471,6 +555,116 @@ class PolicyEngine:
                                  "in a rule or add it to derived_keys")
             return lay.derived_slots[attr]
         return lay.slot_of(attr)
+
+    def _byte_slot_for(self, l: ListEntrySpec) -> int:
+        bslot = self.ruleset.layout.byte_slots.get(l.value_attr)
+        if bslot is None:
+            raise ValueError(
+                f"{l.entry_type} list value {l.value_attr!r} has no byte "
+                "slot; pass it via compile_ruleset(extra_byte_sources=...)")
+        return bslot
+
+    def _build_regex_banks(self, lists: Sequence[ListEntrySpec]) -> list:
+        """REGEX lists grouped by value byte slot → one packed DFA bank
+        per slot; patterns deduplicated within a bank (1,000 rules
+        sharing one handler share ONE DFA, not 1,000). Raises
+        UnsupportedRegex for patterns outside the DFA subset — callers
+        (runtime/fused.py) gate fusability on that."""
+        from istio_tpu.ops.regex_dfa import (pack_dfas, pack_dfas_classes,
+                                             pack_dfas_onehot,
+                                             compile_regex)
+
+        groups: dict[int, dict] = {}
+        for i, l in enumerate(lists):
+            if l.entry_type != "REGEX":
+                continue
+            bslot = self._byte_slot_for(l)
+            g = groups.setdefault(bslot, {"pat_idx": {}, "dfas": [],
+                                          "dollar": [], "lists": []})
+            idxs = []
+            for e in l.entries:
+                e = str(e)
+                j = g["pat_idx"].get(e)
+                if j is None:
+                    j = len(g["dfas"])
+                    g["pat_idx"][e] = j
+                    g["dfas"].append(compile_regex(e))
+                    g["dollar"].append("$" in e)
+                idxs.append(j)
+            g["lists"].append((i, idxs))
+        banks = []
+        for bslot in sorted(groups):
+            g = groups[bslot]
+            trans, accept = pack_dfas(g["dfas"])
+            classes = pack_dfas_classes(g["dfas"])
+            use_onehot = (classes["n_states"] ** 2 * classes["n_classes"]
+                          <= 4_000_000)
+            packed = pack_dfas_onehot(g["dfas"], classes) if use_onehot \
+                else None
+            dollar = np.asarray(g["dollar"], bool)
+            # [n_pats, n_lists_in_bank] membership, transposed for
+            # dot_general; M_def keeps only $-free patterns (whose
+            # prefix hits are definitive on truncated values)
+            m = np.zeros((len(g["dfas"]), len(g["lists"])), np.int8)
+            for r, (_, idxs) in enumerate(g["lists"]):
+                m[idxs, r] = 1
+            banks.append({
+                "bslot": bslot,
+                "trans": jnp.asarray(trans),
+                "accept": jnp.asarray(accept),
+                "packed": packed,
+                "M": jnp.asarray(m),
+                "M_def": jnp.asarray(m * (~dollar[:, None])),
+                "pos": jnp.asarray([i for i, _ in g["lists"]],
+                                   jnp.int32),
+            })
+        return banks
+
+    def _build_cidr_bank(self, lists: Sequence[ListEntrySpec]):
+        """IP_ADDRESSES lists → per-entry (prefix, mask) byte planes in
+        v6-mapped space. v4 nets map to ::ffff:0:0/96+len; membership
+        additionally requires the value's v4/v6 version to equal the
+        entry's (ipaddress `addr in net` is version-strict — host
+        parity with list_adapter._member)."""
+        import ipaddress
+
+        items = [(i, l) for i, l in enumerate(lists)
+                 if l.entry_type == "IP_ADDRESSES"]
+        if not items:
+            return None
+        n_c = len(items)
+        e_max = max((len(l.entries) for _, l in items), default=1) or 1
+        prefix = np.zeros((n_c, e_max, 16), np.uint8)
+        mask = np.zeros((n_c, e_max, 16), np.uint8)
+        valid = np.zeros((n_c, e_max), bool)
+        ent_v4 = np.zeros((n_c, e_max), bool)
+        bslots = np.zeros(n_c, np.int32)
+        pos = np.zeros(n_c, np.int32)
+        for r, (i, l) in enumerate(items):
+            bslots[r] = self._byte_slot_for(l)
+            pos[r] = i
+            for e_i, e in enumerate(l.entries):
+                net = ipaddress.ip_network(str(e), strict=False)
+                if net.version == 4:
+                    plen = net.prefixlen + 96
+                    addr = (b"\x00" * 10 + b"\xff\xff" +
+                            net.network_address.packed)
+                    ent_v4[r, e_i] = True
+                else:
+                    plen = net.prefixlen
+                    addr = net.network_address.packed
+                m_int = (((1 << plen) - 1) << (128 - plen)) if plen else 0
+                mbytes = m_int.to_bytes(16, "big")
+                prefix[r, e_i] = np.frombuffer(
+                    bytes(a & mm for a, mm in zip(addr, mbytes)),
+                    np.uint8)
+                mask[r, e_i] = np.frombuffer(mbytes, np.uint8)
+                valid[r, e_i] = True
+        return {"prefix": jnp.asarray(prefix), "mask": jnp.asarray(mask),
+                "valid": jnp.asarray(valid),
+                "ent_v4": jnp.asarray(ent_v4),
+                "bslots": jnp.asarray(bslots),
+                "pos": jnp.asarray(pos)}
 
     # ------------------------------------------------------------------
     def check(self, batch: AttributeBatch, req_ns: Any) -> CheckVerdict:

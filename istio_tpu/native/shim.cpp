@@ -401,7 +401,18 @@ int32_t shim_tensorize(void* h, const uint8_t* const* msgs,
     }
     for (const auto& kv : msg.bytes()) {
       const std::string* name = resolve_word(*sh, msg, kv.first);
-      if (name) set_scalar(*name, key_bytes(kv.second));
+      if (!name) continue;
+      set_scalar(*name, key_bytes(kv.second));
+      auto bit = L.byte_attr.find(*name);
+      if (bit != L.byte_attr.end()) {
+        uint8_t kind = L.byte_kind.at(*name);
+        // raw bytes ride the byte plane (CIDR list lowering compares
+        // IP bytes in v6-mapped space — layout._byte_source_value
+        // parity); bytes under a numeric order-key slot are
+        // unencodable
+        if (kind == 0) set_bytes_slot(bit->second, kv.second);
+        else set_key_error(bit->second);
+      }
     }
     for (const auto& kv : msg.timestamps()) {
       const std::string* name = resolve_word(*sh, msg, kv.first);

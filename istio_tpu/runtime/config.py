@@ -262,6 +262,26 @@ class SnapshotBuilder:
             if isinstance(ref, tuple):
                 derived.add(ref)
         kwargs["extra_derived_keys"] = sorted(derived)
+        # listentry instances feeding REGEX/IP_ADDRESSES list handlers
+        # additionally get a BYTE slot: their device lowering matches
+        # value bytes (DFA scan / CIDR prefix compare, runtime/fused.py)
+        # rather than interned ids
+        byte_srcs = set()
+        for rc in rules:
+            for a in rc.actions:
+                hc = handlers.get(a.handler)
+                if hc is None or hc.adapter != "list":
+                    continue
+                if hc.params.get("entry_type", "STRINGS") not in \
+                        ("REGEX", "IP_ADDRESSES"):
+                    continue
+                for iname in a.instances:
+                    if instance_templates.get(iname) != "listentry":
+                        continue
+                    ref = instances[iname].value_attr_ref()
+                    if ref is not None:
+                        byte_srcs.add(ref)
+        kwargs["extra_byte_sources"] = sorted(byte_srcs, key=str)
         # rule-axis padded to 8 so the matched/err planes shard evenly
         # over any mp ∈ {1,2,4,8} serving mesh (parallel/mesh.py)
         kwargs["rule_pad"] = 8

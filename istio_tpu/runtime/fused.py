@@ -12,10 +12,14 @@ providers, rules whose predicate fell back to the host oracle) is
 collected into `host_actions` for the dispatcher to overlay per
 request.
 
-Quota is deliberately NOT fused on the serving path: the gRPC quota
-loop (grpcServer.go:188-230) requires dedup-id replay semantics, which
-live in the host memquota adapter. The engine's device quota path
-remains the flagship all-device benchmark step.
+Quota IS on the served device path: QUOTA-variety actions are wired
+into `quota_actions`, the check step's activity bits say which quota
+rules matched each request (no re-resolve), and allocations ride the
+per-handler device counter pools in runtime/device_quota.py — a host
+dedup-replay cache in front (memquota.go:259 buildWithDedup
+semantics), batched device scatter-add allocation behind it. The host
+memquota adapter remains the fallback for non-memquota quota handlers
+and the generic (non-fused) dispatch path.
 """
 from __future__ import annotations
 
@@ -34,7 +38,7 @@ from istio_tpu.utils.log import scope
 
 log = scope("runtime.fused")
 
-_FUSABLE_LIST_TYPES = ("STRINGS",)
+_FUSABLE_LIST_TYPES = ("STRINGS", "REGEX", "IP_ADDRESSES")
 
 
 @dataclasses.dataclass
@@ -53,6 +57,10 @@ class FusedPlan:
     # rules whose rbac action is fused (device pseudo-rule NFA,
     # compiler/rbac_lower.py) — for status messages + diagnostics
     rbac_rules: frozenset = frozenset()
+    # why list actions stayed host-side, e.g. "CASE_INSENSITIVE_STRINGS",
+    # "provider-refreshed", "REGEX:unsupported-pattern" (bench
+    # enumeration of the unfusable envelope)
+    unfused_list_kinds: tuple = ()
     # QUOTA-variety wiring for the served quota loop
     # (grpcServer.go:188-230): [(rule idx, handler qname, instance
     # qname, accepted quota names)] in rule order. The rules' activity
@@ -266,6 +274,7 @@ def build_fused_plan(snapshot: Snapshot,
     deny_info: dict[int, tuple[int, str]] = {}
     lists: list[ListEntrySpec] = []
     list_rules: set[int] = set()
+    unfused_kinds: set[str] = set()
     rbacs: list[RbacSpec] = []
     rbac_rules: set[int] = set()
     host_actions: dict[int, list] = {}
@@ -333,7 +342,7 @@ def build_fused_plan(snapshot: Snapshot,
                 continue
             if hc.adapter == "list" and template == "listentry":
                 fused, host = _split_list_instances(
-                    snapshot, hc, inst_names, layout)
+                    snapshot, hc, inst_names, layout, unfused_kinds)
                 if pos == 0 and fused and not host:
                     fused_first.add(ridx)
                 for iname, value_attr in fused:
@@ -344,7 +353,9 @@ def build_fused_plan(snapshot: Snapshot,
                         valid_duration_s=float(
                             hc.params.get("caching_ttl_s", 300.0)),
                         valid_use_count=int(
-                            hc.params.get("caching_use_count", 10_000))))
+                            hc.params.get("caching_use_count", 10_000)),
+                        entry_type=str(hc.params.get("entry_type",
+                                                     "STRINGS"))))
                     list_rules.add(ridx)
                 if host:
                     add_host(ridx, (hc, template, host))
@@ -452,33 +463,88 @@ def build_fused_plan(snapshot: Snapshot,
                      inst_mask=inst_mask,
                      pred_map_mask=pred_map_mask[:, :n_maps]
                      if n_maps else np.zeros((n_rows, 0), np.int8),
-                     unmapped_instance_attrs=unmapped)
+                     unmapped_instance_attrs=unmapped,
+                     unfused_list_kinds=tuple(sorted(unfused_kinds)))
 
 
-def _split_list_instances(snapshot: Snapshot, hc, inst_names, layout
+def _split_list_instances(snapshot: Snapshot, hc, inst_names, layout,
+                          unfused_kinds: set | None = None
                           ) -> tuple[list, list]:
     """(fused [(iname, value_attr)], host [iname]) for a list action.
 
-    Fusable: case-sensitive exact-string lists from static overrides
-    whose instance value is a bare attribute reference with a layout
-    slot. CIDR/regex/case-insensitive entries and refreshable providers
-    keep list.go's host semantics (mixer/adapter/list/list.go:115-247).
-    """
+    Fusable entry types (each with its own device lowering in
+    models/policy_engine.py ListEntrySpec):
+      STRINGS       — static overrides, exact case-sensitive match
+      REGEX         — every pattern inside the DFA-compilable subset
+                      (ops/regex_dfa); value needs a byte slot
+      IP_ADDRESSES  — every entry a parseable CIDR/address; value must
+                      be an IP_ADDRESS/BYTES-typed attribute
+                      (string-rendered IPs keep host semantics) with a
+                      byte slot
+    CASE_INSENSITIVE_STRINGS and refreshable providers keep list.go's
+    host semantics (mixer/adapter/list/list.go:115-247); `unfused_kinds`
+    collects why an action stayed host-side (bench enumeration,
+    VERDICT r3 item 3)."""
     p: Mapping[str, Any] = hc.params
-    if (p.get("entry_type", "STRINGS") not in _FUSABLE_LIST_TYPES
-            or p.get("provider") is not None
-            or p.get("provider_url")):
+    et = p.get("entry_type", "STRINGS")
+
+    def reject(reason: str) -> tuple[list, list]:
+        if unfused_kinds is not None:
+            unfused_kinds.add(reason)
         return [], list(inst_names)
-    if not all(isinstance(e, str) for e in p.get("overrides", ())):
-        return [], list(inst_names)
+
+    if et not in _FUSABLE_LIST_TYPES:
+        return reject(et)
+    if p.get("provider") is not None or p.get("provider_url"):
+        return reject("provider-refreshed")
+    entries = p.get("overrides", ())
+    if et == "STRINGS":
+        if not all(isinstance(e, str) for e in entries):
+            return reject("STRINGS:non-string-entries")
+    elif et == "REGEX":
+        from istio_tpu.ops.regex_dfa import compile_regex
+        try:
+            for e in entries:
+                compile_regex(str(e))
+        except Exception:
+            return reject("REGEX:unsupported-pattern")
+    elif et == "IP_ADDRESSES":
+        import ipaddress
+        try:
+            for e in entries:
+                ipaddress.ip_network(str(e), strict=False)
+        except ValueError:
+            return reject("IP_ADDRESSES:bad-cidr")
+    from istio_tpu.attribute.types import ValueType
     fused, host = [], []
     for iname in inst_names:
         ref = snapshot.instances[iname].value_attr_ref()
         slot_ok = ref is not None and (
             ref in layout.derived_slots if isinstance(ref, tuple)
             else ref in layout.slots)
+        if et in ("REGEX", "IP_ADDRESSES"):
+            slot_ok = slot_ok and ref in layout.byte_slots
+        if et == "IP_ADDRESSES":
+            # the device compares RAW IP BYTES against binary CIDR
+            # prefixes — only IP_ADDRESS-typed attrs carry those.
+            # Map-derived (tuple) refs are utf-8 TEXT ("10.1.2.3");
+            # fusing them would compare text bytes against binary
+            # prefixes and flip verdicts — host parses instead.
+            if isinstance(ref, tuple) or \
+                    layout.manifest.get(ref) != ValueType.IP_ADDRESS:
+                slot_ok = False
+        elif not isinstance(ref, tuple) and \
+                layout.manifest.get(ref) == ValueType.IP_ADDRESS:
+            # STRINGS/REGEX over an IP-typed value: the host adapter
+            # normalizes the bytes to a textual IP before matching
+            # (list_adapter.handle_check); the device id scan interns
+            # bytes and strings under different tags and the byte plane
+            # carries binary — no lowering matches, keep host
+            slot_ok = False
         if slot_ok:
             fused.append((iname, ref))
         else:
             host.append(iname)
+            if unfused_kinds is not None:
+                unfused_kinds.add(f"{et}:value-not-lowerable")
     return fused, host

@@ -20,10 +20,14 @@ REPORTNOTHING = registry.register(TemplateInfo(
     name="reportnothing", variety=Variety.REPORT, fields=(),
     description="carries no data; signal-only reports"))
 
-# mixer/template/listentry/template.proto:25 — one string value
+# mixer/template/listentry/template.proto:25 — one string value.
+# IP_ADDRESS additionally accepted: the wire carries IPs as bytes, the
+# list adapter normalizes them (list_adapter.handle_check), and the
+# fused engine lowers CIDR membership over those bytes on device.
 LISTENTRY = registry.register(TemplateInfo(
     name="listentry", variety=Variety.CHECK,
-    fields=(Field("value", V.STRING, required=True),),
+    fields=(Field("value", V.STRING, required=True,
+                  accepts=(V.IP_ADDRESS,)),),
     description="membership check of one value against a list adapter"))
 
 # mixer/template/quota/template.proto — dimensions map

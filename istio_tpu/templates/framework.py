@@ -57,6 +57,11 @@ class Field:
     submessage: tuple["Field", ...] | None = None
     required: bool = False
     default: Any = None
+    # additional accepted expression types beyond `type` (e.g.
+    # listentry.value accepts IP_ADDRESS so IP lists can check
+    # `source.ip` directly — the wire carries IPs as bytes and the
+    # list adapter normalizes them, list_adapter.handle_check)
+    accepts: tuple[ValueType, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,7 +139,8 @@ def infer_types(info: TemplateInfo, params: Mapping[str, Any],
                     for k, v in raw.items()}
             else:
                 t = eval_type(parse(raw), finder, DEFAULT_FUNCS)
-                if f.type is not V.UNSPECIFIED and t != f.type:
+                if f.type is not V.UNSPECIFIED and t != f.type \
+                        and t not in f.accepts:
                     raise TemplateError(
                         f"{info.name}.{f.name}: expression '{raw}' has type "
                         f"{t.name}, expected {f.type.name}")

@@ -1,0 +1,59 @@
+"""Loopback echo gRPC server — the transport-ceiling harness.
+
+An aio server whose Check handler returns canned bytes with zero policy
+work: loading it with the perf rig measures the box's python-grpc
+structural ceiling, the upper bound for ANY served number (bench.py
+reports it as served_grpc_ceiling_per_sec so "transport-bound" stays an
+evidenced claim).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+_CANNED = b"\x0a\x02\x08\x00"
+
+
+def start_echo_server(address: str = "127.0.0.1:0",
+                      response: bytes = _CANNED
+                      ) -> tuple[int, Callable[[], None]]:
+    """Start the echo server on its own loop thread.
+    → (port, stop()); raises RuntimeError if it fails to come up."""
+    import asyncio
+
+    import grpc
+    from grpc import aio
+
+    ready = threading.Event()
+    box: list = [0, None, None]   # port, loop, server
+
+    def run() -> None:
+        async def echo(request, context):
+            return response
+
+        async def serve():
+            server = aio.server()
+            handlers = {"Check": grpc.unary_unary_rpc_method_handler(
+                echo, request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)}
+            server.add_generic_rpc_handlers((
+                grpc.method_handlers_generic_handler(
+                    "istio.mixer.v1.Mixer", handlers),))
+            box[0] = server.add_insecure_port(address)
+            await server.start()
+            box[1] = asyncio.get_running_loop()
+            box[2] = server
+            ready.set()
+            await server.wait_for_termination()
+
+        asyncio.run(serve())
+
+    threading.Thread(target=run, daemon=True).start()
+    if not ready.wait(30):
+        raise RuntimeError("echo server failed to start")
+
+    def stop() -> None:
+        import asyncio
+        asyncio.run_coroutine_threadsafe(box[2].stop(0.2), box[1])
+
+    return box[0], stop

@@ -10,52 +10,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 if __name__ == "__main__":
-    import asyncio
-    import threading
-
-    import grpc
-    from grpc import aio
-
     from istio_tpu.testing import perf, workloads
+    from istio_tpu.testing.echo import start_echo_server
 
+    port, stop = start_echo_server()
     payloads = perf.make_check_payloads(
         workloads.make_request_dicts(128))
-    resp = b"\x0a\x02\x08\x00"   # tiny canned bytes
-
-    ready = threading.Event()
-    port_box = [0]
-
-    def run_server():
-        async def echo(request, context):
-            return resp
-
-        async def serve():
-            server = aio.server()
-            handlers = {
-                "Check": grpc.unary_unary_rpc_method_handler(
-                    echo,
-                    request_deserializer=lambda b: b,
-                    response_serializer=lambda b: b),
-            }
-            server.add_generic_rpc_handlers((
-                grpc.method_handlers_generic_handler(
-                    "istio.mixer.v1.Mixer", handlers),))
-            port_box[0] = server.add_insecure_port("127.0.0.1:0")
-            await server.start()
-            ready.set()
-            await server.wait_for_termination()
-
-        asyncio.run(serve())
-
-    t = threading.Thread(target=run_server, daemon=True)
-    t.start()
-    ready.wait(10)
-
-    for conc in (256, 2048):
-        t0 = time.time()
-        rep = perf.run_load(f"127.0.0.1:{port_box[0]}", payloads,
-                            n_record=8000, n_procs=1, concurrency=conc,
-                            warmup_s=1.0)
-        print(f"conc={conc}: {rep.checks_per_sec:.0f}/s "
-              f"p50={rep.p50_ms:.1f}ms p99={rep.p99_ms:.1f}ms "
-              f"err={rep.n_errors} wall={time.time() - t0:.0f}s")
+    try:
+        for conc in (256, 2048):
+            t0 = time.time()
+            rep = perf.run_load(f"127.0.0.1:{port}", payloads,
+                                n_record=8000, n_procs=1,
+                                concurrency=conc, warmup_s=1.0)
+            print(f"conc={conc}: {rep.checks_per_sec:.0f}/s "
+                  f"p50={rep.p50_ms:.1f}ms p99={rep.p99_ms:.1f}ms "
+                  f"err={rep.n_errors} wall={time.time() - t0:.0f}s")
+    finally:
+        stop()

@@ -30,10 +30,21 @@ def _store() -> MemStore:
     s.set(("handler", "istio-system", "verwhitelist"), {
         "adapter": "list",
         "params": {"overrides": ["v1", "v2"], "blacklist": False}})
-    # host: regex entry type can't lower to id equality
+    # fused since r4: static REGEX entries lower to a device DFA bank
     s.set(("handler", "istio-system", "rxlist"), {
         "adapter": "list",
         "params": {"overrides": ["^/api/"], "entry_type": "REGEX",
+                   "blacklist": True}})
+    # fused since r4: CIDR entries lower to device prefix compares
+    s.set(("handler", "istio-system", "ipblock"), {
+        "adapter": "list",
+        "params": {"overrides": ["10.0.0.0/8", "2001:db8::/32"],
+                   "entry_type": "IP_ADDRESSES", "blacklist": True}})
+    # host: case-insensitive matching has no device lowering
+    s.set(("handler", "istio-system", "cilist"), {
+        "adapter": "list",
+        "params": {"overrides": ["Mozilla"],
+                   "entry_type": "CASE_INSENSITIVE_STRINGS",
                    "blacklist": True}})
     s.set(("handler", "istio-system", "denyall"), {
         "adapter": "denier",
@@ -50,6 +61,8 @@ def _store() -> MemStore:
         "params": {"value": 'source.labels["version"] | "none"'}})
     s.set(("instance", "istio-system", "path"), {
         "template": "listentry", "params": {"value": "request.path"}})
+    s.set(("instance", "istio-system", "srcip"), {
+        "template": "listentry", "params": {"value": "source.ip"}})
     s.set(("instance", "istio-system", "nothing"), {
         "template": "checknothing", "params": {}})
     # global rules (config namespace = mesh-wide)
@@ -87,6 +100,12 @@ def _store() -> MemStore:
         "actions": [{"handler": "denyall", "instances": ["nothing"]},
                     {"handler": "verwhitelist",
                      "instances": ["appversion"]}]})
+    s.set(("rule", "istio-system", "r8-ip"), {
+        "match": 'request.scheme == "https"',
+        "actions": [{"handler": "ipblock", "instances": ["srcip"]}]})
+    s.set(("rule", "istio-system", "r9-ci"), {
+        "match": 'request.useragent == "x"',
+        "actions": [{"handler": "cilist", "instances": ["ua"]}]})
     return s
 
 
@@ -126,6 +145,27 @@ def _bags():
         # whitelist miss — denier's status wins on both paths
         {"request.method": "DELETE",
          "source.labels": {"version": "v9"}},
+        # CIDR list (device prefix compare) — v4-mapped 16-byte hit,
+        # 4-byte raw hit, v6 net hit, v4 miss
+        {"request.scheme": "https",
+         "source.ip": b"\x00" * 10 + b"\xff\xff" + bytes([10, 1, 2, 3])},
+        {"request.scheme": "https", "source.ip": bytes([10, 0, 0, 1])},
+        {"request.scheme": "https",
+         "source.ip": bytes.fromhex("20010db8") + b"\x00" * 12},
+        {"request.scheme": "https",
+         "source.ip": b"\x00" * 10 + b"\xff\xff" + bytes([11, 1, 2, 3])},
+        # case-insensitive list stays host-side on both paths
+        {"request.useragent": "x",
+         "request.headers": {"user-agent": "mozilla"}},
+        # REGEX truncation contract: a $-free prefix hit on a truncated
+        # value is definitive (deny on both paths); a truncated miss is
+        # undecidable → device errs the rule and fails open, matching
+        # the host's allow here because the full value has no match
+        # either
+        {"request.scheme": "http",
+         "request.path": "/api/" + "x" * 200},
+        {"request.scheme": "http",
+         "request.path": "/web/" + "x" * 200},
     ]
     return [bag_from_mapping(c) for c in cases]
 
@@ -149,12 +189,18 @@ def test_plan_extraction(servers):
     # r0 + r6 + r7 fuse (ordered comparisons lower via byte order
     # keys since r3); r5 (dynamic map key) stays host-fallback
     assert plan.fused_deny == 3
-    assert plan.fused_lists == 2         # srcns + ua; appversion/path host
+    # srcns + ua + rx path + cidr srcip; appversion (fallback expr)
+    # and the case-insensitive list stay host
+    assert plan.fused_lists == 4
     host_rules = {snap.rules[i].name for i in plan.host_actions}
     assert "r3-version" in host_rules    # `|` fallback expr
-    assert "r4-rx" in host_rules         # regex entry type
+    assert "r4-rx" not in host_rules     # REGEX fuses since r4
+    assert "r8-ip" not in host_rules     # CIDR fuses since r4
+    assert "r9-ci" in host_rules         # case-insensitive: host
     assert "r5-dynkey" in host_rules     # predicate host fallback
     assert "r6-prodonly" not in host_rules   # GTR now on device
+    assert "CASE_INSENSITIVE_STRINGS" in plan.unfused_list_kinds
+    assert "STRINGS:value-not-lowerable" in plan.unfused_list_kinds
 
 
 def test_fused_matches_generic(servers):
@@ -187,6 +233,66 @@ def test_fused_statuses(servers):
     assert r[14].status_code == OK                 # other ns: inert
     assert r[15].status_code == PERMISSION_DENIED  # lowest rule wins
     assert r[15].status_message == "admin is off limits"
+
+
+def test_ip_typed_values_keep_host_semantics():
+    """Two configs that LOOK fusable but must stay host-side: a STRINGS
+    list over an IP_ADDRESS-typed value (host normalizes bytes to a
+    textual IP before matching — the id scan never would), and an
+    IP_ADDRESSES list over a map-derived TEXT value (the device
+    compares raw bytes against binary CIDR prefixes — text would flip
+    verdicts). Both were reproduced as fused-vs-generic divergences in
+    the r4 review."""
+    def store() -> MemStore:
+        s = MemStore()
+        s.set(("handler", "istio-system", "strlist"), {
+            "adapter": "list",
+            "params": {"overrides": ["10.0.0.1"], "blacklist": False}})
+        s.set(("handler", "istio-system", "iptext"), {
+            "adapter": "list",
+            "params": {"overrides": ["10.0.0.0/8"],
+                       "entry_type": "IP_ADDRESSES",
+                       "blacklist": False}})
+        s.set(("instance", "istio-system", "ipinst"), {
+            "template": "listentry", "params": {"value": "source.ip"}})
+        s.set(("instance", "istio-system", "hdrip"), {
+            "template": "listentry",
+            "params": {"value": 'request.headers["x-ip"]'}})
+        s.set(("rule", "istio-system", "r0"), {
+            "match": 'request.scheme == "http"',
+            "actions": [{"handler": "strlist", "instances": ["ipinst"]}]})
+        s.set(("rule", "istio-system", "r1"), {
+            "match": 'request.scheme == "https"',
+            "actions": [{"handler": "iptext", "instances": ["hdrip"]}]})
+        return s
+
+    fused = RuntimeServer(store(), ServerArgs(fused=True))
+    generic = RuntimeServer(store(), ServerArgs(fused=False))
+    try:
+        plan = fused.controller.dispatcher.fused
+        assert plan.fused_lists == 0
+        assert "STRINGS:value-not-lowerable" in plan.unfused_list_kinds
+        assert "IP_ADDRESSES:value-not-lowerable" in \
+            plan.unfused_list_kinds
+        bags = [bag_from_mapping(c) for c in (
+            {"request.scheme": "http",
+             "source.ip": bytes([10, 0, 0, 1])},      # listed (as text)
+            {"request.scheme": "http",
+             "source.ip": bytes([10, 9, 9, 9])},      # not listed
+            {"request.scheme": "https",
+             "request.headers": {"x-ip": "10.1.2.3"}},   # in CIDR
+            {"request.scheme": "https",
+             "request.headers": {"x-ip": "11.1.2.3"}},   # outside
+        )]
+        rf = fused.check_many(bags)
+        rg = generic.check_many(bags)
+        assert [r.status_code for r in rg] == [OK, NOT_FOUND,
+                                               OK, NOT_FOUND]
+        for i, (a, g) in enumerate(zip(rf, rg)):
+            assert a.status_code == g.status_code, i
+    finally:
+        fused.close()
+        generic.close()
 
 
 def test_wire_fast_path_zero_decode():
