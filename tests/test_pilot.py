@@ -406,3 +406,29 @@ def test_route_nfa_synthetic_world_parity():
     assert (sel != rt.default_index).sum() > 10   # workload exercises it
     for i, req in enumerate(reqs):
         assert rt.select_host(req) == sel[i], i
+
+
+def test_route_select_wire_matches_select():
+    """select_wire (C++ decode + device argmax, the sidecar-facing
+    fast path) selects the same winners as select() over dict bags,
+    and block=False returns a pipelineable device array."""
+    import jax
+
+    from istio_tpu.api import mixer_pb2 as pb
+    from istio_tpu.api.wire import bag_to_compressed
+    from istio_tpu.testing import workloads
+
+    services, rules = workloads.make_route_world(120)
+    rt = RouteTable(services, rules)
+    reqs = workloads.make_route_requests(64, n_services=len(services))
+    wires = []
+    for r in reqs:
+        msg = pb.CompressedAttributes()
+        bag_to_compressed(r, msg=msg)
+        wires.append(msg.SerializeToString())
+    got = rt.select_wire(wires)
+    want = rt.select(reqs)
+    assert (got == want).all()
+    async_out = rt.select_wire(wires, block=False)
+    jax.block_until_ready(async_out)
+    assert (np.asarray(async_out) == want).all()
