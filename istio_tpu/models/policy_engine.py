@@ -61,20 +61,9 @@ UNAVAILABLE = 14
 _BIG = np.float32(3.4e38)
 
 
-def _batch_rank(key: Any) -> Any:
-    """rank[i] = #{j < i in sort order : key[j] == key[i]} — the
-    occurrence index of each element within its key group. Inactive
-    elements should carry a sentinel key; their ranks are unused."""
-    n = key.shape[0]
-    order = jnp.argsort(key, stable=True)
-    sk = key[order]
-    idx = jnp.arange(n, dtype=jnp.int32)
-    newseg = jnp.concatenate(
-        [jnp.ones(1, bool), sk[1:] != sk[:-1]])
-    seg_first = lax.associative_scan(jnp.maximum,
-                                     jnp.where(newseg, idx, 0))
-    rank_sorted = idx - seg_first
-    return jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+# occurrence rank within key groups — single-sourced with the rolling
+# quota kernels (models/quota_alloc.batch_rank)
+from istio_tpu.models.quota_alloc import batch_rank as _batch_rank  # noqa: E402
 
 
 @dataclasses.dataclass(frozen=True)

@@ -25,6 +25,21 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def batch_rank(key):
+    """rank[i] = #{j < i in stable sort order : key[j] == key[i]} — the
+    occurrence index of each element within its key group (shared with
+    the engine's fused quota step; sentinel keys get unused ranks)."""
+    n = key.shape[0]
+    order = jnp.argsort(key, stable=True)
+    sk = key[order]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    newseg = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+    seg_first = lax.associative_scan(jnp.maximum,
+                                     jnp.where(newseg, idx, 0))
+    rank_sorted = idx - seg_first
+    return jnp.zeros(n, jnp.int32).at[order].set(rank_sorted)
+
+
 def make_alloc_step(n_buckets: int, jit: bool = True):
     """→ (scan_fn, fast_fn), each
     fn(counts[i32 n_buckets], buckets[i32 B], amounts[i32 B],
@@ -47,7 +62,11 @@ def make_alloc_step(n_buckets: int, jit: bool = True):
         used = counts[buckets]
         avail = max_amounts - used
         g_be = jnp.clip(jnp.minimum(amounts, avail), 0)
-        g_ao = jnp.where(avail >= amounts, amounts, 0)
+        # grants never go negative: the host adapter clamps to 0 and
+        # commits nothing (_Window.alloc / _Exact.alloc) — without the
+        # amounts > 0 guard a wire-supplied negative amount would
+        # DRAIN the counter below real usage
+        g_ao = jnp.where((avail >= amounts) & (amounts > 0), amounts, 0)
         g = jnp.where(active,
                       jnp.where(best_effort, g_be, g_ao),
                       0).astype(jnp.int32)
@@ -75,7 +94,7 @@ def make_alloc_step(n_buckets: int, jit: bool = True):
             consumed = jnp.where(new, 0, consumed)
             avail = mx - used0 - consumed
             g_be = jnp.clip(jnp.minimum(amt, avail), 0)
-            g_ao = jnp.where(avail >= amt, amt, 0)
+            g_ao = jnp.where((avail >= amt) & (amt > 0), amt, 0)
             g = jnp.where(act, jnp.where(be, g_be, g_ao), 0)
             return consumed + g, g
 
@@ -91,3 +110,120 @@ def make_alloc_step(n_buckets: int, jit: bool = True):
         return (jax.jit(step, donate_argnums=(0,)),
                 jax.jit(step_fast, donate_argnums=(0,)))
     return step, step_fast
+
+
+def make_rolling_alloc_step(n_buckets: int, k_ticks: int,
+                            jit: bool = True):
+    """Rolling-window variant: counters are per-(bucket, tick-slot)
+    planes [n_buckets, K]; a batch first ROLLS each touched bucket
+    (reclaiming slots whose ticks left the window — memquota.go
+    rollingWindow.roll), then allocates against
+    avail = max - sum(live slots) and commits grants into the current
+    tick's slot (rollingWindow.alloc :118).
+
+    → (scan_fn, fast_fn, unit_fn), each
+    fn(slots[i32 n_buckets×K], buckets[i32 B], amounts[i32 B],
+       best_effort[bool B], max_amounts[i32 B], active[bool B],
+       ticks[i32 B], last_ticks[i32 B], rolling[bool B])
+    → (granted[i32 B], new_slots).
+
+    Ticks are caller-rebased ints (host: floor(now / tick_len) minus a
+    per-bucket base — int32-safe and boundary-exact vs the host
+    adapter's absolute ticks). rolling=False rows (exact cells, padding)
+    never roll and commit to slot 0 — slot 0 of an exact bucket IS its
+    counter, so exact and rolling cells share one plane. Rows sharing a
+    bucket within a batch carry identical (tick, last) — the roll is
+    idempotent under the duplicate multiply-scatter."""
+
+    def _roll_and_used(slots, buckets, ticks, last, rolling, active):
+        p = jnp.arange(k_ticks, dtype=jnp.int32)
+        delta = jnp.clip(ticks - last, 0, k_ticks)
+        delta = jnp.where(rolling & active, delta, 0)
+        zmask = ((p[None, :] - last[:, None] - 1) % k_ticks) \
+            < delta[:, None]
+        keep = 1 - zmask.astype(slots.dtype)
+        slots = slots.at[buckets].mul(keep)
+        used = slots[buckets].sum(axis=1)
+        return slots, used
+
+    def _commit(slots, buckets, ticks, rolling, granted):
+        col = jnp.where(rolling, ticks % k_ticks, 0)
+        return slots.at[buckets, col].add(granted)
+
+    def step_fast(slots, buckets, amounts, best_effort, max_amounts,
+                  active, ticks, last_ticks, rolling):
+        """EXACT only when every active bucket appears at most once in
+        the batch (caller checks host-side)."""
+        slots = jnp.asarray(slots)
+        slots, used = _roll_and_used(slots, buckets, ticks, last_ticks,
+                                     rolling, active)
+        avail = max_amounts - used
+        g_be = jnp.clip(jnp.minimum(amounts, avail), 0)
+        # negative-amount clamp — see make_alloc_step.step_fast
+        g_ao = jnp.where((avail >= amounts) & (amounts > 0), amounts, 0)
+        g = jnp.where(active,
+                      jnp.where(best_effort, g_be, g_ao),
+                      0).astype(jnp.int32)
+        return g, _commit(slots, buckets, ticks, rolling, g)
+
+    def step(slots, buckets, amounts, best_effort, max_amounts,
+             active, ticks, last_ticks, rolling):
+        """Sequential-within-batch parity under contention (same
+        grant-dependent scan as make_alloc_step)."""
+        slots = jnp.asarray(slots)
+        buckets = jnp.asarray(buckets)
+        active = jnp.asarray(active)
+        slots, used = _roll_and_used(slots, buckets, ticks, last_ticks,
+                                     rolling, active)
+        b = buckets.shape[0]
+        order = jnp.argsort(buckets, stable=True)
+        sb = buckets[order]
+        sa = jnp.where(active, amounts, 0)[order]
+        se = best_effort[order]
+        sm = max_amounts[order]
+        sact = active[order]
+        newseg = jnp.concatenate(
+            [jnp.ones(1, bool), sb[1:] != sb[:-1]])
+        base_used = used[order]
+
+        def body(carry, x):
+            consumed = carry
+            new, used0, amt, be, mx, act = x
+            consumed = jnp.where(new, 0, consumed)
+            avail = mx - used0 - consumed
+            g_be = jnp.clip(jnp.minimum(amt, avail), 0)
+            g_ao = jnp.where((avail >= amt) & (amt > 0), amt, 0)
+            g = jnp.where(act, jnp.where(be, g_be, g_ao), 0)
+            return consumed + g, g
+
+        _, sg = lax.scan(
+            body, jnp.int32(0),
+            (newseg, base_used, sa, se, sm, sact))
+        granted = jnp.zeros(b, jnp.int32).at[order].set(sg)
+        return granted, _commit(slots, buckets, ticks, rolling,
+                                jnp.where(active, granted, 0))
+
+    def step_unit(slots, buckets, amounts, best_effort, max_amounts,
+                  active, ticks, last_ticks, rolling):
+        """Contended batches where EVERY active amount == 1 (the
+        dominant serving shape — rate limits allocate one unit per
+        request): best-effort and all-or-nothing coincide, and the
+        sequential-within-bucket grant reduces to `rank within bucket
+        run < avail` — one parallel sort instead of an O(B) scan.
+        `amounts`/`best_effort` ride the signature for symmetry; the
+        caller guarantees amounts[active] == 1."""
+        slots = jnp.asarray(slots)
+        slots, used = _roll_and_used(slots, buckets, ticks, last_ticks,
+                                     rolling, active)
+        avail = max_amounts - used
+        key = jnp.where(active, buckets,
+                        jnp.iinfo(jnp.int32).max)
+        rank = batch_rank(key)
+        g = (active & (rank < avail)).astype(jnp.int32)
+        return g, _commit(slots, buckets, ticks, rolling, g)
+
+    if jit:
+        return (jax.jit(step, donate_argnums=(0,)),
+                jax.jit(step_fast, donate_argnums=(0,)),
+                jax.jit(step_unit, donate_argnums=(0,)))
+    return step, step_fast, step_unit

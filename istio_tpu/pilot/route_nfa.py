@@ -201,7 +201,13 @@ class RouteTable:
             from istio_tpu.native.tensorizer import NativeTensorizer
             return NativeTensorizer(self.program.layout,
                                     self.program.interner)
-        except Exception:
+        except Exception as exc:
+            # select_wire silently serving the python fallback forever
+            # would read as an unexplained throughput collapse
+            import logging
+            logging.getLogger("istio_tpu.pilot.route_nfa").warning(
+                "native tensorizer unavailable, route wire path "
+                "serving with the python decoder: %s", exc)
             return None
 
     def select_wire(self, wires: Sequence[bytes], block: bool = True):

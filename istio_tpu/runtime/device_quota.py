@@ -18,11 +18,15 @@ Rule matching reuses the CHECK step's activity bits: the fused plan
 exposes which quota-bearing rules matched each request
 (CheckResponse.active_quota_rules), so the quota loop never re-resolves.
 
-Windowing: memquota's 10-tick rolling window is approximated by a
-FIXED window — counters reset every `valid_duration_s` (the engine-side
-QuotaSpec stance, SURVEY §2.3). Exact counters (duration 0) match the
-host `_Exact` cell exactly; the parity tests pin that case, plus dedup
-replay and best-effort semantics, against MemQuotaHandler.
+Windowing (r4): ROLLING windows with host-adapter parity — counters
+are per-(bucket, tick-slot) planes; each flush rolls the touched
+buckets (reclaiming slots whose ticks left the window) before
+allocating, exactly like adapters/memquota._Window (the reference's
+rollingWindow.go quantized to _TICKS_PER_WINDOW slots per window).
+Exact counters (duration 0) live in slot 0 of the same plane and match
+the host `_Exact` cell; the parity tests pin both, plus dedup replay
+and best-effort semantics, against MemQuotaHandler under an injected
+clock.
 
 State is per-replica and best-effort, like the reference. Pools are
 REUSED across config generations when the (handler signature, quota
@@ -39,10 +43,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from istio_tpu.adapters.memquota import _TICKS_PER_WINDOW
 from istio_tpu.adapters.memquota import _key as dims_key
 from istio_tpu.adapters.sdk import QuotaArgs, QuotaResult
 from istio_tpu.models.policy_engine import RESOURCE_EXHAUSTED
-from istio_tpu.models.quota_alloc import make_alloc_step
+from istio_tpu.models.quota_alloc import make_rolling_alloc_step
 from istio_tpu.utils.log import scope
 
 log = scope("runtime.device_quota")
@@ -80,14 +85,19 @@ class DeviceQuotaPool:
         self._lock = threading.Lock()
         self._bucket_of: dict[str, int] = {}
         self._dedup: dict[str, tuple[int, float]] = {}
-        # per-bucket window bookkeeping: fixed-window reset timestamps
-        # are tracked lazily per bucket (duration varies by quota name)
-        self._window_start: np.ndarray = np.zeros(n_buckets, np.float64)
-        self._bucket_duration: np.ndarray = np.zeros(n_buckets,
-                                                     np.float64)
-        self.counts = jnp.zeros(n_buckets, jnp.int32)
-        self._alloc_scan, self._alloc_fast = make_alloc_step(n_buckets,
-                                                             jit=jit)
+        # rolling-window bookkeeping (host side): tick length per
+        # bucket (0 = exact cell), the last tick each bucket rolled to
+        # (absolute), and a per-bucket tick base so device ticks stay
+        # small rebased int32s while HOST tick boundaries (floor of
+        # absolute now / tick_len) match adapters/memquota._Window
+        # exactly
+        self.k_ticks = _TICKS_PER_WINDOW
+        self._tick_len: np.ndarray = np.zeros(n_buckets, np.float64)
+        self._last_tick: np.ndarray = np.zeros(n_buckets, np.int64)
+        self._tick_base: np.ndarray = np.zeros(n_buckets, np.int64)
+        self.counts = jnp.zeros((n_buckets, self.k_ticks), jnp.int32)
+        self._alloc_scan, self._alloc_fast, self._alloc_unit = \
+            make_rolling_alloc_step(n_buckets, self.k_ticks, jit=jit)
         # pending batched allocations: [(bucket, amount, best_effort,
         # max, future)]
         self._pending: list = []
@@ -97,7 +107,7 @@ class DeviceQuotaPool:
         self._wake = threading.Condition(self._lock)
         self._closed = False
         # compile every program the serving path can hit (both pad
-        # shapes × both alloc variants + the window-reset scatter)
+        # shapes × all three alloc variants: fast/scan/unit)
         # BEFORE the worker starts — a first-quota-batch compile
         # mid-serve stalls every pending quota future behind it for
         # seconds behind a device tunnel (observed r4: 60s quota waits
@@ -177,12 +187,12 @@ class DeviceQuotaPool:
         for pn in {self._small_batch, self._max_batch}:
             zeros_i = jnp.zeros(pn, jnp.int32)
             zeros_b = jnp.zeros(pn, bool)
-            for fn in (self._alloc_scan, self._alloc_fast):
+            for fn in (self._alloc_scan, self._alloc_fast,
+                       self._alloc_unit):
                 # all-inactive batch: grants nothing, counters unchanged
                 _, self.counts = fn(self.counts, zeros_i, zeros_i,
-                                    zeros_b, zeros_i, zeros_b)
-        drop = jnp.full(self._small_batch, self.n_buckets, jnp.int32)
-        self.counts = self.counts.at[drop].set(0, mode="drop")
+                                    zeros_b, zeros_i, zeros_b,
+                                    zeros_i, zeros_i, zeros_b)
         jax.block_until_ready(self.counts)
 
     def _bucket_for(self, key: str, lim: Mapping[str, Any],
@@ -193,8 +203,13 @@ class DeviceQuotaPool:
                 return -1
             b = len(self._bucket_of)
             self._bucket_of[key] = b
-            self._window_start[b] = now
-            self._bucket_duration[b] = lim["duration"]
+            dur = lim["duration"]
+            if dur > 0:
+                tl = dur / self.k_ticks    # _Window.tick_len parity
+                tick0 = int(now / tl)
+                self._tick_len[b] = tl
+                self._tick_base[b] = tick0
+                self._last_tick[b] = tick0
         return b
 
     def _run(self) -> None:
@@ -259,7 +274,6 @@ class DeviceQuotaPool:
         if not batch:
             return
         n = len(batch)
-        self._roll_windows(now, [b for b, *_ in batch])
         # pad to one of TWO fixed shapes: every distinct shape is its
         # own XLA compile (multi-second behind a device tunnel), and a
         # mid-serve compile stalls every quota future behind it past
@@ -272,18 +286,41 @@ class DeviceQuotaPool:
         be = np.zeros(pn, bool)
         mx = np.zeros(pn, np.int32)
         active = np.zeros(pn, bool)
+        ticks = np.zeros(pn, np.int32)
+        lasts = np.zeros(pn, np.int32)
+        rolling = np.zeros(pn, bool)
+        roll_updates: list[tuple[int, int]] = []   # (bucket, abs tick)
         for i, (b_, a_, e_, m_, *_rest) in enumerate(batch):
             buckets[i], amounts[i], be[i], mx[i] = b_, a_, e_, m_
             active[i] = True
+            tl = self._tick_len[b_]
+            if tl > 0:
+                # absolute tick boundary = host adapter's _Window
+                # (floor(now / tick_len)); device gets REBASED int32s
+                abs_tick = int(now / tl)
+                base = int(self._tick_base[b_])
+                ticks[i] = abs_tick - base
+                lasts[i] = int(self._last_tick[b_]) - base
+                rolling[i] = True
+                roll_updates.append((b_, abs_tick))
         # sequential-within-batch semantics only matter when a bucket
-        # repeats — rare at 100k-key scale; the contended batch takes
-        # the O(B) scan, everything else the vectorized step
-        alloc = self._alloc_scan \
-            if len(np.unique(buckets[:n])) < n else self._alloc_fast
+        # repeats — rare at 100k-key scale. Contended batches where
+        # every amount is 1 (the dominant rate-limit shape) take the
+        # parallel rank kernel; other contended batches the O(B)
+        # parity scan; everything else the vectorized step
+        if len(np.unique(buckets[:n])) < n:
+            alloc = self._alloc_unit \
+                if bool((amounts[:n] == 1).all()) else self._alloc_scan
+        else:
+            alloc = self._alloc_fast
         granted, self.counts = alloc(
             self.counts, jnp.asarray(buckets), jnp.asarray(amounts),
-            jnp.asarray(be), jnp.asarray(mx), jnp.asarray(active))
+            jnp.asarray(be), jnp.asarray(mx), jnp.asarray(active),
+            jnp.asarray(ticks), jnp.asarray(lasts),
+            jnp.asarray(rolling))
         granted = np.asarray(granted)
+        for b_, abs_tick in roll_updates:
+            self._last_tick[b_] = abs_tick
         with self._lock:
             for i, (_, amount, _, _, duration, dedup_id, fut) \
                     in enumerate(batch):
@@ -302,27 +339,6 @@ class DeviceQuotaPool:
             fut.set(QuotaResult(granted_amount=g,
                                 valid_duration_s=duration,
                                 status_code=status))
-
-    def _roll_windows(self, now: float, touched: list[int]) -> None:
-        """Fixed-window reset for expired buckets among `touched` —
-        zero their counters on device before allocating."""
-        idx = [b for b in set(touched)
-               if self._bucket_duration[b] > 0
-               and now - self._window_start[b] >= self._bucket_duration[b]]
-        if not idx:
-            return
-        # fixed-shape scatter (pad with an out-of-range row + drop):
-        # a per-count shape would re-trace on every distinct number of
-        # expired buckets
-        pad = self._small_batch
-        for i in range(0, len(idx), pad):
-            chunk = idx[i:i + pad]
-            arr = np.full(pad, self.n_buckets, np.int32)
-            arr[:len(chunk)] = chunk
-            self.counts = self.counts.at[jnp.asarray(arr)].set(
-                0, mode="drop")
-        for b in idx:
-            self._window_start[b] = now
 
     def _gc_dedup(self, now: float) -> None:
         if len(self._dedup) > 10_000:
