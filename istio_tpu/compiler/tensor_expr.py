@@ -583,31 +583,44 @@ def compile_dfa_group(subject_ast: Expression, patterns: list[str],
     masks the row; truncated rows are fully undecidable for $-anchored
     patterns and miss-undecidable otherwise."""
     from istio_tpu.ops.regex_dfa import (pack_dfas, pack_dfas_classes,
-                                         pack_dfas_onehot)
+                                         pack_dfas_onehot,
+                                         pack_dfas_onehot_blocked)
 
     max_len = ctx.layout.max_str_len
     fsub = _compile_bytes(subject_ast, ctx)
-    # MXU formulation when the per-step matmul stays reasonable
-    # (B·S²·C flops/step); huge banks take the flat-gather scan. The
-    # size gate runs on the CHEAP class pass — the O(S²·C) step matrix
-    # is only materialized for banks that pass.
+    # Three tiers, all size-gated on the CHEAP class pass: dense
+    # one-hot MXU matmul (small banks), BLOCK-DIAGONAL one-hot (banks
+    # of many small automata — O(N·s_max²·C) per step where dense is
+    # quadratic in the whole bank), flat-gather scan (pathological
+    # single automata too big for either).
     classes = pack_dfas_classes(dfas)
-    use_onehot = (classes["n_states"] ** 2 * classes["n_classes"]
-                  <= 4_000_000)
-    packed = pack_dfas_onehot(dfas, classes) if use_onehot else None
-    trans, accept = pack_dfas(dfas)
-    trans_j = jnp.asarray(trans)
-    accept_j = jnp.asarray(accept)
+    s_max = max(d.n_states for d in dfas)
+    dense_ok = (classes["n_states"] ** 2 * classes["n_classes"]
+                <= 4_000_000)
+    blocked_ok = (len(dfas) * s_max ** 2 * classes["n_classes"]
+                  <= 8_000_000)
+    packed = pack_dfas_onehot(dfas, classes) if dense_ok else None
+    packed_blk = None if dense_ok or not blocked_ok else \
+        pack_dfas_onehot_blocked(dfas, classes)
+    if packed is None and packed_blk is None:
+        trans, accept = pack_dfas(dfas)
+        trans_j = jnp.asarray(trans)
+        accept_j = jnp.asarray(accept)
+    else:   # the flat tables would be dead device weight
+        trans_j = accept_j = None
     trunc_all = jnp.asarray(np.array(["$" in p for p in patterns]))
 
     def fn(batch: AttributeBatch):
         s = fsub(batch)
-        # batch size is STATIC under jit — small batches take the
-        # flat-gather scan (lower fixed latency per step), big batches
-        # amortize the MXU matmul formulation
-        b = batch.ids.shape[0]
-        if packed is not None and b > 512:
+        # the MXU formulations win at EVERY serving batch size
+        # (profiled r4 at B=256: 0.055 ms vs 0.279 ms for the flat
+        # gather — the per-step [B, N] gather is latency-bound on TPU
+        # regardless of B)
+        if packed is not None:
             m = bytes_ops.dfa_match_many_onehot(s.data, s.lens, packed)
+        elif packed_blk is not None:
+            m = bytes_ops.dfa_match_many_onehot_blocked(
+                s.data, s.lens, packed_blk)
         else:
             m = bytes_ops.dfa_match_many(s.data, s.lens, trans_j,
                                          accept_j)

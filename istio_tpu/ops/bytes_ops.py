@@ -279,3 +279,51 @@ def dfa_match_many_onehot(data: jnp.ndarray, lens: jnp.ndarray,
 
     _, final = jax.lax.while_loop(cond, body, (jnp.int32(0), u0))
     return (final @ accept) > 0.5
+
+
+def dfa_match_many_onehot_blocked(data: jnp.ndarray, lens: jnp.ndarray,
+                                  packed: dict) -> jnp.ndarray:
+    """Block-diagonal MXU DFA bank (regex_dfa.pack_dfas_onehot_blocked):
+    per-pattern one-hot states [B, N, s_max] advanced by a batched
+    matmul over the pattern axis. Per-step flops are O(B·N·s_max²·C) —
+    linear in the bank where the dense formulation is quadratic — so
+    banks of many small automata (glob groups) ride the MXU instead of
+    the latency-bound gather scan.
+
+    → bool [B, N] acceptance per pattern."""
+    b, l = data.shape
+    s_max, n_cls = packed["n_states_max"], packed["n_classes"]
+    n = packed["n_pats"]
+    step_m = jnp.asarray(packed["step"], jnp.bfloat16)   # [N, s·C, s]
+    cls_m = jnp.asarray(packed["cls"], jnp.bfloat16)     # [256, C]
+    accept = jnp.asarray(packed["accept"], jnp.bfloat16)  # [N, s]
+
+    u0 = np.zeros((1, n, s_max), np.float32)
+    u0[0, :, 0] = 1.0          # every pattern starts in local state 0
+    u0 = jnp.broadcast_to(jnp.asarray(u0, jnp.bfloat16), (b, n, s_max))
+
+    bytes_tm = data.T
+    maxlen = jnp.minimum(jnp.max(lens), l)
+
+    def cond(carry):
+        i, _ = carry
+        return i < maxlen
+
+    def body(carry):
+        i, u = carry
+        byte = jax.lax.dynamic_index_in_dim(bytes_tm, i, 0,
+                                            keepdims=False)
+        onehot256 = (byte[:, None] ==
+                     jnp.arange(256, dtype=byte.dtype)[None, :]
+                     ).astype(jnp.bfloat16)
+        c1 = onehot256 @ cls_m                        # [B, C]
+        v = (u[:, :, :, None] * c1[:, None, None, :]
+             ).reshape(b, n, s_max * n_cls)
+        nxt = jnp.einsum("bnk,nks->bns", v, step_m,
+                         preferred_element_type=jnp.bfloat16)
+        u = jnp.where((i < lens)[:, None, None], nxt, u)
+        return i + 1, u
+
+    _, final = jax.lax.while_loop(cond, body, (jnp.int32(0), u0))
+    return jnp.einsum("bns,ns->bn", final, accept,
+                      preferred_element_type=jnp.float32) > 0.5

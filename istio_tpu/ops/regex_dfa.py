@@ -527,3 +527,41 @@ def pack_dfas_onehot(dfas: list[DFA],
     return {"step": step, "cls": cls,
             "starts": k["starts"], "accept": k["accept"],
             "n_states": s_tot, "n_classes": n_cls}
+
+
+def pack_dfas_onehot_blocked(dfas: list[DFA],
+                             classes: dict | None = None) -> dict:
+    """BLOCK-DIAGONAL one-hot packing: per-pattern step matrices padded
+    to the widest automaton, for bytes_ops.dfa_match_many_onehot_blocked
+    (a batched matmul over the pattern axis).
+
+    The dense pack_dfas_onehot matrix is O((Σsᵢ)²·C) — quadratic in the
+    BANK, so a 23-glob bank blows the size gate and used to fall back
+    to the latency-bound gather scan. Blocks are O(N·s_max²·C): states
+    never cross patterns, so the dense matrix was block-diagonal
+    anyway — this stores only the blocks.
+
+    Returns {"step": [N, s_max·C, s_max], "cls": [256, C],
+    "accept": [N, s_max] (acceptance of pattern i's own states),
+    "n_states_max", "n_classes", "n_pats"}; pattern i starts in its
+    local state 0 (compile_regex numbers the start state 0)."""
+    k = classes if classes is not None else pack_dfas_classes(dfas)
+    n = len(dfas)
+    n_cls = int(k["n_classes"])
+    class_of, rep = k["class_of"], k["rep"]
+    s_max = max(d.n_states for d in dfas)
+    step = np.zeros((n, s_max * n_cls, s_max), np.float32)
+    accept = np.zeros((n, s_max), np.float32)
+    for i, d in enumerate(dfas):
+        s_i = d.n_states
+        rows = (np.arange(s_i)[:, None] * n_cls
+                + np.arange(n_cls)[None, :]).reshape(-1)
+        cols = d.transitions[:, rep].reshape(-1)
+        step[i, rows, cols] = 1.0
+        accept[i, :s_i] = d.accept
+        # padding states self-loop dead (all-zero rows: a one-hot that
+        # reaches them vanishes — they are unreachable from state 0)
+    cls = np.zeros((ALPHABET, n_cls), np.float32)
+    cls[np.arange(ALPHABET), class_of] = 1.0
+    return {"step": step, "cls": cls, "accept": accept,
+            "n_states_max": s_max, "n_classes": n_cls, "n_pats": n}

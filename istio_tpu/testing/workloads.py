@@ -347,22 +347,129 @@ def make_full_mesh(n_services: int = 5000, n_roles: int = 1000,
     meta = {"n_services": n_services, "n_roles": n_roles,
             "n_routes": n_r, "n_rows": len(preds),
             "n_triples": lowered.n_triples,
-            "host_fallback": len(engine.ruleset.host_fallback)}
+            "host_fallback": len(engine.ruleset.host_fallback),
+            # the route world, so request generators can craft traffic
+            # that actually MATCHES route rows (VERDICT r3 item 7)
+            "rules_by_host": rules_by_host}
     return engine, route_lo, route_hi, weights, meta
+
+
+FULL_MESH_MIX = (0.30, 0.30, 0.20, 0.20)
+"""Stated traffic fractions for make_full_mesh_requests (VERDICT r3
+item 7): (routed+rbac-authorized, routed+rbac-denied, conformant
+SAN/authz on ns-form hostnames, random)."""
+
+
+def _route_request_pools(rules_by_host, n_roles: int):
+    """→ (routed_pool, allowed_pool) of crafted request templates per
+    route rule: (svc index, path-or-None, extra fields). allowed_pool
+    entries additionally satisfy the generated role structure (role X
+    covers svc X: path /api/v{X%9}/*, method GET, subject
+    sa{X%3}@ns{X%41}) so the request both routes AND passes rbac."""
+    routed, allowed = [], []
+    for host, cfgs in sorted(rules_by_host.items()):
+        x = int(host.split(".")[0][3:])
+        for cfg in cfgs:
+            m = cfg.spec.get("match", {}) or {}
+            headers = m.get("request", {}).get("headers", {})
+            fields = {"destination.service": host}
+            path = None
+            uri = headers.get("uri")
+            if uri and "prefix" in uri:
+                path = uri["prefix"] + "items"
+            elif uri and "regex" in uri:
+                # the generated regexes are ^/items/[0-9]+/r{k}$
+                k = uri["regex"].rsplit("/r", 1)[-1].rstrip("$")
+                path = f"/items/12345/r{k}"
+            ck = headers.get("cookie")
+            if ck and "exact" in ck:
+                fields["cookie"] = ck["exact"]
+            src = m.get("source")
+            if src:
+                fields["source.service"] = src
+            entry = (x, path, fields)
+            routed.append(entry)
+            if x >= n_roles:
+                continue        # no role covers this service
+            if path is None:
+                # cookie-only match: path is free — pick the role's
+                allowed.append((x, f"/api/v{x % 9}/allowed", fields))
+            elif path.startswith(f"/api/v{x % 9}/"):
+                allowed.append(entry)
+    return routed, allowed
 
 
 def make_full_mesh_requests(batch: int, n_services: int = 5000,
                             seed: int = 12,
-                            n_roles: int = 1000) -> list[dict]:
-    """Half the traffic follows the generated role structure (an
-    authorized SAN calling an allowed method/path on a role-covered
-    service), half is random — the fused step must exercise allow AND
-    deny outcomes, not a rigged all-deny stream."""
+                            n_roles: int = 1000,
+                            rules_by_host=None,
+                            mix: tuple = FULL_MESH_MIX) -> list[dict]:
+    """Traffic with STATED fractions (`mix`, VERDICT r3 item 7):
+    routed+authorized and routed+denied classes craft requests that
+    match an actual route rule of the generated route world (hostname
+    + uri/header/source conditions — pass `rules_by_host` from
+    make_full_mesh's meta); the conformant class follows the role
+    structure against the ns-form SAN/authz world; the rest is random.
+    Without `rules_by_host` the routed classes fall back to random
+    (the pre-r4 shape)."""
     rng = np.random.default_rng(seed)
     covered = max(1, min(n_roles, n_services))
+    routed_pool: list = []
+    allowed_pool: list = []
+    if rules_by_host:
+        routed_pool, allowed_pool = _route_request_pools(
+            rules_by_host, n_roles)
     out = []
     for i in range(batch):
-        conformant = rng.random() < 0.5
+        roll = rng.random()
+        routed_entry = None
+        conformant = False
+        rbac_ok = False
+        if roll < mix[0] and allowed_pool:
+            routed_entry = allowed_pool[
+                int(rng.integers(len(allowed_pool)))]
+            rbac_ok = True
+        elif roll < mix[0] + mix[1] and routed_pool:
+            routed_entry = routed_pool[
+                int(rng.integers(len(routed_pool)))]
+        elif roll < mix[0] + mix[1]:
+            # routed share with no route world available: fall back to
+            # the pre-r4 50/50 conformant/random shape, NOT all-
+            # conformant (r4 review finding)
+            conformant = bool(rng.random() < 0.5)
+        elif roll < mix[0] + mix[1] + mix[2]:
+            conformant = True
+        if routed_entry is not None:
+            x, path, fields = routed_entry
+            ns = x % 41
+            if rbac_ok:
+                user = f"spiffe://cluster.local/ns/ns{ns}/sa/sa{x % 3}"
+                method = "GET"
+                mtls = True
+            else:
+                user = (f"spiffe://cluster.local/ns/"
+                        f"ns{int(rng.integers(41))}/sa/"
+                        f"sa{int(rng.integers(4))}")
+                method = ("GET", "POST", "DELETE")[int(rng.integers(3))]
+                mtls = bool(rng.random() < 0.8)
+            req = {
+                "destination.namespace": "default",
+                "source.user": user,
+                "source.service":
+                    fields.get("source.service",
+                               f"svc{int(rng.integers(n_services))}"
+                               ".default.svc.cluster.local"),
+                "connection.mtls": mtls,
+                "request.method": method,
+                "request.path": path if path is not None else
+                    f"/free/{i}",
+                "request.headers": {"cookie": fields.get(
+                    "cookie",
+                    f"user=group{int(rng.integers(15))}")},
+                "destination.service": fields["destination.service"],
+            }
+            out.append(req)
+            continue
         svc = int(rng.integers(covered if conformant else n_services))
         ns = svc % 41
         if conformant:

@@ -1,0 +1,78 @@
+"""Decompose the small-batch (B=256) device step at 10k rules: full
+engine step vs ruleset match alone vs standalone DFA kernels — where
+does the <1ms p99 budget go?"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    import jax
+    import numpy as np
+
+    import bench  # noqa: F401 (jax cache config)
+    from istio_tpu.testing import workloads
+
+    B = 256
+    engine = workloads.make_engine(n_rules=10_000, with_quota=True,
+                                   jit=False)
+    bags = workloads.make_bags(2048)
+    ab = jax.device_put(engine.tensorizer.tensorize(bags[:B]))
+    req_ns = jax.device_put(np.asarray(
+        workloads.make_request_ns(engine, 2048)[:B]))
+    params = jax.device_put(engine.params)
+    counts = engine.quota_counts
+    sync = bench._roundtrip_s()
+    print(f"sync {sync*1e3:.1f} ms")
+
+    def timed(label, fn, n=120):
+        out = fn()
+        jax.block_until_ready(out)
+        best = 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn()
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0 - sync) / n)
+        print(f"{label:38s} {best*1e3:8.3f} ms")
+        return best
+
+    step = jax.jit(engine.raw_step)
+
+    def full():
+        v, c = step(params, ab, req_ns, counts)
+        return v.status
+    timed("full engine step", full)
+
+    rs_fn = jax.jit(engine.ruleset.fn)
+
+    def match_only():
+        m, nm, e = rs_fn(params, ab)
+        return m
+    timed("ruleset match only", match_only)
+
+    # standalone DFA banks at this batch size, both formulations
+    from istio_tpu.ops import bytes_ops
+    from istio_tpu.ops.regex_dfa import (compile_regex, pack_dfas,
+                                         pack_dfas_classes,
+                                         pack_dfas_onehot)
+    pats = ([f"^/(products|reviews)/[0-9]+/v{k}$" for k in range(4)])
+    dfas = [compile_regex(p) for p in pats]
+    trans, accept = pack_dfas(dfas)
+    classes = pack_dfas_classes(dfas)
+    packed = pack_dfas_onehot(dfas, classes)
+    data = jax.device_put(np.asarray(ab.str_bytes)[:, 0, :])
+    lens = jax.device_put(np.asarray(ab.str_lens)[:, 0])
+    trans_j = jax.device_put(trans)
+    accept_j = jax.device_put(accept)
+    gather = jax.jit(lambda: bytes_ops.dfa_match_many(
+        data, lens, trans_j, accept_j))
+    timed(f"dfa gather bank ({len(dfas)} pats)", gather)
+    onehot = jax.jit(lambda: bytes_ops.dfa_match_many_onehot(
+        data, lens, packed))
+    timed(f"dfa onehot bank ({len(dfas)} pats)", onehot)
+    print("n_states", classes["n_states"], "n_classes",
+          classes["n_classes"])

@@ -31,6 +31,22 @@ def _run(engine, bags, ns=None):
     return engine.check(batch, req_ns)
 
 
+def test_crafted_traffic_routes_and_mixes(world):
+    """The bench workload must actually exercise routing (VERDICT r3
+    item 7): with the route world passed in, a majority of requests
+    match a route row, and both allow and deny outcomes appear."""
+    engine, lo, hi, weights, meta = world
+    reqs = workloads.make_full_mesh_requests(
+        256, 64, n_roles=16, rules_by_host=meta["rules_by_host"])
+    bags = [bag_from_mapping(r) for r in reqs]
+    v = _run(engine, bags)
+    matched = np.asarray(v.matched)
+    routed_frac = (matched[:, lo:hi].any(axis=1)).mean()
+    assert routed_frac >= 0.5, routed_frac
+    status = np.asarray(v.status)
+    assert (status == 0).any() and (status != 0).any()
+
+
 def test_everything_lowers(world):
     engine, lo, hi, weights, meta = world
     assert meta["host_fallback"] == 0, \
