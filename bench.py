@@ -134,14 +134,17 @@ def main() -> None:
     xt = jax.device_put(np.zeros((small, 64), np.float32))
     xt = triv(xt)
     jax.block_until_ready(xt)
-    t0 = time.perf_counter()
-    y = xt
-    for _ in range(steps * 4):
-        y = triv(y)
-    jax.block_until_ready(y)
-    floor_ms = max(
-        (time.perf_counter() - t0 - sync_overhead) / (steps * 4) * 1e3,
-        0.0)
+    floor_best = float("inf")
+    for _ in range(2):          # best-of-2, like every other window
+        t0 = time.perf_counter()
+        y = xt
+        for _ in range(steps * 4):
+            y = triv(y)
+        jax.block_until_ready(y)
+        floor_best = min(floor_best,
+                         (time.perf_counter() - t0 - sync_overhead)
+                         / (steps * 4))
+    floor_ms = max(floor_best * 1e3, 0.0)
 
     served = _served_bench(n_rules, on_tpu)
     route = _route_bench(on_tpu)
@@ -149,6 +152,7 @@ def main() -> None:
     quota = _quota_bench(on_tpu)
     full_mesh = _full_mesh_bench(on_tpu)
     overlay = _overlay_bench(on_tpu)
+    mesh_scaling = _mesh_scaling_bench(on_tpu)
 
     baseline_cps = 1e9 / (PER_PREDICATE_NS * n_rules)
     out = {
@@ -162,26 +166,30 @@ def main() -> None:
         "step_ms": round(step_ms, 3),
         "small_batch": small,
         "small_batch_step_ms": round(small_ms, 3),
-        # budget gate: the DEVICE share of the latency-tier step —
-        # wall time minus the dispatch floor measured the same way in
-        # the same run (a chained trivial op: pure transport, zero
-        # compute; a colocated chip pays ~µs for it). Quiet-tunnel
-        # runs measure the tier at ~0.70 ms wall (B=64, 10k rules);
-        # congested runs push BOTH numbers up together.
-        "p99_budget_ms_ok": bool(
-            max(small_ms - floor_ms, 0.0) < 1.0),
+        # budget gate, claims kept PROVABLE (r4 review: pipelined
+        # chains overlap host/transport and device time — wall = max,
+        # not sum, so wall-minus-floor may understate device time):
+        # pass on wall-clock under budget, or when the same-run
+        # dispatch floor (a chained trivial op: pure transport, zero
+        # compute) EXCEEDS the step's wall — impossible unless the
+        # window is congestion noise, since the step's wall includes a
+        # dispatch per iteration. Quiet-tunnel runs measure the tier
+        # at ~0.70 ms wall (B=64, 10k rules).
+        "p99_budget_ms_ok": bool(small_ms < 1.0
+                                 or floor_ms >= small_ms),
         "small_batch_breakdown": {
             "latency_tier_batch": small,
             "latency_tier_ms": round(small_ms, 3),
-            "latency_tier_device_ms": round(
-                max(small_ms - floor_ms, 0.0), 3),
             "mid_batch": mid,
             "mid_batch_ms": round(mid_ms, 3),
             "dispatch_floor_ms": round(floor_ms, 3),
+            "transport_dominated": bool(floor_ms >= 0.5 * small_ms),
             "note": "fixed rule-axis cost + ~linear per-row cost; "
                     "the latency tier serves bucket-64 batches; "
                     "dispatch_floor is tunnel transport a colocated "
-                    "chip does not pay",
+                    "chip does not pay; wall and floor are pipelined "
+                    "chains (overlapping), so their difference is NOT "
+                    "a device-time estimate",
         },
         "ruleset_compile_s": round(compile_s, 2),
         "first_step_s": round(trace_s, 2),
@@ -203,6 +211,7 @@ def main() -> None:
     out.update(quota)
     out.update(full_mesh)
     out.update(overlay)
+    out.update(mesh_scaling)
     print(json.dumps(out))
 
 
@@ -513,6 +522,79 @@ def _overlay_bench(on_tpu: bool) -> dict:
                 "overlay_vs_baseline": round(cps / baseline, 2)}
     except Exception as exc:
         return {"overlay_error": f"{type(exc).__name__}: {exc}"}
+
+
+_MESH_CHILD = r"""
+import json, os, time, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")   # before any backend init
+sys.path.insert(0, {repo!r})
+from istio_tpu.runtime import RuntimeServer, ServerArgs
+from istio_tpu.testing import workloads
+
+n_rules, batch, steps = {n_rules}, {batch}, {steps}
+out = {{"mesh_rules": n_rules, "mesh_batch": batch,
+        "mesh_host_cores": os.cpu_count(),
+        "mesh_virtual_devices": len(jax.devices())}}
+bags = workloads.make_bags(batch, seed=17)
+for label, shape in (("dp1", None), ("dp4mp2", (4, 2))):
+    srv = RuntimeServer(workloads.make_store(n_rules), ServerArgs(
+        batch_window_s=0.001, mesh_shape=shape, buckets=(batch,),
+        default_manifest=workloads.MESH_MANIFEST))
+    try:
+        srv.check_many(bags)          # warm/compile
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                srv.check_many(bags)
+            best = min(best, (time.perf_counter() - t0) / steps)
+    finally:
+        srv.close()
+    out[f"mesh_{{label}}_checks_per_sec"] = round(batch / best, 1)
+out["mesh_scaling_ratio"] = round(
+    out["mesh_dp4mp2_checks_per_sec"] / out["mesh_dp1_checks_per_sec"],
+    3)
+print(json.dumps(out))
+"""
+
+
+def _mesh_scaling_bench(on_tpu: bool) -> dict:
+    """SURVEY §5.8 scaling artifact (VERDICT r3 item 8): dispatcher-
+    level check_many throughput dp=1 vs dp=4×mp=2 on the 8-virtual-CPU
+    platform, over a 10k-rule snapshot whose rule rows shard
+    non-trivially across mp. Runs in a SUBPROCESS: this process owns
+    the TPU backend, and the virtual mesh must force the CPU platform
+    before any backend init.
+
+    Honest framing baked into the fields: this box has ONE physical
+    core, so 8 virtual devices time-slice it and the ratio measures
+    the sharding machinery's OVERHEAD at scale, not a speedup — on
+    real multi-chip hardware the dp axis multiplies throughput over
+    ICI. The artifact pins the code path end-to-end (mesh jit +
+    collectives execute for real) plus the measured ratio."""
+    import subprocess
+    import sys
+
+    try:
+        script = _MESH_CHILD.format(
+            repo=os.path.dirname(os.path.abspath(__file__)),
+            n_rules=10_000 if on_tpu else 500,
+            batch=512 if on_tpu else 64,
+            steps=3)
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", script], env=env,
+            capture_output=True, text=True, timeout=900)
+        if proc.returncode != 0:
+            return {"mesh_error":
+                    f"child rc={proc.returncode}: "
+                    f"{proc.stderr.strip()[-300:]}"}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as exc:
+        return {"mesh_error": f"{type(exc).__name__}: {exc}"}
 
 
 def _quota_bench(on_tpu: bool) -> dict:
