@@ -800,6 +800,33 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
                 n_record=10_000 if on_tpu else 500,
                 n_procs=n_procs, concurrency=1024 if on_tpu else 32,
                 warmup_s=8.0 if on_tpu else 2.0)
+            # phase 1b — LIGHT load: the latency-relevant regime
+            # (saturation p50/p99 above is queueing by Little's law,
+            # not service latency). At depth 8 a request's latency ≈
+            # one tunnel RTT + the latency-tier step; a colocated
+            # chip's floor is the step itself.
+            light_fields: dict = {}
+            try:
+                # ONE worker: the point is the depth-8 regime — extra
+                # client processes would each add 8 more in flight
+                lreport = perf.run_load(
+                    f"127.0.0.1:{port}", payloads,
+                    n_record=400 if on_tpu else 100,
+                    n_procs=1, concurrency=8,
+                    warmup_s=2.0)
+                light_fields = {
+                    "served_light_checks_per_sec": round(
+                        lreport.checks_per_sec, 1),
+                    "served_light_p50_ms": round(lreport.p50_ms, 2),
+                    "served_light_p99_ms": round(lreport.p99_ms, 2),
+                    "served_light_clients": "1x8",
+                    "served_light_errors": lreport.n_errors,
+                    "served_light_first_error": lreport.first_error,
+                    "served_light_truncated": lreport.truncated,
+                }
+            except Exception as exc:
+                light_fields = {"served_light_error":
+                                f"{type(exc).__name__}: {exc}"}
             # phase 2 — the shim protocol (mixer.proto BatchCheck): one
             # RPC carries a bucket-sized batch of independent bags, so
             # the ~0.4ms/RPC python-grpc cost (see
@@ -847,6 +874,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             "served_first_error": report.first_error,
             "served_clients": f"{report.n_procs}x{report.concurrency}",
             "served_quota_frac": round(1.0 / quota_every, 3),
+            **light_fields,
             **batched_fields,
             "device_sync_ms": round(sync_ms, 1),
             **_grpc_ceiling_fields(),
