@@ -857,6 +857,35 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             except Exception as exc:   # keep the unary phase's results
                 batched_fields = {"served_batched_error":
                                   f"{type(exc).__name__}: {exc}"}
+            # phase 3 — the REPORT path (grpcServer.go:262; the
+            # reference's report benchmarks are unpublished,
+            # mixer/test/perf/singlereport_test.go): batched records
+            # through gRPC → delta decode → resolve → metric adapter.
+            # Host-side work end to end — no device trip.
+            report_fields: dict = {}
+            try:
+                rsz = 64
+                rpayloads = perf.make_report_payloads(
+                    workloads.make_request_dicts(512),
+                    records_per_request=rsz)
+                rrep = perf.run_load(
+                    f"127.0.0.1:{port}", rpayloads,
+                    n_record=150 if on_tpu else 20,
+                    n_procs=1, concurrency=4,
+                    warmup_s=2.0 if on_tpu else 1.0,
+                    method="/istio.mixer.v1.Mixer/Report",
+                    checks_per_payload=rsz)
+                report_fields = {
+                    "served_report_records_per_sec": round(
+                        rrep.checks_per_sec, 1),
+                    "served_report_records_per_rpc": rsz,
+                    "served_report_rpc_p50_ms": round(rrep.p50_ms, 2),
+                    "served_report_errors": rrep.n_errors,
+                    "served_report_first_error": rrep.first_error,
+                }
+            except Exception as exc:
+                report_fields = {"served_report_error":
+                                 f"{type(exc).__name__}: {exc}"}
         finally:
             g.stop()
             srv.close()
@@ -876,6 +905,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             "served_quota_frac": round(1.0 / quota_every, 3),
             **light_fields,
             **batched_fields,
+            **report_fields,
             "device_sync_ms": round(sync_ms, 1),
             **_grpc_ceiling_fields(),
             **counter_fields(),

@@ -61,6 +61,10 @@ class FusedPlan:
     # "provider-refreshed", "REGEX:unsupported-pattern" (bench
     # enumeration of the unfusable envelope)
     unfused_list_kinds: tuple = ()
+    # rules carrying REPORT-variety actions: their activity bits ride
+    # overlay_cols so dispatcher.report reads ONE bitpacked pull
+    # instead of the full [B, R] matched plane (r4)
+    report_rules: frozenset = frozenset()
     # QUOTA-variety wiring for the served quota loop
     # (grpcServer.go:188-230): [(rule idx, handler qname, instance
     # qname, accepted quota names)] in rule order. The rules' activity
@@ -444,9 +448,12 @@ def build_fused_plan(snapshot: Snapshot,
             if isinstance(item, str) and item in layout.map_slots:
                 pred_map_mask[ridx, layout.map_slots[item]] = 1
 
+    report_rules = {ridx for ridx in range(n_real)
+                    if any(True for _ in snapshot.actions_for(
+                        ridx, Variety.REPORT))}
     real_fallback = {r for r in rs.host_fallback if r < n_real}
     overlay = set(host_actions) | real_fallback | set(unmapped) \
-        | quota_rules
+        | quota_rules | report_rules
     return FusedPlan(engine=engine, native=native,
                      host_actions=host_actions,
                      host_rule_idx=np.asarray(sorted(host_actions),
@@ -464,7 +471,8 @@ def build_fused_plan(snapshot: Snapshot,
                      pred_map_mask=pred_map_mask[:, :n_maps]
                      if n_maps else np.zeros((n_rows, 0), np.int8),
                      unmapped_instance_attrs=unmapped,
-                     unfused_list_kinds=tuple(sorted(unfused_kinds)))
+                     unfused_list_kinds=tuple(sorted(unfused_kinds)),
+                     report_rules=frozenset(report_rules))
 
 
 def _split_list_instances(snapshot: Snapshot, hc, inst_names, layout,

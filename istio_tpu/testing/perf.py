@@ -75,6 +75,29 @@ def make_batch_check_payloads(dicts: Sequence[Mapping[str, Any]],
     return out
 
 
+def make_report_payloads(dicts: Sequence[Mapping[str, Any]],
+                         records_per_request: int = 64,
+                         n_payloads: int = 8) -> list[bytes]:
+    """Pre-serialized ReportRequest bytes: `records_per_request`
+    attribute records per RPC (the report_batch shape). Records are
+    encoded whole (not deltas) — with a consistent key set across
+    `dicts` each record fully overwrites the accumulator, which is
+    delta-decoding-correct server-side."""
+    from istio_tpu.api import mixer_pb2 as pb
+    from istio_tpu.api.wire import bag_to_compressed
+    from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
+
+    out = []
+    for k in range(n_payloads):
+        req = pb.ReportRequest(
+            global_word_count=len(GLOBAL_WORD_LIST))
+        for i in range(records_per_request):
+            values = dicts[(k * records_per_request + i) % len(dicts)]
+            bag_to_compressed(values, msg=req.attributes.add())
+        out.append(req.SerializeToString())
+    return out
+
+
 @dataclasses.dataclass
 class PerfReport:
     checks_per_sec: float

@@ -123,6 +123,22 @@ def make_store(n_rules: int, n_services: int | None = None,
         "template": "checknothing", "params": {}})
     s.set(("instance", "istio-system", "srcns"), {
         "template": "listentry", "params": {"value": "source.namespace"}})
+    # REPORT-path traffic (grpcServer.go:262 → dispatcher.Report →
+    # metric adapter): a request-count metric into prometheus — the
+    # served report bench drives this through the real gRPC surface
+    s.set(("handler", "istio-system", "prom"), {
+        "adapter": "prometheus",
+        "params": {"metrics": [{
+            "name": "reqcount.istio-system", "kind": "COUNTER",
+            "label_names": ["destination"]}]}})
+    s.set(("instance", "istio-system", "reqcount"), {
+        "template": "metric",
+        "params": {"value": "1",
+                   "dimensions": {"destination":
+                                  'destination.service | "unknown"'}}})
+    s.set(("rule", "istio-system", "report-all"), {
+        "match": "",
+        "actions": [{"handler": "prom", "instances": ["reqcount"]}]})
     if host_overlay_every:
         # REGEX entry type keeps list.go's host semantics — the fused
         # plan must overlay these rules per request (runtime/fused.py)

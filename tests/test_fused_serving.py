@@ -295,6 +295,68 @@ def test_ip_typed_values_keep_host_semantics():
         generic.close()
 
 
+def test_report_parity_fused_vs_generic():
+    """dispatcher.report rides the fused packed step (one bitpacked
+    overlay pull) when a plan exists; adapter effects must equal the
+    generic full-plane path — including namespace-scoped report rules
+    and predicate-gated ones."""
+    def store() -> MemStore:
+        s = MemStore()
+        s.set(("handler", "istio-system", "prom"), {
+            "adapter": "prometheus",
+            "params": {"metrics": [{"name": "hits.istio-system",
+                                    "kind": "COUNTER",
+                                    "label_names": ["dest"]}]}})
+        s.set(("instance", "istio-system", "hits"), {
+            "template": "metric",
+            "params": {"value": "1",
+                       "dimensions": {"dest": "destination.service"}}})
+        s.set(("rule", "istio-system", "tally"), {
+            "match": 'request.method == "GET"',
+            "actions": [{"handler": "prom", "instances": ["hits"]}]})
+        # namespace-scoped report rule: only prod-destined requests
+        s.set(("rule", "prod", "tally-prod"), {
+            "match": "",
+            "actions": [{"handler": "prom.istio-system",
+                         "instances": ["hits.istio-system"]}]})
+        return s
+
+    bags = [bag_from_mapping(c) for c in (
+        {"request.method": "GET",
+         "destination.service": "a.default.svc"},
+        {"request.method": "POST",
+         "destination.service": "a.default.svc"},   # predicate miss
+        {"request.method": "GET",
+         "destination.service": "b.default.svc"},
+        {"request.method": "GET",
+         "destination.service": "b.default.svc"},
+        # prod namespace: BOTH the global rule (GET) and the prod rule
+        # fire → +2; POST hits only the prod rule → +1
+        {"request.method": "GET",
+         "destination.service": "c.prod.svc"},
+        {"request.method": "POST",
+         "destination.service": "c.prod.svc"},
+    )]
+    want = {"a.default.svc": 1.0, "b.default.svc": 2.0,
+            "c.prod.svc": 3.0}
+    samples = {}
+    for fused in (True, False):
+        srv = RuntimeServer(store(), ServerArgs(fused=fused))
+        try:
+            d = srv.controller.dispatcher
+            assert (d.fused is not None) == fused
+            d.report(bags)
+            h = d.handlers["prom.istio-system"]
+            samples[fused] = {
+                dest: h.registry.get_sample_value(
+                    "istio_tpu_hits_istio_system_total",
+                    {"dest": dest})
+                for dest in want}
+        finally:
+            srv.close()
+    assert samples[True] == samples[False] == want
+
+
 def test_wire_fast_path_zero_decode():
     """gRPC → C++ tensorize → device step → response, with NO python
     wire decode when every matched rule is fully fused (the mixerclient
