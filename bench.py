@@ -860,8 +860,10 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             # phase 3 — the REPORT path (grpcServer.go:262; the
             # reference's report benchmarks are unpublished,
             # mixer/test/perf/singlereport_test.go): batched records
-            # through gRPC → delta decode → resolve → metric adapter.
-            # Host-side work end to end — no device trip.
+            # through gRPC → delta decode → fused resolve (ONE packed
+            # device trip per RPC, record counts padded to the
+            # prewarmed serving buckets) → metric adapter fan-out on
+            # the host.
             report_fields: dict = {}
             try:
                 rsz = 64

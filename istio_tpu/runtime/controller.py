@@ -113,7 +113,8 @@ class Controller:
             self.device_quotas, quota_orphans = \
                 self._quota_table.rebuild(snapshot)
         dispatcher = Dispatcher(snapshot, handlers, self.identity_attr,
-                                fused=plan)
+                                fused=plan,
+                                buckets=self.prewarm_buckets)
         self._dispatcher = dispatcher      # atomic publish (GIL ref swap)
         if quota_orphans:
             # same delayed drain as handler orphans: in-flight quota

@@ -219,10 +219,16 @@ class DeviceQuotaPool:
                     self._wake.wait(timeout=0.1)
                 if self._closed and not self._pending:
                     return
-                deadline = self._clock() + self._window_s
+                # batch-window timing is a TRANSPORT concern — always
+                # wall clock. The injectable self._clock is quota
+                # SEMANTICS (window ticks, dedup expiry); driving the
+                # collect loop with it meant a frozen test clock never
+                # expired the window and futures hung once arrivals
+                # stopped short of a full batch
+                deadline = time.monotonic() + self._window_s
                 while (len(self._pending) < self._max_batch
                        and not self._closed):
-                    remaining = deadline - self._clock()
+                    remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     self._wake.wait(timeout=remaining)

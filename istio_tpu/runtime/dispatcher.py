@@ -79,13 +79,18 @@ class Dispatcher:
 
     def __init__(self, snapshot: Snapshot, handlers: Mapping[str, Handler],
                  identity_attr: str = DEFAULT_IDENTITY_ATTR,
-                 fused=None):
+                 fused=None,
+                 buckets: tuple[int, ...] = ()):
         self.snapshot = snapshot
         self.handlers = dict(handlers)
         self.identity_attr = identity_attr
         # FusedPlan (runtime/fused.py) — when present, check() runs the
         # fused device engine and overlays only host-only actions
         self.fused = fused
+        # prewarmed serving batch shapes: device work OUTSIDE the
+        # batcher (the fused report resolve) pads to these so arbitrary
+        # arrival counts never compile in-band
+        self.buckets = tuple(sorted(buckets))
         # any ATTRIBUTE_GENERATOR action configured? (when False the
         # server skips the per-request preprocess resolve entirely)
         self.has_apa = any(
@@ -528,18 +533,28 @@ class Dispatcher:
         _resolve path cost ~90ms/RPC in [B, R] transfer alone at 10k
         rules behind the tunnel). Shares the check path's tensorize and
         overlay decode (incl. fallback patching, ns masking and
-        resolve-error accounting)."""
+        resolve-error accounting). Batches pad to the prewarmed
+        serving bucket shapes — arbitrary report-record counts must
+        never compile a fresh XLA program in-band (the variable-shape
+        pathology device_quota.py documents)."""
+        from istio_tpu.runtime.batcher import PadBag, bucket_size
+
         plan = self.fused
+        n = len(bags)
+        padded = list(bags)
+        if self.buckets:
+            target = bucket_size(n, self.buckets)
+            padded += [PadBag()] * (target - n)
         with monitor.resolve_timer():
-            batch, ns_ids = self._tensorize_for_device(bags)
+            batch, ns_ids = self._tensorize_for_device(padded)
             packed = plan.packed_check(batch, ns_ids)
         active_sub, col_pos = self._overlay_active(
-            packed, bags, np.asarray(ns_ids))
+            packed, bags, np.asarray(ns_ids)[:n])
         rcols = [(ridx, col_pos[ridx])
                  for ridx in sorted(plan.report_rules)
                  if ridx in col_pos]
         return [[ridx for ridx, pos in rcols if active_sub[b, pos]]
-                for b in range(len(bags))]
+                for b in range(n)]
 
     def quota(self, bag: Bag, quota_name: str,
               args: QuotaArgs) -> QuotaResult:

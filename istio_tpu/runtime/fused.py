@@ -449,8 +449,7 @@ def build_fused_plan(snapshot: Snapshot,
                 pred_map_mask[ridx, layout.map_slots[item]] = 1
 
     report_rules = {ridx for ridx in range(n_real)
-                    if any(True for _ in snapshot.actions_for(
-                        ridx, Variety.REPORT))}
+                    if snapshot.actions_for(ridx, Variety.REPORT)}
     real_fallback = {r for r in rs.host_fallback if r < n_real}
     overlay = set(host_actions) | real_fallback | set(unmapped) \
         | quota_rules | report_rules

@@ -212,6 +212,126 @@ def test_device_quota_pool_close_races_allocs():
         assert r.status_code in (0, 14)
 
 
+def test_batch_check_races_config_swaps():
+    """BatchCheck RPCs (the shim protocol) from several threads while
+    the config churns: every per-item verdict must be consistent with
+    SOME published snapshot, like the unary race above."""
+    import pytest
+    pytest.importorskip("grpc")
+    from istio_tpu.api import MixerClient
+    from istio_tpu.api.grpc_server import MixerGrpcServer
+
+    store = _store()
+    srv = RuntimeServer(store, ServerArgs(batch_window_s=0.001,
+                                          max_batch=32, buckets=(32,)))
+    g = MixerGrpcServer(srv)
+    port = g.start()
+    failures: list = []
+    stop = threading.Event()
+
+    def checker(tid):
+        client = MixerClient(f"127.0.0.1:{port}",
+                             enable_check_cache=False)
+        i = 0
+        try:
+            while not stop.is_set():
+                resps = client.batch_check(
+                    [{"request.path": f"/admin/{tid}/{i}/{j}"}
+                     for j in range(5)] +
+                    [{"request.path": f"/ok/{tid}/{i}/{j}"}
+                     for j in range(5)])
+                codes = [r.precondition.status.code for r in resps]
+                if codes[:5] != [PERMISSION_DENIED] * 5:
+                    failures.append(("admin-not-denied", codes[:5]))
+                if any(c not in (OK, PERMISSION_DENIED)
+                       for c in codes[5:]):
+                    failures.append(("ok-bad-status", codes[5:]))
+                i += 1
+        finally:
+            client.close()
+
+    def swapper():
+        gen = 0
+        while not stop.is_set():
+            store.set(("rule", "istio-system", "churn"), {
+                "match": f'request.path.startsWith("/churn{gen}/")',
+                "actions": [{"handler": "denyall",
+                             "instances": ["nothing"]}]})
+            gen += 1
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=checker, args=(t,), daemon=True)
+               for t in range(4)] + \
+              [threading.Thread(target=swapper, daemon=True)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+            assert not t.is_alive(), "thread wedged"
+        assert not failures, failures[:5]
+    finally:
+        stop.set()
+        g.stop()
+        srv.close()
+
+
+def test_rolling_pool_never_overgrants_across_window_rolls():
+    """Concurrent unit allocs against a live ROLLING window while the
+    clock advances: the safety invariant is that within any window,
+    total granted never exceeds max_amount + (reclaimed slots). With
+    the clock frozen per phase, each phase must grant exactly the
+    reclaimed budget."""
+    from istio_tpu.adapters.sdk import QuotaArgs
+    from istio_tpu.runtime.device_quota import DeviceQuotaPool
+
+    class Clock:
+        def __init__(self):
+            self.t = 50.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    pool = DeviceQuotaPool(
+        {"q": {"name": "q", "max_amount": 40,
+               "valid_duration_s": 10.0}},
+        n_buckets=8, batch_window_s=0.001, max_batch=64, clock=clock)
+    try:
+        def storm(n_threads=6, per_thread=20):
+            granted = []
+            barrier = threading.Barrier(n_threads)
+
+            def taker():
+                barrier.wait()
+                futs = [pool.alloc("q", {"name": "q", "dimensions": {}},
+                                   QuotaArgs(quota_amount=1,
+                                             best_effort=True))
+                        for _ in range(per_thread)]
+                granted.append(sum(
+                    f.result(timeout=30).granted_amount for f in futs))
+
+            ts = [threading.Thread(target=taker)
+                  for _ in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            return sum(granted)
+
+        assert storm() == 40          # window fills exactly once
+        assert storm() == 0           # same ticks: nothing reclaimed
+        clock.t += 5.0                # half the window rolls out...
+        assert storm() == 0           # ...but all 40 were consumed at
+        #                               the same tick — still live
+        clock.t += 6.0                # now the consuming tick expired
+        assert storm() == 40
+    finally:
+        pool.close()
+
+
 def test_store_watch_delivery_under_write_storm():
     """Concurrent writers + a watcher: the watcher must observe a
     coherent final state once writes quiesce (no lost updates)."""
