@@ -117,34 +117,47 @@ def main() -> None:
     small = 64 if on_tpu else 32
     ab_small = jax.device_put(engine.tensorizer.tensorize(bags[:small]))
     ns_small = jax.device_put(np.asarray(req_ns)[:small])
-    t_small, counts = timed(steps * 4, ab_small, ns_small, counts)
-    t_small -= sync_overhead / (steps * 4)
-    small_ms = max(float(t_small * 1e3), 1e-3)
-    # mid tier + dispatch floor: the breakdown that keeps the budget
-    # claim honest (VERDICT r3 item 2) — mid-batch cost shows the
-    # rule-axis fixed component, the floor shows what the tunnel
-    # transport adds per dispatch (a colocated chip pays ~µs)
+    # small-batch and dispatch-floor windows INTERLEAVE so both sample
+    # the same tunnel-congestion regime (observed: a congested small
+    # window next to a calm floor window flips the budget gate on
+    # noise, with the B=64 wall exceeding the B=256 wall — physically
+    # impossible for real device cost)
+    triv = jax.jit(lambda x: x + 1)
+    xt = jax.device_put(np.zeros((small, 64), np.float32))
+    xt = triv(xt)
+    jax.block_until_ready(xt)
+    n_steps = steps * 2
+    small_best = float("inf")
+    floor_best = float("inf")
+    v, counts = step(params, ab_small, ns_small, counts)  # warm shape
+    jax.block_until_ready(v.status)
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            v, counts = step(params, ab_small, ns_small, counts)
+        jax.block_until_ready(v.status)
+        small_best = min(small_best,
+                         (time.perf_counter() - t0 - sync_overhead)
+                         / n_steps)
+        t0 = time.perf_counter()
+        y = xt
+        for _ in range(n_steps):
+            y = triv(y)
+        jax.block_until_ready(y)
+        floor_best = min(floor_best,
+                         (time.perf_counter() - t0 - sync_overhead)
+                         / n_steps)
+    small_ms = max(float(small_best * 1e3), 1e-3)
+    floor_ms = max(floor_best * 1e3, 0.0)
+    # mid tier: the breakdown that keeps the budget claim honest
+    # (VERDICT r3 item 2) — mid-batch cost shows the rule-axis fixed
+    # component
     mid = 256 if on_tpu else 64
     ab_mid = jax.device_put(engine.tensorizer.tensorize(bags[:mid]))
     ns_mid = jax.device_put(np.asarray(req_ns)[:mid])
     t_mid, counts = timed(steps * 4, ab_mid, ns_mid, counts)
     t_mid -= sync_overhead / (steps * 4)
     mid_ms = max(float(t_mid * 1e3), 1e-3)
-    triv = jax.jit(lambda x: x + 1)
-    xt = jax.device_put(np.zeros((small, 64), np.float32))
-    xt = triv(xt)
-    jax.block_until_ready(xt)
-    floor_best = float("inf")
-    for _ in range(2):          # best-of-2, like every other window
-        t0 = time.perf_counter()
-        y = xt
-        for _ in range(steps * 4):
-            y = triv(y)
-        jax.block_until_ready(y)
-        floor_best = min(floor_best,
-                         (time.perf_counter() - t0 - sync_overhead)
-                         / (steps * 4))
-    floor_ms = max(floor_best * 1e3, 0.0)
 
     served = _served_bench(n_rules, on_tpu)
     route = _route_bench(on_tpu)
@@ -189,6 +202,10 @@ def main() -> None:
             "mid_batch_ms": round(mid_ms, 3),
             "dispatch_floor_ms": round(floor_ms, 3),
             "transport_dominated": bool(floor_ms >= 0.5 * small_ms),
+            # B=64 walling above B=256 is physically impossible for
+            # device cost — it marks the small windows as congestion-
+            # corrupted for the artifact's reader
+            "small_window_congested": bool(small_ms > mid_ms),
             "note": "fixed rule-axis cost + ~linear per-row cost; "
                     "the latency tier serves bucket-64 batches; "
                     "dispatch_floor is tunnel transport a colocated "

@@ -13,9 +13,12 @@ Reference: mixer/adapter/memquota (2,230 LoC; HandleQuota memquota.go:
     hashes the instance signature; we use a stable repr).
 
 State is per-replica and lost on restart — explicitly best-effort, like
-the reference. The device-side fixed-window variant lives in
-models/policy_engine.py QuotaSpec; this host adapter is the general
-path and the semantics oracle.
+the reference. Device-side variants: the SERVED quota pool
+(runtime/device_quota.py) mirrors this adapter's ROLLING windows with
+tick-exact parity; the engine-embedded QuotaSpec
+(models/policy_engine.py) keeps a simplified fixed window for the
+all-device benchmark step. This host adapter is the general path and
+the semantics oracle.
 """
 from __future__ import annotations
 

@@ -99,7 +99,7 @@ class MixerGrpcServer:
         unary Check without quotas/dedup. The batch is padded to the
         server's prewarmed bucket shapes so arbitrary client batch
         sizes never re-trace."""
-        from istio_tpu.runtime.batcher import PadBag, bucket_size
+        from istio_tpu.runtime.batcher import pad_to_bucket
 
         gwc = request.global_word_count
         native = gwc in (0, len(GLOBAL_WORD_LIST))
@@ -116,8 +116,7 @@ class MixerGrpcServer:
         # distinct size (client-controlled stalls)
         for lo in range(0, len(bags), buckets[-1]):
             chunk = bags[lo:lo + buckets[-1]]
-            target = bucket_size(len(chunk), buckets)
-            padded = chunk + [PadBag()] * (target - len(chunk))
+            padded = pad_to_bucket(chunk, buckets)
             results.extend(
                 self.runtime.check_batch_preprocessed(padded)[:len(chunk)])
         blobs = [

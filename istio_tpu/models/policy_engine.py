@@ -20,10 +20,12 @@ Here the WHOLE pipeline for a batch of B requests is one XLA program:
 Adapter semantics fused on device:
   * denier (mixer/adapter/denier): per-rule fixed status + TTLs.
   * list   (mixer/adapter/list): whitelist/blacklist membership of one
-    expression value; entries interned to ids → membership is an
-    equality scan over a padded [n_lists, max_entries] id matrix
-    (id-exact entries; ip-CIDR/regex lists stay host-side, list.go
-    overrides).
+    expression value, lowered per entry type (ListEntrySpec): exact
+    STRINGS as an interned-id equality scan over a padded
+    [n_lists, max_entries] matrix, static REGEX entries as packed
+    per-byte-slot DFA banks, IP_ADDRESSES as CIDR prefix compares in
+    v6-mapped space. Case-insensitive and provider-refreshed lists
+    keep list.go's host semantics via the runtime overlay.
   * memquota (mixer/adapter/memquota): token-bucket-style windowed
     counters resident on device; a batch allocates with a scatter-add
     and reads back grants (best-effort per replica, exactly like the

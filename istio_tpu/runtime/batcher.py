@@ -41,6 +41,15 @@ def bucket_size(n: int, buckets: tuple[int, ...]) -> int:
     return buckets[-1]
 
 
+def pad_to_bucket(bags, buckets: tuple[int, ...]) -> list:
+    """`bags` + PadBags up to the bucket for len(bags) — the single
+    home of bucket padding (batcher, BatchCheck front, fused report
+    resolve). Caller chunks to buckets[-1] first; an over-bucket
+    length returns the bags unpadded."""
+    target = bucket_size(len(bags), buckets)
+    return list(bags) + [PadBag() for _ in range(target - len(bags))]
+
+
 class PadBag(Bag):
     """Empty bag used to pad a batch to its bucket size."""
 
@@ -153,8 +162,7 @@ class CheckBatcher:
         try:
             monitor.CHECK_BATCH_SIZE.observe(len(batch))
             bags = [bag for bag, _ in batch]
-            target = bucket_size(len(bags), self.buckets)
-            padded = bags + [PadBag()] * (target - len(bags))
+            padded = pad_to_bucket(bags, self.buckets)
             # queue-wait = oldest enqueue -> batch start (decomposable
             # served latency; pkg/tracing interceptor role)
             from istio_tpu.utils import tracing
@@ -163,7 +171,7 @@ class CheckBatcher:
                      (getattr(f, "_t_enq", None) for _, f in batch)
                      if t is not None]
             span_ctx = tracing.get_tracer().span(
-                "serve.batch", size=len(batch), bucket=target,
+                "serve.batch", size=len(batch), bucket=len(padded),
                 queue_wait_ms=round(max(waits, default=0.0) * 1e3, 3))
             try:
                 with span_ctx:
@@ -182,6 +190,17 @@ class CheckBatcher:
             for (_, fut), result in zip(batch, results):
                 try:
                     fut.set_result(result)
+                except InvalidStateError:
+                    pass
+        except Exception as exc:
+            # belt over the inner handler: NO failure in batch prep or
+            # result distribution may abandon the futures — an
+            # unresolved future hangs its caller forever (observed r4:
+            # a NameError in the tracing-span line left every request
+            # of the batch timing out)
+            for _, fut in batch:
+                try:
+                    fut.set_exception(exc)
                 except InvalidStateError:
                     pass
         finally:

@@ -10,10 +10,16 @@ by design (SURVEY.md §7 layer 4):
   * Namespace targeting follows resolver.go:180 destAndNamespace — the
     identity attribute `destination.service` (svc.ns.suffix…) selects
     the rule namespace; default-namespace rules always apply.
-  * Instance construction + adapter calls stay host-side here (the
-    generic path); the all-device fused path is models/policy_engine
-    and is benchmarked separately. combineResults semantics preserved:
-    worst status wins, TTLs take the min (dispatcher.go:322).
+  * The SERVING path is the fused device engine
+    (models/policy_engine wired via runtime/fused): check verdicts,
+    list/deny/rbac statuses, referenced bitmaps and report/quota
+    activity bits come off one packed device step; only host-overlay
+    actions (unfusable adapters) and host-fallback predicates run
+    python per request. The generic path below (fused=None) keeps
+    instance construction + adapter calls fully host-side and is the
+    behavioral oracle. combineResults semantics preserved on both:
+    lowest-rule-index non-OK status wins, TTLs take the min
+    (dispatcher.go:322).
   * Adapter calls are panic-isolated (safeDispatch dispatcher.go:399):
     an adapter exception degrades that action to INTERNAL, never kills
     the request.
@@ -533,28 +539,34 @@ class Dispatcher:
         _resolve path cost ~90ms/RPC in [B, R] transfer alone at 10k
         rules behind the tunnel). Shares the check path's tensorize and
         overlay decode (incl. fallback patching, ns masking and
-        resolve-error accounting). Batches pad to the prewarmed
-        serving bucket shapes — arbitrary report-record counts must
-        never compile a fresh XLA program in-band (the variable-shape
-        pathology device_quota.py documents)."""
-        from istio_tpu.runtime.batcher import PadBag, bucket_size
+        resolve-error accounting). Record counts pad to the prewarmed
+        serving bucket shapes, and oversize batches run in
+        largest-bucket CHUNKS — arbitrary (client-controlled) report
+        sizes must never compile a fresh XLA program in-band (the
+        variable-shape pathology device_quota.py documents)."""
+        from istio_tpu.runtime.batcher import pad_to_bucket
 
         plan = self.fused
-        n = len(bags)
-        padded = list(bags)
-        if self.buckets:
-            target = bucket_size(n, self.buckets)
-            padded += [PadBag()] * (target - n)
-        with monitor.resolve_timer():
-            batch, ns_ids = self._tensorize_for_device(padded)
-            packed = plan.packed_check(batch, ns_ids)
-        active_sub, col_pos = self._overlay_active(
-            packed, bags, np.asarray(ns_ids)[:n])
-        rcols = [(ridx, col_pos[ridx])
-                 for ridx in sorted(plan.report_rules)
-                 if ridx in col_pos]
-        return [[ridx for ridx, pos in rcols if active_sub[b, pos]]
-                for b in range(n)]
+        rcols = None
+        cap = self.buckets[-1] if self.buckets else len(bags) or 1
+        out: list[list[int]] = []
+        for lo in range(0, len(bags), cap):
+            chunk = bags[lo:lo + cap]
+            padded = pad_to_bucket(chunk, self.buckets) \
+                if self.buckets else chunk
+            with monitor.resolve_timer():
+                batch, ns_ids = self._tensorize_for_device(padded)
+                packed = plan.packed_check(batch, ns_ids)
+            active_sub, col_pos = self._overlay_active(
+                packed, chunk, np.asarray(ns_ids)[:len(chunk)])
+            if rcols is None:
+                rcols = [(ridx, col_pos[ridx])
+                         for ridx in sorted(plan.report_rules)
+                         if ridx in col_pos]
+            out.extend(
+                [ridx for ridx, pos in rcols if active_sub[b, pos]]
+                for b in range(len(chunk)))
+        return out
 
     def quota(self, bag: Bag, quota_name: str,
               args: QuotaArgs) -> QuotaResult:

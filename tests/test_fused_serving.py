@@ -341,7 +341,12 @@ def test_report_parity_fused_vs_generic():
             "c.prod.svc": 3.0}
     samples = {}
     for fused in (True, False):
-        srv = RuntimeServer(store(), ServerArgs(fused=fused))
+        # tiny buckets: the 6-bag report must CHUNK (4+2) and pad on
+        # the fused path — oversize report batches never reach the
+        # device at arbitrary shapes
+        srv = RuntimeServer(store(), ServerArgs(fused=fused,
+                                                max_batch=4,
+                                                buckets=(4,)))
         try:
             d = srv.controller.dispatcher
             assert (d.fused is not None) == fused

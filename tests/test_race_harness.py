@@ -319,6 +319,7 @@ def test_rolling_pool_never_overgrants_across_window_rolls():
                 t.start()
             for t in ts:
                 t.join(timeout=60)
+                assert not t.is_alive(), "taker wedged"
             return sum(granted)
 
         assert storm() == 40          # window fills exactly once
@@ -330,6 +331,32 @@ def test_rolling_pool_never_overgrants_across_window_rolls():
         assert storm() == 40
     finally:
         pool.close()
+
+
+def test_batcher_never_abandons_futures_on_prep_failure(monkeypatch):
+    """A failure in batch PREP (outside the run_batch call — e.g. the
+    tracing span construction) must resolve every future with the
+    exception, never leave callers hanging (r4: a NameError in the
+    span line hung every request of its batch)."""
+    import pytest
+
+    from istio_tpu.runtime.batcher import CheckBatcher
+    from istio_tpu.utils import tracing
+
+    b = CheckBatcher(lambda bags: [1] * len(bags), window_s=0.001,
+                     max_batch=4)
+
+    def boom():
+        raise RuntimeError("span construction failed")
+
+    monkeypatch.setattr(tracing, "get_tracer", boom)
+    try:
+        fut = b.submit(bag_from_mapping({"request.path": "/x"}))
+        with pytest.raises(RuntimeError, match="span construction"):
+            fut.result(timeout=15)
+    finally:
+        monkeypatch.undo()
+        b.close()
 
 
 def test_store_watch_delivery_under_write_storm():
