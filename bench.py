@@ -165,6 +165,7 @@ def main() -> None:
     quota = _quota_bench(on_tpu)
     full_mesh = _full_mesh_bench(on_tpu)
     overlay = _overlay_bench(on_tpu)
+    capacity = _capacity_bench(on_tpu)
     mesh_scaling = _mesh_scaling_bench(on_tpu)
 
     baseline_cps = 1e9 / (PER_PREDICATE_NS * n_rules)
@@ -233,6 +234,7 @@ def main() -> None:
     out.update(quota)
     out.update(full_mesh)
     out.update(overlay)
+    out.update(capacity)
     out.update(mesh_scaling)
     print(json.dumps(out))
 
@@ -580,6 +582,50 @@ out["mesh_scaling_ratio"] = round(
     3)
 print(json.dumps(out))
 """
+
+
+def _capacity_bench(on_tpu: bool) -> dict:
+    """Rule-capacity spot check: the 50k-rule step (5× the headline
+    scale) must compile and run — r4 caught a TPU kernel fault here
+    that 10k-rule benches never trip (an all-False scatter-max over
+    the [B, R] err plane), so the artifact pins capacity every round.
+    """
+    try:
+        from istio_tpu.testing import workloads
+
+        n_rules = 50_000 if on_tpu else 2_000
+        batch = 1_024 if on_tpu else 128
+        t0 = time.perf_counter()
+        engine = workloads.make_engine(n_rules=n_rules,
+                                       with_quota=False, jit=False)
+        compile_s = time.perf_counter() - t0
+        bags = workloads.make_bags(batch)
+        ab = jax.device_put(engine.tensorizer.tensorize(bags))
+        ns = jax.device_put(np.asarray(
+            workloads.make_request_ns(engine, batch)))
+        params = jax.device_put(engine.params)
+        step = jax.jit(engine.raw_step)
+        counts = engine.quota_counts
+        v, counts = step(params, ab, ns, counts)
+        jax.block_until_ready(v.status)
+        sync_s = _roundtrip_s()
+        steps = 10 if on_tpu else 3
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                v, counts = step(params, ab, ns, counts)
+            jax.block_until_ready(v.status)
+            best = min(best,
+                       (time.perf_counter() - t0 - sync_s) / steps)
+        best = max(best, 1e-6)
+        return {"capacity_rules": n_rules,
+                "capacity_batch": batch,
+                "capacity_step_ms": round(best * 1e3, 2),
+                "capacity_checks_per_sec": round(batch / best, 1),
+                "capacity_compile_s": round(compile_s, 2)}
+    except Exception as exc:
+        return {"capacity_error": f"{type(exc).__name__}: {exc}"}
 
 
 def _mesh_scaling_bench(on_tpu: bool) -> dict:

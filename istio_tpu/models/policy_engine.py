@@ -362,7 +362,12 @@ class PolicyEngine:
                 sym_ok = batch.present[:, list_slot_j]
                 member = jnp.any(
                     sym[:, :, None] == list_ids_j[None, :, :], axis=2)
-                und = jnp.zeros_like(member)
+                # und exists ONLY when regex banks do: the err
+                # scatter-max below is a [B, R]-operand scatter, and
+                # running it with an identically-False mask faulted
+                # the TPU at 50k rules (r4 regression; XLA kernel
+                # fault) while buying nothing
+                und = jnp.zeros_like(member) if rx_banks else None
                 for bank in rx_banks:
                     # one packed DFA scan per value byte slot answers
                     # every REGEX list over that subject
@@ -413,8 +418,10 @@ class PolicyEngine:
                               cidr_bank["ent_v4"][None])
                     member = member.at[:, cidr_bank["pos"]].set(
                         jnp.any(hit_e, axis=2) & val_ok)
-                l_active = active[:, list_rule_j] & sym_ok & ~und
-                err = err.at[:, list_rule_j].max(und)
+                l_active = active[:, list_rule_j] & sym_ok
+                if und is not None:
+                    l_active &= ~und
+                    err = err.at[:, list_rule_j].max(und)
                 l_deny = l_active & (member == list_black_j[None, :])
                 l_key = jnp.where(l_deny, list_rule_j[None, :], BIGI)
                 l_arg = jnp.argmin(l_key, axis=1)

@@ -183,6 +183,12 @@ class CheckBatcher:
                     except InvalidStateError:
                         pass                     # caller cancelled
                 return
+            if len(results) < len(batch):
+                # zip() would silently truncate and hang the trailing
+                # callers — route a contract violation through the belt
+                raise RuntimeError(
+                    f"run_batch returned {len(results)} results for a "
+                    f"{len(batch)}-request batch")
             # a caller may cancel its future mid-batch (an aio client
             # disconnect) — even between a cancelled() check and the
             # set; one cancelled future must never abort result
