@@ -70,6 +70,30 @@ def test_mesh_serving_at_scale_10k_rules():
         mesh.close()
 
 
+def test_batch_check_over_mesh_server(pair):
+    """The BatchCheck shim RPC through the SHARDED server: per-item
+    verdicts equal the single-device server's (the shim protocol and
+    the dp×mp serving layout compose)."""
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    from istio_tpu.api import MixerClient, MixerGrpcServer
+
+    plain, mesh = pair
+    g = MixerGrpcServer(mesh)
+    port = g.start()
+    client = MixerClient(f"127.0.0.1:{port}", enable_check_cache=False)
+    try:
+        cases = [{"request.path": f"/admin/{i}"} if i % 2 else
+                 {"request.path": f"/ok/{i}"} for i in range(10)]
+        got = [r.precondition.status.code
+               for r in client.batch_check(cases)]
+        want = [r.status_code for r in plain.check_many(
+            [bag_from_mapping(c) for c in cases])]
+        assert got == want
+    finally:
+        client.close()
+        g.stop()
+
+
 def test_mesh_server_over_grpc(pair):
     """gRPC wire in → batcher (bucket padding) → SHARDED step →
     response; verdicts equal the single-device server's."""
