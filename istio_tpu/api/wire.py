@@ -17,6 +17,7 @@ import datetime
 from typing import Any, Mapping
 
 from istio_tpu.api import mixer_pb2 as pb
+from istio_tpu.attribute.bag import Bag
 from istio_tpu.attribute.global_dict import (GLOBAL_WORD_INDEX,
                                              GLOBAL_WORD_LIST)
 
@@ -334,7 +335,7 @@ class RawCheckRequest:
         self.quotas[name] = params
 
 
-class LazyWireBag:
+class LazyWireBag(Bag):
     """Bag over raw CompressedAttributes bytes.
 
     The fused serving path tensorizes `wire` directly in C++ (zero

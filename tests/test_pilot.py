@@ -408,6 +408,46 @@ def test_route_nfa_synthetic_world_parity():
         assert rt.select_host(req) == sel[i], i
 
 
+def test_route_select_wire_host_fallback_parity():
+    """A route rule whose regex exceeds the DFA subset demotes to the
+    host oracle; select_wire must fall back to the bag path and still
+    agree with select_host on every request."""
+    from istio_tpu.api import mixer_pb2 as pb
+    from istio_tpu.api.wire import bag_to_compressed
+    from istio_tpu.pilot.model import (Config, ConfigMeta, Port,
+                                       Service)
+
+    svc = Service(hostname="svc0.default.svc.cluster.local",
+                  address="10.0.0.1", ports=(Port("http", 80, "HTTP"),))
+    rules = {svc.hostname: [
+        Config(ConfigMeta(type="route-rule", name="rr-backref",
+                          namespace="default"),
+               {"destination": {"name": "svc0"},
+                # backreference: unsupported by the DFA compiler
+                "match": {"request": {"headers": {
+                    "uri": {"regex": r"^/(a+)\1$"}}}},
+                "route": [{"labels": {"version": "v2"}}]}),
+        Config(ConfigMeta(type="route-rule", name="rr-plain",
+                          namespace="default"),
+               {"destination": {"name": "svc0"},
+                "match": {"request": {"headers": {
+                    "uri": {"prefix": "/api/"}}}},
+                "route": [{"labels": {"version": "v1"}}]}),
+    ]}
+    rt = RouteTable([svc], rules)
+    assert rt.program.host_fallback      # the backref rule demoted
+    reqs = [{"destination.service": svc.hostname, "request.path": p}
+            for p in ("/aaaa", "/aaa", "/api/x", "/other")]
+    wires = []
+    for r in reqs:
+        msg = pb.CompressedAttributes()
+        bag_to_compressed(r, msg=msg)
+        wires.append(msg.SerializeToString())
+    got = rt.select_wire(wires)
+    for i, r in enumerate(reqs):
+        assert got[i] == rt.select_host(r), (i, r)
+
+
 def test_route_select_wire_matches_select():
     """select_wire (C++ decode + device argmax, the sidecar-facing
     fast path) selects the same winners as select() over dict bags,
