@@ -15,6 +15,10 @@ from typing import Any, Iterable, Mapping
 class Bag:
     """Read-only attribute bag interface."""
 
+    # keep subclasses' __slots__ effective (a slotless base silently
+    # re-adds per-instance __dict__ to every wire bag)
+    __slots__ = ()
+
     def get(self, name: str) -> tuple[Any, bool]:
         raise NotImplementedError
 

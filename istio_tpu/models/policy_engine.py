@@ -61,6 +61,10 @@ RESOURCE_EXHAUSTED = 8
 INTERNAL = 13
 UNAVAILABLE = 14
 _BIG = np.float32(3.4e38)
+# adapter CheckResult defaults (adapters/sdk.py) — INTERNAL results
+# min these into the TTL fold, host-_combine parity
+DEFAULT_DUR = np.float32(5.0)
+DEFAULT_USES = np.int32(10_000)
 
 
 # occurrence rank within key groups — single-sourced with the rolling
@@ -395,6 +399,7 @@ class PolicyEngine:
                     member = member.at[:, bank["pos"]].set(
                         jnp.where(trunc, dec, hit))
                     und = und.at[:, bank["pos"]].set(trunc & ~dec)
+                bad = None        # present-but-unusable values
                 if cidr_bank is not None:
                     vb = batch.str_bytes[:, cidr_bank["bslots"], :16]
                     vl = batch.str_lens[:, cidr_bank["bslots"]]
@@ -418,23 +423,50 @@ class PolicyEngine:
                               cidr_bank["ent_v4"][None])
                     member = member.at[:, cidr_bank["pos"]].set(
                         jnp.any(hit_e, axis=2) & val_ok)
-                l_active = active[:, list_rule_j] & sym_ok
+                    # malformed present IP bytes (length not 4/16):
+                    # the host adapter raises before membership →
+                    # INTERNAL (handle_check's bytes normalization)
+                    bad = jnp.zeros_like(member).at[
+                        :, cidr_bank["pos"]].set(~val_ok)
+                # host parity for unusable values: an ACTIVE list rule
+                # whose value is absent (instance build EvalError) or
+                # malformed takes the _safe_check INTERNAL path — the
+                # device must not silently fail open
+                l_rule_act = active[:, list_rule_j]
+                l_internal = l_rule_act & ~sym_ok
+                l_eval = l_rule_act & sym_ok
+                if bad is not None:
+                    l_internal |= l_rule_act & sym_ok & bad
+                    l_eval &= ~bad
                 if und is not None:
-                    l_active &= ~und
+                    l_eval &= ~und
                     err = err.at[:, list_rule_j].max(und)
-                l_deny = l_active & (member == list_black_j[None, :])
-                l_key = jnp.where(l_deny, list_rule_j[None, :], BIGI)
+                l_hit = l_internal | (
+                    l_eval & (member == list_black_j[None, :]))
+                l_key = jnp.where(l_hit, list_rule_j[None, :], BIGI)
                 l_arg = jnp.argmin(l_key, axis=1)
                 l_rule = jnp.min(l_key, axis=1)
+                winner_internal = jnp.take_along_axis(
+                    l_internal, l_arg[:, None], axis=1)[:, 0]
                 take_l = l_rule < cand_rule     # strict: deny wins ties
-                cand_status = jnp.where(take_l, list_code_j[l_arg],
-                                        cand_status)
+                cand_status = jnp.where(
+                    take_l,
+                    jnp.where(winner_internal, INTERNAL,
+                              list_code_j[l_arg]),
+                    cand_status)
                 cand_rule = jnp.minimum(cand_rule, l_rule)
                 dur = jnp.minimum(dur, jnp.min(
-                    jnp.where(l_active, list_dur_j[None, :], _BIG), axis=1))
+                    jnp.where(l_eval, list_dur_j[None, :], _BIG), axis=1))
                 uses = jnp.minimum(uses, jnp.min(
-                    jnp.where(l_active, list_uses_j[None, :],
+                    jnp.where(l_eval, list_uses_j[None, :],
                               np.iinfo(np.int32).max), axis=1))
+                # an INTERNAL result carries the CheckResult DEFAULTS
+                # into the TTL min (host _combine parity)
+                any_internal = jnp.any(l_internal, axis=1)
+                dur = jnp.where(any_internal,
+                                jnp.minimum(dur, DEFAULT_DUR), dur)
+                uses = jnp.where(any_internal,
+                                 jnp.minimum(uses, DEFAULT_USES), uses)
 
             if has_rbac:
                 # allowed iff ANY lowered (binding, subject, role-rule)

@@ -256,6 +256,10 @@ class FusedPlan:
             if status == INTERNAL:
                 return "authorization instance evaluation failed"
         if rule_idx in self.list_rules:
+            if status == INTERNAL:
+                # absent/malformed value: the host path's EvalError /
+                # adapter-panic shape, not a membership rejection
+                return "list instance evaluation failed"
             name = self.engine.ruleset.rules[rule_idx].name
             return f"rejected by list check (rule {name})"
         return "denied by policy"

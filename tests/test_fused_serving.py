@@ -235,6 +235,55 @@ def test_fused_statuses(servers):
     assert r[15].status_message == "admin is off limits"
 
 
+def test_fused_list_edge_values_match_generic():
+    """Device-lowered REGEX/CIDR lists on edge inputs — absent values,
+    malformed IP byte lengths, unparseable addresses — must agree with
+    the host adapter."""
+    def store() -> MemStore:
+        s = MemStore()
+        s.set(("handler", "istio-system", "rx"), {
+            "adapter": "list",
+            "params": {"overrides": ["^/blocked/"],
+                       "entry_type": "REGEX", "blacklist": True}})
+        s.set(("handler", "istio-system", "cidr"), {
+            "adapter": "list",
+            "params": {"overrides": ["10.0.0.0/8"],
+                       "entry_type": "IP_ADDRESSES",
+                       "blacklist": False}})
+        s.set(("instance", "istio-system", "path"), {
+            "template": "listentry", "params": {"value": "request.path"}})
+        s.set(("instance", "istio-system", "ip"), {
+            "template": "listentry", "params": {"value": "source.ip"}})
+        s.set(("rule", "istio-system", "r0"), {
+            "match": 'request.scheme == "http"',
+            "actions": [{"handler": "rx", "instances": ["path"]}]})
+        s.set(("rule", "istio-system", "r1"), {
+            "match": 'request.scheme == "https"',
+            "actions": [{"handler": "cidr", "instances": ["ip"]}]})
+        return s
+
+    bags = [bag_from_mapping(c) for c in (
+        {"request.scheme": "http"},                       # path absent
+        {"request.scheme": "http", "request.path": ""},   # empty value
+        {"request.scheme": "https"},                      # ip absent
+        {"request.scheme": "https",
+         "source.ip": b"\x01\x02\x03"},                   # 3-byte junk
+        {"request.scheme": "https",
+         "source.ip": bytes([10, 0, 0, 1])},              # in CIDR
+    )]
+    fused = RuntimeServer(store(), ServerArgs(fused=True))
+    generic = RuntimeServer(store(), ServerArgs(fused=False))
+    try:
+        rf = fused.check_many(bags)
+        rg = generic.check_many(bags)
+        for i, (a, b) in enumerate(zip(rf, rg)):
+            assert a.status_code == b.status_code, \
+                (i, a.status_code, b.status_code)
+    finally:
+        fused.close()
+        generic.close()
+
+
 def test_ip_typed_values_keep_host_semantics():
     """Two configs that LOOK fusable but must stay host-side: a STRINGS
     list over an IP_ADDRESS-typed value (host normalizes bytes to a

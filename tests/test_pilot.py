@@ -448,6 +448,27 @@ def test_route_select_wire_host_fallback_parity():
         assert got[i] == rt.select_host(r), (i, r)
 
 
+def test_route_select_wire_without_native_shim():
+    """With the native tensorizer unavailable, select_wire serves the
+    python decode path — same winners."""
+    from istio_tpu.api import mixer_pb2 as pb
+    from istio_tpu.api.wire import bag_to_compressed
+    from istio_tpu.testing import workloads
+
+    services, rules = workloads.make_route_world(60)
+    rt = RouteTable(services, rules)
+    rt.native = None          # overwrite the cached_property
+    reqs = workloads.make_route_requests(32, n_services=len(services))
+    wires = []
+    for r in reqs:
+        msg = pb.CompressedAttributes()
+        bag_to_compressed(r, msg=msg)
+        wires.append(msg.SerializeToString())
+    got = rt.select_wire(wires)
+    want = rt.select(reqs)
+    assert (got == want).all()
+
+
 def test_route_select_wire_matches_select():
     """select_wire (C++ decode + device argmax, the sidecar-facing
     fast path) selects the same winners as select() over dict bags,

@@ -54,15 +54,27 @@ def test_whitelist_and_blacklist():
     assert v.status.tolist() == [OK, NOT_FOUND, PERMISSION_DENIED]
 
 
-def test_list_requires_value_presence():
-    """Absent checked attribute → adapter can't run → no deny from it
-    (the runtime surfaces the expression-eval error separately)."""
+def test_list_absent_value_is_internal():
+    """Absent checked attribute on an ACTIVE list rule → INTERNAL with
+    default TTLs, exactly like the host path (instance build EvalError
+    → _safe_check → CheckResult(INTERNAL); r4 parity fix — the device
+    previously failed open)."""
+    from istio_tpu.models.policy_engine import INTERNAL
     rules = [Rule(name="wl", match="")]
     eng = PolicyEngine(rules, FINDER,
                        lists=[ListEntrySpec(rule=0, value_attr="request.user",
                                             entries=["alice"])])
-    v = _run(eng, [{}])
-    assert v.status.tolist() == [OK]
+    v = _run(eng, [{}, {"request.user": "alice"}])
+    assert v.status.tolist() == [INTERNAL, OK]
+    assert float(v.valid_duration_s[0]) == 5.0    # CheckResult default
+    # the device TTL-fold constants must track the adapter SDK's
+    # CheckResult defaults (host _combine parity; they can't share a
+    # module without an import cycle)
+    from istio_tpu.adapters.sdk import (DEFAULT_VALID_DURATION_S,
+                                        DEFAULT_VALID_USE_COUNT)
+    from istio_tpu.models.policy_engine import DEFAULT_DUR, DEFAULT_USES
+    assert float(DEFAULT_DUR) == DEFAULT_VALID_DURATION_S
+    assert int(DEFAULT_USES) == DEFAULT_VALID_USE_COUNT
 
 
 def test_quota_fixed_window():
