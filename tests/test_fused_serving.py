@@ -491,6 +491,40 @@ def test_short_global_dict_falls_back_to_python_path(servers):
         g.stop()
 
 
+def test_batch_check_short_global_dict(servers):
+    """BatchCheck with a shortened global-dictionary prefix: every bag
+    decodes through the python wire path and per-item verdicts match
+    the unary short-dict behavior."""
+    import grpc
+    from istio_tpu.api.grpc_server import MixerGrpcServer
+    from istio_tpu.api import mixer_pb2 as pb
+    from istio_tpu.api.wire import (bag_to_compressed,
+                                    decode_batch_check_response,
+                                    encode_batch_check_request)
+
+    fused, _ = servers
+    g = MixerGrpcServer(fused)
+    port = g.start()
+    try:
+        blobs = []
+        for path in ("/admin/keys", "/ratings/1"):
+            msg = pb.CompressedAttributes()
+            bag_to_compressed({"request.path": path}, 10, msg=msg)
+            blobs.append(msg.SerializeToString())
+        chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+        call = chan.unary_unary(
+            "/istio.mixer.v1.Mixer/BatchCheck",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        raw = call(encode_batch_check_request(blobs, 10))
+        codes = [pb.CheckResponse.FromString(b).precondition.status.code
+                 for b in decode_batch_check_response(raw)]
+        assert codes == [PERMISSION_DENIED, OK]
+        chan.close()
+    finally:
+        g.stop()
+
+
 def test_snapshot_swap_under_load():
     """A config swap must never surface compile time in-band: the old
     snapshot serves while the new one's jit buckets pre-warm (SURVEY
