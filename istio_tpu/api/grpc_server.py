@@ -99,8 +99,6 @@ class MixerGrpcServer:
         unary Check without quotas/dedup. The batch is padded to the
         server's prewarmed bucket shapes so arbitrary client batch
         sizes never re-trace."""
-        from istio_tpu.runtime.batcher import pad_to_bucket
-
         gwc = request.global_word_count
         native = gwc in (0, len(GLOBAL_WORD_LIST))
         bags = [self.runtime.preprocess(
@@ -109,21 +107,29 @@ class MixerGrpcServer:
         if not bags:
             return b""
         monitor.CHECK_REQUESTS.inc(len(bags))
-        buckets = self.runtime.batcher.buckets
-        results: list = []
-        # oversize requests run in largest-bucket chunks — an arbitrary
-        # over-bucket shape would force a fresh device compile per
-        # distinct size (client-controlled stalls)
-        for lo in range(0, len(bags), buckets[-1]):
-            chunk = bags[lo:lo + buckets[-1]]
-            padded = pad_to_bucket(chunk, buckets)
-            results.extend(
-                self.runtime.check_batch_preprocessed(padded)[:len(chunk)])
+        results = self._check_bags_chunked(bags)
         blobs = [
             self._check_response(None, bag, result,
                                  quotas=[]).SerializeToString()
             for bag, result in zip(bags, results)]
         return encode_batch_check_response(blobs)
+
+    def _check_bags_chunked(self, bags: list) -> list:
+        """Preprocessed bags → results, in largest-bucket CHUNKS padded
+        to the prewarmed bucket shapes — an arbitrary over-bucket shape
+        would force a fresh device compile per distinct size (client-
+        controlled stalls). Single home of the rule: the BatchCheck
+        front and the native front-end pump both ride it."""
+        from istio_tpu.runtime.batcher import pad_to_bucket
+
+        buckets = self.runtime.batcher.buckets
+        results: list = []
+        for lo in range(0, len(bags), buckets[-1]):
+            chunk = bags[lo:lo + buckets[-1]]
+            padded = pad_to_bucket(chunk, buckets)
+            results.extend(
+                self.runtime.check_batch_preprocessed(padded)[:len(chunk)])
+        return results
 
     def _check_bag(self, request: RawCheckRequest):
         monitor.CHECK_REQUESTS.inc()

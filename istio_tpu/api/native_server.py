@@ -1,0 +1,280 @@
+"""Native Mixer front-end — the C++ HTTP/2 wire + python engine pumps.
+
+The data-plane component SURVEY §2.9 implication (a) owes: unary
+istio.mixer.v1.Mixer/Check|Report terminated in C++
+(native/httpd.cpp — connections, HTTP/2 framing, HPACK, gRPC framing,
+envelope split, adaptive batch formation, response framing), with
+python doing only per-BATCH engine work through the same fused path
+the grpc front uses. Reference anchor: mixer/pkg/api/grpcServer.go:118
+(Check), :262 (Report) — same request semantics (precondition check +
+per-quota loop with dedup ids), different transport economics: the
+python-grpc front pays ~0.4 ms of interpreter per RPC; this front pays
+it once per batch.
+
+Pump threads block in h2srv_take (ctypes releases the GIL, so the C++
+wire keeps running), run the batch through
+RuntimeServer.check_batch_preprocessed / report, resolve quotas via
+the device pools, and hand serialized CheckResponse bytes back for
+C++ to frame. Response serialization is memoized per verdict signature
+(uniform traffic → a handful of distinct responses per snapshot).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import struct
+import threading
+from typing import Any
+
+from istio_tpu.adapters.sdk import QuotaArgs
+from istio_tpu.api import mixer_pb2 as pb
+from istio_tpu.api.grpc_server import MixerGrpcServer
+from istio_tpu.api.wire import LazyWireBag
+from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
+from istio_tpu.native.build import ensure_httpd_built
+from istio_tpu.runtime import monitor
+from istio_tpu.runtime.server import RuntimeServer
+
+log = logging.getLogger("istio_tpu.api.native")
+
+_TAKE_TIMEOUT_MS = 200
+_COUNTER_NAMES = ("requests_decoded", "responses_sent",
+                  "batches_formed", "batch_rows", "in_flight",
+                  "conns_opened", "conns_closed", "protocol_errors",
+                  "bytes_in", "bytes_out")
+
+
+class _RowRequest:
+    """The slice of RawCheckRequest the quota loop reads."""
+
+    __slots__ = ("deduplication_id", "quotas")
+
+    def __init__(self, dedup: str, quotas: dict):
+        self.deduplication_id = dedup
+        self.quotas = quotas
+
+
+def _load_lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(ensure_httpd_built())
+    lib.h2srv_start.restype = ctypes.c_void_p
+    lib.h2srv_start.argtypes = [ctypes.c_int32] * 3 + \
+        [ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
+    lib.h2srv_port.restype = ctypes.c_int32
+    lib.h2srv_port.argtypes = [ctypes.c_void_p]
+    lib.h2srv_take.restype = ctypes.c_int64
+    lib.h2srv_take.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                               ctypes.c_char_p, ctypes.c_int64]
+    lib.h2srv_complete.restype = None
+    lib.h2srv_complete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int64]
+    lib.h2srv_counters.restype = None
+    lib.h2srv_counters.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int64)]
+    lib.h2srv_stop.restype = None
+    lib.h2srv_stop.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class NativeMixerServer(MixerGrpcServer):
+    """C++-wire Mixer server over a RuntimeServer core.
+
+    Inherits the response/quota assembly from MixerGrpcServer (the
+    single home of PreconditionResult/quota-loop semantics); replaces
+    the grpcio transport entirely.
+    """
+
+    def __init__(self, runtime: RuntimeServer, port: int = 0,
+                 max_batch: int = 1024, min_fill: int = 256,
+                 window_us: int = 2000, pumps: int = 2):
+        # deliberately NOT calling super().__init__ — no grpc.server
+        self.runtime = runtime
+        self._ref_cache: dict = {}
+        self._ref_cache_lock = threading.Lock()
+        self._resp_memo: dict = {}
+        self._lib = _load_lib()
+        self._h = self._lib.h2srv_start(port, max_batch, min_fill,
+                                        window_us, pumps, 0)
+        if not self._h:
+            raise RuntimeError("h2srv_start failed (port in use?)")
+        self.port = self._lib.h2srv_port(self._h)
+        self._stop_flag = threading.Event()
+        self._final_counters: dict | None = None
+        self._pumps = [
+            threading.Thread(target=self._pump_loop, daemon=True,
+                             name=f"mixer-native-pump-{i}")
+            for i in range(pumps)]
+
+    # -- lifecycle --
+
+    def start(self) -> int:
+        for t in self._pumps:
+            t.start()
+        log.info("native mixer server on port %d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        # order matters: pumps must be out of h2srv_take before the
+        # server object is torn down
+        if self._h is None:
+            return
+        self._stop_flag.set()
+        for t in self._pumps:
+            t.join(timeout=grace + 30)
+        self._final_counters = self.counters()
+        self._lib.h2srv_stop(self._h)
+        self._h = None
+
+    def counters(self) -> dict:
+        if self._h is None:   # post-stop: last snapshot, never a NULL
+            return dict(self._final_counters or {})
+        c = (ctypes.c_int64 * 10)()
+        hist = (ctypes.c_int64 * 16)()
+        self._lib.h2srv_counters(self._h, c, hist)
+        out = dict(zip(_COUNTER_NAMES, [int(v) for v in c]))
+        out["batch_size_hist"] = {1 << b: int(hist[b])
+                                  for b in range(16) if hist[b]}
+        return out
+
+    # -- pump --
+
+    def _pump_loop(self) -> None:
+        cap = 1 << 23          # per-thread: cap and buffer must agree
+        buf = ctypes.create_string_buffer(cap)
+        while not self._stop_flag.is_set():
+            n = self._lib.h2srv_take(self._h, _TAKE_TIMEOUT_MS, buf,
+                                     cap)
+            if n == -1:
+                return
+            if n == 0:
+                continue
+            if n < 0:          # buffer too small: grow and retry
+                cap = -int(n) * 2
+                buf = ctypes.create_string_buffer(cap)
+                continue
+            try:
+                self._run_batch(buf.raw[:n])
+            except Exception:
+                log.exception("native pump batch failed")
+
+    @staticmethod
+    def _parse_take(blob: bytes) -> list[tuple]:
+        """→ [(tag, kind, payload, gwc, dedup, quotas{name: (amount,
+        best_effort)})]."""
+        items = []
+        (_, n) = struct.unpack_from("<II", blob, 0)
+        off = 8
+        for _ in range(n):
+            (tag,) = struct.unpack_from("<Q", blob, off)
+            off += 8
+            kind = blob[off]
+            off += 1
+            (plen,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            payload = blob[off:off + plen]
+            off += plen
+            (gwc, dlen) = struct.unpack_from("<II", blob, off)
+            off += 8
+            dedup = blob[off:off + dlen].decode("utf-8", "replace")
+            off += dlen
+            (nq,) = struct.unpack_from("<H", blob, off)
+            off += 2
+            quotas = {}
+            for _q in range(nq):
+                (nlen,) = struct.unpack_from("<I", blob, off)
+                off += 4
+                qname = blob[off:off + nlen].decode("utf-8", "replace")
+                off += nlen
+                amount, be = struct.unpack_from("<qB", blob, off)
+                off += 9
+                quotas[qname] = (amount, bool(be))
+            items.append((tag, kind, payload, gwc, dedup, quotas))
+        return items
+
+    def _run_batch(self, blob: bytes) -> None:
+        items = self._parse_take(blob)
+        checks = [it for it in items if it[1] == 0]
+        reports = [it for it in items if it[1] == 1]
+        completions: list[tuple[int, int, bytes]] = []
+
+        if checks:
+            monitor.CHECK_REQUESTS.inc(len(checks))
+            bags = []
+            for _, _, payload, gwc, _, _ in checks:
+                native = gwc in (0, len(GLOBAL_WORD_LIST))
+                bags.append(self.runtime.preprocess(
+                    LazyWireBag(payload, gwc or None,
+                                native_ok=native)))
+            results = self._check_bags_chunked(bags)
+            # submit EVERY quota before resolving any: pool futures
+            # share one device batch window (aio front parity)
+            pending: list[tuple[int, Any, Any, list]] = []
+            for i, (item, bag, result) in enumerate(
+                    zip(checks, bags, results)):
+                _, _, _, _, dedup, quotas = item
+                if quotas and result.status_code == 0:
+                    req = _RowRequest(dedup, {
+                        name: pb.CheckRequest.QuotaParams(
+                            amount=amount, best_effort=be)
+                        for name, (amount, be) in quotas.items()})
+                    pending.append((i, bag, result,
+                                    self._submit_quotas(req, bag,
+                                                        result)))
+            resolved: dict[int, list] = {}
+            for i, bag, result, subs in pending:
+                done = []
+                for name, qr in subs:
+                    if hasattr(qr, "result"):
+                        qr = qr.result()
+                    done.append((name, qr))
+                resolved[i] = done
+            memo_hits = 0
+            for i, (item, bag, result) in enumerate(
+                    zip(checks, bags, results)):
+                tag = item[0]
+                quotas = resolved.get(i)
+                # memo ONLY bag-independent responses: presence must
+                # COVER the referenced set (incomplete presence makes
+                # _referenced_proto fall back to per-bag lookups —
+                # grpc_server._referenced_proto applies the same gate)
+                presence = result.referenced_presence
+                if quotas is None and presence is not None and \
+                        len(presence) == len(result.referenced):
+                    key = (result.status_code, result.status_message,
+                           result.valid_duration_s,
+                           result.valid_use_count, result.referenced,
+                           frozenset(
+                               result.referenced_presence.items()))
+                    raw = self._resp_memo.get(key)
+                    if raw is None:
+                        raw = self._check_response(
+                            None, bag, result,
+                            quotas=[]).SerializeToString()
+                        if len(self._resp_memo) > 8192:
+                            self._resp_memo.clear()
+                        self._resp_memo[key] = raw
+                    else:
+                        memo_hits += 1
+                else:
+                    raw = self._check_response(
+                        None, bag, result,
+                        quotas=quotas or []).SerializeToString()
+                completions.append((tag, 0, raw))
+            if memo_hits:   # memoized rows skip _check_response
+                monitor.CHECK_RESPONSES.inc(memo_hits)
+
+        for tag, _, payload, _, _, _ in reports:
+            try:
+                req = pb.ReportRequest.FromString(payload)
+                self._report(req, None)
+                completions.append((tag, 0, b""))
+            except Exception as exc:
+                completions.append(
+                    (tag, 13, f"report failed: {exc}".encode()))
+
+        out = [struct.pack("<I", len(completions))]
+        for tag, status, raw in completions:
+            out.append(struct.pack("<QiI", tag, status, len(raw)))
+            out.append(raw)
+        comp = b"".join(out)
+        self._lib.h2srv_complete(self._h, comp, len(comp))

@@ -15,6 +15,10 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _BUILD = os.path.join(_DIR, "_build")
 _SO = os.path.join(_BUILD, "libmixer_shim.so")
 _HASH = os.path.join(_BUILD, ".srchash")
+_HTTPD_SO = os.path.join(_BUILD, "libmixer_httpd.so")
+_HTTPD_HASH = os.path.join(_BUILD, ".httpd_srchash")
+_H2LOAD = os.path.join(_BUILD, "h2load")
+_H2LOAD_HASH = os.path.join(_BUILD, ".h2load_srchash")
 _PROTO_DIR = os.path.join(_DIR, "..", "api", "proto")
 _lock = threading.Lock()
 
@@ -61,3 +65,43 @@ def ensure_built() -> str:
         with open(_HASH, "w", encoding="ascii") as f:
             f.write(want + "\n")
         return _SO
+
+
+def _build_one(srcs: list[str], out: str, hash_path: str,
+               extra_args: list[str],
+               hash_extra: list[str] | None = None) -> str:
+    """Hash-gated g++ build of one native artifact."""
+    want = _source_hash(*srcs, *(hash_extra or []))
+    with _lock:
+        if os.path.exists(out) and os.path.exists(hash_path):
+            with open(hash_path, encoding="ascii") as f:
+                if f.read().strip() == want:
+                    return out
+        os.makedirs(_BUILD, exist_ok=True)
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", *extra_args, *srcs,
+                 "-o", out],
+                check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as exc:
+            raise NativeBuildError(
+                f"native build failed for {out}:\n{exc.stderr}") from exc
+        except FileNotFoundError as exc:
+            raise NativeBuildError(f"toolchain missing: {exc}") from exc
+        with open(hash_path, "w", encoding="ascii") as f:
+            f.write(want + "\n")
+        return out
+
+
+def ensure_httpd_built() -> str:
+    """Compile the native HTTP/2 front-end (httpd.cpp) → .so path."""
+    return _build_one(
+        [os.path.join(_DIR, "httpd.cpp")], _HTTPD_SO, _HTTPD_HASH,
+        ["-fPIC", "-shared", "-pthread", f"-I{_DIR}"],
+        hash_extra=[os.path.join(_DIR, "hpack_tables.h")])
+
+
+def ensure_h2load_built() -> str:
+    """Compile the C++ load client (h2load.cpp) → binary path."""
+    return _build_one(
+        [os.path.join(_DIR, "h2load.cpp")], _H2LOAD, _H2LOAD_HASH, [])

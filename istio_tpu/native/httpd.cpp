@@ -1,0 +1,1151 @@
+// Native sidecar-facing Mixer front-end: a from-scratch HTTP/2 (h2c
+// prior-knowledge) + HPACK + gRPC-framing server speaking the REAL
+// unary istio.mixer.v1.Mixer/Check|Report protocol at the wire.
+//
+// Role (SURVEY §2.9 implication (a), VERDICT r4 item 1): the reference
+// terminates sidecar gRPC in Go (mixer/pkg/api/grpcServer.go:118) and
+// its per-request cost is goroutine-cheap; this repo's python-grpc
+// front caps the box at ~2.4k RPC/s of pure transport. Here the wire
+// lives in C++: connections, HTTP/2 framing, HPACK state, request
+// envelope splitting and BATCH formation all happen off the GIL;
+// python only runs the per-batch engine step (decode → tensorize →
+// device → verdicts) through the existing fused path and returns
+// serialized CheckResponse bytes that this layer frames back onto the
+// wire. Done deliberately WITHOUT a grpc dependency: the image has no
+// C++ gRPC/nghttp2 headers, and the subset HTTP/2 a unary gRPC server
+// needs (SETTINGS/HEADERS/CONTINUATION/DATA/WINDOW_UPDATE/PING/
+// RST_STREAM/GOAWAY + full HPACK decode incl. Huffman and the dynamic
+// table) is small enough to own — and owning it is what makes the
+// front-end auditable as the data-plane component the survey owes.
+//
+// Threading model: ONE IO thread owns every socket (poll loop; writes
+// and protocol state never race). Decoded requests are queued; python
+// "pump" threads block in h2srv_take() (ctypes releases the GIL) and
+// receive whole batches under an adaptive policy — a batch dispatches
+// when it reaches `min_fill`, when `window_us` has passed since its
+// first request, or instantly when a pump is idle and anything is
+// queued. Completions enter via h2srv_complete() from pump threads,
+// are handed to the IO thread over an eventfd-signalled queue, and are
+// framed + written there.
+//
+// C ABI only (ctypes; no pybind11 in this image).
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "hpack_tables.h"
+
+namespace {
+
+// ------------------------------ HPACK ------------------------------
+
+struct HuffNode {
+  int16_t next[2];   // child node index, -1 none
+  int16_t sym;       // decoded symbol (0..256), -1 internal
+};
+
+struct HuffTrie {
+  std::vector<HuffNode> nodes;
+  HuffTrie() {
+    nodes.push_back({{-1, -1}, -1});
+    for (int s = 0; s < 257; s++) {
+      uint32_t code = kHuffCodes[s];
+      int len = kHuffLens[s];
+      int at = 0;
+      for (int b = len - 1; b >= 0; b--) {
+        int bit = (code >> b) & 1;
+        if (nodes[at].next[bit] < 0) {
+          nodes[at].next[bit] = static_cast<int16_t>(nodes.size());
+          nodes.push_back({{-1, -1}, -1});
+        }
+        at = nodes[at].next[bit];
+      }
+      nodes[at].sym = static_cast<int16_t>(s);
+    }
+  }
+};
+
+const HuffTrie& huff_trie() {
+  static HuffTrie t;
+  return t;
+}
+
+bool huff_decode(const uint8_t* p, size_t n, std::string* out) {
+  const HuffTrie& t = huff_trie();
+  int at = 0;
+  int bits_since_sym = 0;
+  for (size_t i = 0; i < n; i++) {
+    for (int b = 7; b >= 0; b--) {
+      int bit = (p[i] >> b) & 1;
+      at = t.nodes[at].next[bit];
+      if (at < 0) return false;
+      bits_since_sym++;
+      int sym = t.nodes[at].sym;
+      if (sym >= 0) {
+        if (sym == 256) return false;  // EOS in data is an error
+        out->push_back(static_cast<char>(sym));
+        at = 0;
+        bits_since_sym = 0;
+      }
+    }
+  }
+  // padding: ≤7 bits, all 1s (a prefix of EOS) — lenient on content,
+  // strict on length
+  return bits_since_sym <= 7;
+}
+
+struct HpackDecoder {
+  // dynamic table, newest first (RFC 7541 §2.3.2 addressing)
+  std::deque<std::pair<std::string, std::string>> dyn;
+  size_t dyn_size = 0;
+  size_t max_dyn = 4096;   // our advertised SETTINGS_HEADER_TABLE_SIZE
+
+  void evict() {
+    while (dyn_size > max_dyn && !dyn.empty()) {
+      dyn_size -= dyn.back().first.size() + dyn.back().second.size() + 32;
+      dyn.pop_back();
+    }
+  }
+  void add(const std::string& n, const std::string& v) {
+    dyn_size += n.size() + v.size() + 32;
+    dyn.emplace_front(n, v);
+    evict();
+  }
+  bool lookup(uint64_t idx, std::string* n, std::string* v) {
+    if (idx == 0) return false;
+    if (idx <= 61) {
+      *n = kHpackStatic[idx - 1].name;
+      *v = kHpackStatic[idx - 1].value;
+      return true;
+    }
+    size_t di = idx - 62;
+    if (di >= dyn.size()) return false;
+    *n = dyn[di].first;
+    *v = dyn[di].second;
+    return true;
+  }
+};
+
+bool hpack_int(const uint8_t*& p, const uint8_t* end, int prefix,
+               uint64_t* out) {
+  if (p >= end) return false;
+  uint64_t max = (1u << prefix) - 1;
+  uint64_t v = *p++ & max;
+  if (v < max) { *out = v; return true; }
+  int shift = 0;
+  while (p < end) {
+    uint8_t b = *p++;
+    v += static_cast<uint64_t>(b & 0x7f) << shift;
+    if (v > (1ull << 32)) return false;   // sanity bound
+    if (!(b & 0x80)) { *out = v; return true; }
+    shift += 7;
+    if (shift > 35) return false;
+  }
+  return false;
+}
+
+bool hpack_str(const uint8_t*& p, const uint8_t* end, std::string* out) {
+  if (p >= end) return false;
+  bool huff = (*p & 0x80) != 0;
+  uint64_t len;
+  if (!hpack_int(p, end, 7, &len)) return false;
+  if (p + len > end) return false;
+  out->clear();
+  if (huff) {
+    if (!huff_decode(p, len, out)) return false;
+  } else {
+    out->assign(reinterpret_cast<const char*>(p), len);
+  }
+  p += len;
+  return true;
+}
+
+// Decode a complete header block; collects every header (table state
+// depends on all of them) and reports the few the server routes on.
+bool hpack_block(HpackDecoder* dec, const uint8_t* p, size_t n,
+                 std::string* path, std::string* content_type,
+                 std::string* te) {
+  const uint8_t* end = p + n;
+  while (p < end) {
+    uint8_t b = *p;
+    std::string name, value;
+    if (b & 0x80) {                       // indexed field
+      uint64_t idx;
+      if (!hpack_int(p, end, 7, &idx)) return false;
+      if (!dec->lookup(idx, &name, &value)) return false;
+    } else if ((b & 0xe0) == 0x20) {      // dynamic table size update
+      uint64_t sz;
+      if (!hpack_int(p, end, 5, &sz)) return false;
+      if (sz > 4096) return false;        // above our advertised max
+      dec->max_dyn = sz;
+      dec->evict();
+      continue;
+    } else {
+      bool incremental = (b & 0xc0) == 0x40;
+      int prefix = incremental ? 6 : 4;
+      uint64_t idx;
+      if (!hpack_int(p, end, prefix, &idx)) return false;
+      if (idx) {
+        std::string ignored;
+        if (!dec->lookup(idx, &name, &ignored)) return false;
+      } else if (!hpack_str(p, end, &name)) {
+        return false;
+      }
+      if (!hpack_str(p, end, &value)) return false;
+      if (incremental) dec->add(name, value);
+    }
+    if (name == ":path") *path = value;
+    else if (name == "content-type") *content_type = value;
+    else if (name == "te") *te = value;
+  }
+  return true;
+}
+
+// --------------------------- HTTP/2 bits ---------------------------
+
+constexpr uint8_t F_DATA = 0x0, F_HEADERS = 0x1, F_PRIORITY = 0x2,
+                  F_RST = 0x3, F_SETTINGS = 0x4, F_PUSH = 0x5,
+                  F_PING = 0x6, F_GOAWAY = 0x7, F_WINUPD = 0x8,
+                  F_CONT = 0x9;
+constexpr uint8_t FL_END_STREAM = 0x1, FL_END_HEADERS = 0x4,
+                  FL_PADDED = 0x8, FL_PRIORITY = 0x20, FL_ACK = 0x1;
+const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+constexpr uint32_t kOurWindow = 1u << 30;
+
+void put_frame_header(std::string* out, uint32_t len, uint8_t type,
+                      uint8_t flags, uint32_t stream) {
+  char h[9];
+  h[0] = static_cast<char>((len >> 16) & 0xff);
+  h[1] = static_cast<char>((len >> 8) & 0xff);
+  h[2] = static_cast<char>(len & 0xff);
+  h[3] = static_cast<char>(type);
+  h[4] = static_cast<char>(flags);
+  uint32_t s = htonl(stream & 0x7fffffffu);
+  memcpy(h + 5, &s, 4);
+  out->append(h, 9);
+}
+
+// response header blocks are STATELESS hpack (no dynamic-table adds):
+// indexed :status 200 + literal-without-indexing content-type
+std::string resp_headers_block() {
+  std::string b;
+  b.push_back(static_cast<char>(0x88));        // :status 200 (static 8)
+  b.push_back(static_cast<char>(0x0f));        // literal w/o idx, name
+  b.push_back(static_cast<char>(31 - 15));     //   = static 31
+  const char ct[] = "application/grpc";
+  b.push_back(static_cast<char>(sizeof(ct) - 1));
+  b.append(ct, sizeof(ct) - 1);
+  return b;
+}
+
+void lit_header(std::string* b, const char* name, const std::string& v) {
+  b->push_back(0x00);                          // literal w/o idx, new name
+  b->push_back(static_cast<char>(strlen(name)));
+  b->append(name);
+  // values here are short (status ints / messages ≤ 126 bytes after
+  // truncation below); keep 7-bit length encoding valid
+  std::string vv = v.size() > 120 ? v.substr(0, 120) : v;
+  b->push_back(static_cast<char>(vv.size()));
+  b->append(vv);
+}
+
+// ------------------------- protobuf walking ------------------------
+// The request ENVELOPE (CheckRequest / ReportRequest top level) is
+// split with a hand varint walker — the payload `attributes` bytes
+// pass through to the python/engine side untouched (the shim's
+// protobuf decode happens once, there).
+
+struct PbReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+  bool skip(uint32_t wt) {
+    switch (wt) {
+      case 0: varint(); return ok;
+      case 1: if (end - p < 8) return ok = false; p += 8; return true;
+      case 2: {
+        uint64_t n = varint();
+        if (!ok || static_cast<uint64_t>(end - p) < n) return ok = false;
+        p += n;
+        return true;
+      }
+      case 5: if (end - p < 4) return ok = false; p += 4; return true;
+      default: return ok = false;
+    }
+  }
+  bool bytes_field(std::string* out) {
+    uint64_t n = varint();
+    if (!ok || static_cast<uint64_t>(end - p) < n) return ok = false;
+    out->assign(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return true;
+  }
+};
+
+struct QuotaParam {
+  std::string name;
+  int64_t amount = 0;
+  uint8_t best_effort = 0;
+};
+
+struct CheckEnvelope {
+  std::string attributes;   // raw CompressedAttributes bytes
+  uint32_t global_word_count = 0;
+  std::string dedup;
+  std::vector<QuotaParam> quotas;
+};
+
+bool parse_check_envelope(const uint8_t* p, size_t n, CheckEnvelope* out) {
+  PbReader r{p, p + n};
+  while (r.ok && r.p < r.end) {
+    uint64_t tag = r.varint();
+    if (!r.ok) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wt = tag & 7;
+    if (field == 1 && wt == 2) {
+      if (!r.bytes_field(&out->attributes)) return false;
+    } else if (field == 2 && wt == 0) {
+      out->global_word_count = static_cast<uint32_t>(r.varint());
+    } else if (field == 3 && wt == 2) {
+      if (!r.bytes_field(&out->dedup)) return false;
+    } else if (field == 4 && wt == 2) {
+      std::string entry;
+      if (!r.bytes_field(&entry)) return false;
+      QuotaParam q;
+      PbReader er{reinterpret_cast<const uint8_t*>(entry.data()),
+                  reinterpret_cast<const uint8_t*>(entry.data()) +
+                      entry.size()};
+      while (er.ok && er.p < er.end) {
+        uint64_t etag = er.varint();
+        if (!er.ok) return false;
+        if ((etag >> 3) == 1 && (etag & 7) == 2) {
+          if (!er.bytes_field(&q.name)) return false;
+        } else if ((etag >> 3) == 2 && (etag & 7) == 2) {
+          std::string params;
+          if (!er.bytes_field(&params)) return false;
+          PbReader pr{reinterpret_cast<const uint8_t*>(params.data()),
+                      reinterpret_cast<const uint8_t*>(params.data()) +
+                          params.size()};
+          while (pr.ok && pr.p < pr.end) {
+            uint64_t ptag = pr.varint();
+            if (!pr.ok) return false;
+            if ((ptag >> 3) == 1 && (ptag & 7) == 0) {
+              q.amount = static_cast<int64_t>(pr.varint());
+            } else if ((ptag >> 3) == 2 && (ptag & 7) == 0) {
+              q.best_effort = pr.varint() ? 1 : 0;
+            } else if (!pr.skip(ptag & 7)) {
+              return false;
+            }
+          }
+        } else if (!er.skip(etag & 7)) {
+          return false;
+        }
+      }
+      out->quotas.push_back(std::move(q));
+    } else if (!r.skip(wt)) {
+      return false;
+    }
+  }
+  return r.ok;
+}
+
+// ------------------------------ server -----------------------------
+
+struct Stream {
+  std::string path;
+  std::string body;          // gRPC-framed request bytes
+  bool headers_done = false;
+  bool dispatched = false;   // handed to the pump queue
+  bool closed = false;       // RST/error — completion is discarded
+  int64_t send_window = 65535;
+  std::string pending_out;   // DATA bytes parked on flow control
+  bool trailers_after_data = false;
+  std::string trailer_buf;   // trailers to emit once pending_out drains
+};
+
+struct PendingItem {
+  uint64_t tag;
+  uint8_t kind;   // 0 Check, 1 Report
+  CheckEnvelope env;
+  std::string report_raw;   // kind 1: full ReportRequest bytes
+  int64_t t_enq_ns;
+};
+
+struct Completion {
+  uint64_t tag;
+  int32_t grpc_status;
+  std::string msg;   // resp proto (status 0) | grpc-message text
+};
+
+struct Conn {
+  int fd = -1;
+  uint32_t gen = 0;
+  std::string in;            // unparsed inbound bytes
+  std::string out;           // outbound bytes awaiting write
+  bool preface_done = false;
+  bool goaway_sent = false;
+  bool broken = false;       // protocol error seen; drain out + close
+  HpackDecoder hpack;
+  std::unordered_map<uint32_t, Stream> streams;
+  // CONTINUATION state
+  uint32_t cont_stream = 0;
+  uint8_t cont_flags = 0;
+  std::string cont_block;
+  bool in_cont = false;
+  int64_t send_window = 65535;           // connection-level, theirs
+  int64_t remote_initial_window = 65535;
+  uint32_t remote_max_frame = 16384;
+  uint64_t recv_since_update = 0;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  int wake_fd = -1;
+  std::thread io;
+  std::atomic<bool> stopping{false};
+
+  int32_t max_batch = 1024;
+  int32_t min_fill = 256;
+  int64_t window_us = 2000;
+  int32_t n_pumps = 1;
+  bool echo = false;
+  std::string echo_resp;
+
+  std::mutex mu;                      // guards queue + hist
+  std::condition_variable cv;
+  std::deque<PendingItem> queue;
+  int64_t first_enq_ns = 0;
+  int idle_pumps = 0;
+
+  std::mutex cmu;                     // completion queue (pump → IO)
+  std::deque<Completion> completions;
+
+  // counters: [0] requests_decoded [1] responses_sent [2] batches
+  // [3] batch_rows [4] in_flight [5] conns_opened [6] conns_closed
+  // [7] protocol_errors [8] bytes_in [9] bytes_out
+  std::atomic<int64_t> counters[10] = {};
+  int64_t hist[16] = {0};
+
+  std::unordered_map<uint32_t, Conn*> conns;   // by gen
+  uint32_t next_gen = 1;
+};
+
+int64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec * 1000000000ll + ts.tv_nsec;
+}
+
+void conn_error(Server* srv, Conn* c, uint32_t code) {
+  if (!c->goaway_sent) {
+    std::string f;
+    put_frame_header(&f, 8, F_GOAWAY, 0, 0);
+    uint32_t last = htonl(0), ec = htonl(code);
+    f.append(reinterpret_cast<char*>(&last), 4);
+    f.append(reinterpret_cast<char*>(&ec), 4);
+    c->out += f;
+    c->goaway_sent = true;
+  }
+  srv->counters[7]++;
+}
+
+// emit DATA in frames capped at the client's SETTINGS_MAX_FRAME_SIZE
+void put_data_frames(Conn* c, uint32_t stream_id,
+                     const std::string& data) {
+  size_t off = 0;
+  do {
+    size_t chunk = std::min(data.size() - off,
+                            static_cast<size_t>(c->remote_max_frame));
+    put_frame_header(&c->out, chunk, F_DATA, 0, stream_id);
+    c->out.append(data, off, chunk);
+    off += chunk;
+  } while (off < data.size());
+}
+
+// frame up one gRPC response onto the stream (headers + DATA +
+// trailers), honoring send windows; parks DATA when blocked
+void write_response(Server* srv, Conn* c, uint32_t stream_id,
+                    int32_t grpc_status, const std::string& msg) {
+  auto it = c->streams.find(stream_id);
+  if (it == c->streams.end()) return;
+  if (it->second.closed) {   // RST'd while dispatched: drop, reclaim
+    c->streams.erase(it);
+    return;
+  }
+  Stream& st = it->second;
+
+  static const std::string hdr_block = resp_headers_block();
+  put_frame_header(&c->out, hdr_block.size(), F_HEADERS, FL_END_HEADERS,
+                   stream_id);
+  c->out += hdr_block;
+
+  std::string trailers;
+  {
+    std::string tb;
+    lit_header(&tb, "grpc-status", std::to_string(grpc_status));
+    if (grpc_status != 0 && !msg.empty())
+      lit_header(&tb, "grpc-message", msg);
+    put_frame_header(&trailers, tb.size(), F_HEADERS,
+                     FL_END_HEADERS | FL_END_STREAM, stream_id);
+    trailers += tb;
+  }
+
+  if (grpc_status == 0) {
+    std::string data;
+    data.push_back('\0');
+    uint32_t n = htonl(static_cast<uint32_t>(msg.size()));
+    data.append(reinterpret_cast<char*>(&n), 4);
+    data += msg;
+    int64_t len = static_cast<int64_t>(data.size());
+    if (st.send_window >= len && c->send_window >= len) {
+      st.send_window -= len;
+      c->send_window -= len;
+      put_data_frames(c, stream_id, data);
+      c->out += trailers;
+      c->streams.erase(it);
+      srv->counters[1]++;
+      return;
+    }
+    // parked: tiny responses only hit this when the client starves
+    // its windows; drained on WINDOW_UPDATE/SETTINGS
+    st.pending_out = std::move(data);
+    st.trailers_after_data = true;
+    st.trailer_buf = std::move(trailers);
+    return;
+  }
+  c->out += trailers;
+  c->streams.erase(it);
+  srv->counters[1]++;
+}
+
+void flush_parked(Server* srv, Conn* c) {
+  for (auto it = c->streams.begin(); it != c->streams.end();) {
+    Stream& st = it->second;
+    if (!st.trailers_after_data || st.pending_out.empty()) {
+      ++it;
+      continue;
+    }
+    int64_t len = static_cast<int64_t>(st.pending_out.size());
+    if (st.send_window >= len && c->send_window >= len) {
+      st.send_window -= len;
+      c->send_window -= len;
+      put_data_frames(c, it->first, st.pending_out);
+      c->out += st.trailer_buf;
+      srv->counters[1]++;
+      it = c->streams.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void enqueue_request(Server* srv, Conn* c, uint32_t stream_id,
+                     Stream* st) {
+  // unary gRPC: exactly one length-prefixed message in the body
+  if (st->body.size() < 5 || st->body[0] != 0) {
+    write_response(srv, c, stream_id, 12,
+                   st->body.empty() ? "empty body"
+                                    : "compressed requests unsupported");
+    return;
+  }
+  uint32_t mlen;
+  memcpy(&mlen, st->body.data() + 1, 4);
+  mlen = ntohl(mlen);
+  if (st->body.size() < 5 + static_cast<size_t>(mlen)) {
+    write_response(srv, c, stream_id, 13, "truncated grpc frame");
+    return;
+  }
+  const uint8_t* msg =
+      reinterpret_cast<const uint8_t*>(st->body.data()) + 5;
+
+  uint8_t kind;
+  PendingItem item;
+  if (st->path == "/istio.mixer.v1.Mixer/Check") {
+    kind = 0;
+    if (!parse_check_envelope(msg, mlen, &item.env)) {
+      write_response(srv, c, stream_id, 13, "bad CheckRequest");
+      return;
+    }
+  } else if (st->path == "/istio.mixer.v1.Mixer/Report") {
+    kind = 1;
+    item.report_raw.assign(reinterpret_cast<const char*>(msg), mlen);
+  } else {
+    write_response(srv, c, stream_id, 12, "unknown method " + st->path);
+    return;
+  }
+  st->dispatched = true;
+  st->body.clear();
+  st->body.shrink_to_fit();
+
+  if (srv->echo) {   // wire-ceiling mode: respond in C++, no engine
+    srv->counters[0]++;
+    write_response(srv, c, stream_id, 0, srv->echo_resp);
+    return;
+  }
+
+  item.tag = (static_cast<uint64_t>(c->gen) << 32) | stream_id;
+  item.kind = kind;
+  item.t_enq_ns = now_ns();
+  {
+    std::lock_guard<std::mutex> lk(srv->mu);
+    if (srv->queue.empty()) srv->first_enq_ns = item.t_enq_ns;
+    srv->queue.push_back(std::move(item));
+  }
+  srv->counters[0]++;
+  srv->counters[4]++;
+  srv->cv.notify_one();
+}
+
+// a complete header block arrived (HEADERS or final CONTINUATION):
+// initial headers open the stream; a second block on the same stream
+// is client trailers — decoded for HPACK table state, content dropped
+bool finish_header_block(Server* srv, Conn* c, uint32_t stream_id,
+                         uint8_t flags) {
+  Stream& st = c->streams[stream_id];
+  if (st.headers_done) {
+    std::string a, b2, d;
+    if (!hpack_block(&c->hpack,
+                     reinterpret_cast<const uint8_t*>(
+                         c->cont_block.data()),
+                     c->cont_block.size(), &a, &b2, &d))
+      return false;
+    if ((flags & FL_END_STREAM) && !st.dispatched)
+      enqueue_request(srv, c, stream_id, &st);
+    return true;
+  }
+  std::string ct, te;
+  if (!hpack_block(&c->hpack,
+                   reinterpret_cast<const uint8_t*>(
+                       c->cont_block.data()),
+                   c->cont_block.size(), &st.path, &ct, &te))
+    return false;
+  st.headers_done = true;
+  st.send_window = c->remote_initial_window;
+  if (flags & FL_END_STREAM)
+    enqueue_request(srv, c, stream_id, &st);
+  return true;
+}
+
+// parse as many complete frames as the inbound buffer holds
+bool process_in(Server* srv, Conn* c) {
+  if (!c->preface_done) {
+    if (c->in.size() < kPrefaceLen) return true;
+    if (memcmp(c->in.data(), kPreface, kPrefaceLen) != 0) return false;
+    c->in.erase(0, kPrefaceLen);
+    c->preface_done = true;
+  }
+  size_t pos = 0;   // cursor: one erase per call, not per frame
+  while (c->in.size() - pos >= 9) {
+    const uint8_t* hp =
+        reinterpret_cast<const uint8_t*>(c->in.data()) + pos;
+    uint32_t len = (hp[0] << 16) | (hp[1] << 8) | hp[2];
+    if (len > (1u << 24)) return false;
+    if (c->in.size() - pos < 9 + len) break;
+    uint8_t type = hp[3], flags = hp[4];
+    uint32_t stream_id;
+    memcpy(&stream_id, hp + 5, 4);
+    stream_id = ntohl(stream_id) & 0x7fffffffu;
+    const uint8_t* payload = hp + 9;
+
+    if (c->in_cont && type != F_CONT) return false;
+
+    switch (type) {
+      case F_SETTINGS: {
+        if (flags & FL_ACK) break;
+        if (len % 6) return false;
+        for (uint32_t off = 0; off + 6 <= len; off += 6) {
+          uint16_t id = (payload[off] << 8) | payload[off + 1];
+          uint32_t val;
+          memcpy(&val, payload + off + 2, 4);
+          val = ntohl(val);
+          if (id == 4) {   // INITIAL_WINDOW_SIZE
+            int64_t delta = static_cast<int64_t>(val) -
+                            c->remote_initial_window;
+            c->remote_initial_window = val;
+            for (auto& kv : c->streams) kv.second.send_window += delta;
+          } else if (id == 5 && val >= 16384) {   // MAX_FRAME_SIZE
+            c->remote_max_frame = val;
+          }
+        }
+        put_frame_header(&c->out, 0, F_SETTINGS, FL_ACK, 0);
+        flush_parked(srv, c);
+        break;
+      }
+      case F_PING: {
+        if (len != 8) return false;
+        if (!(flags & FL_ACK)) {
+          put_frame_header(&c->out, 8, F_PING, FL_ACK, 0);
+          c->out.append(reinterpret_cast<const char*>(payload), 8);
+        }
+        break;
+      }
+      case F_WINUPD: {
+        if (len != 4) return false;
+        uint32_t inc;
+        memcpy(&inc, payload, 4);
+        inc = ntohl(inc) & 0x7fffffffu;
+        if (stream_id == 0) {
+          c->send_window += inc;
+        } else {
+          auto it = c->streams.find(stream_id);
+          if (it != c->streams.end()) it->second.send_window += inc;
+        }
+        flush_parked(srv, c);
+        break;
+      }
+      case F_HEADERS: {
+        if (stream_id == 0) return false;
+        const uint8_t* p = payload;
+        uint32_t n = len;
+        if (flags & FL_PADDED) {
+          if (!n) return false;
+          uint8_t pad = *p++;
+          n--;
+          if (pad > n) return false;
+          n -= pad;
+        }
+        if (flags & FL_PRIORITY) {
+          if (n < 5) return false;
+          p += 5;
+          n -= 5;
+        }
+        c->cont_stream = stream_id;
+        c->cont_flags = flags;
+        c->cont_block.assign(reinterpret_cast<const char*>(p), n);
+        if (flags & FL_END_HEADERS) {
+          c->in_cont = false;
+          if (!finish_header_block(srv, c, stream_id, flags))
+            return false;
+        } else {
+          c->in_cont = true;
+        }
+        break;
+      }
+      case F_CONT: {
+        if (!c->in_cont || stream_id != c->cont_stream) return false;
+        c->cont_block.append(reinterpret_cast<const char*>(payload),
+                             len);
+        if (flags & FL_END_HEADERS) {
+          c->in_cont = false;
+          if (!finish_header_block(srv, c, stream_id, c->cont_flags))
+            return false;
+        }
+        break;
+      }
+      case F_DATA: {
+        if (stream_id == 0) return false;
+        const uint8_t* p = payload;
+        uint32_t n = len;
+        if (flags & FL_PADDED) {
+          if (!n) return false;
+          uint8_t pad = *p++;
+          n--;
+          if (pad > n) return false;
+          n -= pad;
+        }
+        auto it = c->streams.find(stream_id);
+        if (it != c->streams.end() && !it->second.dispatched) {
+          it->second.body.append(reinterpret_cast<const char*>(p), n);
+          if (it->second.body.size() > (1u << 24)) return false;
+          if (flags & FL_END_STREAM)
+            enqueue_request(srv, c, stream_id, &it->second);
+        }
+        // connection window top-up (we granted 1GB upfront)
+        c->recv_since_update += len;
+        if (c->recv_since_update >= (1u << 20)) {
+          put_frame_header(&c->out, 4, F_WINUPD, 0, 0);
+          uint32_t inc = htonl(
+              static_cast<uint32_t>(c->recv_since_update));
+          c->out.append(reinterpret_cast<char*>(&inc), 4);
+          c->recv_since_update = 0;
+        }
+        break;
+      }
+      case F_RST: {
+        if (len != 4 || stream_id == 0) return false;
+        auto it = c->streams.find(stream_id);
+        if (it != c->streams.end()) {
+          it->second.closed = true;
+          if (!it->second.dispatched) c->streams.erase(it);
+        }
+        break;
+      }
+      case F_GOAWAY:
+        break;   // client is draining; keep serving open streams
+      case F_PRIORITY:
+      case F_PUSH:
+      default:
+        break;   // ignore (PUSH from a client is protocol-noise)
+    }
+    srv->counters[8] += 9 + len;
+    pos += 9 + len;
+  }
+  if (pos) c->in.erase(0, pos);
+  return true;
+}
+
+void close_conn(Server* srv, Conn* c) {
+  srv->conns.erase(c->gen);
+  if (c->fd >= 0) close(c->fd);
+  srv->counters[6]++;
+  delete c;
+}
+
+void io_loop(Server* srv) {
+  std::vector<pollfd> pfds;
+  std::vector<Conn*> order;
+  while (!srv->stopping.load(std::memory_order_relaxed)) {
+    pfds.clear();
+    order.clear();
+    pfds.push_back({srv->listen_fd, POLLIN, 0});
+    pfds.push_back({srv->wake_fd, POLLIN, 0});
+    for (auto& kv : srv->conns) {
+      short ev = POLLIN;
+      if (!kv.second->out.empty()) ev |= POLLOUT;
+      pfds.push_back({kv.second->fd, ev, 0});
+      order.push_back(kv.second);
+    }
+    int rc = poll(pfds.data(), pfds.size(), 100);
+    if (rc < 0 && errno != EINTR) break;
+
+    // batch-window wakeups: a pump waiting out a window needs a
+    // notify when the window expires even with no IO
+    srv->cv.notify_all();
+
+    if (pfds[1].revents & POLLIN) {
+      uint64_t x;
+      while (read(srv->wake_fd, &x, 8) > 0) {}
+    }
+    // drain completions (frame + queue bytes on the owning conn)
+    {
+      std::deque<Completion> done;
+      {
+        std::lock_guard<std::mutex> lk(srv->cmu);
+        done.swap(srv->completions);
+      }
+      for (auto& comp : done) {
+        uint32_t gen = static_cast<uint32_t>(comp.tag >> 32);
+        uint32_t sid = static_cast<uint32_t>(comp.tag & 0xffffffffu);
+        auto it = srv->conns.find(gen);
+        srv->counters[4]--;
+        if (it != srv->conns.end())
+          write_response(srv, it->second, sid, comp.grpc_status,
+                         comp.msg);
+      }
+    }
+    if (pfds[0].revents & POLLIN) {
+      while (true) {
+        int fd = accept4(srv->listen_fd, nullptr, nullptr,
+                         SOCK_NONBLOCK);
+        if (fd < 0) break;
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        Conn* c = new Conn();
+        c->fd = fd;
+        c->gen = srv->next_gen++;
+        srv->conns[c->gen] = c;
+        srv->counters[5]++;
+        // server preface: SETTINGS + big connection window
+        std::string f;
+        put_frame_header(&f, 12, F_SETTINGS, 0, 0);
+        const uint16_t ids[2] = {4, 3};      // INITIAL_WINDOW, MAX_STREAMS
+        const uint32_t vals[2] = {kOurWindow, 65535};
+        for (int i = 0; i < 2; i++) {
+          char s[6];
+          s[0] = static_cast<char>(ids[i] >> 8);
+          s[1] = static_cast<char>(ids[i] & 0xff);
+          uint32_t v = htonl(vals[i]);
+          memcpy(s + 2, &v, 4);
+          f.append(s, 6);
+        }
+        put_frame_header(&f, 4, F_WINUPD, 0, 0);
+        uint32_t inc = htonl(kOurWindow - 65535);
+        f.append(reinterpret_cast<char*>(&inc), 4);
+        c->out += f;
+      }
+    }
+    // per-conn IO
+    for (size_t i = 2; i < pfds.size(); i++) {
+      Conn* c = order[i - 2];
+      if (pfds[i].revents & (POLLERR | POLLHUP | POLLNVAL)) {
+        close_conn(srv, c);
+        continue;
+      }
+      if (pfds[i].revents & POLLIN) {
+        char buf[65536];
+        bool dead = false;
+        while (true) {
+          ssize_t n = read(c->fd, buf, sizeof(buf));
+          if (n > 0) {
+            if (!c->broken) c->in.append(buf, n);
+            if (c->in.size() > (1u << 26)) { dead = true; break; }
+          } else if (n == 0) {
+            dead = true;
+            break;
+          } else {
+            if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true;
+            break;
+          }
+        }
+        if (!dead && !c->broken && !process_in(srv, c)) {
+          conn_error(srv, c, 1);   // PROTOCOL_ERROR
+          c->broken = true;
+          dead = c->out.empty();
+        }
+        if (dead) {
+          close_conn(srv, c);
+          continue;
+        }
+      }
+      if (!c->out.empty()) {
+        ssize_t n = write(c->fd, c->out.data(), c->out.size());
+        if (n > 0) {
+          srv->counters[9] += n;
+          c->out.erase(0, n);
+        } else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+          close_conn(srv, c);
+          continue;
+        }
+        if ((c->goaway_sent || c->broken) && c->out.empty())
+          close_conn(srv, c);
+      }
+    }
+  }
+  // shutdown: close everything
+  std::vector<Conn*> all;
+  for (auto& kv : srv->conns) all.push_back(kv.second);
+  for (Conn* c : all) close_conn(srv, c);
+}
+
+void put_u32(std::string* s, uint32_t v) {
+  s->append(reinterpret_cast<char*>(&v), 4);
+}
+void put_u64(std::string* s, uint64_t v) {
+  s->append(reinterpret_cast<char*>(&v), 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* h2srv_start(int32_t port, int32_t max_batch, int32_t min_fill,
+                  int64_t window_us, int32_t n_pumps,
+                  int32_t echo_mode) {
+  Server* srv = new Server();
+  srv->max_batch = max_batch > 0 ? max_batch : 1024;
+  srv->min_fill = min_fill > 0 ? min_fill : 256;
+  srv->window_us = window_us > 0 ? window_us : 2000;
+  srv->n_pumps = n_pumps > 0 ? n_pumps : 1;
+  srv->echo = echo_mode != 0;
+  if (srv->echo) {
+    // fixed OK CheckResponse: precondition{status{} dur{5s} uses 10000}
+    // (field 2 msg: {1:{},2:{1:5},3:10000})
+    const uint8_t resp[] = {0x12, 0x09, 0x0a, 0x00, 0x12, 0x02, 0x08,
+                            0x05, 0x18, 0x90, 0x4e};
+    srv->echo_resp.assign(reinterpret_cast<const char*>(resp),
+                          sizeof(resp));
+  }
+
+  srv->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one,
+             sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+           sizeof(addr)) != 0 ||
+      listen(srv->listen_fd, 512) != 0) {
+    close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+              &alen);
+  srv->port = ntohs(addr.sin_port);
+  srv->wake_fd = eventfd(0, EFD_NONBLOCK);
+  srv->io = std::thread(io_loop, srv);
+  return srv;
+}
+
+int32_t h2srv_port(void* h) { return static_cast<Server*>(h)->port; }
+
+// Blocking batch take (pump side). Adaptive policy (the saturation-
+// batcher fix the python batcher's fixed window lacked): dispatch when
+// the queue reaches min_fill; dispatch IMMEDIATELY when every pump is
+// idle (nothing in flight → a waiting request buys nothing by
+// waiting — light-load latency is one trip); otherwise a trip is in
+// flight, and this pump holds out for min_fill or window_us — tiny
+// trips never ride a busy transport. Returns bytes written, 0 on
+// timeout, -needed if the buffer is too small, -1 on shutdown.
+int64_t h2srv_take(void* h, int32_t timeout_ms, uint8_t* buf,
+                   int64_t cap) {
+  Server* srv = static_cast<Server*>(h);
+  std::unique_lock<std::mutex> lk(srv->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  srv->idle_pumps++;
+  while (true) {
+    if (srv->stopping.load(std::memory_order_relaxed)) {
+      srv->idle_pumps--;
+      return -1;
+    }
+    if (!srv->queue.empty()) {
+      int64_t waited_us = (now_ns() - srv->first_enq_ns) / 1000;
+      if (static_cast<int32_t>(srv->queue.size()) >= srv->min_fill ||
+          srv->idle_pumps == srv->n_pumps ||
+          waited_us >= srv->window_us) {
+        break;   // this pump takes the batch
+      }
+      // wait out the window (bounded; re-checked on every enqueue)
+      srv->cv.wait_for(lk, std::chrono::microseconds(
+                               srv->window_us - waited_us + 100));
+      continue;
+    }
+    if (srv->cv.wait_until(lk, deadline) == std::cv_status::timeout &&
+        srv->queue.empty()) {
+      srv->idle_pumps--;
+      return 0;
+    }
+  }
+  srv->idle_pumps--;
+
+  int32_t n = static_cast<int32_t>(srv->queue.size());
+  if (n > srv->max_batch) n = srv->max_batch;
+  // size pass
+  int64_t need = 8;
+  for (int32_t i = 0; i < n; i++) {
+    const PendingItem& it = srv->queue[i];
+    need += 8 + 1 + 4 + 4 + 4 + 2;
+    need += it.kind ? it.report_raw.size() : it.env.attributes.size();
+    need += it.env.dedup.size();
+    for (const auto& q : it.env.quotas) need += 4 + q.name.size() + 9;
+  }
+  if (need > cap) return -need;
+
+  std::string out;
+  out.reserve(need);
+  put_u32(&out, static_cast<uint32_t>(srv->counters[2]));
+  put_u32(&out, static_cast<uint32_t>(n));
+  for (int32_t i = 0; i < n; i++) {
+    PendingItem& it = srv->queue.front();
+    put_u64(&out, it.tag);
+    out.push_back(static_cast<char>(it.kind));
+    const std::string& payload =
+        it.kind ? it.report_raw : it.env.attributes;
+    put_u32(&out, static_cast<uint32_t>(payload.size()));
+    out += payload;
+    put_u32(&out, it.env.global_word_count);
+    put_u32(&out, static_cast<uint32_t>(it.env.dedup.size()));
+    out += it.env.dedup;
+    uint16_t nq = static_cast<uint16_t>(it.env.quotas.size());
+    out.append(reinterpret_cast<char*>(&nq), 2);
+    for (const auto& q : it.env.quotas) {
+      put_u32(&out, static_cast<uint32_t>(q.name.size()));
+      out += q.name;
+      put_u64(&out, static_cast<uint64_t>(q.amount));
+      out.push_back(static_cast<char>(q.best_effort));
+    }
+    srv->queue.pop_front();
+  }
+  if (!srv->queue.empty()) srv->first_enq_ns = now_ns();
+  srv->counters[2]++;
+  srv->counters[3] += n;
+  int b = 0;
+  while ((1 << b) < n && b < 15) b++;
+  srv->hist[b]++;
+  memcpy(buf, out.data(), out.size());
+  return static_cast<int64_t>(out.size());
+}
+
+// Completion blob: u32 n, then per item u64 tag, i32 grpc_status,
+// u32 len, bytes (resp proto when status 0, else grpc-message text).
+void h2srv_complete(void* h, const uint8_t* blob, int64_t len) {
+  Server* srv = static_cast<Server*>(h);
+  const uint8_t* p = blob;
+  const uint8_t* end = blob + len;
+  if (end - p < 4) return;
+  uint32_t n;
+  memcpy(&n, p, 4);
+  p += 4;
+  std::deque<Completion> out;
+  for (uint32_t i = 0; i < n && p + 16 <= end; i++) {
+    Completion comp;
+    memcpy(&comp.tag, p, 8);
+    p += 8;
+    memcpy(&comp.grpc_status, p, 4);
+    p += 4;
+    uint32_t mlen;
+    memcpy(&mlen, p, 4);
+    p += 4;
+    if (p + mlen > end) break;
+    comp.msg.assign(reinterpret_cast<const char*>(p), mlen);
+    p += mlen;
+    out.push_back(std::move(comp));
+  }
+  {
+    std::lock_guard<std::mutex> lk(srv->cmu);
+    for (auto& comp : out) srv->completions.push_back(std::move(comp));
+  }
+  uint64_t one = 1;
+  ssize_t ignored = write(srv->wake_fd, &one, 8);
+  (void)ignored;
+}
+
+void h2srv_counters(void* h, int64_t* out, int64_t* hist) {
+  Server* srv = static_cast<Server*>(h);
+  for (int i = 0; i < 10; i++)
+    out[i] = srv->counters[i].load(std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lk(srv->mu);
+  memcpy(hist, srv->hist, sizeof(srv->hist));
+}
+
+void h2srv_stop(void* h) {
+  Server* srv = static_cast<Server*>(h);
+  srv->stopping.store(true);
+  srv->cv.notify_all();
+  uint64_t one = 1;
+  ssize_t ignored = write(srv->wake_fd, &one, 8);
+  (void)ignored;
+  if (srv->io.joinable()) srv->io.join();
+  close(srv->listen_fd);
+  close(srv->wake_fd);
+  delete srv;
+}
+
+}  // extern "C"

@@ -1,0 +1,68 @@
+"""Smoke-drive the native C++ front-end end-to-end on CPU:
+RuntimeServer + NativeMixerServer, one grpcio-client check, then the
+C++ h2load client against the same server (payload-file plumbing and
+JSON output). Safe to run anywhere (hermetic CPU jax)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import json          # noqa: E402
+import struct        # noqa: E402
+import subprocess    # noqa: E402
+import tempfile      # noqa: E402
+
+
+def main() -> None:
+    from istio_tpu.api import MixerClient, mixer_pb2 as pb
+    from istio_tpu.api.native_server import NativeMixerServer
+    from istio_tpu.api.wire import bag_to_compressed
+    from istio_tpu.native.build import ensure_h2load_built
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.testing import workloads
+
+    srv = RuntimeServer(workloads.make_store(200), ServerArgs(
+        batch_window_s=0.001, max_batch=256, buckets=(256,),
+        default_manifest=workloads.MESH_MANIFEST))
+    native = NativeMixerServer(srv, min_fill=32, window_us=1000)
+    port = native.start()
+    try:
+        client = MixerClient(f"127.0.0.1:{port}",
+                             enable_check_cache=False)
+        r = client.check(workloads.make_request_dicts(1)[0])
+        print("grpcio check status:", r.precondition.status.code)
+        client.close()
+
+        # h2load payload file: u32-len-prefixed CheckRequests
+        reqs = workloads.make_request_dicts(64)
+        with tempfile.NamedTemporaryFile(suffix=".bin",
+                                         delete=False) as f:
+            for d in reqs:
+                msg = pb.CheckRequest(
+                    attributes=bag_to_compressed(d))
+                raw = msg.SerializeToString()
+                f.write(struct.pack("<I", len(raw)) + raw)
+            path = f.name
+        out = subprocess.run(
+            [ensure_h2load_built(), str(port), path, "500", "64",
+             "0.5"],
+            capture_output=True, text=True, timeout=120)
+        os.unlink(path)
+        print("h2load stderr:", out.stderr.strip() or "(none)")
+        rep = json.loads(out.stdout.strip())
+        print("h2load:", json.dumps(rep))
+        assert rep["errors"] == 0, rep
+        print("counters:", json.dumps(native.counters()))
+    finally:
+        native.stop()
+        srv.close()
+    print("SMOKE OK")
+
+
+if __name__ == "__main__":
+    main()
