@@ -54,6 +54,17 @@ class _RowRequest:
         self.quotas = quotas
 
 
+def start_echo_server(max_batch: int = 1024) -> tuple[int, Any]:
+    """Wire-ceiling mode: the C++ server answers every Check with a
+    fixed OK CheckResponse, no engine — (port, stop_fn). Single home
+    of the h2srv C ABI for bench/scripts (with _load_lib below)."""
+    lib = _load_lib()
+    h = lib.h2srv_start(0, max_batch, 256, 2000, 1, 1)
+    if not h:
+        raise RuntimeError("h2srv_start failed (echo)")
+    return lib.h2srv_port(h), lambda: lib.h2srv_stop(h)
+
+
 def _load_lib() -> ctypes.CDLL:
     lib = ctypes.CDLL(ensure_httpd_built())
     lib.h2srv_start.restype = ctypes.c_void_p
@@ -100,6 +111,10 @@ class NativeMixerServer(MixerGrpcServer):
         self.port = self._lib.h2srv_port(self._h)
         self._stop_flag = threading.Event()
         self._final_counters: dict | None = None
+        # serializes h2srv_complete against stop(): deferred quota
+        # completions fire from pool-worker threads and must never
+        # race the server teardown into a freed handle
+        self._comp_lock = threading.Lock()
         self._pumps = [
             threading.Thread(target=self._pump_loop, daemon=True,
                              name=f"mixer-native-pump-{i}")
@@ -122,8 +137,17 @@ class NativeMixerServer(MixerGrpcServer):
         for t in self._pumps:
             t.join(timeout=grace + 30)
         self._final_counters = self.counters()
-        self._lib.h2srv_stop(self._h)
-        self._h = None
+        if any(t.is_alive() for t in self._pumps):
+            # a pump is wedged mid-batch (device stall): freeing the
+            # handle under it would turn a stall into a segfault —
+            # leak the C++ server instead (it stays valid for the
+            # straggler's h2srv_take/complete calls)
+            log.error("native server handle leaked: pump stuck "
+                      "past %.0fs grace", grace + 30)
+            return
+        with self._comp_lock:
+            self._lib.h2srv_stop(self._h)
+            self._h = None
 
     def counters(self) -> dict:
         if self._h is None:   # post-stop: last snapshot, never a NULL
@@ -193,9 +217,27 @@ class NativeMixerServer(MixerGrpcServer):
 
     def _run_batch(self, blob: bytes) -> None:
         items = self._parse_take(blob)
+        completions: list[tuple[int, int, bytes]] = []
+        deferred: set[int] = set()
+        try:
+            self._run_batch_inner(items, completions, deferred)
+        except Exception:
+            # belt: NO failure may abandon a row — an unanswered tag
+            # hangs its client until deadline AND leaks the C++
+            # in_flight count (one bad request must not poison its
+            # batch-mates' connections)
+            log.exception("native pump batch failed")
+        done = {tag for tag, _, _ in completions} | deferred
+        for item in items:
+            if item[0] not in done:
+                completions.append(
+                    (item[0], 13, b"internal: batch processing failed"))
+        self._send_completions(completions)
+
+    def _run_batch_inner(self, items: list, completions: list,
+                         deferred: set) -> None:
         checks = [it for it in items if it[1] == 0]
         reports = [it for it in items if it[1] == 1]
-        completions: list[tuple[int, int, bytes]] = []
 
         if checks:
             monitor.CHECK_REQUESTS.inc(len(checks))
@@ -206,45 +248,41 @@ class NativeMixerServer(MixerGrpcServer):
                     LazyWireBag(payload, gwc or None,
                                 native_ok=native)))
             results = self._check_bags_chunked(bags)
-            # submit EVERY quota before resolving any: pool futures
-            # share one device batch window (aio front parity)
-            pending: list[tuple[int, Any, Any, list]] = []
-            for i, (item, bag, result) in enumerate(
-                    zip(checks, bags, results)):
-                _, _, _, _, dedup, quotas = item
-                if quotas and result.status_code == 0:
-                    req = _RowRequest(dedup, {
-                        name: pb.CheckRequest.QuotaParams(
-                            amount=amount, best_effort=be)
-                        for name, (amount, be) in quotas.items()})
-                    pending.append((i, bag, result,
-                                    self._submit_quotas(req, bag,
-                                                        result)))
-            resolved: dict[int, list] = {}
-            for i, bag, result, subs in pending:
-                done = []
-                for name, qr in subs:
-                    if hasattr(qr, "result"):
-                        qr = qr.result()
-                    done.append((name, qr))
-                resolved[i] = done
             memo_hits = 0
-            for i, (item, bag, result) in enumerate(
-                    zip(checks, bags, results)):
-                tag = item[0]
-                quotas = resolved.get(i)
+            for item, bag, result in zip(checks, bags, results):
+                tag, _, _, _, dedup, quotas = item
+                try:
+                    if quotas and result.status_code == 0:
+                        # quota rows complete via pool-future
+                        # callbacks: a batch's non-quota rows must NOT
+                        # wait out the quota flush window + device
+                        # trip (that added ~2 serialized trips to
+                        # EVERY row's latency)
+                        req = _RowRequest(dedup, {
+                            name: pb.CheckRequest.QuotaParams(
+                                amount=amount, best_effort=be)
+                            for name, (amount, be) in quotas.items()})
+                        self._defer_quota_row(
+                            tag, bag, result,
+                            self._submit_quotas(req, bag, result))
+                        deferred.add(tag)
+                        continue
+                except Exception as exc:   # row-isolated (quota path)
+                    monitor.DISPATCH_ERRORS.inc()
+                    completions.append(
+                        (tag, 13, f"quota submit: {exc}".encode()))
+                    continue
                 # memo ONLY bag-independent responses: presence must
                 # COVER the referenced set (incomplete presence makes
                 # _referenced_proto fall back to per-bag lookups —
                 # grpc_server._referenced_proto applies the same gate)
                 presence = result.referenced_presence
-                if quotas is None and presence is not None and \
+                if presence is not None and \
                         len(presence) == len(result.referenced):
                     key = (result.status_code, result.status_message,
                            result.valid_duration_s,
                            result.valid_use_count, result.referenced,
-                           frozenset(
-                               result.referenced_presence.items()))
+                           frozenset(presence.items()))
                     raw = self._resp_memo.get(key)
                     if raw is None:
                         raw = self._check_response(
@@ -258,7 +296,7 @@ class NativeMixerServer(MixerGrpcServer):
                 else:
                     raw = self._check_response(
                         None, bag, result,
-                        quotas=quotas or []).SerializeToString()
+                        quotas=[]).SerializeToString()
                 completions.append((tag, 0, raw))
             if memo_hits:   # memoized rows skip _check_response
                 monitor.CHECK_RESPONSES.inc(memo_hits)
@@ -272,9 +310,51 @@ class NativeMixerServer(MixerGrpcServer):
                 completions.append(
                     (tag, 13, f"report failed: {exc}".encode()))
 
+    def _send_completions(self, completions: list) -> None:
+        if not completions:
+            return
         out = [struct.pack("<I", len(completions))]
         for tag, status, raw in completions:
             out.append(struct.pack("<QiI", tag, status, len(raw)))
             out.append(raw)
         comp = b"".join(out)
-        self._lib.h2srv_complete(self._h, comp, len(comp))
+        with self._comp_lock:
+            if self._h is None:    # torn down under a deferred row
+                return
+            self._lib.h2srv_complete(self._h, comp, len(comp))
+
+    def _defer_quota_row(self, tag: int, bag, result,
+                         subs: list) -> None:
+        """Complete one quota-carrying row when its pool futures
+        resolve. All quotas were submitted already (they share a flush
+        window); the LAST future to land builds + sends the response
+        from the pool-worker thread — no pump thread blocks."""
+        futures = [qr for _, qr in subs
+                   if hasattr(qr, "add_done_callback")]
+        remaining = [len(futures)]
+        lock = threading.Lock()
+
+        def finish() -> None:
+            try:
+                raw = self._check_response(
+                    None, bag, result,
+                    quotas=subs).SerializeToString()
+                self._send_completions([(tag, 0, raw)])
+            except Exception:
+                log.exception("deferred quota completion failed")
+                self._send_completions(
+                    [(tag, 13, b"quota completion failed")])
+
+        if not futures:
+            finish()
+            return
+
+        def on_done(_value) -> None:
+            with lock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            finish()
+
+        for fut in futures:
+            fut.add_done_callback(on_done)

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import multiprocessing as mp
+import os
 import time
 from typing import Any, Mapping, Sequence
 
@@ -96,6 +97,38 @@ def make_report_payloads(dicts: Sequence[Mapping[str, Any]],
             bag_to_compressed(values, msg=req.attributes.add())
         out.append(req.SerializeToString())
     return out
+
+
+def run_h2load(port: int, payloads: Sequence[bytes], n_record: int,
+               depth: int, warmup_s: float,
+               timeout_s: float = 300.0) -> dict:
+    """Drive the native front-end (native/httpd.cpp) with the C++
+    closed-loop client (native/h2load.cpp) — the wire-speed
+    counterpart of run_load for servers whose transport is not bounded
+    by the python grpc stack. Payloads are serialized CheckRequests
+    (make_check_payloads); returns h2load's JSON report dict."""
+    import json
+    import struct
+    import subprocess
+    import tempfile
+
+    from istio_tpu.native.build import ensure_h2load_built
+
+    with tempfile.NamedTemporaryFile(suffix=".bin", delete=False) as f:
+        for raw in payloads:
+            f.write(struct.pack("<I", len(raw)) + raw)
+        path = f.name
+    try:
+        out = subprocess.run(
+            [ensure_h2load_built(), str(port), path, str(n_record),
+             str(depth), str(warmup_s)],
+            capture_output=True, text=True, timeout=timeout_s)
+        if out.returncode != 0:
+            raise PerfError(f"h2load rc={out.returncode}: "
+                            f"{out.stderr.strip()[-300:]}")
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    finally:
+        os.unlink(path)
 
 
 @dataclasses.dataclass

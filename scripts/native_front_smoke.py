@@ -13,18 +13,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import json          # noqa: E402
-import struct        # noqa: E402
-import subprocess    # noqa: E402
-import tempfile      # noqa: E402
 
 
 def main() -> None:
-    from istio_tpu.api import MixerClient, mixer_pb2 as pb
+    from istio_tpu.api import MixerClient
     from istio_tpu.api.native_server import NativeMixerServer
-    from istio_tpu.api.wire import bag_to_compressed
-    from istio_tpu.native.build import ensure_h2load_built
     from istio_tpu.runtime import RuntimeServer, ServerArgs
-    from istio_tpu.testing import workloads
+    from istio_tpu.testing import perf, workloads
 
     srv = RuntimeServer(workloads.make_store(200), ServerArgs(
         batch_window_s=0.001, max_batch=256, buckets=(256,),
@@ -38,23 +33,9 @@ def main() -> None:
         print("grpcio check status:", r.precondition.status.code)
         client.close()
 
-        # h2load payload file: u32-len-prefixed CheckRequests
-        reqs = workloads.make_request_dicts(64)
-        with tempfile.NamedTemporaryFile(suffix=".bin",
-                                         delete=False) as f:
-            for d in reqs:
-                msg = pb.CheckRequest(
-                    attributes=bag_to_compressed(d))
-                raw = msg.SerializeToString()
-                f.write(struct.pack("<I", len(raw)) + raw)
-            path = f.name
-        out = subprocess.run(
-            [ensure_h2load_built(), str(port), path, "500", "64",
-             "0.5"],
-            capture_output=True, text=True, timeout=120)
-        os.unlink(path)
-        print("h2load stderr:", out.stderr.strip() or "(none)")
-        rep = json.loads(out.stdout.strip())
+        payloads = perf.make_check_payloads(
+            workloads.make_request_dicts(64))
+        rep = perf.run_h2load(port, payloads, 500, 64, 0.5)
         print("h2load:", json.dumps(rep))
         assert rep["errors"] == 0, rep
         print("counters:", json.dumps(native.counters()))

@@ -1,0 +1,29 @@
+"""Measure the native wire's loopback ceiling: httpd.cpp in ECHO mode
+(fixed OK CheckResponse written in C++, no engine) driven by the C++
+h2load client. This is the counterpart of scripts/grpc_ceiling.py for
+the native front — the number that bounds served_native throughput on
+this box (1 core shared by client + server)."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    from istio_tpu.api.native_server import start_echo_server
+    from istio_tpu.testing import perf, workloads
+
+    port, stop = start_echo_server()
+    payloads = perf.make_check_payloads(workloads.make_request_dicts(64))
+    try:
+        for depth in (1, 64, 256):
+            rep = perf.run_h2load(port, payloads, 20000, depth, 1.0)
+            print(json.dumps({"mode": "echo", **rep}))
+    finally:
+        stop()
+
+
+if __name__ == "__main__":
+    main()
