@@ -150,11 +150,12 @@ class NativeMixerServer(MixerGrpcServer):
             self._h = None
 
     def counters(self) -> dict:
-        if self._h is None:   # post-stop: last snapshot, never a NULL
-            return dict(self._final_counters or {})
-        c = (ctypes.c_int64 * 10)()
-        hist = (ctypes.c_int64 * 16)()
-        self._lib.h2srv_counters(self._h, c, hist)
+        with self._comp_lock:   # h2srv_complete's teardown guard too
+            if self._h is None:   # post-stop: last snapshot, no NULL
+                return dict(self._final_counters or {})
+            c = (ctypes.c_int64 * 10)()
+            hist = (ctypes.c_int64 * 16)()
+            self._lib.h2srv_counters(self._h, c, hist)
         out = dict(zip(_COUNTER_NAMES, [int(v) for v in c]))
         out["batch_size_hist"] = {1 << b: int(hist[b])
                                   for b in range(16) if hist[b]}

@@ -98,10 +98,13 @@ def ensure_httpd_built() -> str:
     return _build_one(
         [os.path.join(_DIR, "httpd.cpp")], _HTTPD_SO, _HTTPD_HASH,
         ["-fPIC", "-shared", "-pthread", f"-I{_DIR}"],
-        hash_extra=[os.path.join(_DIR, "hpack_tables.h")])
+        hash_extra=[os.path.join(_DIR, "hpack_tables.h"),
+                    os.path.join(_DIR, "h2_frame.h")])
 
 
 def ensure_h2load_built() -> str:
     """Compile the C++ load client (h2load.cpp) → binary path."""
     return _build_one(
-        [os.path.join(_DIR, "h2load.cpp")], _H2LOAD, _H2LOAD_HASH, [])
+        [os.path.join(_DIR, "h2load.cpp")], _H2LOAD, _H2LOAD_HASH,
+        [f"-I{_DIR}"],
+        hash_extra=[os.path.join(_DIR, "h2_frame.h")])

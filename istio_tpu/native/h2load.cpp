@@ -35,25 +35,9 @@
 #include <unordered_map>
 #include <vector>
 
+#include "h2_frame.h"
+
 namespace {
-
-constexpr uint8_t F_DATA = 0x0, F_HEADERS = 0x1, F_SETTINGS = 0x4,
-                  F_PING = 0x6, F_GOAWAY = 0x7, F_WINUPD = 0x8;
-constexpr uint8_t FL_END_STREAM = 0x1, FL_END_HEADERS = 0x4,
-                  FL_ACK = 0x1;
-
-void put_frame_header(std::string* out, uint32_t len, uint8_t type,
-                      uint8_t flags, uint32_t stream) {
-  char h[9];
-  h[0] = static_cast<char>((len >> 16) & 0xff);
-  h[1] = static_cast<char>((len >> 8) & 0xff);
-  h[2] = static_cast<char>(len & 0xff);
-  h[3] = static_cast<char>(type);
-  h[4] = static_cast<char>(flags);
-  uint32_t s = htonl(stream & 0x7fffffffu);
-  memcpy(h + 5, &s, 4);
-  out->append(h, 9);
-}
 
 void lit_header(std::string* b, const std::string& name,
                 const std::string& v) {
@@ -64,11 +48,7 @@ void lit_header(std::string* b, const std::string& name,
   *b += v;
 }
 
-double now_s() {
-  timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  return ts.tv_sec + ts.tv_nsec * 1e-9;
-}
+double now_s() { return mono_s(); }
 
 }  // namespace
 
@@ -214,7 +194,9 @@ int main(int argc, char** argv) {
           double dt = now_s() - it->second;
           inflight.erase(it);
           completions++;
-          if (!ok) errors++;
+          // errors cover the SAME window as n/checks_per_sec — a
+          // warmup-phase blip must not taint the recorded figures
+          if (!ok && recording) errors++;
           if (recording) {
             lat.push_back(dt);
           } else if (now_s() - t_start >= warmup_s) {

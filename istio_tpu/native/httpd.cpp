@@ -52,6 +52,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "h2_frame.h"
 #include "hpack_tables.h"
 
 namespace {
@@ -221,29 +222,12 @@ bool hpack_block(HpackDecoder* dec, const uint8_t* p, size_t n,
 }
 
 // --------------------------- HTTP/2 bits ---------------------------
+// frame constants + put_frame_header live in h2_frame.h (shared with
+// the h2load client)
 
-constexpr uint8_t F_DATA = 0x0, F_HEADERS = 0x1, F_PRIORITY = 0x2,
-                  F_RST = 0x3, F_SETTINGS = 0x4, F_PUSH = 0x5,
-                  F_PING = 0x6, F_GOAWAY = 0x7, F_WINUPD = 0x8,
-                  F_CONT = 0x9;
-constexpr uint8_t FL_END_STREAM = 0x1, FL_END_HEADERS = 0x4,
-                  FL_PADDED = 0x8, FL_PRIORITY = 0x20, FL_ACK = 0x1;
 const char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
 constexpr size_t kPrefaceLen = 24;
 constexpr uint32_t kOurWindow = 1u << 30;
-
-void put_frame_header(std::string* out, uint32_t len, uint8_t type,
-                      uint8_t flags, uint32_t stream) {
-  char h[9];
-  h[0] = static_cast<char>((len >> 16) & 0xff);
-  h[1] = static_cast<char>((len >> 8) & 0xff);
-  h[2] = static_cast<char>(len & 0xff);
-  h[3] = static_cast<char>(type);
-  h[4] = static_cast<char>(flags);
-  uint32_t s = htonl(stream & 0x7fffffffu);
-  memcpy(h + 5, &s, 4);
-  out->append(h, 9);
-}
 
 // response header blocks are STATELESS hpack (no dynamic-table adds):
 // indexed :status 200 + literal-without-indexing content-type
@@ -463,12 +447,6 @@ struct Server {
   uint32_t next_gen = 1;
 };
 
-int64_t now_ns() {
-  timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  return ts.tv_sec * 1000000000ll + ts.tv_nsec;
-}
-
 void conn_error(Server* srv, Conn* c, uint32_t code) {
   if (!c->goaway_sent) {
     std::string f;
@@ -618,7 +596,7 @@ void enqueue_request(Server* srv, Conn* c, uint32_t stream_id,
 
   item.tag = (static_cast<uint64_t>(c->gen) << 32) | stream_id;
   item.kind = kind;
-  item.t_enq_ns = now_ns();
+  item.t_enq_ns = mono_ns();
   {
     std::lock_guard<std::mutex> lk(srv->mu);
     if (srv->queue.empty()) srv->first_enq_ns = item.t_enq_ns;
@@ -737,7 +715,7 @@ bool process_in(Server* srv, Conn* c) {
           if (pad > n) return false;
           n -= pad;
         }
-        if (flags & FL_PRIORITY) {
+        if (flags & FL_PRIORITY_FLAG) {
           if (n < 5) return false;
           p += 5;
           n -= 5;
@@ -1026,7 +1004,7 @@ int64_t h2srv_take(void* h, int32_t timeout_ms, uint8_t* buf,
       return -1;
     }
     if (!srv->queue.empty()) {
-      int64_t waited_us = (now_ns() - srv->first_enq_ns) / 1000;
+      int64_t waited_us = (mono_ns() - srv->first_enq_ns) / 1000;
       if (static_cast<int32_t>(srv->queue.size()) >= srv->min_fill ||
           srv->idle_pumps == srv->n_pumps ||
           waited_us >= srv->window_us) {
@@ -1083,7 +1061,7 @@ int64_t h2srv_take(void* h, int32_t timeout_ms, uint8_t* buf,
     }
     srv->queue.pop_front();
   }
-  if (!srv->queue.empty()) srv->first_enq_ns = now_ns();
+  if (!srv->queue.empty()) srv->first_enq_ns = mono_ns();
   srv->counters[2]++;
   srv->counters[3] += n;
   int b = 0;
