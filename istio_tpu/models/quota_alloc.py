@@ -24,6 +24,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# Device-path bound on a SINGLE request's quota amount (step_seg): at
+# B ≤ 512 rows per flush, cumsums of clamped amounts stay int32-exact
+# (512 × 2^21 = 2^30). Over-domain all-or-nothing rows are denied;
+# best-effort rows cap here. memquota amounts are per-request counts,
+# so real traffic is orders of magnitude below this.
+DOMAIN_MAX = 1 << 21
+
 
 def batch_rank(key):
     """rank[i] = #{j < i in stable sort order : key[j] == key[i]} — the
@@ -112,6 +119,20 @@ def make_alloc_step(n_buckets: int, jit: bool = True):
     return step, step_fast
 
 
+def seg_scan(op, v, newseg):
+    """Segmented inclusive scan: op over runs delimited by `newseg`
+    (True at each run's first element). Standard segmented-scan
+    operator — (v1,f1)⊕(v2,f2) = (v2 if f2 else op(v1,v2), f1|f2) —
+    which is associative, so the whole thing is one parallel
+    lax.associative_scan instead of an O(B) sequential loop."""
+    def comb(a, b):
+        av, af = a
+        bv, bf = b
+        return jnp.where(bf, bv, op(av, bv)), af | bf
+    out, _ = lax.associative_scan(comb, (v, newseg))
+    return out
+
+
 def make_rolling_alloc_step(n_buckets: int, k_ticks: int,
                             jit: bool = True):
     """Rolling-window variant: counters are per-(bucket, tick-slot)
@@ -121,11 +142,14 @@ def make_rolling_alloc_step(n_buckets: int, k_ticks: int,
     avail = max - sum(live slots) and commits grants into the current
     tick's slot (rollingWindow.alloc :118).
 
-    → (scan_fn, fast_fn, unit_fn), each
+    → (scan_fn, fast_fn, unit_fn, seg_fn), each
     fn(slots[i32 n_buckets×K], buckets[i32 B], amounts[i32 B],
        best_effort[bool B], max_amounts[i32 B], active[bool B],
        ticks[i32 B], last_ticks[i32 B], rolling[bool B])
-    → (granted[i32 B], new_slots).
+    → (granted[i32 B], new_slots). scan_fn is the sequential parity
+    ORACLE (tests/bench only — the serving path never selects it);
+    fast_fn needs unique active buckets, unit_fn all-ones amounts,
+    seg_fn handles any contended mixed batch in parallel.
 
     Ticks are caller-rebased ints (host: floor(now / tick_len) minus a
     per-bucket base — int32-safe and boundary-exact vs the host
@@ -203,6 +227,78 @@ def make_rolling_alloc_step(n_buckets: int, k_ticks: int,
         return granted, _commit(slots, buckets, ticks, rolling,
                                 jnp.where(active, granted, 0))
 
+    def step_seg(slots, buckets, amounts, best_effort, max_amounts,
+                 active, ticks, last_ticks, rolling):
+        """Contended MIXED-amount batches without an O(B) scan
+        (VERDICT r4 item 4): the serving path fixes the intra-window
+        serialization order to (bucket, all-or-nothing before
+        best-effort, amount ascending) — the window collects ~10ms of
+        raced arrivals, so any deterministic order is as faithful to
+        the reference's mutex as arrival order was — and under THAT
+        order sequential memquota semantics (memquota.go:118) have a
+        closed form:
+
+          * all-or-nothing, amounts ascending: a denial consumes
+            nothing, and every later request is ≥ the denied one with
+            the same remaining budget, so denial is a prefix-sum
+            threshold — grant a_i iff cumsum_incl_i ≤ avail;
+          * best-effort rows (after every ao row): consumption equals
+            their amount-cumsum until the budget saturates, so
+            g_i = clip(min(a_i, avail − consumed_ao − becum_before_i)).
+
+        Equals the sequential scan kernel run over the lexsorted batch
+        bit-for-bit (pinned by tests) WITHIN the device quota domain:
+        single-request amounts are bounded at DOMAIN_MAX = 2^21 so a
+        512-row run's amount-cumsum stays int32-exact (jax here runs
+        without x64 — an int64 astype would silently truncate, and an
+        adversarial wire amount near INT32_MAX could wrap the cumsum
+        into an over-grant). Over-domain rows fail SAFE: all-or-
+        nothing above 2^21 is denied (never a wrong partial grant);
+        best-effort caps at the bound. memquota amounts are
+        per-request counts — real traffic sits many orders below."""
+        slots = jnp.asarray(slots)
+        slots, used = _roll_and_used(slots, buckets, ticks, last_ticks,
+                                     rolling, active)
+        b = buckets.shape[0]
+        domain_max = jnp.int32(DOMAIN_MAX)
+        over = amounts > domain_max
+        a_pos = jnp.clip(amounts, 0, domain_max)
+        key_bucket = jnp.where(active, buckets,
+                               jnp.iinfo(jnp.int32).max)
+        order = jnp.lexsort((a_pos, best_effort, key_bucket))
+        sb = key_bucket[order]
+        sa = a_pos[order]
+        sbe = best_effort[order]
+        sact = active[order]
+        sover = over[order]
+        savail = (max_amounts - used)[order]
+        newseg = jnp.concatenate(
+            [jnp.ones(1, bool), sb[1:] != sb[:-1]])
+        # all-or-nothing sub-run: prefix-sum threshold
+        v_ao = jnp.where(sact & ~sbe, sa, 0)
+        cum_ao = seg_scan(jnp.add, v_ao, newseg)
+        grant_ao = sact & ~sbe & ~sover & (sa > 0) & (cum_ao <= savail)
+        # budget the ao rows consumed, as seen by every later row of
+        # the run (a running max: denied rows contribute nothing)
+        consumed_ao = seg_scan(jnp.maximum,
+                               jnp.where(grant_ao, cum_ao, 0), newseg)
+        # best-effort sub-run (sorts after ao): partial at the
+        # boundary. Intermediates are clamped non-negative BEFORE each
+        # subtraction — savail can sit anywhere in int32 (a shrunken
+        # limit leaves used > max), and a raw savail-consumed-cum
+        # chain could wrap negative→positive into an over-grant.
+        v_be = jnp.where(sact & sbe, sa, 0)
+        cum_be_before = seg_scan(jnp.add, v_be, newseg) - v_be
+        rem_after_ao = jnp.maximum(jnp.maximum(savail, 0) - consumed_ao,
+                                   0)
+        g_be = jnp.clip(
+            jnp.minimum(sa, rem_after_ao - cum_be_before), 0)
+        sg = jnp.where(grant_ao, sa,
+                       jnp.where(sact & sbe, g_be, 0)).astype(jnp.int32)
+        granted = jnp.zeros(b, jnp.int32).at[order].set(sg)
+        return granted, _commit(slots, buckets, ticks, rolling,
+                                jnp.where(active, granted, 0))
+
     def step_unit(slots, buckets, amounts, best_effort, max_amounts,
                   active, ticks, last_ticks, rolling):
         """Contended batches where EVERY active amount == 1 (the
@@ -225,5 +321,6 @@ def make_rolling_alloc_step(n_buckets: int, k_ticks: int,
     if jit:
         return (jax.jit(step, donate_argnums=(0,)),
                 jax.jit(step_fast, donate_argnums=(0,)),
-                jax.jit(step_unit, donate_argnums=(0,)))
-    return step, step_fast, step_unit
+                jax.jit(step_unit, donate_argnums=(0,)),
+                jax.jit(step_seg, donate_argnums=(0,)))
+    return step, step_fast, step_unit, step_seg

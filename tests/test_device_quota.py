@@ -62,7 +62,8 @@ def test_rolling_unit_kernel_matches_scan():
 
     rng = np.random.default_rng(7)
     n_buckets, k, b = 16, 10, 256
-    scan, fast, unit = make_rolling_alloc_step(n_buckets, k, jit=False)
+    scan, fast, unit, seg = make_rolling_alloc_step(n_buckets, k,
+                                                    jit=False)
     slots0 = rng.integers(0, 3, (n_buckets, k)).astype(np.int32)
     buckets = rng.integers(0, n_buckets, b).astype(np.int32)
     amounts = np.ones(b, np.int32)
@@ -81,6 +82,13 @@ def test_rolling_unit_kernel_matches_scan():
                   ticks, lasts, rolling)
     np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
     np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    # the segmented kernel serializes ao-before-be, so WHICH unit rows
+    # win can differ from submission order — but the per-bucket grant
+    # totals (hence the committed slots) are order-independent
+    g3, s3 = seg(slots0, buckets, amounts, be, mx, active,
+                 ticks, lasts, rolling)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s3))
+    assert int(np.asarray(g3).sum()) == int(np.asarray(g1).sum())
 
 
 def test_kernels_never_grant_negative_amounts():
@@ -91,7 +99,8 @@ def test_kernels_never_grant_negative_amounts():
     from istio_tpu.models.quota_alloc import make_rolling_alloc_step
 
     n_buckets, k = 8, 10
-    scan, fast, _unit = make_rolling_alloc_step(n_buckets, k, jit=False)
+    scan, fast, _unit, seg = make_rolling_alloc_step(n_buckets, k,
+                                                     jit=False)
     slots0 = np.zeros((n_buckets, k), np.int32)
     slots0[2, 0] = 5
     buckets = np.array([2, 2], np.int32)
@@ -101,7 +110,7 @@ def test_kernels_never_grant_negative_amounts():
     active = np.ones(2, bool)
     z = np.zeros(2, np.int32)
     roll = np.zeros(2, bool)
-    for fn in (scan, fast):
+    for fn in (scan, fast, seg):
         g, s = fn(slots0, buckets, amounts, be, mx, active, z, z, roll)
         assert (np.asarray(g) == 0).all(), fn
         np.testing.assert_array_equal(np.asarray(s), slots0)
@@ -112,6 +121,129 @@ def test_kernels_never_grant_negative_amounts():
         g, c = fn(c0, buckets, amounts, be, mx, active)
         assert (np.asarray(g) == 0).all(), fn
         np.testing.assert_array_equal(np.asarray(c), c0)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_seg_kernel_matches_scan_under_fixed_order(seed):
+    """The segmented prefix-sum kernel (VERDICT r4 item 4) IS the
+    sequential scan under the serving path's deterministic
+    intra-window order — (bucket, ao-before-be, amount ascending).
+    Heavily contended mixed-amount batches with live rolling windows;
+    expected = scan run over the pre-lexsorted batch, unscattered."""
+    from istio_tpu.models.quota_alloc import make_rolling_alloc_step
+
+    rng = np.random.default_rng(seed)
+    n_buckets, k, b = 12, 10, 256
+    scan, fast, unit, seg = make_rolling_alloc_step(n_buckets, k,
+                                                    jit=False)
+    slots0 = rng.integers(0, 3, (n_buckets, k)).astype(np.int32)
+    buckets = rng.integers(0, n_buckets, b).astype(np.int32)
+    amounts = rng.integers(0, 6, b).astype(np.int32)
+    be = rng.random(b) < 0.4
+    mx = np.take(rng.integers(4, 30, n_buckets).astype(np.int32),
+                 buckets)
+    active = rng.random(b) < 0.9
+    ticks = np.full(b, 9, np.int32)
+    lasts = np.take(rng.integers(0, 9, n_buckets).astype(np.int32),
+                    buckets)
+    rolling = np.take(rng.random(n_buckets) < 0.7, buckets)
+
+    g_seg, s_seg = seg(slots0, buckets, amounts, be, mx, active,
+                       ticks, lasts, rolling)
+
+    sent = np.where(active, buckets, np.iinfo(np.int32).max)
+    order = np.lexsort((np.maximum(amounts, 0), be, sent))
+    g_sorted, s_ref = scan(
+        slots0, buckets[order], amounts[order], be[order], mx[order],
+        active[order], ticks[order], lasts[order], rolling[order])
+    g_ref = np.zeros(b, np.int32)
+    g_ref[order] = np.asarray(g_sorted)
+
+    np.testing.assert_array_equal(np.asarray(g_seg), g_ref)
+    np.testing.assert_array_equal(np.asarray(s_seg),
+                                  np.asarray(s_ref))
+
+
+def test_pool_serving_never_selects_scan():
+    """No serving-reachable input may pick the O(B) scan (VERDICT r4
+    item 4): a hot bucket + mixed amounts — the exact shape that used
+    to fall back — must resolve through the parallel kernels. The
+    scan is booby-trapped; grants must still match the host-adapter
+    oracle fed in the pool's stated (ao-asc, be-asc) order."""
+    clk = _Clock()
+    quotas = {"rq": {"max_amount": 12, "valid_duration_s": 60.0}}
+    pool = DeviceQuotaPool(quotas, n_buckets=64, clock=clk,
+                           batch_window_s=0.02, jit=False)
+
+    def _bomb(*_a, **_k):
+        raise AssertionError("O(B) scan selected on the serving path")
+
+    pool._alloc_scan = _bomb
+    try:
+        args = [(5, False), (4, False), (1, False), (6, True),
+                (3, True), (2, False)]
+        futs = [pool.alloc("rq", _inst({}),
+                           QuotaArgs(quota_amount=a, best_effort=e))
+                for a, e in args]
+        got = [f.result(timeout=30).granted_amount for f in futs]
+    finally:
+        pool.close()
+
+    host = MemQuotaHandler({"quotas": [
+        {"name": "rq", "max_amount": 12, "valid_duration_s": 60.0}]},
+        Env("test"), clock=clk)
+    # the pool's deterministic intra-window order: ao amount-asc,
+    # then be amount-asc
+    order = sorted(range(len(args)), key=lambda i: (args[i][1],
+                                                    args[i][0]))
+    want = [0] * len(args)
+    for i in order:
+        a, e = args[i]
+        r = host.handle_quota("quota", _inst({}),
+                              QuotaArgs(quota_amount=a, best_effort=e))
+        want[i] = r.granted_amount
+    assert got == want, (got, want)
+
+
+def test_seg_kernel_adversarial_amounts_never_over_grant():
+    """Wire-supplied near-INT32_MAX amounts must never wrap the
+    segment cumsum into an over-grant (this repo runs jax without
+    x64, so int64 casts silently truncate — the guard is the
+    DOMAIN_MAX clamp + fail-closed over-domain handling)."""
+    from istio_tpu.models.quota_alloc import (DOMAIN_MAX,
+                                              make_rolling_alloc_step)
+
+    n_buckets, k = 4, 10
+    _scan, _fast, _unit, seg = make_rolling_alloc_step(n_buckets, k,
+                                                       jit=False)
+    slots0 = np.zeros((n_buckets, k), np.int32)
+    big = np.int32(1_500_000_000)
+    buckets = np.array([1, 1, 1], np.int32)
+    amounts = np.array([big, big, 5], np.int32)
+    be = np.array([False, False, True])
+    mx = np.full(3, 10, np.int32)
+    active = np.ones(3, bool)
+    z = np.zeros(3, np.int32)
+    roll = np.zeros(3, bool)
+    g, s = seg(slots0, buckets, amounts, be, mx, active, z, z, roll)
+    g = np.asarray(g)
+    # over-domain ao rows fail closed; the small be row still grants
+    assert g[0] == 0 and g[1] == 0
+    assert g[2] == 5
+    assert int(np.asarray(s).sum()) == 5
+    # over-domain BEST-EFFORT caps at avail (never a huge commit)
+    g2, s2 = seg(slots0, buckets, amounts,
+                 np.array([True, True, True]), mx, active, z, z, roll)
+    g2 = np.asarray(g2)
+    assert g2.sum() == 10 and (g2 <= 10).all()
+    assert int(np.asarray(s2).sum()) == 10
+    # deeply negative avail (limit shrunk under live usage) grants 0
+    slots_over = np.zeros((n_buckets, k), np.int32)
+    slots_over[1, 0] = np.iinfo(np.int32).max - 3
+    g3, _ = seg(slots_over, buckets,
+                np.array([3, 2, DOMAIN_MAX], np.int32),
+                np.array([False, True, True]), mx, active, z, z, roll)
+    assert (np.asarray(g3) == 0).all()
 
 
 def test_fast_kernel_matches_on_unique_buckets():
@@ -182,18 +314,26 @@ def test_pool_matches_memquota_oracle_under_contention():
 
 def test_pool_burst_matches_sequential_oracle():
     """A burst submitted without waiting coalesces into one device
-    batch (the contended scan path); grants must equal the oracle
-    applied in submission order."""
+    batch (the contended mixed-amount path — the segmented kernel);
+    grants must equal the oracle applied in the pool's STATED
+    intra-window serialization: all-or-nothing rows first, then
+    best-effort, amount-ascending, stable by submission (the window
+    collects raced arrivals, so any deterministic order is as
+    faithful to memquota's mutex as arrival order was)."""
     pool, oracle, clock = _pool_and_oracle(max_amount=5, duration=0.0)
     try:
-        futs = []
-        want = []
-        for i in range(12):
-            args = QuotaArgs(quota_amount=2, best_effort=(i % 2 == 0))
-            futs.append(pool.alloc("rq", _inst({"k": "same"}), args))
-            want.append(oracle.handle_quota("quota", _inst({"k": "same"}),
-                                            args))
+        all_args = [QuotaArgs(quota_amount=2, best_effort=(i % 2 == 0))
+                    for i in range(12)]
+        futs = [pool.alloc("rq", _inst({"k": "same"}), args)
+                for args in all_args]
         got = [f.result() for f in futs]
+        order = sorted(range(12),
+                       key=lambda i: (all_args[i].best_effort,
+                                      all_args[i].quota_amount, i))
+        want: list = [None] * 12
+        for i in order:
+            want[i] = oracle.handle_quota("quota", _inst({"k": "same"}),
+                                          all_args[i])
         assert [g.granted_amount for g in got] == \
             [w.granted_amount for w in want]
     finally:
@@ -310,13 +450,21 @@ def test_pool_rolling_contended_batch_matches_oracle():
     pool, oracle, _ = _pool_and_oracle(max_amount=6, duration=10.0,
                                        clock=clock)
     try:
-        futs, want = [], []
-        for i in range(8):
-            args = QuotaArgs(quota_amount=2, best_effort=(i % 2 == 0))
-            futs.append(pool.alloc("rq", _inst({"k": "hot"}), args))
-            want.append(oracle.handle_quota(
-                "quota", _inst({"k": "hot"}), args).granted_amount)
-        assert [f.result().granted_amount for f in futs] == want
+        all_args = [QuotaArgs(quota_amount=2, best_effort=(i % 2 == 0))
+                    for i in range(8)]
+        futs = [pool.alloc("rq", _inst({"k": "hot"}), args)
+                for args in all_args]
+        got = [f.result().granted_amount for f in futs]
+        # oracle applied in the pool's stated intra-window order
+        # (ao-before-be, amount-ascending, stable)
+        order = sorted(range(8),
+                       key=lambda i: (all_args[i].best_effort,
+                                      all_args[i].quota_amount, i))
+        want = [0] * 8
+        for i in order:
+            want[i] = oracle.handle_quota(
+                "quota", _inst({"k": "hot"}), all_args[i]).granted_amount
+        assert got == want
         # dedup recorded before the roll replays after it (mirrored
         # into the oracle so pool and oracle states stay aligned)
         args = QuotaArgs(quota_amount=1, best_effort=True,
