@@ -142,7 +142,11 @@ def main() -> None:
     floor_ts: list = []
     v, counts = step(params, ab_small, ns_small, counts)  # warm shape
     jax.block_until_ready(v.status)
-    for _ in range(3):
+    # FIVE interleaved windows: the tier's device cost is now ~0.2ms
+    # (min window) and the spread is pure tunnel jitter, so extra
+    # windows are cheap and the median is what keeps the verdict
+    # honest across reruns (VERDICT r4 item 2)
+    for _ in range(5):
         t0 = time.perf_counter()
         for _ in range(n_steps):
             v, counts = step(params, ab_small, ns_small, counts)
@@ -158,8 +162,8 @@ def main() -> None:
                         / n_steps)
     small_ts = sorted(max(float(t * 1e3), 1e-3) for t in small_ts)
     floor_ts = sorted(max(float(t * 1e3), 0.0) for t in floor_ts)
-    small_ms = small_ts[1]                 # median of 3 windows
-    floor_ms = floor_ts[1]
+    small_ms = small_ts[len(small_ts) // 2]   # median window
+    floor_ms = floor_ts[len(floor_ts) // 2]
     # mid tier: the breakdown that keeps the budget claim honest
     # (VERDICT r3 item 2) — mid-batch cost shows the rule-axis fixed
     # component

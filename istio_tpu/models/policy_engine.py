@@ -374,12 +374,19 @@ class PolicyEngine:
                 und = jnp.zeros_like(member) if rx_banks else None
                 for bank in rx_banks:
                     # one packed DFA scan per value byte slot answers
-                    # every REGEX list over that subject
+                    # every REGEX list over that subject. MXU one-hot
+                    # formulations win at EVERY batch size (profiled
+                    # r4/r5: the per-step [B, N] gather is latency-
+                    # bound regardless of B — it alone held the B=64
+                    # latency tier over the 1ms budget)
                     s_data = batch.str_bytes[:, bank["bslot"]]
                     s_lens = batch.str_lens[:, bank["bslot"]]
-                    if bank["packed"] is not None and b > 512:
+                    if bank["packed"] is not None:
                         m = bytes_ops.dfa_match_many_onehot(
                             s_data, s_lens, bank["packed"])
+                    elif bank["packed_blk"] is not None:
+                        m = bytes_ops.dfa_match_many_onehot_blocked(
+                            s_data, s_lens, bank["packed_blk"])
                     else:
                         m = bytes_ops.dfa_match_many(
                             s_data, s_lens, bank["trans"],
@@ -527,7 +534,18 @@ class PolicyEngine:
                     quota_counts.shape[1]
                 ckey = jnp.where(q_active, bucket + qoff,
                                  jnp.iinfo(jnp.int32).max)
-                rank = _batch_rank(ckey.T.reshape(-1)).reshape(n_q, b).T
+                if b <= 256:
+                    # latency tier: the flattened sort costs ~0.2ms of
+                    # fixed latency; a strict-lower-triangle pairwise
+                    # count is B²·Q trivial compares at small static B
+                    eq = ckey[None, :, :] == ckey[:, None, :]  # [B,B,Q]
+                    lower = (jnp.arange(b)[None, :] <
+                             jnp.arange(b)[:, None])[:, :, None]
+                    rank = jnp.sum(eq & lower, axis=1,
+                                   dtype=jnp.int32)            # [B, Q]
+                else:
+                    rank = _batch_rank(
+                        ckey.T.reshape(-1)).reshape(n_q, b).T
                 prior_per_req = quota_counts[
                     jnp.arange(n_q)[None, :], bucket]            # [B, Q]
                 granted = q_active & (prior_per_req + rank < q_max_j[None, :])
@@ -602,6 +620,7 @@ class PolicyEngine:
         (runtime/fused.py) gate fusability on that."""
         from istio_tpu.ops.regex_dfa import (pack_dfas, pack_dfas_classes,
                                              pack_dfas_onehot,
+                                             pack_dfas_onehot_blocked,
                                              compile_regex)
 
         groups: dict[int, dict] = {}
@@ -627,10 +646,17 @@ class PolicyEngine:
             g = groups[bslot]
             trans, accept = pack_dfas(g["dfas"])
             classes = pack_dfas_classes(g["dfas"])
-            use_onehot = (classes["n_states"] ** 2 * classes["n_classes"]
-                          <= 4_000_000)
-            packed = pack_dfas_onehot(g["dfas"], classes) if use_onehot \
+            # same three tiers as tensor_expr.compile_dfa_group: dense
+            # one-hot, block-diagonal one-hot, flat gather (last resort)
+            s_max = max(d.n_states for d in g["dfas"])
+            dense_ok = (classes["n_states"] ** 2 * classes["n_classes"]
+                        <= 4_000_000)
+            blocked_ok = (len(g["dfas"]) * s_max ** 2
+                          * classes["n_classes"] <= 8_000_000)
+            packed = pack_dfas_onehot(g["dfas"], classes) if dense_ok \
                 else None
+            packed_blk = None if dense_ok or not blocked_ok else \
+                pack_dfas_onehot_blocked(g["dfas"], classes)
             dollar = np.asarray(g["dollar"], bool)
             # [n_pats, n_lists_in_bank] membership, transposed for
             # dot_general; M_def keeps only $-free patterns (whose
@@ -643,6 +669,7 @@ class PolicyEngine:
                 "trans": jnp.asarray(trans),
                 "accept": jnp.asarray(accept),
                 "packed": packed,
+                "packed_blk": packed_blk,
                 "M": jnp.asarray(m),
                 "M_def": jnp.asarray(m * (~dollar[:, None])),
                 "pos": jnp.asarray([i for i, _ in g["lists"]],

@@ -255,7 +255,14 @@ def make_rolling_alloc_step(n_buckets: int, k_ticks: int,
         into an over-grant). Over-domain rows fail SAFE: all-or-
         nothing above 2^21 is denied (never a wrong partial grant);
         best-effort caps at the bound. memquota amounts are
-        per-request counts — real traffic sits many orders below."""
+        per-request counts — real traffic sits many orders below.
+
+        PRECONDITION: max_amounts is uniform within each bucket run
+        (the pool keys buckets by (quota name, dims), one limit per
+        bucket — device_quota._bucket_for). The prefix threshold reads
+        each row's own savail; a mixed-max run would let a denied
+        small-max row's amount inflate cum_ao against a later
+        larger-max row. The scan/fast kernels stay fully general."""
         slots = jnp.asarray(slots)
         slots, used = _roll_and_used(slots, buckets, ticks, last_ticks,
                                      rolling, active)
@@ -274,8 +281,12 @@ def make_rolling_alloc_step(n_buckets: int, k_ticks: int,
         savail = (max_amounts - used)[order]
         newseg = jnp.concatenate(
             [jnp.ones(1, bool), sb[1:] != sb[:-1]])
-        # all-or-nothing sub-run: prefix-sum threshold
-        v_ao = jnp.where(sact & ~sbe, sa, 0)
+        # all-or-nothing sub-run: prefix-sum threshold. Over-domain
+        # rows are excluded from the cumsum too — they are denied
+        # unconditionally and a denial consumes NOTHING, so letting
+        # their clipped amounts inflate cum_ao would wrongly deny a
+        # later legit row (review r5 finding)
+        v_ao = jnp.where(sact & ~sbe & ~sover, sa, 0)
         cum_ao = seg_scan(jnp.add, v_ao, newseg)
         grant_ao = sact & ~sbe & ~sover & (sa > 0) & (cum_ao <= savail)
         # budget the ao rows consumed, as seen by every later row of

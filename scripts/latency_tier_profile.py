@@ -1,0 +1,98 @@
+"""Ablation profile of the B=64 latency-tier step at 10k rules: which
+component carries the fixed rule-axis cost that keeps the tier above
+the 1ms budget? (VERDICT r4 item 2). Runs on the real device; median
+of 3 deep chained windows per variant."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+if __name__ == "__main__":
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench  # noqa: F401 (jax cache config)
+    from istio_tpu.testing import workloads
+
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    engine = workloads.make_engine(n_rules=10_000, with_quota=True,
+                                   jit=False)
+    bags = workloads.make_bags(B)
+    ab = jax.device_put(engine.tensorizer.tensorize(bags))
+    req_ns = jax.device_put(np.asarray(
+        workloads.make_request_ns(engine, B)))
+    params = jax.device_put(engine.params)
+    counts = engine.quota_counts
+    sync = bench._roundtrip_s()
+    print(f"B={B} sync {sync*1e3:.1f} ms")
+
+    def timed(label, fn, n=200):
+        out = fn()
+        jax.block_until_ready(out)
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn()
+            jax.block_until_ready(out)
+            ts.append((time.perf_counter() - t0 - sync) / n)
+        ts.sort()
+        print(f"{label:34s} med {ts[1]*1e3:7.3f}  "
+              f"min {ts[0]*1e3:7.3f}  max {ts[2]*1e3:7.3f} ms")
+        return ts[1]
+
+    step = jax.jit(engine.raw_step)
+    timed("full engine step",
+          lambda: step(params, ab, req_ns, counts)[0].status)
+
+    rs_fn = jax.jit(engine.ruleset.fn)
+    timed("ruleset match only",
+          lambda: rs_fn(params, ab)[0])
+
+    # match + namespace mask + deny combine, nothing else
+    rule_ns = jnp.asarray(engine.ruleset.rule_ns)
+    default_ns = engine.ruleset.ns_ids[""]
+
+    @jax.jit
+    def match_deny(params, batch, req_ns):
+        matched, _, err = engine.ruleset.fn(params, batch)
+        ns_ok = (rule_ns[None, :] == default_ns) | \
+                (rule_ns[None, :] == req_ns[:, None])
+        active = matched & ns_ok
+        BIGI = jnp.iinfo(jnp.int32).max
+        rule_idx = jnp.arange(active.shape[1], dtype=jnp.int32)
+        d_key = jnp.where(active, rule_idx[None, :], BIGI)
+        return jnp.min(d_key, axis=1)
+    timed("match+ns+argmin", lambda: match_deny(params, ab, req_ns))
+
+    # referenced bitmap dot alone
+    attr_mask = jnp.asarray(engine.ruleset.attr_mask.astype(np.int8))
+    ns_ok_c = jax.device_put(np.ones((B, attr_mask.shape[0]), np.int8))
+    dims = (((1,), (0,)), ((), ()))
+
+    @jax.jit
+    def ref_dot(ns_ok):
+        return jax.lax.dot_general(ns_ok, attr_mask, dims,
+                                   preferred_element_type=jnp.int32) > 0
+    timed("referenced dot [B,R]@[R,W]", lambda: ref_dot(ns_ok_c))
+
+    # quota rank sort alone (Q buckets x B)
+    from istio_tpu.models.policy_engine import _batch_rank
+    nq = counts.shape[0]
+    ckey = jax.device_put(
+        np.random.default_rng(0).integers(
+            0, 1 << 20, (B, nq)).astype(np.int32))
+
+    @jax.jit
+    def rank_only(ck):
+        return _batch_rank(ck.T.reshape(-1)).reshape(nq, B).T
+    timed(f"quota rank sort (Q={nq})", lambda: rank_only(ckey))
+
+    # ruleset internals: atom eval vs rule fold — report param sizes
+    tot = 0
+    for leaf in jax.tree.leaves(params):
+        tot += leaf.size * leaf.dtype.itemsize
+    print(f"ruleset param bytes: {tot/1e6:.1f} MB")

@@ -237,6 +237,14 @@ def test_seg_kernel_adversarial_amounts_never_over_grant():
     g2 = np.asarray(g2)
     assert g2.sum() == 10 and (g2 <= 10).all()
     assert int(np.asarray(s2).sum()) == 10
+    # a DENIED over-domain ao row consumes nothing: the legit ao row
+    # behind it in the run must still be granted (review r5 finding —
+    # over-domain amounts must not inflate the segment cumsum)
+    g4, _ = seg(slots0, buckets,
+                np.array([big, 7, 2], np.int32),
+                np.array([False, False, False]), mx, active, z, z,
+                roll)
+    assert np.asarray(g4).tolist() == [0, 7, 2]
     # deeply negative avail (limit shrunk under live usage) grants 0
     slots_over = np.zeros((n_buckets, k), np.int32)
     slots_over[1, 0] = np.iinfo(np.int32).max - 3
