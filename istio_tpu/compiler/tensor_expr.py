@@ -582,32 +582,19 @@ def compile_dfa_group(subject_ast: Expression, patterns: list[str],
     _compile_byte_pred's semantics per column: subject absence/error
     masks the row; truncated rows are fully undecidable for $-anchored
     patterns and miss-undecidable otherwise."""
-    from istio_tpu.ops.regex_dfa import (pack_dfas, pack_dfas_classes,
-                                         pack_dfas_onehot,
-                                         pack_dfas_onehot_blocked)
+    from istio_tpu.ops.regex_dfa import pack_dfas_tiered
 
     max_len = ctx.layout.max_str_len
     fsub = _compile_bytes(subject_ast, ctx)
-    # Three tiers, all size-gated on the CHEAP class pass: dense
-    # one-hot MXU matmul (small banks), BLOCK-DIAGONAL one-hot (banks
-    # of many small automata — O(N·s_max²·C) per step where dense is
-    # quadratic in the whole bank), flat-gather scan (pathological
-    # single automata too big for either).
-    classes = pack_dfas_classes(dfas)
-    s_max = max(d.n_states for d in dfas)
-    dense_ok = (classes["n_states"] ** 2 * classes["n_classes"]
-                <= 4_000_000)
-    blocked_ok = (len(dfas) * s_max ** 2 * classes["n_classes"]
-                  <= 8_000_000)
-    packed = pack_dfas_onehot(dfas, classes) if dense_ok else None
-    packed_blk = None if dense_ok or not blocked_ok else \
-        pack_dfas_onehot_blocked(dfas, classes)
-    if packed is None and packed_blk is None:
-        trans, accept = pack_dfas(dfas)
-        trans_j = jnp.asarray(trans)
-        accept_j = jnp.asarray(accept)
-    else:   # the flat tables would be dead device weight
-        trans_j = accept_j = None
+    # tier selection shared with the engine's list banks
+    # (regex_dfa.pack_dfas_tiered)
+    tiers = pack_dfas_tiered(dfas)
+    packed = tiers["packed"]
+    packed_blk = tiers["packed_blk"]
+    trans_j = None if tiers["trans"] is None \
+        else jnp.asarray(tiers["trans"])
+    accept_j = None if tiers["accept"] is None \
+        else jnp.asarray(tiers["accept"])
     trunc_all = jnp.asarray(np.array(["$" in p for p in patterns]))
 
     def fn(batch: AttributeBatch):

@@ -618,10 +618,8 @@ class PolicyEngine:
         sharing one handler share ONE DFA, not 1,000). Raises
         UnsupportedRegex for patterns outside the DFA subset — callers
         (runtime/fused.py) gate fusability on that."""
-        from istio_tpu.ops.regex_dfa import (pack_dfas, pack_dfas_classes,
-                                             pack_dfas_onehot,
-                                             pack_dfas_onehot_blocked,
-                                             compile_regex)
+        from istio_tpu.ops.regex_dfa import (compile_regex,
+                                             pack_dfas_tiered)
 
         groups: dict[int, dict] = {}
         for i, l in enumerate(lists):
@@ -644,19 +642,7 @@ class PolicyEngine:
         banks = []
         for bslot in sorted(groups):
             g = groups[bslot]
-            trans, accept = pack_dfas(g["dfas"])
-            classes = pack_dfas_classes(g["dfas"])
-            # same three tiers as tensor_expr.compile_dfa_group: dense
-            # one-hot, block-diagonal one-hot, flat gather (last resort)
-            s_max = max(d.n_states for d in g["dfas"])
-            dense_ok = (classes["n_states"] ** 2 * classes["n_classes"]
-                        <= 4_000_000)
-            blocked_ok = (len(g["dfas"]) * s_max ** 2
-                          * classes["n_classes"] <= 8_000_000)
-            packed = pack_dfas_onehot(g["dfas"], classes) if dense_ok \
-                else None
-            packed_blk = None if dense_ok or not blocked_ok else \
-                pack_dfas_onehot_blocked(g["dfas"], classes)
+            tiers = pack_dfas_tiered(g["dfas"])
             dollar = np.asarray(g["dollar"], bool)
             # [n_pats, n_lists_in_bank] membership, transposed for
             # dot_general; M_def keeps only $-free patterns (whose
@@ -666,10 +652,12 @@ class PolicyEngine:
                 m[idxs, r] = 1
             banks.append({
                 "bslot": bslot,
-                "trans": jnp.asarray(trans),
-                "accept": jnp.asarray(accept),
-                "packed": packed,
-                "packed_blk": packed_blk,
+                "trans": None if tiers["trans"] is None
+                else jnp.asarray(tiers["trans"]),
+                "accept": None if tiers["accept"] is None
+                else jnp.asarray(tiers["accept"]),
+                "packed": tiers["packed"],
+                "packed_blk": tiers["packed_blk"],
                 "M": jnp.asarray(m),
                 "M_def": jnp.asarray(m * (~dollar[:, None])),
                 "pos": jnp.asarray([i for i, _ in g["lists"]],

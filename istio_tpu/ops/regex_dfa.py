@@ -565,3 +565,33 @@ def pack_dfas_onehot_blocked(dfas: list[DFA],
     cls[np.arange(ALPHABET), class_of] = 1.0
     return {"step": step, "cls": cls, "accept": accept,
             "n_states_max": s_max, "n_classes": n_cls, "n_pats": n}
+
+
+def pack_dfas_tiered(dfas: "list[DFA]") -> dict:
+    """One home for the engine-wide DFA bank strategy (used by both
+    tensor_expr.compile_dfa_group and the policy engine's list banks):
+    dense one-hot MXU matmul (small banks), BLOCK-DIAGONAL one-hot
+    (banks of many small automata — O(N·s_max²·C) per step where dense
+    is quadratic in the whole bank), flat-gather scan (pathological
+    single automata too big for either). The MXU formulations win at
+    EVERY batch size — the per-step [B, N] gather is latency-bound on
+    TPU — so flat tables are built ONLY when both one-hot tiers are
+    infeasible (they would otherwise be dead device weight).
+
+    → {"packed", "packed_blk", "trans", "accept", "classes"} with
+    exactly one of packed / packed_blk / (trans, accept) non-None.
+    """
+    classes = pack_dfas_classes(dfas)
+    s_max = max(d.n_states for d in dfas)
+    dense_ok = (classes["n_states"] ** 2 * classes["n_classes"]
+                <= 4_000_000)
+    blocked_ok = (len(dfas) * s_max ** 2 * classes["n_classes"]
+                  <= 8_000_000)
+    packed = pack_dfas_onehot(dfas, classes) if dense_ok else None
+    packed_blk = None if dense_ok or not blocked_ok else \
+        pack_dfas_onehot_blocked(dfas, classes)
+    trans = accept = None
+    if packed is None and packed_blk is None:
+        trans, accept = pack_dfas(dfas)
+    return {"packed": packed, "packed_blk": packed_blk,
+            "trans": trans, "accept": accept, "classes": classes}

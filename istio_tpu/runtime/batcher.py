@@ -75,10 +75,20 @@ class CheckBatcher:
     def __init__(self, run_batch: Callable[[Sequence[Bag]], Sequence[Any]],
                  window_s: float = 0.0003, max_batch: int = 1024,
                  pipeline: int = 4,
-                 buckets: tuple[int, ...] | None = None):
+                 buckets: tuple[int, ...] | None = None,
+                 hold_at: int | None = None):
         self.run_batch = run_batch
         self.window_s = window_s
         self.max_batch = max_batch
+        # occupancy threshold for the adaptive window (see _loop):
+        # batches accumulate while >= hold_at trips are in flight.
+        # Default 1: on every rig measured (serialized tunnel,
+        # 1-core CPU) fat batches beat trip overlap — host prep is
+        # ~0.3ms against a 110ms tunnel trip, and concurrent steps
+        # contend for the device/core anyway (CPU rig: 756/s at 1 vs
+        # 520/s at 2 vs 203/s at pipeline=8). A transport that truly
+        # executes trips in parallel can raise it.
+        self._hold_at = max(hold_at if hold_at is not None else 1, 1)
         self.buckets = tuple(sorted(buckets)) if buckets \
             else default_buckets(max_batch)
         if self.buckets[-1] < max_batch:
@@ -95,9 +105,14 @@ class CheckBatcher:
         # (slightly better tail) when colocated. pipeline=1 restores
         # strictly serial batches.
         from concurrent.futures import ThreadPoolExecutor
-        self._pool = ThreadPoolExecutor(max_workers=max(pipeline, 1),
+        self._pipeline = max(pipeline, 1)
+        self._pool = ThreadPoolExecutor(max_workers=self._pipeline,
                                         thread_name_prefix="check-step")
-        self._inflight = threading.Semaphore(max(pipeline, 1))
+        self._inflight = threading.Semaphore(self._pipeline)
+        # occupancy counter for the adaptive window (the semaphore
+        # can't be read): >0 → a device trip is in flight
+        self._inflight_n = 0
+        self._inflight_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="check-batcher")
         self._closed = False
@@ -115,22 +130,35 @@ class CheckBatcher:
         return fut
 
     def _loop(self) -> None:
+        """Collect batches under an OCCUPANCY-ADAPTIVE window: with
+        fewer than `hold_at` trips in flight a batch sails after the
+        fixed window (light-load latency = one trip), at or past that
+        occupancy it keeps accumulating until a slot frees —
+        dispatching a 1-row trip behind a busy transport wastes a trip
+        slot the queued batch-mates then wait out (VERDICT r4 item 6:
+        half of all saturation batches carried ≤2 rows while 1024
+        clients were blocked). See __init__ for the hold_at default's
+        measured rationale."""
+        hold_at = min(self._pipeline, self._hold_at)
         while True:
             item = self._queue.get()
             if item is None:
                 self._drain_on_close()
                 return
             batch = [item]
-            deadline = None
+            deadline = time.perf_counter() + self.window_s
             while len(batch) < self.max_batch:
-                if deadline is None:
-                    deadline = time.perf_counter() + self.window_s
+                busy = self._inflight_n >= hold_at
                 timeout = deadline - time.perf_counter()
                 if timeout <= 0:
-                    break
+                    if not busy:
+                        break
+                    timeout = 0.002   # busy: hold, re-check occupancy
                 try:
                     nxt = self._queue.get(timeout=timeout)
                 except queue.Empty:
+                    if busy and len(batch) < self.max_batch:
+                        continue
                     break
                 if nxt is None:
                     self._flush(batch)
@@ -156,6 +184,8 @@ class CheckBatcher:
 
     def _flush(self, batch: list[tuple[Bag, Future]]) -> None:
         self._inflight.acquire()
+        with self._inflight_lock:
+            self._inflight_n += 1
         self._pool.submit(self._run_one, batch)
 
     def _run_one(self, batch: list[tuple[Bag, Future]]) -> None:
@@ -210,6 +240,8 @@ class CheckBatcher:
                 except InvalidStateError:
                     pass
         finally:
+            with self._inflight_lock:
+                self._inflight_n -= 1
             self._inflight.release()
 
     def close(self) -> None:

@@ -235,6 +235,22 @@ def get_tracer() -> Tracer:
     return _global
 
 
+def capture(service_name: str = "capture"):
+    """Temporarily swap in a MemoryReporter-backed tracer →
+    (reporter, restore_fn). The bench uses it to decompose served
+    latency into the pipeline's stage spans (queue-wait / tensorize /
+    device / overlay) without a zipkin endpoint."""
+    global _global
+    prev = _global
+    mem = MemoryReporter()
+    _global = Tracer(service_name=service_name, reporter=mem)
+
+    def restore() -> None:
+        global _global
+        _global = prev
+    return mem, restore
+
+
 def shutdown() -> None:
     global _global
     for c in _closers:
