@@ -925,6 +925,10 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
         buckets = (64, 256, 1024, 2048)
         srv = RuntimeServer(store, ServerArgs(
             batch_window_s=0.002, max_batch=2048, pipeline=pipeline,
+            # colocated chips overlap trips for real — let the deep
+            # pipeline actually pipeline (hold_at=pipeline); behind
+            # the serializing tunnel keep hold_at=1 (fat batches win)
+            hold_at=pipeline if sync_ms <= 20 else None,
             buckets=buckets,
             default_manifest=workloads.MESH_MANIFEST))
         n_cores = mp.cpu_count() or 4
@@ -981,16 +985,25 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
                 # tensorize / device / overlay per batch)
                 from istio_tpu.utils import tracing as _tr
                 mem, restore = _tr.capture("bench-light")
+                t_light0 = time.time()
+                light_warm_s = 2.0
                 try:
                     lreport = perf.run_load(
                         f"127.0.0.1:{port}", payloads,
                         n_record=400 if on_tpu else 100,
                         n_procs=1, concurrency=8,
-                        warmup_s=2.0)
+                        warmup_s=light_warm_s)
                 finally:
                     restore()
+                # steady-state spans only: the recorded-completion
+                # window excludes the warmup ramp, so the stage
+                # medians must too (ramp batches run at different
+                # sizes/depths than the regime they'd be blamed on)
+                t_steady_us = (t_light0 + light_warm_s) * 1e6
                 stage: dict = {}
                 for span in mem.spans:
+                    if span.get("timestamp", 0) < t_steady_us:
+                        continue
                     ms = span.get("duration", 0) / 1000.0
                     stage.setdefault(span.get("name"), []).append(ms)
                     qw = (span.get("tags") or {}).get("queue_wait_ms")

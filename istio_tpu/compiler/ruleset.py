@@ -315,6 +315,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                     jit: bool = True,
                     extra_derived_keys: Sequence[tuple[str, str]] = (),
                     extra_byte_sources: Sequence[Any] = (),
+                    extra_extern_sources: Sequence[tuple[str, str, Any]] = (),
                     rule_pad: int = 1
                     ) -> RuleSetProgram:
     """Compile a rule snapshot. Never raises for individual bad rules —
@@ -327,7 +328,9 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     into id-membership scans (runtime/fused.py). `extra_byte_sources`
     likewise adds byte slots (attr name or (map, key)) for consumers
     that match VALUE BYTES rather than interned ids — REGEX/CIDR list
-    entries lowered to device DFA/prefix scans.
+    entries lowered to device DFA/prefix scans. `extra_extern_sources`
+    adds ip()/timestamp() ingest columns the same way (REPORT instance
+    field expressions lowered by runtime/report_lower.py).
 
     `rule_pad` rounds the RULE-AXIS arrays (conj index matrices,
     rule_ns, attr_mask — and therefore the matched/err planes) up to a
@@ -383,12 +386,15 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
 
     manifest = {n: finder.get_attribute(n) for n in finder.names()}
     kwargs = {} if max_str_len is None else {"max_str_len": max_str_len}
+    ext = dict(reqs.extern_sources)
+    for n, k, east in extra_extern_sources:
+        ext.setdefault((n, k), east)
     layout = build_layout(
         manifest,
         sorted(set(reqs.derived_keys) | set(extra_derived_keys)),
         sorted(set(reqs.byte_sources) | set(extra_byte_sources), key=str),
         extern_sources=[(n, k, ast) for (n, k), ast
-                        in reqs.extern_sources.items()], **kwargs)
+                        in ext.items()], **kwargs)
 
     # ---- classify atoms into vectorizable tiers ----
     # An atom can still refuse to lower here (e.g. STRING_MAP equality

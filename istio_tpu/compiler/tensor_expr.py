@@ -767,3 +767,20 @@ def compile_expression(text: str, finder: AttributeDescriptorFinder,
     return TensorProgram(text=text, result_type=rtype,
                          fn=jax.jit(run) if jit else run,
                          layout=layout, interner=interner)
+
+
+def compile_field(ast: Expression, finder: AttributeDescriptorFinder,
+                  layout: BatchLayout, interner: InternTable
+                  ) -> tuple[NodeFn, ValueType]:
+    """Lower ONE already-parsed instance-field expression to an
+    UNJITTED batched node (REPORT instance construction,
+    runtime/report_lower.py — the reference evaluates these through
+    the same IL hot loop as predicates, template.gen.go ProcessReport).
+    The caller stacks many field nodes into a single device program
+    alongside the packed check step. Raises HostFallback exactly like
+    compile_expression; the returned TVal follows the same masked
+    algebra (`ok & ~err` marks rows where the oracle would NOT raise).
+    """
+    rtype = eval_type(ast, finder, DEFAULT_FUNCS)
+    ctx = _Ctx(layout, interner, finder)
+    return _compile_node(ast, ctx), rtype

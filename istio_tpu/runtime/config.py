@@ -282,6 +282,57 @@ class SnapshotBuilder:
                     if ref is not None:
                         byte_srcs.add(ref)
         kwargs["extra_byte_sources"] = sorted(byte_srcs, key=str)
+        # REPORT instance field expressions lower onto the device
+        # (runtime/report_lower.py — the reference runs them through
+        # the same IL loop as predicates, template.gen.go
+        # ProcessReport): collect their layout needs (derived map-key
+        # columns, byte slots for match()/startsWith subjects, extern
+        # ingest columns) in the same pre-pass the predicates use. An
+        # instance whose requirements cannot collect keeps the host
+        # build — never a config error.
+        from istio_tpu.compiler.tensor_expr import (HostFallback,
+                                                    Requirements,
+                                                    collect_requirements)
+
+        def _field_asts(tree):
+            for v in tree.values():
+                if isinstance(v, dict):
+                    yield from _field_asts(v)
+                else:
+                    yield v
+
+        rep_reqs = Requirements()
+        seen_report: set[str] = set()
+        for rc in rules:
+            for a in rc.actions:
+                for iname in a.instances:
+                    ib = instances.get(iname)
+                    tmpl = instance_templates.get(iname)
+                    if ib is None or tmpl is None or iname in seen_report:
+                        continue
+                    if template_registry.get(tmpl).variety is not \
+                            Variety.REPORT:
+                        continue
+                    seen_report.add(iname)
+                    try:
+                        r = Requirements()
+                        for ast in _field_asts(ib.expr_tree()):
+                            collect_requirements(ast, finder, r)
+                        rep_reqs.merge(r)
+                    except HostFallback:
+                        pass    # instance keeps InstanceBuilder.build
+        if rep_reqs.derived_keys:
+            kwargs["extra_derived_keys"] = sorted(
+                set(kwargs["extra_derived_keys"]) | rep_reqs.derived_keys)
+        if rep_reqs.byte_sources:
+            kwargs["extra_byte_sources"] = sorted(
+                set(kwargs["extra_byte_sources"])
+                | rep_reqs.byte_sources, key=str)
+        if rep_reqs.extern_sources:
+            kwargs["extra_extern_sources"] = [
+                (n, k, east)
+                for (n, k), east in sorted(rep_reqs.extern_sources.items(),
+                                           key=lambda kv: kv[0])]
         # rule-axis padded to 8 so the matched/err planes shard evenly
         # over any mp ∈ {1,2,4,8} serving mesh (parallel/mesh.py)
         kwargs["rule_pad"] = 8

@@ -30,6 +30,13 @@ class ServerArgs:
     # in-flight device batches (overlaps host↔device sync across
     # batches; see runtime/batcher.py)
     pipeline: int = 4
+    # occupancy threshold for the batcher's adaptive window: batches
+    # keep accumulating while >= hold_at trips are in flight. The
+    # default (None → 1) serializes trips — right whenever trips
+    # contend for one transport/core; a rig whose device genuinely
+    # overlaps trips should set hold_at=pipeline to restore overlap
+    # (runtime/batcher.py CheckBatcher)
+    hold_at: int | None = None
     # serving batch shapes (None → batcher.default_buckets(max_batch));
     # each is one jit trace, pre-warmed before config swaps
     buckets: tuple[int, ...] | None = None
@@ -77,7 +84,8 @@ class RuntimeServer:
                                     window_s=self.args.batch_window_s,
                                     max_batch=self.args.max_batch,
                                     pipeline=self.args.pipeline,
-                                    buckets=buckets)
+                                    buckets=buckets,
+                                    hold_at=self.args.hold_at)
 
     # -- API surface (grpcServer.go Check/Report semantics) --
     # Preprocessing (the APA phase) happens exactly ONCE per request, in

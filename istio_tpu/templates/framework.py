@@ -249,6 +249,13 @@ class InstanceBuilder:
             return out
         return walk(self._plan)
 
+    def compiled_plan(self) -> list[tuple]:
+        """The compiled field plan [(field, kind, payload)] with kind ∈
+        const/sub/map/expr — read by the REPORT device lowering
+        (runtime/report_lower.py) to compile each field expression into
+        the fused step while keeping const/submessage/map structure."""
+        return self._plan
+
     def value_attr_ref(self) -> Any | None:
         """attr name / (map, key) when the instance's `value` field is a
         bare attribute read — the fusability probe shared by the layout
