@@ -338,6 +338,16 @@ class MixerAioGrpcServer(MixerGrpcServer):
         from grpc import aio
 
         async def serve():
+            # dedicated executor for the blocking offloads (_check_bag
+            # decode, _report waiting out its coalesced batches): the
+            # loop default is ~cpu+4 threads on a small box, which
+            # would cap in-flight Report RPCs — and with them the
+            # report batcher's fill — at a handful. Blocked waiters
+            # are cheap; batch formation wants the depth.
+            from concurrent.futures import ThreadPoolExecutor
+            asyncio.get_running_loop().set_default_executor(
+                ThreadPoolExecutor(max_workers=32,
+                                   thread_name_prefix="mixer-aio-exec"))
             server = aio.server()
             handlers = {
                 "Check": grpc.unary_unary_rpc_method_handler(

@@ -50,6 +50,15 @@ def pad_to_bucket(bags, buckets: tuple[int, ...]) -> list:
     return list(bags) + [PadBag() for _ in range(target - len(bags))]
 
 
+def trim_pads(bags):
+    """`bags` without their trailing PadBag rows — the single inverse
+    of pad_to_bucket (padding is always appended at the tail)."""
+    n = len(bags)
+    while n and isinstance(bags[n - 1], PadBag):
+        n -= 1
+    return bags[:n] if n < len(bags) else bags
+
+
 class PadBag(Bag):
     """Empty bag used to pad a batch to its bucket size."""
 
@@ -76,8 +85,14 @@ class CheckBatcher:
                  window_s: float = 0.0003, max_batch: int = 1024,
                  pipeline: int = 4,
                  buckets: tuple[int, ...] | None = None,
-                 hold_at: int | None = None):
+                 hold_at: int | None = None,
+                 size_hist=None):
         self.run_batch = run_batch
+        # batch-size histogram to observe (default: the check path's;
+        # the report batcher passes monitor.REPORT_BATCH_SIZE so the
+        # two coalescers stay separately diagnosable)
+        self._size_hist = size_hist if size_hist is not None \
+            else monitor.CHECK_BATCH_SIZE
         self.window_s = window_s
         self.max_batch = max_batch
         # occupancy threshold for the adaptive window (see _loop):
@@ -190,7 +205,7 @@ class CheckBatcher:
 
     def _run_one(self, batch: list[tuple[Bag, Future]]) -> None:
         try:
-            monitor.CHECK_BATCH_SIZE.observe(len(batch))
+            self._size_hist.observe(len(batch))
             bags = [bag for bag, _ in batch]
             padded = pad_to_bucket(bags, self.buckets)
             # queue-wait = oldest enqueue -> batch start (decomposable

@@ -287,11 +287,9 @@ class Dispatcher:
         # PadBags at the tail and zips results against real requests)
         # — at small arrival rates a 512-bucket batch is mostly
         # padding, and per-row python here is the serving CPU budget
-        from istio_tpu.runtime.batcher import PadBag
+        from istio_tpu.runtime.batcher import trim_pads
+        bags = trim_pads(bags)
         n_real = len(bags)
-        while n_real and isinstance(bags[n_real - 1], PadBag):
-            n_real -= 1
-        bags = bags[:n_real]
         ns_ids = ns_ids[:n_real]
 
         # referenced-attribute item bits (rows 5..5+W): the device
@@ -500,6 +498,14 @@ class Dispatcher:
                                    r.valid_use_count)
 
     def report(self, bags: Sequence[Bag]) -> None:
+        from istio_tpu.runtime.batcher import trim_pads
+
+        # the report batcher pads coalesced batches to bucket shapes;
+        # padding rows carry no caller and must not fire empty-match
+        # report rules (the check path trims identically)
+        bags = trim_pads(bags)
+        if not bags:
+            return
         fctx = None
         if self.fused is not None:
             if not self.fused.report_rules:

@@ -33,6 +33,12 @@ CHECK_BATCH_SIZE = prometheus_client.Histogram(
     "mixer_runtime_check_batch_size", "coalesced check batch sizes",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048),
     registry=REGISTRY)
+REPORT_BATCH_SIZE = prometheus_client.Histogram(
+    "mixer_runtime_report_batch_size",
+    "coalesced report record batch sizes (records from concurrent "
+    "Report RPCs share one packed device trip)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048),
+    registry=REGISTRY)
 # gRPC serving-path counters (grpcServer.go's monitoring role): a
 # failed perf run must be diagnosable from these alone — how many
 # requests were decoded vs answered, and how batch formation went.
@@ -62,6 +68,10 @@ def serving_counters() -> dict:
         "batches_formed": sum(hist.values()),
         "batch_rows": int(CHECK_BATCH_SIZE._sum.get()),
         "batch_size_hist": hist,
+        "report_batch_rows": int(REPORT_BATCH_SIZE._sum.get()),
+        "report_batches_formed": int(
+            REPORT_BATCH_SIZE._buckets and sum(
+                int(b.get()) for b in REPORT_BATCH_SIZE._buckets)),
     }
 
 

@@ -37,6 +37,10 @@ class ServerArgs:
     # overlaps trips should set hold_at=pipeline to restore overlap
     # (runtime/batcher.py CheckBatcher)
     hold_at: int | None = None
+    # coalesce report records across Report RPCs into shared device
+    # trips (see RuntimeServer.report); False dispatches each call's
+    # records as their own batch
+    report_batching: bool = True
     # serving batch shapes (None → batcher.default_buckets(max_batch));
     # each is one jit trace, pre-warmed before config swaps
     buckets: tuple[int, ...] | None = None
@@ -86,6 +90,20 @@ class RuntimeServer:
                                     pipeline=self.args.pipeline,
                                     buckets=buckets,
                                     hold_at=self.args.hold_at)
+        # the REPORT coalescer: records from concurrent Report RPCs
+        # share packed device trips (see report()). Separate instance
+        # so report trips are separately counted and the two queues
+        # can't starve each other's windows.
+        from istio_tpu.runtime import monitor as _monitor
+        self._report_batcher = CheckBatcher(
+            self._run_report_batch,
+            window_s=self.args.batch_window_s,
+            max_batch=self.args.max_batch,
+            pipeline=self.args.pipeline,
+            buckets=buckets,
+            hold_at=self.args.hold_at,
+            size_hist=_monitor.REPORT_BATCH_SIZE) \
+            if self.args.report_batching else None
 
     # -- API surface (grpcServer.go Check/Report semantics) --
     # Preprocessing (the APA phase) happens exactly ONCE per request, in
@@ -103,6 +121,14 @@ class RuntimeServer:
     def _run_check_batch(self,
                          bags: Sequence[Bag]) -> Sequence[CheckResponse]:
         return self.controller.dispatcher.check(bags)
+
+    def _run_report_batch(self, bags: Sequence[Bag]) -> Sequence[None]:
+        """Report batcher hook: dispatch the coalesced (padded) record
+        batch; results are completion-only (Report returns empty)."""
+        from istio_tpu.runtime.batcher import trim_pads
+        bags = trim_pads(bags)
+        self.controller.dispatcher.report(bags)
+        return [None] * len(bags)
 
     def check(self, bag: Bag) -> CheckResponse:
         """One request; coalesced into a device batch."""
@@ -133,8 +159,20 @@ class RuntimeServer:
         return list(self._run_check_batch(bags))
 
     def report(self, bags: Sequence[Bag]) -> None:
-        d = self.controller.dispatcher
-        d.report([self.preprocess(b) for b in bags])
+        """Report records coalesce ACROSS RPCs into shared device
+        trips: each record rides the report batcher (its own
+        CheckBatcher instance), so N concurrent 64-record Report RPCs
+        form one bucket-sized packed pull instead of N separate trips —
+        on a trip-serialized transport records/s = trips/s × batch
+        size. The call returns after every record's batch completed
+        (grpcServer.go Report returns post-dispatch)."""
+        bags = [self.preprocess(b) for b in bags]
+        rb = self._report_batcher
+        if rb is None:
+            self.controller.dispatcher.report(bags)
+            return
+        for fut in [rb.submit(b) for b in bags]:
+            fut.result()
 
     def quota(self, bag: Bag, quota_name: str,
               args: QuotaArgs | None = None,
@@ -196,4 +234,6 @@ class RuntimeServer:
 
     def close(self) -> None:
         self.batcher.close()
+        if self._report_batcher is not None:
+            self._report_batcher.close()
         self.controller.close()

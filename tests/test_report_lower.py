@@ -187,6 +187,36 @@ def test_materialized_values_exact():
                                            "host": "a.com"}
 
 
+def test_report_coalescing_across_calls():
+    """RuntimeServer.report rides the report batcher: records from
+    CONCURRENT calls coalesce into shared padded device trips, and
+    every caller's adapter effects still land exactly once."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from istio_tpu.runtime import monitor
+
+    srv = RuntimeServer(_store(), ServerArgs(fused=True, max_batch=8,
+                                             buckets=(8,),
+                                             batch_window_s=0.01))
+    try:
+        d = srv.controller.dispatcher
+        cap = CaptureHandler()
+        d.handlers["sink.istio-system"] = cap
+        rows0 = int(monitor.REPORT_BATCH_SIZE._sum.get())
+        bags = _bags()
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(lambda b: srv.report([b]), bags * 4))
+        # 4 copies of the 4-bag set: every per-bag effect lands
+        names = [i["name"] for i in cap.flat()]
+        assert names.count("m.istio-system") == 16
+        assert names.count("lg.istio-system") == 24
+        assert names.count("raw.istio-system") == 16
+        # and they rode the REPORT coalescer (batches observed)
+        assert int(monitor.REPORT_BATCH_SIZE._sum.get()) - rows0 == 16
+    finally:
+        srv.close()
+
+
 def test_absent_value_aborts_instance_like_host():
     """bag 2 has no response.size: the metric instance must be ABSENT
     from the adapter call on both paths (EvalError abort), and the
