@@ -1097,8 +1097,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
                     "served_report_baseline_records_per_sec": round(
                         base_rps, 1),
                     "served_report_vs_baseline": round(
-                        rrep.checks_per_sec / base_rps, 2) if base_rps
-                    else None,
+                        rrep.checks_per_sec / base_rps, 2),
                     "served_report_baseline_derivation":
                         f"{n_rules} rules x 250ns/predicate IL resolve "
                         "per record-bag (bench.baseline:3-8)",

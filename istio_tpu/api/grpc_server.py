@@ -214,8 +214,7 @@ class MixerGrpcServer:
                 self._ref_cache[key] = cached
         return cached
 
-    def _report(self, request: "pb.ReportRequest",
-                context) -> "pb.ReportResponse":
+    def _decode_report(self, request: "pb.ReportRequest") -> list:
         bags = []
         current: dict[str, Any] = {}
         default_words = list(request.default_words)
@@ -225,6 +224,11 @@ class MixerGrpcServer:
                                    request.global_word_count or None,
                                    default_words)
             bags.append(bag_from_mapping(dict(current)))
+        return bags
+
+    def _report(self, request: "pb.ReportRequest",
+                context) -> "pb.ReportResponse":
+        bags = self._decode_report(request)
         if bags:
             self.runtime.report(bags)
         return pb.ReportResponse()
@@ -327,10 +331,28 @@ class MixerAioGrpcServer(MixerGrpcServer):
     async def _areport(self, request: "pb.ReportRequest",
                        context) -> "pb.ReportResponse":
         import asyncio
-        # the report pipeline is synchronous host work (decode +
-        # adapter fan-out); never stall in-flight checks on the loop
-        return await asyncio.get_running_loop().run_in_executor(
-            None, self._report, request, context)
+        loop = asyncio.get_running_loop()
+        # decode + preprocess are synchronous host work — off the
+        # loop; the WAIT for the coalesced batches holds no thread
+        # (futures bridge back via wrap_future, like _acheck), so
+        # in-flight Reports are bounded by the batcher, not a pool
+        bags = await loop.run_in_executor(None, self._decode_report,
+                                          request)
+        if bags:
+            futs = await loop.run_in_executor(
+                None, self.runtime.submit_report, bags)
+            if futs:
+                # shield: a client cancel must never poison shared
+                # batch-mates; gather-with-exceptions retrieves every
+                # future before the first error re-raises
+                results = await asyncio.shield(asyncio.gather(
+                    *[asyncio.wrap_future(f) for f in futs],
+                    return_exceptions=True))
+                first = next((r for r in results
+                              if isinstance(r, BaseException)), None)
+                if first is not None:
+                    raise first
+        return pb.ReportResponse()
 
     def _run(self) -> None:
         import asyncio
@@ -338,15 +360,14 @@ class MixerAioGrpcServer(MixerGrpcServer):
         from grpc import aio
 
         async def serve():
-            # dedicated executor for the blocking offloads (_check_bag
-            # decode, _report waiting out its coalesced batches): the
-            # loop default is ~cpu+4 threads on a small box, which
-            # would cap in-flight Report RPCs — and with them the
-            # report batcher's fill — at a handful. Blocked waiters
-            # are cheap; batch formation wants the depth.
+            # dedicated executor for the SHORT blocking offloads
+            # (check/report decode, preprocess+submit) — waiting on
+            # batches holds no thread (wrap_future); sized past the
+            # loop default so a decode burst never queues behind the
+            # next burst on a small box
             from concurrent.futures import ThreadPoolExecutor
             asyncio.get_running_loop().set_default_executor(
-                ThreadPoolExecutor(max_workers=32,
+                ThreadPoolExecutor(max_workers=16,
                                    thread_name_prefix="mixer-aio-exec"))
             server = aio.server()
             handlers = {

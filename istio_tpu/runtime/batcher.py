@@ -86,8 +86,13 @@ class CheckBatcher:
                  pipeline: int = 4,
                  buckets: tuple[int, ...] | None = None,
                  hold_at: int | None = None,
-                 size_hist=None):
+                 size_hist=None,
+                 pad_batches: bool = True):
         self.run_batch = run_batch
+        # False for hooks whose downstream re-pads anyway (the report
+        # batcher: dispatcher._report_active_fused pads per chunk) —
+        # skips allocate-then-trim churn on every light-load batch
+        self._pad_batches = pad_batches
         # batch-size histogram to observe (default: the check path's;
         # the report batcher passes monitor.REPORT_BATCH_SIZE so the
         # two coalescers stay separately diagnosable)
@@ -207,7 +212,8 @@ class CheckBatcher:
         try:
             self._size_hist.observe(len(batch))
             bags = [bag for bag, _ in batch]
-            padded = pad_to_bucket(bags, self.buckets)
+            padded = pad_to_bucket(bags, self.buckets) \
+                if self._pad_batches else bags
             # queue-wait = oldest enqueue -> batch start (decomposable
             # served latency; pkg/tracing interceptor role)
             from istio_tpu.utils import tracing

@@ -500,9 +500,9 @@ class Dispatcher:
     def report(self, bags: Sequence[Bag]) -> None:
         from istio_tpu.runtime.batcher import trim_pads
 
-        # the report batcher pads coalesced batches to bucket shapes;
-        # padding rows carry no caller and must not fire empty-match
-        # report rules (the check path trims identically)
+        # defensive vs padded callers (BatchCheck-style fronts hand
+        # bucket-shaped batches): padding rows carry no caller and
+        # must not fire empty-match report rules
         bags = trim_pads(bags)
         if not bags:
             return

@@ -102,7 +102,10 @@ class RuntimeServer:
             pipeline=self.args.pipeline,
             buckets=buckets,
             hold_at=self.args.hold_at,
-            size_hist=_monitor.REPORT_BATCH_SIZE) \
+            size_hist=_monitor.REPORT_BATCH_SIZE,
+            # the fused report resolve pads per chunk itself — don't
+            # allocate padding here just to trim it
+            pad_batches=False) \
             if self.args.report_batching else None
 
     # -- API surface (grpcServer.go Check/Report semantics) --
@@ -123,10 +126,9 @@ class RuntimeServer:
         return self.controller.dispatcher.check(bags)
 
     def _run_report_batch(self, bags: Sequence[Bag]) -> Sequence[None]:
-        """Report batcher hook: dispatch the coalesced (padded) record
-        batch; results are completion-only (Report returns empty)."""
-        from istio_tpu.runtime.batcher import trim_pads
-        bags = trim_pads(bags)
+        """Report batcher hook: dispatch the coalesced record batch
+        (unpadded — the fused resolve pads per chunk); results are
+        completion-only (Report returns empty)."""
         self.controller.dispatcher.report(bags)
         return [None] * len(bags)
 
@@ -158,21 +160,38 @@ class RuntimeServer:
         and padded to a bucket shape (the BatchCheck gRPC front)."""
         return list(self._run_check_batch(bags))
 
-    def report(self, bags: Sequence[Bag]) -> None:
-        """Report records coalesce ACROSS RPCs into shared device
-        trips: each record rides the report batcher (its own
-        CheckBatcher instance), so N concurrent 64-record Report RPCs
-        form one bucket-sized packed pull instead of N separate trips —
-        on a trip-serialized transport records/s = trips/s × batch
-        size. The call returns after every record's batch completed
-        (grpcServer.go Report returns post-dispatch)."""
+    def submit_report(self, bags: Sequence[Bag]) -> list:
+        """Non-blocking report entry → concurrent Futures, one per
+        record (empty when no batcher is configured — records already
+        dispatched inline). Records coalesce ACROSS RPCs into shared
+        device trips via the report batcher, so N concurrent 64-record
+        Report RPCs form one bucket-sized packed pull instead of N
+        separate trips — on a trip-serialized transport
+        records/s = trips/s × batch size. The aio front awaits the
+        futures so an in-flight Report holds no thread."""
         bags = [self.preprocess(b) for b in bags]
         rb = self._report_batcher
         if rb is None:
             self.controller.dispatcher.report(bags)
+            return []
+        return [rb.submit(b) for b in bags]
+
+    def report(self, bags: Sequence[Bag]) -> None:
+        """Blocking report: returns after EVERY record's batch
+        completed (grpcServer.go Report returns post-dispatch); the
+        first batch error re-raises only after all futures resolved —
+        abandoning later batches would leave records executing past
+        the call and their exceptions unretrieved."""
+        from concurrent.futures import wait as _wait
+
+        futs = self.submit_report(bags)
+        if not futs:
             return
-        for fut in [rb.submit(b) for b in bags]:
-            fut.result()
+        _wait(futs)
+        first = next((e for e in (f.exception() for f in futs)
+                      if e is not None), None)
+        if first is not None:
+            raise first
 
     def quota(self, bag: Bag, quota_name: str,
               args: QuotaArgs | None = None,
