@@ -360,14 +360,16 @@ class MixerAioGrpcServer(MixerGrpcServer):
         from grpc import aio
 
         async def serve():
-            # dedicated executor for the SHORT blocking offloads
-            # (check/report decode, preprocess+submit) — waiting on
-            # batches holds no thread (wrap_future); sized past the
-            # loop default so a decode burst never queues behind the
-            # next burst on a small box
+            # dedicated executor for the blocking offloads. Check and
+            # Report decode are short (their batch waits bridge back
+            # via wrap_future, holding no thread), but _abatch_check
+            # and the non-fused quota fallback still park a thread
+            # across a full device trip — size for a burst of those
+            # so unary decode never queues behind a device step
+            # (asyncio's default is only ~cpu+4 on a small box)
             from concurrent.futures import ThreadPoolExecutor
             asyncio.get_running_loop().set_default_executor(
-                ThreadPoolExecutor(max_workers=16,
+                ThreadPoolExecutor(max_workers=32,
                                    thread_name_prefix="mixer-aio-exec"))
             server = aio.server()
             handlers = {

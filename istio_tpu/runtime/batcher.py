@@ -214,6 +214,11 @@ class CheckBatcher:
             bags = [bag for bag, _ in batch]
             padded = pad_to_bucket(bags, self.buckets) \
                 if self._pad_batches else bags
+            # the span's bucket field always reports the DEVICE shape
+            # (even when a downstream re-padder owns the padding) so
+            # size-vs-bucket keeps measuring pad overhead
+            bucket_n = len(padded) if self._pad_batches else next(
+                (b for b in self.buckets if b >= len(bags)), len(bags))
             # queue-wait = oldest enqueue -> batch start (decomposable
             # served latency; pkg/tracing interceptor role)
             from istio_tpu.utils import tracing
@@ -222,7 +227,7 @@ class CheckBatcher:
                      (getattr(f, "_t_enq", None) for _, f in batch)
                      if t is not None]
             span_ctx = tracing.get_tracer().span(
-                "serve.batch", size=len(batch), bucket=len(padded),
+                "serve.batch", size=len(batch), bucket=bucket_n,
                 queue_wait_ms=round(max(waits, default=0.0) * 1e3, 3))
             try:
                 with span_ctx:

@@ -520,11 +520,15 @@ def test_wire_dedup_replay_across_clients_and_windows():
 
         def one(dedup_id):
             # own channel per call: real concurrent client sockets
-            cli = MixerClient(f"127.0.0.1:{port}", enable_check_cache=False)
-            resp = cli.check(values, quotas={"rq": 5},
-                             dedup_id=dedup_id)
-            assert resp.precondition.status.code == OK
-            return resp.quotas["rq"].granted_amount
+            cli = MixerClient(f"127.0.0.1:{port}",
+                              enable_check_cache=False)
+            try:
+                resp = cli.check(values, quotas={"rq": 5},
+                                 dedup_id=dedup_id)
+                assert resp.precondition.status.code == OK
+                return resp.quotas["rq"].granted_amount
+            finally:
+                cli.close()
 
         # wave 1: 8 clients, one dedup id, one batch window — exactly
         # ONE 5-unit consumption, every caller sees the grant replayed
