@@ -217,8 +217,8 @@ class CheckBatcher:
             # the span's bucket field always reports the DEVICE shape
             # (even when a downstream re-padder owns the padding) so
             # size-vs-bucket keeps measuring pad overhead
-            bucket_n = len(padded) if self._pad_batches else next(
-                (b for b in self.buckets if b >= len(bags)), len(bags))
+            bucket_n = len(padded) if self._pad_batches \
+                else bucket_size(len(bags), self.buckets)
             # queue-wait = oldest enqueue -> batch start (decomposable
             # served latency; pkg/tracing interceptor role)
             from istio_tpu.utils import tracing
