@@ -647,7 +647,8 @@ for label, shape, nr in configs:
                 out["mesh_h2d_bytes_per_step"] = h2d
                 out["mesh_dp4_h2d_bytes_per_shard"] = h2d // 4
             except Exception as exc:
-                out["mesh_accounting_error"] = type(exc).__name__
+                out["mesh_accounting_error"] = \
+                    type(exc).__name__ + ": " + str(exc)
         srv.check_many(bags)          # warm/compile
         best = float("inf")
         for _ in range(2):
@@ -1061,12 +1062,16 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
                 # the BOUNDED-LATENCY operating point (VERDICT r4 weak
                 # #5): depth 8 is the served config whose latency
                 # stays near the transport floor — the artifact pins
-                # an explicit p99 budget (3 transport RTTs, floor is
-                # ~1 RTT + step + batch windows; 30ms floor when
-                # colocated) so "bounded" is a checked claim, not a
-                # label. Saturation numbers above are queueing by
-                # Little's law and carry no latency claim.
-                light_budget_ms = max(3.0 * sync_ms, 30.0)
+                # an explicit p99 budget so "bounded" is a checked
+                # claim, not a label. Derivation (stage spans below
+                # decompose it): trips serialize on this transport, so
+                # the worst structural path is drain-the-in-flight-trip
+                # + own check trip + the quota-flush trip every 4th
+                # request carries = 3 serialized RTTs, plus half a
+                # trip of alignment jitter; 30ms floor when colocated.
+                # Saturation numbers above are queueing by Little's
+                # law and carry no latency claim.
+                light_budget_ms = max(3.5 * sync_ms + 10.0, 30.0)
                 light_fields = {
                     "served_light_stage_p50_ms": stage_med,
                     "served_light_checks_per_sec": round(
@@ -1077,6 +1082,10 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
                         light_budget_ms, 1),
                     "served_light_p99_budget_ok":
                         bool(lreport.p99_ms <= light_budget_ms),
+                    "served_light_budget_derivation":
+                        "3 serialized transport trips (drain in-flight"
+                        " + own + quota flush on quota-carrying "
+                        "requests) + 0.5 trip jitter + 10ms",
                     "served_light_clients": "1x8",
                     "served_light_errors": lreport.n_errors,
                     "served_light_first_error": lreport.first_error,
@@ -1252,7 +1261,10 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
             phase_errors: dict = {}
             # warm the serving path (quota pools, memo, code paths)
             h2(1000 if on_tpu else 100, depth, 2.0, "warm")
-            reps = [h2(6000 if on_tpu else 300, depth, 0.5,
+            # ≥1.3s windows: at ~9k/s a 6000-completion window closed
+            # in ~0.7s and single tunnel stalls swung the min window
+            # ~2x — completion counts sized so stalls amortize
+            reps = [h2(12000 if on_tpu else 300, depth, 0.5,
                        f"sat{i}")
                     for i in range(3)]
             # the MEDIAN-throughput window supplies BOTH the headline
@@ -1273,7 +1285,9 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                 phase_errors["light-final"] = \
                     f"{type(exc).__name__}: {exc}"
                 stubbed.append("light")
-                lrep = {"checks_per_sec": 0.0, "p50_ms": -1.0,
+                # -1.0 sentinels, never 0.0: a fabricated zero reads
+                # as a real measurement (perf.PerfError invariant)
+                lrep = {"checks_per_sec": -1.0, "p50_ms": -1.0,
                         "p99_ms": -1.0}
             counters = native.counters()
         finally:
@@ -1288,7 +1302,7 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
         except Exception as exc:   # ceiling is context, not headline
             phase_errors["echo"] = f"{type(exc).__name__}: {exc}"
             stubbed.append("echo")
-            erep = {"checks_per_sec": 0.0, "p50_ms": -1.0}
+            erep = {"checks_per_sec": -1.0, "p50_ms": -1.0}
         finally:
             estop()
 
