@@ -1226,7 +1226,10 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
         from istio_tpu.testing import perf, workloads
 
         buckets = (64, 256, 1024, 2048) if on_tpu else (64, 256)
-        depth = 2048 if on_tpu else 64
+        # depth 2x the top bucket: half the in-flight rows ride the
+        # current trip, the other half fill the next batch (measured
+        # +30% over depth=bucket on the serialized tunnel)
+        depth = 4096 if on_tpu else 64
         store = workloads.make_store(n_rules)
         srv = RuntimeServer(store, ServerArgs(
             batch_window_s=0.002, max_batch=buckets[-1], pipeline=2,
@@ -1244,27 +1247,27 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
             if plan is not None:
                 plan.prewarm(buckets)
             port = native.start()
-            payloads = perf.make_check_payloads(
-                workloads.make_request_dicts(512), quota_every=4)
+            dicts = workloads.make_request_dicts(512)
+            payloads = perf.make_check_payloads(dicts, quota_every=4)
 
-            def h2(n, d, warm, tag):
+            def h2(pay, n, d, warm, tag):
                 # one retry per phase: a single tunnel hiccup (poll
                 # timeout) must not wipe a section whose other phases
                 # measured fine (r5: the whole native artifact once
                 # died on a transient in the depth-8 phase)
                 try:
-                    return perf.run_h2load(port, payloads, n, d, warm)
+                    return perf.run_h2load(port, pay, n, d, warm)
                 except Exception as exc:
                     phase_errors[tag] = f"{type(exc).__name__}: {exc}"
-                    return perf.run_h2load(port, payloads, n, d, warm)
+                    return perf.run_h2load(port, pay, n, d, warm)
 
             phase_errors: dict = {}
             # warm the serving path (quota pools, memo, code paths)
-            h2(1000 if on_tpu else 100, depth, 2.0, "warm")
+            h2(payloads, 1000 if on_tpu else 100, depth, 2.0, "warm")
             # ≥1.3s windows: at ~9k/s a 6000-completion window closed
             # in ~0.7s and single tunnel stalls swung the min window
             # ~2x — completion counts sized so stalls amortize
-            reps = [h2(12000 if on_tpu else 300, depth, 0.5,
+            reps = [h2(payloads, 12000 if on_tpu else 300, depth, 0.5,
                        f"sat{i}")
                     for i in range(3)]
             # the MEDIAN-throughput window supplies BOTH the headline
@@ -1273,11 +1276,29 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
             by_cps = sorted(reps, key=lambda r: r["checks_per_sec"])
             med_rep = by_cps[1]
             cps = [r["checks_per_sec"] for r in by_cps]
+            # no-quota window: every trip the quota mix costs is a
+            # POOL-FLUSH trip serialized between check trips (25% of
+            # rows carry quota → ~1:1 trip ratio, halving the rate);
+            # this field pins the pure-check wire rate so the gap is
+            # attributed to the quota protocol, not the engine
+            stubbed: list = []
+            nq_payloads = perf.make_check_payloads(dicts,
+                                                   quota_every=0)
+            try:
+                # ~2x the mixed rate → 2x the completions for the same
+                # ≥1.3s window criterion the sat phases follow
+                nqrep = h2(nq_payloads, 24000 if on_tpu else 300,
+                           depth, 0.5, "noquota")
+            except Exception as exc:
+                phase_errors["noquota-final"] = \
+                    f"{type(exc).__name__}: {exc}"
+                stubbed.append("noquota")
+                nqrep = {"checks_per_sec": -1.0, "p50_ms": -1.0}
             # light load: depth 8 — the latency regime (saturation
             # p50/p99 is queueing, not service time)
-            stubbed: list = []
             try:
-                lrep = h2(300 if on_tpu else 100, 8, 2.0, "light")
+                lrep = h2(payloads, 300 if on_tpu else 100, 8, 2.0,
+                          "light")
             except Exception as exc:
                 # the light phase is informative, not the headline —
                 # never let it take the saturation numbers down; its
@@ -1318,6 +1339,9 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
             "served_native_depth": depth,
             "served_native_errors": sum(r["errors"] for r in reps),
             "served_native_quota_frac": 0.25,
+            "served_native_noquota_checks_per_sec": round(
+                nqrep["checks_per_sec"], 1),
+            "served_native_noquota_p50_ms": round(nqrep["p50_ms"], 2),
             "served_native_light_checks_per_sec": round(
                 lrep["checks_per_sec"], 1),
             "served_native_light_p50_ms": round(lrep["p50_ms"], 2),
@@ -1329,10 +1353,10 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                 erep["p50_ms"], 3),
             "served_native_srv": counters,
             "served_native_batch_hist": hist,
-            # phase_errors: transient failures that were RETRIED (the
-            # emitted numbers are real measurements) — except phases
-            # listed in served_native_stubbed_phases, whose fields are
-            # fabricated zeros after the retry also failed
+            # phase_errors: failures during a phase (retried once,
+            # except the *-final entries whose retry also failed) —
+            # phases listed in served_native_stubbed_phases emit -1.0
+            # sentinel fields, never a fabricated measurement
             **({"served_native_phase_errors": phase_errors}
                if phase_errors else {}),
             **({"served_native_stubbed_phases": stubbed}
