@@ -623,6 +623,7 @@ bags = workloads.make_bags(batch, seed=17)
 configs = (("dp1", None, n_rules), ("dp4mp2", (4, 2), n_rules),
            ("mp2", (1, 2), n_rules), ("half", None, n_rules // 2))
 times = {{}}
+servers = {{}}
 for label, shape, nr in configs:
     srv = RuntimeServer(workloads.make_store(nr), ServerArgs(
         batch_window_s=0.001, mesh_shape=shape, buckets=(batch,),
@@ -656,15 +657,42 @@ for label, shape, nr in configs:
             for _ in range(steps):
                 srv.check_many(bags)
             best = min(best, (time.perf_counter() - t0) / steps)
-    finally:
+    except BaseException:
         srv.close()
+        raise
     times[label] = best
-    out[f"mesh_{{label}}_checks_per_sec"] = round(batch / best, 1)
+    if label in ("mp2", "half"):
+        servers[label] = srv    # kept open for the interleaved pass
+    else:
+        srv.close()
+# the weak-scaling pair re-measures INTERLEAVED (mp2/half/mp2/half)
+# with both servers alive: measured minutes apart, host drift between
+# the two configs swung mesh_overhead_ratio 1.05-1.5x run to run —
+# alternating windows sample the same host conditions for both sides.
+# The RATIO uses interleaved-pass times ONLY (mixing a quiet solo
+# window into one side would re-compare unmatched conditions); the
+# standalone throughput fields keep the overall best.
+pair = {{"mp2": float("inf"), "half": float("inf")}}
+try:
+    for _ in range(2):
+        for label in ("mp2", "half"):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                servers[label].check_many(bags)
+            pair[label] = min(pair[label],
+                              (time.perf_counter() - t0) / steps)
+            times[label] = min(times[label], pair[label])
+finally:
+    for srv in servers.values():
+        srv.close()
+for label, _shape, _nr in configs:
+    out[f"mesh_{{label}}_checks_per_sec"] = round(
+        batch / times[label], 1)
 out["mesh_scaling_ratio"] = round(
     out["mesh_dp4mp2_checks_per_sec"] / out["mesh_dp1_checks_per_sec"],
     3)
 out["mesh_overhead_ratio"] = round(
-    times["mp2"] / (2.0 * times["half"]), 3)
+    pair["mp2"] / (2.0 * pair["half"]), 3)
 out["mesh_overhead_interpretation"] = (
     "mp2@" + str(n_rules) + " step time over 2x the dp1@"
     + str(n_rules // 2) + " step time: the 1-core host serializes the "
