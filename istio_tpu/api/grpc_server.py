@@ -131,6 +131,35 @@ class MixerGrpcServer:
                 self.runtime.check_batch_preprocessed(padded)[:len(chunk)])
         return results
 
+    def _check_bags_quota_instep(self, bags: list, qspecs: list,
+                                 target) -> tuple[list, dict]:
+        """_check_bags_chunked with each chunk's quota rows allocated
+        IN its check trip (ServerArgs.quota_in_step; the pool-flush
+        trip disappears — FusedPlan.packed_check_instep). qspecs[i] is
+        (name, QuotaArgs) or None; `target` from
+        RuntimeServer.instep_quota_target(). Returns (results,
+        {global row → QuotaResult}); rows whose check was denied keep
+        their entry but callers must NOT attach it (the device gate
+        consumed nothing for them — grpcServer.go:188)."""
+        from istio_tpu.runtime.batcher import pad_to_bucket
+
+        buckets = self.runtime.batcher.buckets
+        results: list = []
+        qres: dict[int, Any] = {}
+        cap = buckets[-1]
+        for lo in range(0, len(bags), cap):
+            chunk = bags[lo:lo + cap]
+            padded = pad_to_bucket(chunk, buckets)
+            qrows = [(i, qspecs[lo + i][0], qspecs[lo + i][1])
+                     for i in range(len(chunk))
+                     if qspecs[lo + i] is not None]
+            resps, rq = self.runtime.check_batch_quota_instep(
+                padded, qrows, target)
+            results.extend(resps[:len(chunk)])
+            for i, qr in rq.items():
+                qres[lo + i] = qr
+        return results, qres
+
     def _check_bag(self, request: RawCheckRequest):
         monitor.CHECK_REQUESTS.inc()
         gwc = request.global_word_count

@@ -248,11 +248,54 @@ class NativeMixerServer(MixerGrpcServer):
                 bags.append(self.runtime.preprocess(
                     LazyWireBag(payload, gwc or None,
                                 native_ok=native)))
-            results = self._check_bags_chunked(bags)
+            # in-step quota (ServerArgs.quota_in_step): eligible
+            # single-quota rows allocate IN the check trip — no
+            # pool-flush trip serialized behind it, no defer
+            # machinery. Ineligible rows (multi-quota, unknown name,
+            # target-less snapshot) keep the classic defer path.
+            target = self.runtime.instep_quota_target()
+            qspecs = None
+            if target is not None:
+                _, by_name = target
+                qspecs = []
+                for _, _, _, _, dedup, quotas in checks:
+                    spec = None
+                    if len(quotas) == 1:
+                        (qname, (amount, be)), = quotas.items()
+                        if qname in by_name:
+                            spec = (qname, QuotaArgs(
+                                quota_amount=amount, best_effort=be,
+                                dedup_id=dedup + ":" + qname
+                                if dedup else ""))
+                    qspecs.append(spec)
+                if not any(qspecs):
+                    qspecs = None
+            if qspecs is not None:
+                results, inres = self._check_bags_quota_instep(
+                    bags, qspecs, target)
+            else:
+                results = self._check_bags_chunked(bags)
+                inres = {}
             memo_hits = 0
-            for item, bag, result in zip(checks, bags, results):
+            for row, (item, bag, result) in enumerate(
+                    zip(checks, bags, results)):
                 tag, _, _, _, dedup, quotas = item
                 try:
+                    if row in inres:
+                        # quota already allocated in the check trip;
+                        # attach it only on success (a denied row's
+                        # entry is grant-freely noise the gate never
+                        # consumed for — the fronts omit quotas on
+                        # denial, grpcServer.go:188)
+                        qpair = []
+                        if result.status_code == 0:
+                            (qname, _), = quotas.items()
+                            qpair = [(qname, inres[row])]
+                        raw = self._check_response(
+                            None, bag, result,
+                            quotas=qpair).SerializeToString()
+                        completions.append((tag, 0, raw))
+                        continue
                     if quotas and result.status_code == 0:
                         # quota rows complete via pool-future
                         # callbacks: a batch's non-quota rows must NOT

@@ -111,6 +111,28 @@ class DeviceQuotaPool:
         self._small_batch = min(64, max_batch)
         self._wake = threading.Condition(self._lock)
         self._closed = False
+        # counter-buffer ownership token: `counts` is mutated by the
+        # worker's flush AND by in-step sessions (quota alloc riding
+        # the check trip, see inline_begin). Sessions hold it only
+        # from stage to DISPATCH (the successor buffer is swapped in
+        # as a device future — trips chain on-device, so two pumps'
+        # trips overlap on the transport while the data dependency
+        # resolves in XLA). Lock order: never take self._lock then
+        # _counts_lock — the worker releases _lock before allocating.
+        self._counts_lock = threading.Lock()
+        # in-step commit ordering: bookkeeping (dedup-cache writes,
+        # pending-dedup replays) must apply in DISPATCH order even
+        # though pulls race — sessions take numbered turns
+        self._seq_next = 0
+        self._commit_cv = threading.Condition(threading.Lock())
+        self._commit_turn = 0
+        # dedup ids consumed by a dispatched-but-uncommitted session:
+        # a same-id row staged meanwhile must NOT re-consume — it
+        # resolves from the cache at its own (later) commit turn
+        self._dedup_pending: dict[str, int] = {}
+        # last known-good counter handle (restore target when a
+        # dispatched trip's pull fails)
+        self._counts_good = self.counts
         # compile every program the serving path can hit (both pad
         # shapes × the serving alloc variants: fast/unit/seg)
         # BEFORE the worker starts — a first-quota-batch compile
@@ -172,6 +194,87 @@ class DeviceQuotaPool:
                     or len(self._pending) >= self._max_batch:
                 self._wake.notify()
         return fut
+
+    def inline_begin(self, n: int, rows: list, now: float
+                     ) -> "InlineQuotaSession | None":
+        """Stage in-step quota rows for ONE check trip (the quota
+        alloc rides the packed check program instead of its own
+        serialized device trip — FusedPlan.packed_check_instep).
+
+        `rows`: [(slot, name, instance, args)], slot < n indexing the
+        check batch row (at most one quota per row — callers defer
+        multi-quota requests to the classic pool path). Returns a
+        session HOLDING the pool's counter token until commit/abort,
+        or None when the pool is closed (callers fall back). Rows
+        resolved without the trip — dedup replays, unknown quota
+        names, keyspace exhaustion — land in session.early and their
+        array rows stay inactive; in-batch duplicate dedup ids replay
+        the first row's outcome at commit (the _flush first_of rule).
+        """
+        self._counts_lock.acquire()
+        sess = InlineQuotaSession(self, n)
+        try:
+            with self._lock:
+                if self._closed:
+                    self._counts_lock.release()
+                    return None
+                sess.seq = self._seq_next
+                self._seq_next += 1
+                sess.prev_counts = self.counts
+                self._gc_dedup(now)
+                first_of: dict[str, int] = {}
+                for slot, name, instance, args in rows:
+                    lim = self.limits.get(name)
+                    if lim is None:
+                        sess.early[slot] = QuotaResult(
+                            granted_amount=0,
+                            status_code=RESOURCE_EXHAUSTED,
+                            status_message=f"unknown quota {name}")
+                        continue
+                    did = args.dedup_id
+                    if did:
+                        hit = self._dedup.get(did)
+                        if hit is not None and hit[1] > now:
+                            status = 0 if hit[0] > 0 or \
+                                args.quota_amount == 0 \
+                                else RESOURCE_EXHAUSTED
+                            sess.early[slot] = QuotaResult(
+                                granted_amount=hit[0],
+                                valid_duration_s=lim["duration"],
+                                status_code=status)
+                            continue
+                        if did in first_of:
+                            sess.replay_of[slot] = (first_of[did],
+                                                    lim["duration"])
+                            continue
+                        if did in self._dedup_pending:
+                            # consumed by a dispatched-but-uncommitted
+                            # session: resolve from the cache at OUR
+                            # (later) commit turn — never re-consume
+                            sess.pending_replay[slot] = \
+                                (did, lim["duration"],
+                                 int(args.quota_amount))
+                            continue
+                    bucket = self._bucket_for(dims_key(instance),
+                                              lim, now)
+                    if bucket < 0:
+                        sess.early[slot] = QuotaResult(
+                            granted_amount=0,
+                            status_code=RESOURCE_EXHAUSTED,
+                            status_message="quota keyspace exhausted")
+                        continue
+                    if did:
+                        first_of[did] = slot
+                        self._dedup_pending[did] = sess.seq
+                    sess.stage(slot, bucket, args, lim, did, now)
+            sess.now = now
+            return sess
+        except BaseException:
+            self._counts_lock.release()
+            if sess.seq >= 0:   # consume the turn or later sessions wedge
+                sess._take_turn()
+                sess._end_turn()
+            raise
 
     def close(self) -> None:
         with self._lock:
@@ -330,12 +433,14 @@ class DeviceQuotaPool:
                 if bool((amounts[:n] == 1).all()) else self._alloc_seg
         else:
             alloc = self._alloc_fast
-        granted, self.counts = alloc(
-            self.counts, jnp.asarray(buckets), jnp.asarray(amounts),
-            jnp.asarray(be), jnp.asarray(mx), jnp.asarray(active),
-            jnp.asarray(ticks), jnp.asarray(lasts),
-            jnp.asarray(rolling))
-        granted = np.asarray(granted)
+        with self._counts_lock:
+            granted, self.counts = alloc(
+                self.counts, jnp.asarray(buckets),
+                jnp.asarray(amounts), jnp.asarray(be),
+                jnp.asarray(mx), jnp.asarray(active),
+                jnp.asarray(ticks), jnp.asarray(lasts),
+                jnp.asarray(rolling))
+            granted = np.asarray(granted)
         for b_, abs_tick in roll_updates:
             self._last_tick[b_] = abs_tick
         with self._lock:
@@ -406,6 +511,182 @@ class QuotaFuture:
 
     def done(self) -> bool:
         return self._ev.is_set()
+
+
+class InlineQuotaSession:
+    """One check trip's staged in-step quota work (pipelined).
+
+    Lifecycle: inline_begin (stage, token held) → dispatched(new)
+    (pool.counts swaps to the trip's DEVICE FUTURE and the token
+    releases — the next trip chains on-device, so trips overlap on
+    the transport) → commit(granted, gate) in dispatch order (the
+    commit turn serializes dedup-cache writes and pending replays).
+    Tick bookkeeping is optimistic at stage time: the dispatched
+    program rolls every staged row's bucket unconditionally (only the
+    ALLOC is gated via zeroed amounts), so host _last_tick and device
+    slots agree for chained trips. A trip that fails AFTER dispatch
+    restores the last known-good counter handle; its optimistic tick
+    advances then under-grant (never over-grant) for at most one
+    window — the documented device-failure tradeoff.
+
+    Result parity (memquota/dispatcher semantics): gate-off rows grant
+    the requested amount freely WITHOUT consuming (dispatcher.quota's
+    no-matching-rule tail); dedup ids cache only consumed outcomes."""
+
+    def __init__(self, pool: DeviceQuotaPool, n: int) -> None:
+        self.pool = pool
+        self.n = n
+        self.now = 0.0
+        self.seq = -1
+        self.prev_counts: Any = None
+        self.new_counts: Any = None
+        self.early: dict[int, QuotaResult] = {}
+        self.replay_of: dict[int, tuple[int, float]] = {}
+        # slot → (dedup id, duration, requested amount): same-id rows
+        # racing a dispatched-but-uncommitted session
+        self.pending_replay: dict[int, tuple[str, float, int]] = {}
+        self._staged: dict[int, tuple] = {}   # slot → (amount, dur, did)
+        self.buckets = np.zeros(n, np.int32)
+        self.amounts = np.zeros(n, np.int32)
+        self.be = np.zeros(n, bool)
+        self.mx = np.zeros(n, np.int32)
+        self.active = np.zeros(n, bool)
+        self.ticks = np.zeros(n, np.int32)
+        self.lasts = np.zeros(n, np.int32)
+        self.rolling = np.zeros(n, bool)
+        self._token_held = True
+        self._done = False
+
+    def stage(self, slot: int, bucket: int, args: QuotaArgs,
+              lim: Mapping[str, Any], dedup_id: str,
+              now: float) -> None:
+        """Called under pool._lock (inline_begin)."""
+        p = self.pool
+        self.buckets[slot] = bucket
+        self.amounts[slot] = int(args.quota_amount)
+        self.be[slot] = bool(args.best_effort)
+        self.mx[slot] = lim["max"]
+        self.active[slot] = True
+        tl = p._tick_len[bucket]
+        if tl > 0:
+            abs_tick = int(now / tl)
+            base = int(p._tick_base[bucket])
+            self.ticks[slot] = abs_tick - base
+            self.lasts[slot] = int(p._last_tick[bucket]) - base
+            self.rolling[slot] = True
+            # OPTIMISTIC: the dispatched program rolls this bucket to
+            # abs_tick regardless of the alloc gate — chained trips
+            # must stage against the post-roll state
+            p._last_tick[bucket] = abs_tick
+        self._staged[slot] = (int(args.quota_amount), lim["duration"],
+                              dedup_id)
+
+    def dispatched(self, new_counts) -> None:
+        """The program is in flight: swap the pool onto its output
+        future and release the token — the next trip chains on it."""
+        self.new_counts = new_counts
+        self.pool.counts = new_counts
+        self._token_held = False
+        self.pool._counts_lock.release()
+
+    def _take_turn(self) -> None:
+        cv = self.pool._commit_cv
+        with cv:
+            while self.pool._commit_turn != self.seq:
+                cv.wait(timeout=1.0)
+
+    def _end_turn(self) -> None:
+        cv = self.pool._commit_cv
+        with cv:
+            self.pool._commit_turn = self.seq + 1
+            cv.notify_all()
+
+    def commit(self, granted: np.ndarray, gate: np.ndarray
+               ) -> dict[int, QuotaResult]:
+        """granted/gate: the pulled per-row outputs. Returns
+        {slot → QuotaResult} for staged/replay/pending rows (merge
+        with .early for the full picture)."""
+        p = self.pool
+        out: dict[int, QuotaResult] = {}
+        self._take_turn()
+        try:
+            with p._lock:
+                p._counts_good = self.new_counts
+                for slot, (amount, duration, did) in \
+                        self._staged.items():
+                    if did:
+                        p._dedup_pending.pop(did, None)
+                    if not gate[slot]:
+                        # no active quota rule for this request: grant
+                        # the requested amount freely, consuming
+                        # nothing (dispatcher.quota tail)
+                        out[slot] = QuotaResult(granted_amount=amount)
+                        continue
+                    g = int(granted[slot])
+                    if did:
+                        expiry = self.now + max(duration,
+                                                p.min_dedup_s)
+                        p._dedup[did] = (g, expiry)
+                    status = 0 if g > 0 or amount == 0 \
+                        else RESOURCE_EXHAUSTED
+                    out[slot] = QuotaResult(granted_amount=g,
+                                            valid_duration_s=duration,
+                                            status_code=status)
+                for slot, (did, duration, amount) in \
+                        self.pending_replay.items():
+                    hit = p._dedup.get(did)
+                    if hit is not None and hit[1] > self.now:
+                        status = 0 if hit[0] > 0 or amount == 0 \
+                            else RESOURCE_EXHAUSTED
+                        out[slot] = QuotaResult(
+                            granted_amount=hit[0],
+                            valid_duration_s=duration,
+                            status_code=status)
+                    else:
+                        # the consuming session aborted (device
+                        # failure): no outcome to replay
+                        out[slot] = QuotaResult(
+                            granted_amount=0, status_code=14,
+                            status_message="quota trip failed")
+            for slot, (first, duration) in self.replay_of.items():
+                prior = out.get(first, self.early.get(first))
+                if prior is None:   # first row resolved early w/o entry
+                    prior = QuotaResult(granted_amount=0,
+                                        status_code=RESOURCE_EXHAUSTED)
+                out[slot] = prior
+            return out
+        finally:
+            self._done = True
+            self._end_turn()
+
+    def abort(self) -> None:
+        """Trip failed. Pre-dispatch: release the token, nothing
+        changed. Post-dispatch: take the commit turn, drop pending
+        markers, and restore the last known-good counter handle unless
+        a later trip already chained past this one."""
+        if self._done:
+            return
+        self._done = True
+        p = self.pool
+        if self._token_held:
+            self._token_held = False
+            p._counts_lock.release()
+            # the turn MUST still be consumed or every later session
+            # wedges behind this seq
+            self._take_turn()
+            self._end_turn()
+            return
+        self._take_turn()
+        try:
+            with p._lock:
+                for _slot, (_a, _d, did) in self._staged.items():
+                    if did:
+                        p._dedup_pending.pop(did, None)
+            with p._counts_lock:
+                if p.counts is self.new_counts:
+                    p.counts = p._counts_good
+        finally:
+            self._end_turn()
 
 
 class DeviceQuotaTable:

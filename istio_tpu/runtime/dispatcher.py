@@ -244,16 +244,31 @@ class Dispatcher:
     # varieties
     # ------------------------------------------------------------------
 
-    def check(self, bags: Sequence[Bag]) -> list[CheckResponse]:
+    def check(self, bags: Sequence[Bag], instep: Any = None,
+              pre_tensorized: Any = None) -> list[CheckResponse]:
+        """`instep`: optional (q_arrays, counts, on_dispatch, on_pull)
+        from an in-step quota session (device_quota.
+        InlineQuotaSession) — the quota alloc rides the check
+        program's trip; `on_dispatch(new_counts)` fires the moment
+        the program is in flight (the session swaps the pool onto the
+        device future and releases its token, letting the next trip
+        chain on-device) and `on_pull(granted, gate)` right after the
+        pull, before any per-row response python. `pre_tensorized`:
+        (batch, ns_ids) computed by the caller (outside the token);
+        must correspond to `bags` exactly. Both require the fused
+        path."""
         if self.fused is not None:
-            return self._check_fused(bags)
+            return self._check_fused(bags, instep=instep,
+                                     pre_tensorized=pre_tensorized)
         actives, visibles = self._resolve(bags)
         out = []
         for bag, rule_idxs, vis in zip(bags, actives, visibles):
             out.append(self._check_one(bag, rule_idxs, vis))
         return out
 
-    def _check_fused(self, bags: Sequence[Bag]) -> list[CheckResponse]:
+    def _check_fused(self, bags: Sequence[Bag], instep: Any = None,
+                     pre_tensorized: Any = None
+                     ) -> list[CheckResponse]:
         """Fused serving path: ONE device step computes rule matching +
         denier/list verdicts + TTLs for the whole batch; the host loop
         below only touches rules with non-fusable actions (and rules
@@ -266,15 +281,32 @@ class Dispatcher:
         snap, plan = self.snapshot, self.fused
         tr = tracing.get_tracer()
         with monitor.resolve_timer():
-            with tr.span("serve.tensorize", batch=len(bags)):
-                # C++ wire→tensor decode when possible: no per-request
-                # python work
-                batch, ns_ids = self._tensorize_for_device(bags)
+            if pre_tensorized is not None:
+                batch, ns_ids = pre_tensorized
+            else:
+                with tr.span("serve.tensorize", batch=len(bags)):
+                    # C++ wire→tensor decode when possible: no
+                    # per-request python work
+                    batch, ns_ids = self._tensorize_for_device(bags)
             # ONE device→host pull for the whole verdict: each extra
             # pull costs a full RTT (~120ms behind the axon tunnel),
             # and plane-by-plane conversion was 6 RTTs per batch
             with tr.span("serve.device"):
-                packed = plan.packed_check(batch, ns_ids)
+                if instep is not None:
+                    q_arrays, counts, on_dispatch, on_pull = instep
+                    packed_dev, new_counts = plan.packed_check_instep(
+                        batch, ns_ids, q_arrays, counts)
+                    # the program is IN FLIGHT: on_dispatch swaps the
+                    # pool onto the device-future counters and drops
+                    # the token, so the next trip chains on-device
+                    # while this one's pull is still outstanding
+                    on_dispatch(new_counts)
+                    packed = np.asarray(packed_dev)   # the pull
+                    # granted/gate are the LAST two rows; everything
+                    # the overlay decode reads sits before them
+                    on_pull(packed[-2], packed[-1] != 0)
+                else:
+                    packed = plan.packed_check(batch, ns_ids)
             status = packed[0]
             dur = packed[1].view(np.float32)
             uses = packed[2]

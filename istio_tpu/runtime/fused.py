@@ -132,6 +132,7 @@ class FusedPlan:
     # the host InstanceBuilder.build for every instance
     report_lowering: Any = None
     _report_packer: Any = None
+    _instep_packer: Any = None
 
     @property
     def n_ref_words(self) -> int:
@@ -259,6 +260,75 @@ class FusedPlan:
         return np.asarray(self._report_packer(verdict,
                                               np.asarray(ns_ids), batch))
 
+    def packed_check_instep(self, batch, ns_ids, q: Mapping[str, Any],
+                            counts) -> tuple[Any, Any]:
+        """packed_check's rows PLUS an IN-STEP quota allocation in the
+        SAME device program — the quota-carrying batch pays ONE trip
+        instead of check-trip + pool-flush-trip serialized on the
+        transport (the bench's no-quota windows measure ~2x the mixed
+        rate for exactly this reason).
+
+        `q` carries the staged per-row alloc arrays from
+        device_quota.InlineQuotaSession (buckets/amounts/be/mx/active/
+        ticks/lasts/rolling, plus rule_idx — the ruleset row whose
+        ns-masked matched bit gates the alloc by zeroing its amount;
+        the roll runs for every staged row). `counts` is the pool's
+        counter buffer; returns DEVICE handles (packed, new_counts) —
+        packed's last TWO rows are granted and gate once pulled."""
+        import jax
+
+        if self._instep_packer is None:
+            import jax.numpy as jnp
+            from istio_tpu.models.quota_alloc import \
+                make_rolling_alloc_step
+            pack = self._base_packer()
+            rs = self.engine.ruleset
+            rule_ns = jnp.asarray(rs.rule_ns)
+            default_ns = rs.ns_ids[""]
+            n_buckets, k_ticks = counts.shape
+            # the general contended-mixed kernel unconditionally: the
+            # fast/unit variants are host-selected shape optimizations
+            # the merged program cannot branch on
+            seg = make_rolling_alloc_step(int(n_buckets), int(k_ticks),
+                                          jit=False)[3]
+
+            def packq(verdict, req_ns, cnt, buckets, amounts, be, mx,
+                      active, ticks, lasts, rolling, rule_idx):
+                head = pack(verdict, req_ns)
+                rows = jnp.arange(buckets.shape[0])
+                safe_rule = jnp.clip(rule_idx, 0,
+                                     rule_ns.shape[0] - 1)
+                rn = rule_ns[safe_rule]
+                ns_ok = (rn == default_ns) | (rn == req_ns)
+                # the reference's quota loop runs ONLY on successful
+                # checks (grpcServer.go:188) — a denied row must not
+                # consume. Device status IS the final status here:
+                # instep_quota_target refuses snapshots with host
+                # overlay actions or host-fallback predicates. The
+                # gate zeroes AMOUNTS (consume nothing) while the ROLL
+                # runs for every STAGED row — the session's optimistic
+                # host tick bookkeeping depends on rolls being
+                # unconditional (chained-trip staging).
+                gate = active & (rule_idx >= 0) & ns_ok & \
+                    (verdict.status == 0) & \
+                    verdict.matched[rows, safe_rule]
+                amt = jnp.where(gate, amounts, 0)
+                granted, new_cnt = seg(cnt, buckets, amt, be, mx,
+                                       active, ticks, lasts, rolling)
+                extra = jnp.stack([granted.astype(jnp.int32),
+                                   gate.astype(jnp.int32)])
+                return jnp.concatenate([head, extra], axis=0), new_cnt
+
+            self._instep_packer = jax.jit(packq)
+        verdict = self.engine.check(batch, ns_ids)
+        # DEVICE handles, not host arrays: the caller swaps the pool
+        # onto new_counts at dispatch (the next trip chains on-device)
+        # and pulls `packed` with the counter token already released
+        return self._instep_packer(
+            verdict, np.asarray(ns_ids), counts,
+            q["buckets"], q["amounts"], q["be"], q["mx"], q["active"],
+            q["ticks"], q["lasts"], q["rolling"], q["rule_idx"])
+
     def pred_attrs_for_ns(self, ns_id: int) -> frozenset:
         """Union of predicate attr uses over rules visible to ns_id —
         every visible rule's predicate is evaluated for the request
@@ -308,6 +378,41 @@ class FusedPlan:
                 # the report path's packer (check rows + field planes)
                 # is a separate XLA program per bucket shape
                 self.packed_report(batch, np.zeros(b, np.int32))
+
+    def prewarm_instep(self, buckets, counts) -> None:
+        """Compile the in-step quota program for every serving bucket
+        (ServerArgs.quota_in_step fronts call this before taking
+        traffic — a first-quota-batch compile mid-serve stalls every
+        row behind it). `counts` only supplies the counter SHAPE; the
+        dummy trips never touch the pool's live buffer."""
+        from istio_tpu.compiler.layout import AttributeBatch
+
+        import jax.numpy as jnp
+
+        lay = self.engine.ruleset.layout
+        zero_counts = jnp.zeros_like(counts)
+        for b in sorted(set(buckets)):
+            batch = AttributeBatch(
+                ids=np.zeros((b, lay.n_columns), np.int32),
+                present=np.zeros((b, lay.n_columns), bool),
+                map_present=np.zeros((b, max(lay.n_maps, 1)), bool),
+                str_bytes=np.zeros((b, max(lay.n_byte_slots, 1),
+                                    lay.max_str_len), np.uint8),
+                str_lens=np.zeros((b, max(lay.n_byte_slots, 1)),
+                                  np.int32),
+                hash_ids=np.zeros((b, lay.n_columns), np.int32))
+            q = {"buckets": np.zeros(b, np.int32),
+                 "amounts": np.zeros(b, np.int32),
+                 "be": np.zeros(b, bool),
+                 "mx": np.zeros(b, np.int32),
+                 "active": np.zeros(b, bool),
+                 "ticks": np.zeros(b, np.int32),
+                 "lasts": np.zeros(b, np.int32),
+                 "rolling": np.zeros(b, bool),
+                 "rule_idx": np.full(b, -1, np.int32)}
+            packed, _cnt = self.packed_check_instep(
+                batch, np.zeros(b, np.int32), q, zero_counts)
+            np.asarray(packed)   # force compile + execute
 
     def message_for(self, rule_idx: int, status: int) -> str:
         """Best-effort status message for a device-produced denial."""

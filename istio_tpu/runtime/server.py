@@ -9,6 +9,8 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Mapping, Sequence
 
+import numpy as np
+
 from istio_tpu.adapters.sdk import QuotaArgs, QuotaResult
 from istio_tpu.attribute.bag import Bag
 from istio_tpu.attribute.global_dict import GLOBAL_MANIFEST
@@ -41,6 +43,13 @@ class ServerArgs:
     # trips (see RuntimeServer.report); False dispatches each call's
     # records as their own batch
     report_batching: bool = True
+    # allocate quota IN the check trip (FusedPlan.packed_check_instep)
+    # instead of a separate pool-flush trip serialized behind it —
+    # gated: only the native front's pump consumes it, and only for
+    # single-pool, single-rule-per-name snapshots
+    # (RuntimeServer.instep_quota_target); everything else keeps the
+    # classic defer path
+    quota_in_step: bool = False
     # serving batch shapes (None → batcher.default_buckets(max_batch));
     # each is one jit trace, pre-warmed before config swaps
     buckets: tuple[int, ...] | None = None
@@ -250,6 +259,135 @@ class RuntimeServer:
             return pool.alloc(inst_q, instance, args)
         # no matching active quota rule: grant freely
         return QuotaResult(granted_amount=args.quota_amount)
+
+    # -- in-step quota (gated: ServerArgs.quota_in_step) ---------------
+
+    def instep_quota_target(self) -> tuple | None:
+        """(pool, {name → (ridx, inst_q)}) when the CURRENT snapshot is
+        in-step eligible: exactly one device pool, and every quota name
+        resolving to exactly one quota action on that pool's handler
+        whose rule predicate is device-evaluated (host-fallback rules'
+        activity is invisible to the device gate). None → callers use
+        the classic defer/pool-flush path."""
+        if not self.args.quota_in_step:
+            return None
+        d = self.controller.dispatcher
+        cached = getattr(self, "_instep_cache", None)
+        if cached is not None and cached[0] is d.snapshot:
+            return cached[1]
+        target = self._build_instep_target(d)
+        self._instep_cache = (d.snapshot, target)
+        return target
+
+    def _build_instep_target(self, d) -> tuple | None:
+        plan = d.fused
+        pools = self.controller.device_quotas
+        if plan is None or not plan.quota_actions or not pools:
+            return None
+        if len(set(map(id, pools.values()))) != 1:
+            return None
+        pool = next(iter(pools.values()))
+        rs = d.snapshot.ruleset
+        # the device alloc gates on the DEVICE status (a denied check
+        # must not consume, grpcServer.go:188); host overlay actions
+        # or host-fallback predicates could flip the final status
+        # after the trip — such snapshots keep the classic path
+        n_cfg = len(d.snapshot.rules)
+        if plan.host_actions or \
+                any(r < n_cfg for r in rs.host_fallback):
+            return None
+        by_name: dict[str, Any] = {}
+        for ridx, handler_q, inst_q, names in plan.quota_actions:
+            for name in names:
+                by_name.setdefault(name, []).append(
+                    (ridx, handler_q, inst_q))
+        out: dict[str, tuple] = {}
+        for name, cands in by_name.items():
+            if len(cands) != 1:
+                continue
+            ridx, handler_q, inst_q = cands[0]
+            if pools.get(handler_q) is not pool \
+                    or not pool.knows(inst_q) \
+                    or ridx in rs.host_fallback:
+                continue
+            out[name] = (ridx, inst_q)
+        return (pool, out) if out else None
+
+    def check_batch_quota_instep(self, bags: Sequence[Bag],
+                                 qrows: Sequence[tuple],
+                                 target: tuple):
+        """One padded batch with its quota rows allocated IN the check
+        trip. `qrows`: [(slot, requested name, QuotaArgs)]; `target`
+        from instep_quota_target() (same snapshot). Returns
+        (responses, {slot → QuotaResult}). Rows whose instance build
+        fails resolve INTERNAL without the trip (quota_fused parity).
+        """
+        from istio_tpu.expr.oracle import EvalError
+        from istio_tpu.models.policy_engine import INTERNAL
+
+        d = self.controller.dispatcher
+        snap = d.snapshot
+        pool, by_name = target
+        early: dict[int, QuotaResult] = {}
+        rows: list[tuple] = []
+        rule_idx = np.full(len(bags), -1, np.int32)
+        for slot, name, args in qrows:
+            ridx, inst_q = by_name[name]
+            try:
+                instance = snap.instances[inst_q].build(bags[slot])
+            except EvalError as exc:
+                early[slot] = QuotaResult(granted_amount=0,
+                                          status_code=INTERNAL,
+                                          status_message=str(exc))
+                continue
+            except Exception as exc:
+                early[slot] = QuotaResult(
+                    granted_amount=0, status_code=INTERNAL,
+                    status_message=f"instance build: {exc}")
+                continue
+            rule_idx[slot] = ridx
+            rows.append((slot, inst_q, instance, args))
+        # tensorize OUTSIDE the counter token: the token covers ONLY
+        # stage→dispatch (the successor counters swap in as a device
+        # future and the next trip chains on it), so concurrent
+        # pumps' host work AND their trips overlap on the transport
+        # (measured: a token held across the pull made in-step SLOWER
+        # than two serialized trips)
+        pre = d._tensorize_for_device(bags)
+        sess = pool.inline_begin(len(bags), rows,
+                                 pool._clock()) if rows else None
+        if sess is None:
+            if rows:   # pool closed under a config swap: fall back
+                for slot, _, _, args in rows:
+                    early[slot] = QuotaResult(
+                        granted_amount=0, status_code=14,
+                        status_message="quota pool closed by config "
+                                       "swap")
+            return d.check(bags, pre_tensorized=pre), early
+        results: dict[int, QuotaResult] = {}
+
+        def on_pull(granted, gate) -> None:
+            # fires right after the device pull, inside d.check —
+            # commits (in dispatch order) before the per-row response
+            # python runs
+            results.update(sess.commit(np.asarray(granted),
+                                       np.asarray(gate)))
+
+        try:
+            q = {"buckets": sess.buckets, "amounts": sess.amounts,
+                 "be": sess.be, "mx": sess.mx, "active": sess.active,
+                 "ticks": sess.ticks, "lasts": sess.lasts,
+                 "rolling": sess.rolling, "rule_idx": rule_idx}
+            responses = d.check(
+                bags, instep=(q, sess.prev_counts, sess.dispatched,
+                              on_pull),
+                pre_tensorized=pre)
+        except BaseException:
+            sess.abort()   # no-op when on_pull already committed
+            raise
+        results.update(sess.early)
+        results.update(early)
+        return responses, results
 
     def close(self) -> None:
         self.batcher.close()

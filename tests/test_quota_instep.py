@@ -1,0 +1,311 @@
+"""In-step quota: alloc rides the packed check program (gated,
+ServerArgs.quota_in_step).
+
+The classic served path pays check-trip + pool-flush-trip serialized
+on the transport per quota-carrying batch; the in-step path allocates
+in the SAME program, gated on the device's own ns-masked matched bit
+(FusedPlan.packed_check_instep + device_quota.InlineQuotaSession).
+Semantics must be EXACTLY the pool path's: memquota rolling windows,
+dedup replay (cache, in-batch first_of, cross-wave), best-effort
+partials, grant-freely on rule-inactive rows, INTERNAL on instance
+eval errors. At most ONE quota per check row by design — multi-quota
+requests keep the classic defer path. Reference:
+mixer/adapter/memquota/memquota.go:107-118,259;
+mixer/pkg/runtime/dispatcher.go:242.
+"""
+import pytest
+
+from istio_tpu.adapters.sdk import QuotaArgs
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+
+OK, RESOURCE_EXHAUSTED, INTERNAL = 0, 8, 13
+
+
+def _store() -> MemStore:
+    s = MemStore()
+    s.set(("handler", "istio-system", "mq"), {
+        "adapter": "memquota",
+        "params": {"quotas": [
+            {"name": "rq.istio-system", "max_amount": 40,
+             "valid_duration_s": 10.0},
+            {"name": "eq.istio-system", "max_amount": 10}]}})
+    s.set(("instance", "istio-system", "rq"), {
+        "template": "quota",
+        "params": {"dimensions": {"user": 'source.user | "anon"'}}})
+    s.set(("instance", "istio-system", "eq"), {
+        "template": "quota",
+        "params": {"dimensions": {"svc": "destination.service"}}})
+    # rq gated on method; eq unconditional
+    s.set(("rule", "istio-system", "rq-rule"), {
+        "match": 'request.method == "GET"',
+        "actions": [{"handler": "mq", "instances": ["rq"]}]})
+    s.set(("rule", "istio-system", "eq-rule"), {
+        "match": "",
+        "actions": [{"handler": "mq", "instances": ["eq"]}]})
+    return s
+
+
+class Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _server(instep: bool, clock) -> RuntimeServer:
+    srv = RuntimeServer(_store(), ServerArgs(
+        fused=True, max_batch=8, buckets=(8,), quota_in_step=instep))
+    for pool in set(srv.controller.device_quotas.values()):
+        pool._clock = clock
+    return srv
+
+
+def _classic_round(srv, bags, qrows):
+    """The served defer path: check, then (status-gated, like the
+    gRPC fronts — grpcServer.go:188) quota_fused per row."""
+    d = srv.controller.dispatcher
+    resps = d.check(bags)
+    out = {}
+    for slot, name, args in qrows:
+        if resps[slot].status_code != OK:
+            continue   # denied checks never reach the quota loop
+        qr = srv.quota_fused(bags[slot], name, args, resps[slot])
+        if qr is None:
+            qr = srv.quota(bags[slot], name, args, preprocessed=True)
+        if hasattr(qr, "result"):
+            qr = qr.result()
+        out[slot] = qr
+    return resps, out
+
+
+def _instep_round(srv, bags, qrows):
+    target = srv.instep_quota_target()
+    assert target is not None
+    return srv.check_batch_quota_instep(bags, qrows, target)
+
+
+def _bags():
+    return [bag_from_mapping(c) for c in (
+        {"request.method": "GET", "source.user": "alice",
+         "destination.service": "a.svc"},
+        {"request.method": "GET", "source.user": "bob",
+         "destination.service": "a.svc"},
+        # gate-off for rq (POST): grant freely without consuming
+        {"request.method": "POST", "source.user": "alice",
+         "destination.service": "b.svc"},
+        # defaulted dims: no source.user → "anon"
+        {"request.method": "GET",
+         "destination.service": "b.svc"},
+        {"request.method": "GET", "source.user": "alice",
+         "destination.service": "a.svc"},
+        {"request.method": "GET", "source.user": "alice",
+         "destination.service": "c.svc"},
+    )]
+
+
+def _run_waves(waves, clock_moves=None):
+    """Drive the same waves through both paths; compare grants."""
+    clock_a, clock_b = Clock(), Clock()
+    srv_a = _server(True, clock_a)    # in-step
+    srv_b = _server(False, clock_b)   # classic pool path
+    try:
+        for wi, wave in enumerate(waves):
+            if clock_moves and wi in clock_moves:
+                clock_a.t += clock_moves[wi]
+                clock_b.t += clock_moves[wi]
+            bags = _bags()
+            ra, qa = _instep_round(srv_a, bags, wave)
+            rb, qb = _classic_round(srv_b, bags, wave)
+            for slot, name, _args in wave:
+                if rb[slot].status_code != OK:
+                    # denied check: the fronts attach no quota result
+                    # (and the device gate consumed nothing) — the
+                    # in-step result for the row is discarded
+                    assert slot not in qb
+                    continue
+                a, b = qa[slot], qb[slot]
+                assert (a.granted_amount, a.status_code) == \
+                    (b.granted_amount, b.status_code), \
+                    (wi, slot, name, a, b)
+            for x, y in zip(ra, rb):
+                assert x.status_code == y.status_code
+    finally:
+        srv_a.close()
+        srv_b.close()
+
+
+def test_grants_defaults_gating_and_contention():
+    """Gated/ungated rows, defaulted dims, mixed amounts contending
+    on one bucket, best-effort partials, window exhaustion."""
+    _run_waves([
+        # alice 5 + 5 (slots 0,4 same bucket, contended), anon 3,
+        # POST freely, eq consumption on a.svc
+        [(0, "rq", QuotaArgs(quota_amount=5, best_effort=True)),
+         (4, "rq", QuotaArgs(quota_amount=5, best_effort=True)),
+         (2, "rq", QuotaArgs(quota_amount=7, best_effort=True)),
+         (3, "rq", QuotaArgs(quota_amount=3, best_effort=True)),
+         (1, "eq", QuotaArgs(quota_amount=6, best_effort=True))],
+        # alice 40 → partial 30 left; eq 6 → partial 4 left; then zero
+        [(0, "rq", QuotaArgs(quota_amount=40, best_effort=True)),
+         (1, "eq", QuotaArgs(quota_amount=6, best_effort=True))],
+        [(4, "rq", QuotaArgs(quota_amount=1, best_effort=True)),
+         (1, "eq", QuotaArgs(quota_amount=6, best_effort=True))],
+    ])
+
+
+def test_rolling_window_reclaim_parity():
+    """Consume the whole window, advance past the consuming tick,
+    re-consume — tick math must match the host adapter exactly on
+    both paths."""
+    _run_waves([
+        [(0, "rq", QuotaArgs(quota_amount=40, best_effort=True))],
+        [(0, "rq", QuotaArgs(quota_amount=1, best_effort=True))],
+        [(0, "rq", QuotaArgs(quota_amount=40, best_effort=True))],
+    ], clock_moves={1: 5.0, 2: 6.0})   # half window, then past it
+
+
+def test_dedup_replay_in_batch_and_across_waves():
+    """Same dedup id twice in one trip replays the first outcome
+    without consuming; resends within min_dedup replay from cache;
+    a fresh id sees single consumption."""
+    _run_waves([
+        [(0, "rq", QuotaArgs(quota_amount=5, best_effort=True,
+                             dedup_id="d1")),
+         (4, "rq", QuotaArgs(quota_amount=5, best_effort=True,
+                             dedup_id="d1"))],       # in-batch replay
+        [(0, "rq", QuotaArgs(quota_amount=5, best_effort=True,
+                             dedup_id="d1"))],       # cache replay
+        [(5, "rq", QuotaArgs(quota_amount=40, best_effort=True))],
+        # only 5 of 40 were consumed → 35 granted proves single
+        # consumption on both paths
+    ])
+
+
+def test_denied_checks_never_consume():
+    """A fused denier matching /admin: denied rows allocate NOTHING
+    on either path (grpcServer.go:188) — proven by the follow-up
+    wave still seeing the full window."""
+    s = _store()
+    s.set(("handler", "istio-system", "deny"), {
+        "adapter": "denier", "params": {"status_code": 7}})
+    s.set(("instance", "istio-system", "nothing"), {
+        "template": "checknothing", "params": {}})
+    s.set(("rule", "istio-system", "deny-admin"), {
+        "match": 'request.path.startsWith("/admin")',
+        "actions": [{"handler": "deny", "instances": ["nothing"]}]})
+    clock = Clock()
+    srv = RuntimeServer(s, ServerArgs(fused=True, max_batch=8,
+                                      buckets=(8,),
+                                      quota_in_step=True))
+    for pool in set(srv.controller.device_quotas.values()):
+        pool._clock = clock
+    try:
+        bags = [bag_from_mapping(
+            {"request.method": "GET", "source.user": "alice",
+             "request.path": "/admin/x",
+             "destination.service": "a.svc"})]
+        resps, q = _instep_round(
+            srv, bags,
+            [(0, "rq", QuotaArgs(quota_amount=40, best_effort=True))])
+        assert resps[0].status_code == 7
+        # nothing consumed: a clean row gets the FULL window
+        bags2 = [bag_from_mapping(
+            {"request.method": "GET", "source.user": "alice",
+             "request.path": "/ok",
+             "destination.service": "a.svc"})]
+        _, q2 = _instep_round(
+            srv, bags2,
+            [(0, "rq", QuotaArgs(quota_amount=40, best_effort=True))])
+        assert q2[0].granted_amount == 40
+    finally:
+        srv.close()
+
+
+def test_instance_eval_error_is_internal():
+    """eq dims read destination.service with NO default: a bag missing
+    it must yield INTERNAL without touching counters (quota_fused /
+    dispatcher.quota parity)."""
+    clock = Clock()
+    srv = _server(True, clock)
+    try:
+        bags = [bag_from_mapping({"request.method": "GET"})]
+        _, q = _instep_round(
+            srv, bags,
+            [(0, "eq", QuotaArgs(quota_amount=3, best_effort=True))])
+        assert q[0].status_code == INTERNAL
+    finally:
+        srv.close()
+
+
+def test_target_rejects_ambiguous_names():
+    """A quota name served by TWO rules is ineligible for in-step
+    (activity picks the handler at runtime); others stay eligible."""
+    s = _store()
+    s.set(("rule", "istio-system", "rq-rule-2"), {
+        "match": 'request.method == "PUT"',
+        "actions": [{"handler": "mq", "instances": ["rq"]}]})
+    srv = RuntimeServer(s, ServerArgs(fused=True, max_batch=8,
+                                      buckets=(8,),
+                                      quota_in_step=True))
+    try:
+        target = srv.instep_quota_target()
+        assert target is not None
+        _, by_name = target
+        assert "rq.istio-system" not in by_name and "rq" not in by_name
+        assert "eq" in by_name
+    finally:
+        srv.close()
+
+
+def test_flag_off_means_no_target():
+    srv = _server(False, Clock())
+    try:
+        assert srv.instep_quota_target() is None
+    finally:
+        srv.close()
+
+
+def test_native_wire_instep_end_to_end():
+    """The native front with quota_in_step on: grants at the real wire
+    match the classic semantics, the in-step target is live, and the
+    pool's OWN flush worker never runs (no second device trip)."""
+    pytest.importorskip("grpc")
+    from istio_tpu.api.client import MixerClient
+    from istio_tpu.api.native_server import NativeMixerServer
+
+    clock = Clock()
+    srv = _server(True, clock)
+    flushes = []
+    for pool in set(srv.controller.device_quotas.values()):
+        orig = pool._flush
+        pool._flush = lambda b, _o=orig: (flushes.append(len(b)),
+                                          _o(b))[1]
+    native = NativeMixerServer(srv, min_fill=8, window_us=500)
+    port = native.start()
+    cli = MixerClient(f"127.0.0.1:{port}", enable_check_cache=False)
+    try:
+        assert srv.instep_quota_target() is not None
+        values = {"request.method": "GET", "source.user": "alice",
+                  "destination.service": "a.svc"}
+        r1 = cli.check(values, quotas={"rq": 5}, dedup_id="w1")
+        assert r1.precondition.status.code == OK
+        assert r1.quotas["rq"].granted_amount == 5
+        # dedup replay at the wire
+        r2 = cli.check(values, quotas={"rq": 5}, dedup_id="w1")
+        assert r2.quotas["rq"].granted_amount == 5
+        # fresh id: window had 40, 5 consumed once
+        r3 = cli.check(values, quotas={"rq": 40}, dedup_id="w2")
+        assert r3.quotas["rq"].granted_amount == 35
+        # POST: quota rule inactive → freely granted, nothing consumed
+        r4 = cli.check({**values, "request.method": "POST"},
+                       quotas={"rq": 9}, dedup_id="w3")
+        assert r4.quotas["rq"].granted_amount == 9
+        r5 = cli.check(values, quotas={"rq": 1}, dedup_id="w4")
+        assert r5.quotas["rq"].granted_amount == 0   # exhausted
+        assert flushes == [], "pool flush trip ran despite in-step"
+    finally:
+        cli.close()
+        native.stop()
+        srv.close()
