@@ -1040,11 +1040,38 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             # completion-counted window (VERDICT r3 item 1): record the
             # next N completions after attach + warmup + steady-state —
             # such a window cannot close empty while the server answers
+            # scenario boundary: warmup traffic (incl. any in-band
+            # compile) must not pollute the window's live percentiles
+            # or its stage decomposition — the baseline token and
+            # window reset are taken by run_load's on_go hook AT the
+            # go signal (warmup over), not before the run
+            sat_box: dict = {}
+
+            def _sat_go() -> None:
+                if monitor is not None:
+                    monitor.reset_latency_window()
+                    sat_box["base"] = monitor.stage_baseline()
             report = perf.run_load(
                 f"127.0.0.1:{port}", payloads,
                 n_record=10_000 if on_tpu else 500,
                 n_procs=n_procs, concurrency=1024 if on_tpu else 32,
-                warmup_s=8.0 if on_tpu else 2.0)
+                warmup_s=8.0 if on_tpu else 2.0, on_go=_sat_go)
+            # stage-level attribution for the saturation window (the
+            # introspect /metrics decomposition, scraped in-process):
+            # every BENCH from this PR on carries queue_wait /
+            # tensorize / h2d / device_step / fold / respond so a perf
+            # regression names its stage without a rerun
+            sat_stage_fields: dict = {}
+            if monitor is not None:
+                snap = monitor.latency_snapshot(
+                    since=sat_box.get("base"))
+                sat_stage_fields = {
+                    "served_stage_decomposition": snap["stages"],
+                    "served_live_p99_ms": round(
+                        snap["live"]["p99_ms"], 2),
+                    "served_live_window_n": snap["live"]["n_window"],
+                }
+                monitor.reset_latency_window()
             # phase 1b — LIGHT load: the latency-relevant regime
             # (saturation p50/p99 above is queueing by Little's law,
             # not service latency). At depth 8 a request's latency ≈
@@ -1062,12 +1089,21 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
                 mem, restore = _tr.capture("bench-light")
                 t_light0 = time.time()
                 light_warm_s = 2.0
+                # same on_go discipline as the saturation phase: the
+                # server-side window/baseline open when warmup ends,
+                # matching the client-side recorded window
+                light_box: dict = {}
+
+                def _light_go() -> None:
+                    if monitor is not None:
+                        monitor.reset_latency_window()
+                        light_box["base"] = monitor.stage_baseline()
                 try:
                     lreport = perf.run_load(
                         f"127.0.0.1:{port}", payloads,
                         n_record=400 if on_tpu else 100,
                         n_procs=1, concurrency=8,
-                        warmup_s=light_warm_s)
+                        warmup_s=light_warm_s, on_go=_light_go)
                 finally:
                     restore()
                 # steady-state spans only: the recorded-completion
@@ -1113,8 +1149,35 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
                 trip_ms = min(stage_med.get("serve.batch", sync_ms),
                               1.5 * sync_ms + 15.0)
                 light_budget_ms = max(4.5 * trip_ms + 10.0, 30.0)
+                # live (server-side) percentile tracker vs the rig's
+                # client-side p99 — the acceptance cross-check: the
+                # sliding window covers the same light run (reset at
+                # phase start), so the two p99s should agree up to
+                # wire + decode overhead (<=20% at trip-scale
+                # latencies)
+                light_live_fields: dict = {}
+                if monitor is not None:
+                    lsnap = monitor.latency_snapshot(
+                        since=light_box.get("base"))
+                    live_p99 = lsnap["live"]["p99_ms"]
+                    light_live_fields = {
+                        "served_light_stage_decomposition":
+                            lsnap["stages"],
+                        "served_light_live_p99_ms": round(live_p99, 2),
+                        "served_light_live_p50_ms": round(
+                            lsnap["live"]["p50_ms"], 2),
+                        "served_light_live_window_n":
+                            lsnap["live"]["n_window"],
+                        "served_light_live_p99_agrees":
+                            bool(lreport.p99_ms > 0 and
+                                 abs(live_p99 - lreport.p99_ms)
+                                 <= 0.2 * lreport.p99_ms),
+                        "check_p99_under_target":
+                            lsnap["live"]["under_target"],
+                    }
                 light_fields = {
                     "served_light_stage_p50_ms": stage_med,
+                    **light_live_fields,
                     "served_light_checks_per_sec": round(
                         lreport.checks_per_sec, 1),
                     "served_light_p50_ms": round(lreport.p50_ms, 2),
@@ -1239,6 +1302,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             "served_first_error": report.first_error,
             "served_clients": f"{report.n_procs}x{report.concurrency}",
             "served_quota_frac": round(1.0 / quota_every, 3),
+            **sat_stage_fields,
             **light_fields,
             **batched_fields,
             **report_fields,
@@ -1292,6 +1356,12 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
             if plan is not None:
                 plan.prewarm(buckets)
             port = native.start()
+            try:
+                from istio_tpu.runtime import monitor as _mon
+                _mon.reset_latency_window()
+                native_stage_base = _mon.stage_baseline()
+            except Exception:
+                _mon, native_stage_base = None, None
             dicts = workloads.make_request_dicts(512)
             payloads = perf.make_check_payloads(dicts, quota_every=4)
 
@@ -1371,6 +1441,21 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                 lrep = {"checks_per_sec": -1.0, "p50_ms": -1.0,
                         "p99_ms": -1.0}
             counters = native.counters()
+            # stage decomposition for THIS scenario only (delta vs the
+            # baseline taken at server start — the histograms are
+            # process-cumulative and the grpc section ran first): the
+            # native pump drives the same fused path, so h2d /
+            # device_step / fold / respond attribute its windows
+            try:
+                stage_fields = {
+                    "served_native_stage_decomposition":
+                        _mon.latency_snapshot(
+                            since=native_stage_base)["stages"]} \
+                    if _mon is not None else {}
+                if _mon is not None:
+                    _mon.reset_latency_window()
+            except Exception:
+                stage_fields = {}
         finally:
             native.stop()
             srv.close()
@@ -1418,6 +1503,7 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                 erep["p50_ms"], 3),
             "served_native_srv": counters,
             "served_native_batch_hist": hist,
+            **stage_fields,
             # phase_errors: failures during a phase (retried once,
             # except the *-final entries whose retry also failed) —
             # phases listed in served_native_stubbed_phases emit -1.0

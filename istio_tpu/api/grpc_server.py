@@ -88,9 +88,15 @@ class MixerGrpcServer:
 
     def _check(self, request: RawCheckRequest,
                context) -> "pb.CheckResponse":
-        bag = self._check_bag(request)
-        result = self.runtime.check_preprocessed(bag)
-        return self._check_response(request, bag, result)
+        # ROOT span at RPC decode (pkg/tracing's interceptor role):
+        # the batcher's serve.batch span parents under it (submit
+        # captures this thread's current span), so queue-wait is
+        # attributed to a REQUEST, not anonymously to a batch
+        from istio_tpu.utils import tracing
+        with tracing.get_tracer().span("rpc.check"):
+            bag = self._check_bag(request)
+            result = self.runtime.check_preprocessed(bag)
+            return self._check_response(request, bag, result)
 
     def _batch_check(self, request: RawBatchCheckRequest,
                      context) -> bytes:
@@ -99,6 +105,12 @@ class MixerGrpcServer:
         unary Check without quotas/dedup. The batch is padded to the
         server's prewarmed bucket shapes so arbitrary client batch
         sizes never re-trace."""
+        from istio_tpu.utils import tracing
+        with tracing.get_tracer().span(
+                "rpc.batch_check", items=len(request.attributes_raw)):
+            return self._batch_check_traced(request)
+
+    def _batch_check_traced(self, request: RawBatchCheckRequest) -> bytes:
         gwc = request.global_word_count
         native = gwc in (0, len(GLOBAL_WORD_LIST))
         bags = [self.runtime.preprocess(
@@ -301,7 +313,23 @@ class MixerAioGrpcServer(MixerGrpcServer):
     async def _acheck(self, request: RawCheckRequest,
                       context) -> "pb.CheckResponse":
         import asyncio
+
+        from istio_tpu.utils import tracing
         loop = asyncio.get_running_loop()
+        # ROOT span at RPC decode, DETACHED (start_span): a `with`
+        # span held across an await would leak onto interleaved tasks
+        # via the thread-local stack. The batcher parents its batch
+        # span under this dict (submit trace=).
+        tr = tracing.get_tracer()
+        root = tr.start_span("rpc.check")
+        try:
+            return await self._acheck_traced(request, loop, root)
+        finally:
+            tr.finish_span(root)
+
+    async def _acheck_traced(self, request: RawCheckRequest, loop,
+                             root) -> "pb.CheckResponse":
+        import asyncio
         d = self.runtime.controller.dispatcher
         if self.runtime.args.preprocess and d.has_apa:
             # preprocess runs an APA device round-trip — off the loop
@@ -315,7 +343,7 @@ class MixerAioGrpcServer(MixerGrpcServer):
         # the shared batcher future (a cancelled batch-mate would
         # otherwise poison result distribution for the whole batch)
         result = await asyncio.shield(asyncio.wrap_future(
-            self.runtime.submit_check_preprocessed(bag)))
+            self.runtime.submit_check_preprocessed(bag, trace=root)))
         if request.quotas and result.status_code == 0:
             # fused-path quota futures bridge to the loop via
             # callbacks — an in-flight quota holds NO thread (an

@@ -159,7 +159,46 @@ class NativeMixerServer(MixerGrpcServer):
         out = dict(zip(_COUNTER_NAMES, [int(v) for v in c]))
         out["batch_size_hist"] = {1 << b: int(hist[b])
                                   for b in range(16) if hist[b]}
+        self._publish_counters(out)
         return out
+
+    # gauges (not counters): the C++ side owns the monotonic totals,
+    # we mirror absolute snapshots — lazily created so merely importing
+    # this module never registers native metrics. The lock serializes
+    # first-use registration: an introspect scrape thread and a bench
+    # thread racing the init would double-register the families (a
+    # malformed exposition forever) or KeyError on a half-built dict.
+    _NATIVE_GAUGES: dict = {}
+    _NATIVE_GAUGES_LOCK = threading.Lock()
+
+    def _publish_counters(self, snap: dict) -> None:
+        """Mirror the C++ wire counters into the shared homegrown
+        registry so /metrics covers the native front end (previously
+        these lived only in this ad-hoc dict — invisible to scrapes).
+        Called on every counters() read; the introspect server reads
+        counters() before each exposition."""
+        from istio_tpu.utils import metrics as hostmetrics
+
+        with NativeMixerServer._NATIVE_GAUGES_LOCK:
+            gauges = NativeMixerServer._NATIVE_GAUGES
+            if not gauges:
+                for name in _COUNTER_NAMES:
+                    gauges[name] = hostmetrics.default_registry.gauge(
+                        f"mixer_native_{name}",
+                        f"native front-end wire counter {name}")
+                gauges["batch_size_hist"] = \
+                    hostmetrics.default_registry.gauge(
+                        "mixer_native_batch_rows_bucketed",
+                        "native front-end batch counts by power-of-two "
+                        "size bucket (label: bucket; per-bucket point "
+                        "values, NOT a cumulative histogram ladder)")
+        for name in _COUNTER_NAMES:
+            gauges[name].set(float(snap.get(name, 0)))
+        # label is `bucket`, not `le`: these are per-bucket point
+        # counts — `le` is reserved for cumulative histogram series
+        # and would silently break histogram_quantile()
+        for bucket, n in snap.get("batch_size_hist", {}).items():
+            gauges["batch_size_hist"].set(float(n), bucket=str(bucket))
 
     # -- pump --
 
@@ -237,113 +276,21 @@ class NativeMixerServer(MixerGrpcServer):
 
     def _run_batch_inner(self, items: list, completions: list,
                          deferred: set) -> None:
+        from istio_tpu.utils import tracing
+
         checks = [it for it in items if it[1] == 0]
         reports = [it for it in items if it[1] == 1]
 
         if checks:
-            monitor.CHECK_REQUESTS.inc(len(checks))
-            bags = []
-            for _, _, payload, gwc, _, _ in checks:
-                native = gwc in (0, len(GLOBAL_WORD_LIST))
-                bags.append(self.runtime.preprocess(
-                    LazyWireBag(payload, gwc or None,
-                                native_ok=native)))
-            # in-step quota (ServerArgs.quota_in_step): eligible
-            # single-quota rows allocate IN the check trip — no
-            # pool-flush trip serialized behind it, no defer
-            # machinery. Ineligible rows (multi-quota, unknown name,
-            # target-less snapshot) keep the classic defer path.
-            target = self.runtime.instep_quota_target()
-            qspecs = None
-            if target is not None:
-                _, by_name = target
-                qspecs = []
-                for _, _, _, _, dedup, quotas in checks:
-                    spec = None
-                    if len(quotas) == 1:
-                        (qname, (amount, be)), = quotas.items()
-                        if qname in by_name:
-                            spec = (qname, QuotaArgs(
-                                quota_amount=amount, best_effort=be,
-                                dedup_id=dedup + ":" + qname
-                                if dedup else ""))
-                    qspecs.append(spec)
-                if not any(qspecs):
-                    qspecs = None
-            if qspecs is not None:
-                results, inres = self._check_bags_quota_instep(
-                    bags, qspecs, target)
-            else:
-                results = self._check_bags_chunked(bags)
-                inres = {}
-            memo_hits = 0
-            for row, (item, bag, result) in enumerate(
-                    zip(checks, bags, results)):
-                tag, _, _, _, dedup, quotas = item
-                try:
-                    if row in inres:
-                        # quota already allocated in the check trip;
-                        # attach it only on success (a denied row's
-                        # entry is grant-freely noise the gate never
-                        # consumed for — the fronts omit quotas on
-                        # denial, grpcServer.go:188)
-                        qpair = []
-                        if result.status_code == 0:
-                            (qname, _), = quotas.items()
-                            qpair = [(qname, inres[row])]
-                        raw = self._check_response(
-                            None, bag, result,
-                            quotas=qpair).SerializeToString()
-                        completions.append((tag, 0, raw))
-                        continue
-                    if quotas and result.status_code == 0:
-                        # quota rows complete via pool-future
-                        # callbacks: a batch's non-quota rows must NOT
-                        # wait out the quota flush window + device
-                        # trip (that added ~2 serialized trips to
-                        # EVERY row's latency)
-                        req = _RowRequest(dedup, {
-                            name: pb.CheckRequest.QuotaParams(
-                                amount=amount, best_effort=be)
-                            for name, (amount, be) in quotas.items()})
-                        self._defer_quota_row(
-                            tag, bag, result,
-                            self._submit_quotas(req, bag, result))
-                        deferred.add(tag)
-                        continue
-                except Exception as exc:   # row-isolated (quota path)
-                    monitor.DISPATCH_ERRORS.inc()
-                    completions.append(
-                        (tag, 13, f"quota submit: {exc}".encode()))
-                    continue
-                # memo ONLY bag-independent responses: presence must
-                # COVER the referenced set (incomplete presence makes
-                # _referenced_proto fall back to per-bag lookups —
-                # grpc_server._referenced_proto applies the same gate)
-                presence = result.referenced_presence
-                if presence is not None and \
-                        len(presence) == len(result.referenced):
-                    key = (result.status_code, result.status_message,
-                           result.valid_duration_s,
-                           result.valid_use_count, result.referenced,
-                           frozenset(presence.items()))
-                    raw = self._resp_memo.get(key)
-                    if raw is None:
-                        raw = self._check_response(
-                            None, bag, result,
-                            quotas=[]).SerializeToString()
-                        if len(self._resp_memo) > 8192:
-                            self._resp_memo.clear()
-                        self._resp_memo[key] = raw
-                    else:
-                        memo_hits += 1
-                else:
-                    raw = self._check_response(
-                        None, bag, result,
-                        quotas=[]).SerializeToString()
-                completions.append((tag, 0, raw))
-            if memo_hits:   # memoized rows skip _check_response
-                monitor.CHECK_RESPONSES.inc(memo_hits)
+            # ROOT span at wire decode (API-layer root, same role as
+            # the grpc fronts' rpc.check): downstream engine spans on
+            # this pump thread parent under it via the thread-local
+            # stack, so the batch's queue/tensorize/device time is
+            # attributed to the RPC group that paid it
+            span_ctx = tracing.get_tracer().span(
+                "rpc.check", transport="native", batch=len(checks))
+            with span_ctx:
+                self._run_checks(checks, completions, deferred)
 
         for tag, _, payload, _, _, _ in reports:
             try:
@@ -353,6 +300,112 @@ class NativeMixerServer(MixerGrpcServer):
             except Exception as exc:
                 completions.append(
                     (tag, 13, f"report failed: {exc}".encode()))
+
+    def _run_checks(self, checks: list, completions: list,
+                    deferred: set) -> None:
+        monitor.CHECK_REQUESTS.inc(len(checks))
+        bags = []
+        for _, _, payload, gwc, _, _ in checks:
+            native = gwc in (0, len(GLOBAL_WORD_LIST))
+            bags.append(self.runtime.preprocess(
+                LazyWireBag(payload, gwc or None,
+                            native_ok=native)))
+        # in-step quota (ServerArgs.quota_in_step): eligible
+        # single-quota rows allocate IN the check trip — no
+        # pool-flush trip serialized behind it, no defer
+        # machinery. Ineligible rows (multi-quota, unknown name,
+        # target-less snapshot) keep the classic defer path.
+        target = self.runtime.instep_quota_target()
+        qspecs = None
+        if target is not None:
+            _, by_name = target
+            qspecs = []
+            for _, _, _, _, dedup, quotas in checks:
+                spec = None
+                if len(quotas) == 1:
+                    (qname, (amount, be)), = quotas.items()
+                    if qname in by_name:
+                        spec = (qname, QuotaArgs(
+                            quota_amount=amount, best_effort=be,
+                            dedup_id=dedup + ":" + qname
+                            if dedup else ""))
+                qspecs.append(spec)
+            if not any(qspecs):
+                qspecs = None
+        if qspecs is not None:
+            results, inres = self._check_bags_quota_instep(
+                bags, qspecs, target)
+        else:
+            results = self._check_bags_chunked(bags)
+            inres = {}
+        memo_hits = 0
+        for row, (item, bag, result) in enumerate(
+                zip(checks, bags, results)):
+            tag, _, _, _, dedup, quotas = item
+            try:
+                if row in inres:
+                    # quota already allocated in the check trip;
+                    # attach it only on success (a denied row's
+                    # entry is grant-freely noise the gate never
+                    # consumed for — the fronts omit quotas on
+                    # denial, grpcServer.go:188)
+                    qpair = []
+                    if result.status_code == 0:
+                        (qname, _), = quotas.items()
+                        qpair = [(qname, inres[row])]
+                    raw = self._check_response(
+                        None, bag, result,
+                        quotas=qpair).SerializeToString()
+                    completions.append((tag, 0, raw))
+                    continue
+                if quotas and result.status_code == 0:
+                    # quota rows complete via pool-future
+                    # callbacks: a batch's non-quota rows must NOT
+                    # wait out the quota flush window + device
+                    # trip (that added ~2 serialized trips to
+                    # EVERY row's latency)
+                    req = _RowRequest(dedup, {
+                        name: pb.CheckRequest.QuotaParams(
+                            amount=amount, best_effort=be)
+                        for name, (amount, be) in quotas.items()})
+                    self._defer_quota_row(
+                        tag, bag, result,
+                        self._submit_quotas(req, bag, result))
+                    deferred.add(tag)
+                    continue
+            except Exception as exc:   # row-isolated (quota path)
+                monitor.DISPATCH_ERRORS.inc()
+                completions.append(
+                    (tag, 13, f"quota submit: {exc}".encode()))
+                continue
+            # memo ONLY bag-independent responses: presence must
+            # COVER the referenced set (incomplete presence makes
+            # _referenced_proto fall back to per-bag lookups —
+            # grpc_server._referenced_proto applies the same gate)
+            presence = result.referenced_presence
+            if presence is not None and \
+                    len(presence) == len(result.referenced):
+                key = (result.status_code, result.status_message,
+                       result.valid_duration_s,
+                       result.valid_use_count, result.referenced,
+                       frozenset(presence.items()))
+                raw = self._resp_memo.get(key)
+                if raw is None:
+                    raw = self._check_response(
+                        None, bag, result,
+                        quotas=[]).SerializeToString()
+                    if len(self._resp_memo) > 8192:
+                        self._resp_memo.clear()
+                    self._resp_memo[key] = raw
+                else:
+                    memo_hits += 1
+            else:
+                raw = self._check_response(
+                    None, bag, result,
+                    quotas=[]).SerializeToString()
+            completions.append((tag, 0, raw))
+        if memo_hits:   # memoized rows skip _check_response
+            monitor.CHECK_RESPONSES.inc(memo_hits)
 
     def _send_completions(self, completions: list) -> None:
         if not completions:

@@ -36,13 +36,28 @@ def cmd_mixs(args: argparse.Namespace) -> int:
     port = server.start()
     print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
           f"(config={'fs:' + args.config_store if args.config_store else 'memory'})")
+    intro = None
     if args.monitoring_port:
-        import prometheus_client
-        from istio_tpu.runtime import monitor
-        prometheus_client.start_http_server(args.monitoring_port,
-                                            registry=monitor.REGISTRY)
+        # the reference's :9093 self-monitoring port, upgraded to the
+        # full introspection surface (istio_tpu/introspect/): /metrics
+        # merges BOTH registries, plus /healthz /readyz /debug/*
+        from istio_tpu.introspect import IntrospectServer
+        # trace ring OFF unless asked: enabling it flips the global
+        # tracer to recording, and span construction (2x uuid per
+        # span) is hot-path work the bench-certified p99 never pays
+        intro = IntrospectServer(runtime=runtime,
+                                 port=args.monitoring_port,
+                                 host=args.monitoring_host,
+                                 trace_capacity=args.trace_ring)
+        intro.start()
+        print(f"mixs: introspection on "
+              f"{args.monitoring_host}:{intro.port} "
+              "(/metrics /healthz /readyz /debug/config /debug/queues"
+              " /debug/cache /debug/traces)")
     _serve_forever()
     server.stop()
+    if intro is not None:
+        intro.close()
     runtime.close()
     return 0
 
@@ -590,6 +605,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--address", default="127.0.0.1")
     s.add_argument("--port", type=int, default=9091)
     s.add_argument("--monitoring-port", type=int, default=9093)
+    s.add_argument("--monitoring-host", default="127.0.0.1",
+                   help="introspection bind address (loopback by "
+                        "default; 0.0.0.0 restores the reference's "
+                        "network-scrapable :9093)")
+    s.add_argument("--trace-ring", type=int, default=0,
+                   help="/debug/traces ring capacity; 0 (default) "
+                        "keeps span recording OFF the serving hot "
+                        "path")
     s.add_argument("--config-store", default="",
                    help="YAML config dir (FsStore); empty = memory")
     s.add_argument("--batch-window-us", type=int, default=300)

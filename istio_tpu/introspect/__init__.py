@@ -1,0 +1,23 @@
+"""In-process introspection (ControlZ + Mixer self-monitoring port).
+
+Reference anchors: Istio's ControlZ facility (every component exposes
+an admin port with process/config/metrics pages) and Mixer's :9093
+self-monitoring server (mixer/pkg/server/monitoring.go). This package
+is their TPU-build counterpart: one stdlib HTTP server, loopback by
+default, no egress, that unifies the repo's three observability
+systems — the prometheus_client REGISTRY (runtime/monitor.py), the
+homegrown registry (utils/metrics.py, incl. the serving-stage
+decomposition + live p99 gauges), and the span stream
+(utils/tracing.py) — behind six endpoints:
+
+  /metrics        one merged Prometheus text exposition
+  /healthz        liveness (+ optional probe-controller aggregation)
+  /readyz         readiness: config snapshot published + device probe
+  /debug/config   active snapshot summary (generation, rules, errors)
+  /debug/queues   batcher depth/age/in-flight + stage decomposition
+  /debug/cache    compile/layout/response cache occupancy
+  /debug/traces   ring buffer of recent spans
+"""
+from istio_tpu.introspect.server import IntrospectServer
+
+__all__ = ["IntrospectServer"]

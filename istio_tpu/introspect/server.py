@@ -1,0 +1,282 @@
+"""The introspection HTTP server (ControlZ / Mixer :9093 role).
+
+stdlib http.server only — this image has no egress and the admin
+surface must never add a dependency to the serving path. The server
+binds loopback by default; every handler is read-only and built to be
+safe to hit while the hot path is under load (scrape-rate work only:
+no per-request state, quantile sorts happen here, not in serving).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+log = logging.getLogger("istio_tpu.introspect")
+
+
+def _merged_metrics_text() -> str:
+    """ONE Prometheus text exposition covering both registries: the
+    prometheus_client REGISTRY (runtime/monitor.py — resolve/dispatch
+    counters, batch-size histograms, config generation) and the
+    homegrown utils/metrics registry (serving-stage decomposition,
+    live percentile gauges, native wire counters). The live gauges are
+    refreshed first so a scrape always sees percentiles over the
+    current window."""
+    from prometheus_client import generate_latest
+
+    from istio_tpu.runtime import monitor
+    from istio_tpu.utils import metrics as hostmetrics
+
+    monitor.refresh_latency_gauges()
+    prom = generate_latest(monitor.REGISTRY).decode("utf-8", "replace")
+    home = hostmetrics.default_registry.expose_text()
+    if prom and not prom.endswith("\n"):
+        prom += "\n"
+    return prom + home
+
+
+class IntrospectServer:
+    """Admin server over a RuntimeServer core (+ optional collaborators).
+
+    `runtime`: the RuntimeServer whose controller/batcher/dispatcher
+    the debug endpoints read (None → those endpoints degrade to
+    minimal payloads instead of failing; /metrics always works).
+    `native`: a NativeMixerServer whose counters() mirror into the
+    shared registry on every /metrics scrape.
+    `probe_controller`: a utils/probe.ProbeController aggregated into
+    /healthz (reference: pkg/probe's controller).
+    `trace_capacity`: size of the /debug/traces ring; 0 disables ring
+    installation (use when the process owns its own reporters).
+    """
+
+    def __init__(self, runtime: Any = None, port: int = 0,
+                 host: str = "127.0.0.1", native: Any = None,
+                 probe_controller: Any = None,
+                 trace_capacity: int = 256):
+        self.runtime = runtime
+        self.native = native
+        self.probe_controller = probe_controller
+        self._ring = None
+        # extra cache-stat providers: name -> zero-arg callable
+        self._cache_stats: dict[str, Callable[[], Any]] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:          # noqa: N802 (stdlib API)
+                outer._route(self)
+
+            def log_message(self, fmt: str, *args: Any) -> None:
+                log.debug("introspect: " + fmt, *args)
+
+        # bind BEFORE touching the global tracer: a bind failure (port
+        # in use) raises out of __init__ with no instance to close(),
+        # and a ring installed first would leak on the hot path forever
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if trace_capacity:
+            from istio_tpu.utils import tracing
+            self._ring = tracing.enable_ring(trace_capacity)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="introspect-http")
+
+    # -- lifecycle --
+
+    def start(self) -> int:
+        self._thread.start()
+        log.info("introspect server on port %d", self.port)
+        return self.port
+
+    def close(self) -> None:
+        # shutdown() blocks on an event only serve_forever() sets —
+        # calling it when start() never ran (a pre-start failure's
+        # cleanup path) would hang the caller forever
+        started = self._thread.ident is not None
+        if started:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if started:
+            self._thread.join(timeout=5)
+        if self._ring is not None:
+            # restore the pre-introspect tracer: a closed admin server
+            # must not leave span construction on the hot path (or
+            # stack dead rings across create/close cycles)
+            from istio_tpu.utils import tracing
+            tracing.disable_ring(self._ring)
+            self._ring = None
+
+    def add_cache_stats(self, name: str,
+                        fn: Callable[[], Any]) -> None:
+        """Register an extra /debug/cache section (e.g. an API front's
+        response memo)."""
+        self._cache_stats[name] = fn
+
+    # -- routing --
+
+    _ROUTES = {
+        "/metrics": "_h_metrics",
+        "/healthz": "_h_healthz",
+        "/readyz": "_h_readyz",
+        "/debug/config": "_h_config",
+        "/debug/queues": "_h_queues",
+        "/debug/cache": "_h_cache",
+        "/debug/traces": "_h_traces",
+    }
+
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0]
+        name = self._ROUTES.get(path)
+        if name is None:
+            body = ("not found; endpoints: " +
+                    " ".join(sorted(self._ROUTES))).encode()
+            self._send(req, 404, "text/plain; charset=utf-8", body)
+            return
+        try:
+            getattr(self, name)(req)
+        except Exception as exc:   # an admin page must never take the
+            log.exception("introspect handler %s failed", path)
+            self._send(req, 500, "text/plain; charset=utf-8",
+                       f"{type(exc).__name__}: {exc}".encode())
+
+    @staticmethod
+    def _send(req: BaseHTTPRequestHandler, code: int, ctype: str,
+              body: bytes) -> None:
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _send_json(self, req: BaseHTTPRequestHandler, payload: Any,
+                   code: int = 200) -> None:
+        self._send(req, code, "application/json",
+                   json.dumps(payload, indent=1, default=str).encode())
+
+    # -- endpoints --
+
+    def _h_metrics(self, req: BaseHTTPRequestHandler) -> None:
+        if self.native is not None:
+            try:
+                self.native.counters()   # mirrors into the registry
+            except Exception:
+                log.exception("native counter mirror failed")
+        self._send(req, 200,
+                   "text/plain; version=0.0.4; charset=utf-8",
+                   _merged_metrics_text().encode())
+
+    def _probe_status(self) -> tuple[bool, str]:
+        if self.probe_controller is None:
+            return True, ""
+        return self.probe_controller.status()
+
+    def _h_healthz(self, req: BaseHTTPRequestHandler) -> None:
+        ok, err = self._probe_status()
+        payload = {"status": "ok" if ok else "unavailable"}
+        if err:
+            payload["error"] = err
+        if self.runtime is not None:
+            payload["config_generation"] = \
+                self.runtime.controller.dispatcher.snapshot.revision
+        self._send_json(req, payload, 200 if ok else 503)
+
+    def _h_readyz(self, req: BaseHTTPRequestHandler) -> None:
+        """Ready = a config snapshot is published, the batcher accepts
+        work, and (when probes are wired) every probe is available —
+        the gate a load balancer flips traffic on."""
+        ok, err = self._probe_status()
+        payload: dict[str, Any] = {}
+        if self.runtime is not None:
+            try:
+                snap = self.runtime.controller.dispatcher.snapshot
+                payload["config_generation"] = snap.revision
+                payload["n_rules"] = len(snap.rules)
+            except Exception as exc:
+                ok, err = False, f"no published snapshot: {exc}"
+            if self.runtime.batcher._closed:
+                ok, err = False, "batcher closed"
+        payload["status"] = "ready" if ok else "unready"
+        if err:
+            payload["error"] = err
+        self._send_json(req, payload, 200 if ok else 503)
+
+    def _h_config(self, req: BaseHTTPRequestHandler) -> None:
+        if self.runtime is None:
+            self._send_json(req, {"error": "no runtime attached"}, 503)
+            return
+        ctl = self.runtime.controller
+        d = ctl.dispatcher
+        snap = d.snapshot
+        args = self.runtime.args
+        payload = {
+            "generation": snap.revision,
+            "n_rules": len(snap.rules),
+            "n_instances": len(snap.instances),
+            "n_handlers": len(d.handlers),
+            "errors": [str(e) for e in snap.errors],
+            "identity_attr": d.identity_attr,
+            "fused": d.fused is not None,
+            "has_apa": d.has_apa,
+            "buckets": list(d.buckets),
+            "batch_window_s": args.batch_window_s,
+            "pipeline": args.pipeline,
+            "report_batching": args.report_batching,
+            "quota_in_step": args.quota_in_step,
+            "mesh_shape": args.mesh_shape,
+        }
+        if d.fused is not None:
+            payload["fused_deny"] = d.fused.fused_deny
+            payload["fused_lists"] = d.fused.fused_lists
+            payload["host_overlay_rules"] = \
+                len(d.fused.host_rule_idx)
+        self._send_json(req, payload)
+
+    def _h_queues(self, req: BaseHTTPRequestHandler) -> None:
+        from istio_tpu.runtime import monitor
+
+        payload: dict[str, Any] = {
+            "latency": monitor.latency_snapshot(),
+        }
+        if self.runtime is not None:
+            payload["check"] = self.runtime.batcher.stats()
+            rb = self.runtime._report_batcher
+            if rb is not None:
+                payload["report"] = rb.stats()
+        self._send_json(req, payload)
+
+    def _h_cache(self, req: BaseHTTPRequestHandler) -> None:
+        payload: dict[str, Any] = {}
+        if self.runtime is not None:
+            d = self.runtime.controller.dispatcher
+            if d.fused is not None:
+                payload["compile"] = d.fused.cache_stats()
+            rs = d.snapshot.ruleset
+            interner = getattr(rs, "interner", None)
+            vals = getattr(interner, "_values", None)
+            if vals is not None:
+                # intern-table occupancy (compile-time constants; a
+                # growing number here across swaps is config growth,
+                # never request traffic — InternTable's contract)
+                payload["interner_values"] = len(vals)
+        for name, fn in self._cache_stats.items():
+            try:
+                payload[name] = fn()
+            except Exception as exc:
+                payload[name] = f"error: {exc}"
+        if self.native is not None:
+            payload["native_resp_memo"] = len(self.native._resp_memo)
+            payload["native_ref_cache"] = len(self.native._ref_cache)
+        self._send_json(req, payload)
+
+    def _h_traces(self, req: BaseHTTPRequestHandler) -> None:
+        if self._ring is None:
+            self._send_json(req, {"error": "trace ring not installed"},
+                            503)
+            return
+        self._send_json(req, {
+            "dropped": self._ring.dropped,
+            "spans": self._ring.snapshot(limit=128),
+        })

@@ -87,8 +87,13 @@ class CheckBatcher:
                  buckets: tuple[int, ...] | None = None,
                  hold_at: int | None = None,
                  size_hist=None,
-                 pad_batches: bool = True):
+                 pad_batches: bool = True,
+                 observe_latency: bool = True):
         self.run_batch = run_batch
+        # False for non-Check coalescers (the report batcher): their
+        # batches must not feed the Check() stage decomposition or the
+        # live p99 window
+        self._observe_latency = observe_latency
         # False for hooks whose downstream re-pads anyway (the report
         # batcher: dispatcher._report_active_fused pads per chunk) —
         # skips allocate-then-trim churn on every light-load batch
@@ -141,11 +146,25 @@ class CheckBatcher:
     def check(self, bag: Bag) -> Any:
         return self.submit(bag).result()
 
-    def submit(self, bag: Bag) -> Future:
+    def submit(self, bag: Bag, trace: Any = None) -> Future:
+        """`trace`: the caller's root span dict (API-layer rpc.check) —
+        the batch span parents under it so queue-wait is attributed to
+        a request, not a batch. None captures the submitting thread's
+        current span (the sync fronts, which submit inside their root
+        span's `with` block)."""
         if self._closed:
             raise RuntimeError("batcher is closed")
         fut: Future = Future()
         fut._t_enq = time.perf_counter()   # queue-wait span tag
+        if trace is None:
+            try:
+                from istio_tpu.utils import tracing
+                tr = tracing.get_tracer()
+                if tr.reporter is not None:
+                    trace = tr._current()
+            except Exception:
+                trace = None   # tracing must never break submission
+        fut._trace = trace
         self._queue.put((bag, fut))
         return fut
 
@@ -226,8 +245,17 @@ class CheckBatcher:
             waits = [now - t for t in
                      (getattr(f, "_t_enq", None) for _, f in batch)
                      if t is not None]
+            if self._observe_latency:
+                monitor.observe_stage("queue_wait",
+                                      max(waits, default=0.0))
+            # parent under the OLDEST request's rpc root span — the
+            # request whose queue-wait the batch's wait tag reports
+            parent = next((t for t in
+                           (getattr(f, "_trace", None)
+                            for _, f in batch) if t is not None), None)
             span_ctx = tracing.get_tracer().span(
-                "serve.batch", size=len(batch), bucket=bucket_n,
+                "serve.batch", parent=parent, size=len(batch),
+                bucket=bucket_n,
                 queue_wait_ms=round(max(waits, default=0.0) * 1e3, 3))
             try:
                 with span_ctx:
@@ -254,6 +282,14 @@ class CheckBatcher:
                     fut.set_result(result)
                 except InvalidStateError:
                     pass
+            # per-request end-to-end (enqueue -> result delivered):
+            # feeds the e2e histogram + sliding-window p99 tracker
+            if self._observe_latency:
+                done = time.perf_counter()
+                for _, fut in batch:
+                    t = getattr(fut, "_t_enq", None)
+                    if t is not None:
+                        monitor.observe_check_e2e(done - t)
         except Exception as exc:
             # belt over the inner handler: NO failure in batch prep or
             # result distribution may abandon the futures — an
@@ -269,6 +305,31 @@ class CheckBatcher:
             with self._inflight_lock:
                 self._inflight_n -= 1
             self._inflight.release()
+
+    def stats(self) -> dict:
+        """Point-in-time queue/pipeline state for the introspect
+        server's /debug/queues (reference: ControlZ's process state
+        pages). `oldest_wait_ms` is the head-of-queue request's age —
+        the wait the NEXT batch will report."""
+        oldest_wait_ms = 0.0
+        with self._queue.mutex:
+            depth = len(self._queue.queue)
+            head = self._queue.queue[0] if self._queue.queue else None
+        if head is not None:
+            t = getattr(head[1], "_t_enq", None)
+            if t is not None:
+                oldest_wait_ms = (time.perf_counter() - t) * 1e3
+        return {
+            "depth": depth,
+            "oldest_wait_ms": round(oldest_wait_ms, 3),
+            "in_flight": self._inflight_n,
+            "pipeline": self._pipeline,
+            "hold_at": self._hold_at,
+            "window_s": self.window_s,
+            "max_batch": self.max_batch,
+            "buckets": list(self.buckets),
+            "closed": self._closed,
+        }
 
     def close(self) -> None:
         if not self._closed:

@@ -221,20 +221,30 @@ class Dispatcher:
             monitor.RESOLVE_ERRORS.inc(n_err)
         return matched & ns_ok, ns_ok
 
-    def _resolve(self, bags: Sequence[Bag]
+    def _resolve(self, bags: Sequence[Bag], observe: bool = False
                  ) -> tuple[list[list[int]], list[list[int]]]:
         """Batched rule matching → per-bag (active, namespace-visible)
         rule index lists. One device step for the whole batch; fallback
-        + namespace masking applied host-side (cheap: bool arrays)."""
+        + namespace masking applied host-side (cheap: bool arrays).
+        `observe`: feed the CHECK stage histograms — only the check
+        path sets it; report/quota/APA resolves share this code but
+        must not pollute the Check() decomposition."""
         snap = self.snapshot
         if snap.ruleset.n_rules == 0:   # device arrays are padded to ≥1
             empty: list[list[int]] = [[] for _ in bags]
             return empty, [[] for _ in bags]
         with monitor.resolve_timer():
+            t0 = time.perf_counter()
             batch = snap.tensorizer.tensorize(bags)
+            t1 = time.perf_counter()
+            if observe:
+                monitor.observe_stage("tensorize", t1 - t0)
             matched, _, err = snap.ruleset(batch)
             matched = np.array(matched)
             err = np.array(err)
+            if observe:
+                monitor.observe_stage("device_step",
+                                      time.perf_counter() - t1)
         ns_ids = self._request_ns_ids(bags)
         active, ns_ok = self._overlay_fallback(matched, err, ns_ids, bags)
         return ([list(np.nonzero(active[b])[0]) for b in range(len(bags))],
@@ -260,10 +270,13 @@ class Dispatcher:
         if self.fused is not None:
             return self._check_fused(bags, instep=instep,
                                      pre_tensorized=pre_tensorized)
-        actives, visibles = self._resolve(bags)
+        actives, visibles = self._resolve(bags, observe=True)
+        t_respond = time.perf_counter()
         out = []
         for bag, rule_idxs, vis in zip(bags, actives, visibles):
             out.append(self._check_one(bag, rule_idxs, vis))
+        monitor.observe_stage("respond",
+                              time.perf_counter() - t_respond)
         return out
 
     def _check_fused(self, bags: Sequence[Bag], instep: Any = None,
@@ -284,15 +297,19 @@ class Dispatcher:
             if pre_tensorized is not None:
                 batch, ns_ids = pre_tensorized
             else:
+                t_tz = time.perf_counter()
                 with tr.span("serve.tensorize", batch=len(bags)):
                     # C++ wire→tensor decode when possible: no
                     # per-request python work
                     batch, ns_ids = self._tensorize_for_device(bags)
+                monitor.observe_stage("tensorize",
+                                      time.perf_counter() - t_tz)
             # ONE device→host pull for the whole verdict: each extra
             # pull costs a full RTT (~120ms behind the axon tunnel),
             # and plane-by-plane conversion was 6 RTTs per batch
             with tr.span("serve.device"):
                 if instep is not None:
+                    t_d = time.perf_counter()
                     q_arrays, counts, on_dispatch, on_pull = instep
                     packed_dev, new_counts = plan.packed_check_instep(
                         batch, ns_ids, q_arrays, counts)
@@ -301,7 +318,11 @@ class Dispatcher:
                     # the token, so the next trip chains on-device
                     # while this one's pull is still outstanding
                     on_dispatch(new_counts)
+                    t_pull = time.perf_counter()
+                    monitor.observe_stage("h2d", t_pull - t_d)
                     packed = np.asarray(packed_dev)   # the pull
+                    monitor.observe_stage(
+                        "device_step", time.perf_counter() - t_pull)
                     # granted/gate are the LAST two rows; everything
                     # the overlay decode reads sits before them
                     on_pull(packed[-2], packed[-1] != 0)
@@ -410,6 +431,11 @@ class Dispatcher:
                     if active_sub[b, col_pos[ridx]]:
                         referenced |= extra
                 ref_of.append((tuple(sorted(referenced, key=str)), {}))
+        # fold = packed-plane decode (overlay bits, referenced/presence
+        # signature dedup); respond = the per-row CheckResponse loop —
+        # together they are the span the serve.overlay emit reports
+        t_respond = time.perf_counter()
+        monitor.observe_stage("fold", t_respond - t_overlay)
         out = []
         for b, bag in enumerate(bags):
             resp = CheckResponse()
@@ -455,6 +481,8 @@ class Dispatcher:
             else:
                 resp.active_quota_rules = ()
             out.append(resp)
+        monitor.observe_stage("respond",
+                              time.perf_counter() - t_respond)
         tr.emit("serve.overlay", time.perf_counter() - t_overlay,
                 batch=len(bags))
         return out
@@ -624,7 +652,8 @@ class Dispatcher:
                 batch, ns_ids = self._tensorize_for_device(padded)
                 packed = plan.packed_report(batch, ns_ids) \
                     if rl is not None \
-                    else plan.packed_check(batch, ns_ids)
+                    else plan.packed_check(batch, ns_ids,
+                                           observe=False)
             active_sub, col_pos = self._overlay_active(
                 packed, chunk, np.asarray(ns_ids)[:len(chunk)])
             if rcols is None:

@@ -24,6 +24,7 @@ and the generic (non-fused) dispatch path.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Mapping
 
 import numpy as np
@@ -142,7 +143,8 @@ class FusedPlan:
     def n_overlay_words(self) -> int:
         return (len(self.overlay_cols) + 31) // 32
 
-    def packed_check(self, batch, ns_ids) -> np.ndarray:
+    def packed_check(self, batch, ns_ids,
+                     observe: bool = True) -> np.ndarray:
         """engine.check + device-side packing into ONE int32 array
         [5 + W + C, B] pulled with a single host↔device sync (W =
         n_ref_words, C = len(overlay_cols)). Pulling plane-by-plane
@@ -157,10 +159,27 @@ class FusedPlan:
         8 MB/batch of D2H, ~1.6 s behind the tunnel."""
         import jax
 
+        from istio_tpu.runtime import monitor
+
         if self._packer is None:
             self._packer = jax.jit(self._base_packer())
+        # h2d = host->device staging + async program dispatch;
+        # device_step = the blocking pull (execution + D2H transfer,
+        # carries the transport RTT). Together they decompose the trip
+        # the serve.device span reports as one number. `observe=False`
+        # for non-Check callers (prewarm dummy batches — a compile
+        # would dwarf every real observation — and the fused report
+        # fallback): only check trips feed the Check() decomposition.
+        t0 = time.perf_counter()
         verdict = self.engine.check(batch, ns_ids)
-        return np.asarray(self._packer(verdict, np.asarray(ns_ids)))
+        dev = self._packer(verdict, np.asarray(ns_ids))
+        t1 = time.perf_counter()
+        out = np.asarray(dev)          # the single host<->device sync
+        if observe:
+            monitor.observe_stage("h2d", t1 - t0)
+            monitor.observe_stage("device_step",
+                                  time.perf_counter() - t1)
+        return out
 
     def _base_packer(self):
         """The pack(verdict, req_ns) closure shared by packed_check and
@@ -235,8 +254,10 @@ class FusedPlan:
         if self.report_lowering is None or \
                 self.report_lowering.n_fields == 0:
             # zero field programs (e.g. reportnothing-only): the check
-            # rows alone serve; ReportFieldCtx slices empty planes
-            return self.packed_check(batch, ns_ids)
+            # rows alone serve; ReportFieldCtx slices empty planes.
+            # observe=False: this is REPORT traffic — it must not feed
+            # the Check() stage decomposition
+            return self.packed_check(batch, ns_ids, observe=False)
         import jax
 
         if self._report_packer is None:
@@ -346,6 +367,22 @@ class FusedPlan:
         self._ns_pred_cache[ns_id] = frozen
         return frozen
 
+    def cache_stats(self) -> dict:
+        """Compiled-program cache occupancy per packer (one entry per
+        warmed bucket shape) — the /debug/cache payload's compile-cache
+        half. A serving bucket missing here means the next batch at
+        that shape pays an in-band XLA trace."""
+        out: dict[str, Any] = {}
+        for name in ("_packer", "_report_packer", "_instep_packer"):
+            f = getattr(self, name, None)
+            if f is None:
+                continue
+            size = getattr(f, "_cache_size", None)
+            out[name.lstrip("_") + "_entries"] = \
+                int(size()) if callable(size) else None
+        out["ns_pred_cache_entries"] = len(self._ns_pred_cache)
+        return out
+
     def prewarm(self, buckets) -> None:
         """Trace/compile the engine step for every serving batch shape.
 
@@ -373,7 +410,8 @@ class FusedPlan:
                 hash_ids=np.zeros((b, lay.n_columns), np.int32))
             # warm the SERVING entry (engine step + packer), not just
             # the engine — the packer gather is its own XLA program
-            self.packed_check(batch, np.zeros(b, np.int32))
+            self.packed_check(batch, np.zeros(b, np.int32),
+                              observe=False)
             if self.report_lowering is not None and self.report_rules:
                 # the report path's packer (check rows + field planes)
                 # is a separate XLA program per bucket shape

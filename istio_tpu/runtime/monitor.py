@@ -1,11 +1,23 @@
 """Runtime self-metrics (reference: mixer/pkg/runtime/monitor.go:34-88
-prometheus counters/histograms for resolve + dispatch)."""
+prometheus counters/histograms for resolve + dispatch).
+
+Two registries live here by design: the prometheus_client REGISTRY
+below (the reference's promhttp role) and the homegrown
+`utils/metrics.py` default_registry, which carries the serving-path
+STAGE decomposition added for the <1ms-p99 north star — per-batch
+stage histograms (queue_wait / tensorize / h2d / device_step / fold /
+respond), a per-request end-to-end histogram, and a sliding-window
+live p50/p95/p99 tracker with an SLO gauge (`check_p99_under_target`)
+against the 1ms target. The introspect server
+(istio_tpu/introspect/) merges both into one /metrics exposition."""
 from __future__ import annotations
 
 import contextlib
 import time
 
 import prometheus_client
+
+from istio_tpu.utils import metrics as hostmetrics
 
 REGISTRY = prometheus_client.CollectorRegistry()
 
@@ -47,6 +59,144 @@ CHECK_REQUESTS = prometheus_client.Counter(
 CHECK_RESPONSES = prometheus_client.Counter(
     "mixer_grpc_check_responses", "Check responses sent",
     registry=REGISTRY)
+
+
+# -- end-to-end Check() latency decomposition ------------------------
+#
+# Stage semantics (one observation per BATCH per stage; e2e is one
+# observation per REQUEST, so sum-of-stage-sums <= sum-of-e2e holds
+# whenever batches carry >= 1 request):
+#   queue_wait  — oldest enqueue -> batch start (batcher) or entry ->
+#                 dispatch (pre-batched check_many fronts)
+#   tensorize   — bags -> AttributeBatch (+ns ids), host side
+#   h2d         — host->device staging + program dispatch (the
+#                 non-blocking half of the device call)
+#   device_step — the blocking device->host pull (program execution +
+#                 transfer; carries the full transport RTT)
+#   fold        — packed-plane decode: overlay bits, referenced /
+#                 presence signature dedup
+#   respond     — per-row CheckResponse construction
+CHECK_STAGES = ("queue_wait", "tensorize", "h2d", "device_step",
+                "fold", "respond")
+CHECK_P99_TARGET_MS = 1.0   # BASELINE north star: <1ms p99 at 10k rules
+
+CHECK_STAGE_SECONDS = hostmetrics.default_registry.histogram(
+    "mixer_check_stage_seconds",
+    "per-batch serving stage latency (label: stage)")
+CHECK_E2E_SECONDS = hostmetrics.default_registry.histogram(
+    "mixer_check_e2e_seconds",
+    "per-request served check latency, enqueue to response")
+CHECK_WINDOW = hostmetrics.SlidingWindow(4096)
+CHECK_P50_MS = hostmetrics.default_registry.gauge(
+    "mixer_check_p50_ms", "sliding-window served check p50 (ms)")
+CHECK_P95_MS = hostmetrics.default_registry.gauge(
+    "mixer_check_p95_ms", "sliding-window served check p95 (ms)")
+CHECK_P99_MS = hostmetrics.default_registry.gauge(
+    "mixer_check_p99_ms", "sliding-window served check p99 (ms)")
+CHECK_SLO_GAUGE = hostmetrics.default_registry.gauge(
+    "check_p99_under_target",
+    f"1 when the sliding-window check p99 is under the "
+    f"{CHECK_P99_TARGET_MS}ms target (vacuously 1 while the window is "
+    f"empty — mask alerts on mixer_check_e2e_seconds_count), else 0")
+
+
+def observe_stage(stage: str, seconds: float) -> None:
+    CHECK_STAGE_SECONDS.observe(seconds, stage=stage)
+
+
+def observe_check_e2e(seconds: float) -> None:
+    """Per-request end-to-end observation; gauges refresh lazily via
+    refresh_latency_gauges() (sorting the window per request would put
+    an O(n log n) on the hot path)."""
+    CHECK_E2E_SECONDS.observe(seconds)
+    CHECK_WINDOW.observe(seconds)
+
+
+def refresh_latency_gauges() -> dict:
+    """Recompute the sliding-window percentile gauges + SLO gauge from
+    the current window. Called by scrape-rate readers (the introspect
+    /metrics handler, bench, the smoke script) — never per request."""
+    p50, p95, p99 = CHECK_WINDOW.quantiles((0.50, 0.95, 0.99))
+    p50_ms, p95_ms, p99_ms = p50 * 1e3, p95 * 1e3, p99 * 1e3
+    CHECK_P50_MS.set(p50_ms)
+    CHECK_P95_MS.set(p95_ms)
+    CHECK_P99_MS.set(p99_ms)
+    # empty window → vacuously under target: an idle/fresh server is
+    # not violating its SLO, and alerting on ==0 must not fire before
+    # the first request (mask on the e2e count for 'no data')
+    under = not len(CHECK_WINDOW) or p99_ms <= CHECK_P99_TARGET_MS
+    CHECK_SLO_GAUGE.set(1.0 if under else 0.0)
+    return {"p50_ms": p50_ms, "p95_ms": p95_ms, "p99_ms": p99_ms,
+            "n_window": len(CHECK_WINDOW),
+            "n_total": CHECK_WINDOW.total,
+            "target_ms": CHECK_P99_TARGET_MS,
+            "under_target": under}
+
+
+def reset_latency_window() -> None:
+    """Drop windowed observations (bench scenario boundaries — a
+    saturation phase's queueing tail must not pollute the light
+    phase's live p99). Histograms keep accumulating; only the
+    sliding-window gauges reset."""
+    CHECK_WINDOW.reset()
+
+
+def stage_baseline() -> dict:
+    """Subtraction token for latency_snapshot(since=...): the stage +
+    e2e histogram states at a window's start. The histograms are
+    process-lifetime cumulative (prometheus semantics); per-SCENARIO
+    readings (bench phases) must delta against a baseline or the
+    previous phase's ~10k batches drown the window's few hundred."""
+    token = {stage: CHECK_STAGE_SECONDS.state(stage=stage)
+             for stage in CHECK_STAGES}
+    token["__e2e__"] = CHECK_E2E_SECONDS.state()
+    return token
+
+
+def _delta(state, base):
+    counts, total, n = state
+    bcounts, btotal, bn = base
+    if bcounts:
+        counts = [c - b for c, b in zip(counts, bcounts)] \
+            if counts else []
+    return counts, total - btotal, n - bn
+
+
+def latency_snapshot(since: dict | None = None) -> dict:
+    """Stage decomposition + live percentiles as one JSON-able dict —
+    what bench.py appends to the BENCH artifact after each served
+    scenario and /debug/queues consumers read. `since`: a
+    stage_baseline() token; readings then cover only the window after
+    it (quantiles computed from delta bucket counts)."""
+    from istio_tpu.utils.metrics import quantile_from_counts
+
+    empty = ([], 0.0, 0)
+    stages: dict[str, dict] = {}
+    h = CHECK_STAGE_SECONDS
+    for stage in CHECK_STAGES:
+        counts, total, n = h.state(stage=stage)
+        if since is not None:
+            counts, total, n = _delta((counts, total, n),
+                                      since.get(stage, empty))
+        if not n:
+            continue
+        stages[stage] = {
+            "count": n,
+            "sum_ms": round(total * 1e3, 3),
+            "p50_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.5) * 1e3, 3),
+            "p99_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.99) * 1e3, 3),
+        }
+    e2e = CHECK_E2E_SECONDS.state()
+    if since is not None:
+        e2e = _delta(e2e, since.get("__e2e__", empty))
+    return {
+        "stages": stages,
+        "e2e_count": e2e[2],
+        "e2e_sum_ms": round(e2e[1] * 1e3, 3),
+        "live": refresh_latency_gauges(),
+    }
 
 
 def serving_counters() -> dict:

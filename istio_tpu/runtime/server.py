@@ -114,7 +114,10 @@ class RuntimeServer:
             size_hist=_monitor.REPORT_BATCH_SIZE,
             # the fused report resolve pads per chunk itself — don't
             # allocate padding here just to trim it
-            pad_batches=False) \
+            pad_batches=False,
+            # report records must not feed the CHECK latency
+            # decomposition / live p99 window
+            observe_latency=False) \
             if self.args.report_batching else None
 
     # -- API surface (grpcServer.go Check/Report semantics) --
@@ -150,24 +153,49 @@ class RuntimeServer:
         (the gRPC server, which reuses the bag for the quota loop)."""
         return self.batcher.check(bag)
 
-    def submit_check_preprocessed(self, bag: Bag):
+    def submit_check_preprocessed(self, bag: Bag, trace=None):
         """Non-blocking batcher entry → concurrent.futures.Future.
         The async gRPC front awaits it so an in-flight check holds no
         thread (the sync front burns one blocked thread per RPC for
-        the whole batch round-trip)."""
-        return self.batcher.submit(bag)
+        the whole batch round-trip). `trace`: the RPC's root span dict
+        (the batch span parents under it — API-layer root spans)."""
+        return self.batcher.submit(bag, trace=trace)
 
     def check_many(self, bags: Sequence[Bag]) -> list[CheckResponse]:
-        """Pre-batched entry (load tests / the C++ shim's batches)."""
-        return list(self._run_check_batch(
-            [self.preprocess(b) for b in bags]))
+        """Pre-batched entry (load tests / the C++ shim's batches).
+        Observes the full stage decomposition: the preprocess+handoff
+        time counts as this batch's queue-wait (no batcher queue in
+        front of a pre-formed batch), and every request's wall time
+        feeds the e2e histogram + live-percentile tracker."""
+        import time as _time
+
+        from istio_tpu.runtime import monitor as _monitor
+
+        t0 = _time.perf_counter()
+        pre = [self.preprocess(b) for b in bags]
+        _monitor.observe_stage("queue_wait", _time.perf_counter() - t0)
+        out = list(self._run_check_batch(pre))
+        e2e = _time.perf_counter() - t0
+        for _ in bags:
+            _monitor.observe_check_e2e(e2e)
+        return out
 
     def check_batch_preprocessed(self,
                                  bags: Sequence[Bag]
                                  ) -> list[CheckResponse]:
         """Pre-batched entry for callers that already ran preprocess()
         and padded to a bucket shape (the BatchCheck gRPC front)."""
-        return list(self._run_check_batch(bags))
+        import time as _time
+
+        from istio_tpu.runtime import monitor as _monitor
+        from istio_tpu.runtime.batcher import trim_pads
+
+        t0 = _time.perf_counter()
+        out = list(self._run_check_batch(bags))
+        e2e = _time.perf_counter() - t0
+        for _ in trim_pads(bags):      # padding rows carry no caller
+            _monitor.observe_check_e2e(e2e)
+        return out
 
     def submit_report(self, bags: Sequence[Bag]) -> list:
         """Non-blocking report entry → concurrent Futures, one per
@@ -322,6 +350,28 @@ class RuntimeServer:
         (responses, {slot → QuotaResult}). Rows whose instance build
         fails resolve INTERNAL without the trip (quota_fused parity).
         """
+        import time as _time
+
+        from istio_tpu.runtime import monitor as _monitor
+        from istio_tpu.runtime.batcher import trim_pads
+
+        # quota-carrying batches must feed the e2e histogram + live
+        # p99 window like every other serving entry — their stage
+        # observations (tensorize below, h2d/device_step in the
+        # dispatcher's instep branch) need matching e2e mass. Observed
+        # only on SUCCESS: the batcher likewise skips errored batches,
+        # so a transient device fault never flips the live p99 / SLO
+        # gauges on error-path latency no request was answered with.
+        t0 = _time.perf_counter()
+        out = self._check_batch_quota_instep_inner(bags, qrows, target)
+        e2e = _time.perf_counter() - t0
+        for _ in trim_pads(bags):
+            _monitor.observe_check_e2e(e2e)
+        return out
+
+    def _check_batch_quota_instep_inner(self, bags: Sequence[Bag],
+                                        qrows: Sequence[tuple],
+                                        target: tuple):
         from istio_tpu.expr.oracle import EvalError
         from istio_tpu.models.policy_engine import INTERNAL
 
@@ -353,7 +403,14 @@ class RuntimeServer:
         # pumps' host work AND their trips overlap on the transport
         # (measured: a token held across the pull made in-step SLOWER
         # than two serialized trips)
+        import time as _time
+
+        from istio_tpu.runtime import monitor as _monitor
+
+        t_tz = _time.perf_counter()
         pre = d._tensorize_for_device(bags)
+        _monitor.observe_stage("tensorize",
+                               _time.perf_counter() - t_tz)
         sess = pool.inline_begin(len(bags), rows,
                                  pool._clock()) if rows else None
         if sess is None:

@@ -302,10 +302,18 @@ def run_load(target: str, payloads: Sequence[bytes],
              n_record: int = 2000, n_procs: int = 4,
              concurrency: int = 32, warmup_s: float = 2.0,
              method: str = "/istio.mixer.v1.Mixer/Check",
-             checks_per_payload: int = 1) -> PerfReport:
+             checks_per_payload: int = 1,
+             on_go: Any = None) -> PerfReport:
     """Fire Check load at `target`; record the next `n_record`
     completions per worker after attach + warmup + steady-state, and
     report client-side numbers from those completions.
+
+    `on_go`: zero-arg callable invoked IN THIS PROCESS the moment the
+    go signal fires (warmup over, workers entering steady-state
+    detection) — the hook the bench uses to reset server-side latency
+    windows / take stage baselines so warmup traffic stays out of the
+    scraped decomposition. Exceptions are swallowed: a metrics hook
+    must never kill a measurement.
 
     Raises PerfError only if attachment fails or literally no RPC
     completes inside the recording window's hard deadline — a rig that
@@ -343,6 +351,11 @@ def run_load(target: str, payloads: Sequence[bytes],
         # worker then self-detects a steady completion rate before it
         # starts recording
         time.sleep(warmup_s)
+        if on_go is not None:
+            try:
+                on_go()
+            except Exception:
+                pass
         start_val.value = time.time()
         all_lat: list[np.ndarray] = []
         n_err = 0

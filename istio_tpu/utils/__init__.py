@@ -2,12 +2,14 @@
 
 from istio_tpu.utils.log import scope, configure_logging
 from istio_tpu.utils.cache import LRUCache, TTLCache
-from istio_tpu.utils.metrics import Counter, Gauge, Histogram, Registry, default_registry
+from istio_tpu.utils.metrics import (Counter, Gauge, Histogram, Registry,
+                                     SlidingWindow, default_registry)
 from istio_tpu.utils.probe import Probe, ProbeController, probe_fresh
 from istio_tpu.utils.version import BUILD_INFO
 
 __all__ = [
     "scope", "configure_logging", "LRUCache", "TTLCache",
-    "Counter", "Gauge", "Histogram", "Registry", "default_registry",
+    "Counter", "Gauge", "Histogram", "Registry", "SlidingWindow",
+    "default_registry",
     "Probe", "ProbeController", "probe_fresh", "BUILD_INFO",
 ]

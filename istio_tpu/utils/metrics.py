@@ -8,6 +8,7 @@ perf comes from the bench harness.
 from __future__ import annotations
 
 import bisect
+import collections
 import threading
 from typing import Iterable
 
@@ -27,6 +28,22 @@ def _fmt_labels(key: tuple) -> str:
     return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
 
 
+def quantile_from_counts(buckets: tuple[float, ...],
+                         counts: list[int], n: int, q: float) -> float:
+    """Quantile (bucket upper bound) from a per-bucket count vector —
+    shared by Histogram.quantile and delta-window readers that
+    subtract two Histogram.state() snapshots."""
+    if not counts or n <= 0:
+        return 0.0
+    target = q * n
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            return buckets[i] if i < len(buckets) else float("inf")
+    return float("inf")
+
+
 class Counter:
     def __init__(self, name: str, help_: str = ""):
         self.name, self.help = name, help_
@@ -42,6 +59,8 @@ class Counter:
         return self._values.get(_label_key(labels), 0.0)
 
     def expose(self) -> Iterable[str]:
+        if self.help:
+            yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} counter"
         with self._lock:
             snapshot = sorted(self._values.items())
@@ -63,6 +82,8 @@ class Gauge:
         return self._values.get(_label_key(labels), 0.0)
 
     def expose(self) -> Iterable[str]:
+        if self.help:
+            yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
         with self._lock:
             snapshot = sorted(self._values.items())
@@ -92,26 +113,50 @@ class Histogram:
             self._sum[key] += value
             self._n[key] += 1
 
+    @property
+    def buckets(self) -> tuple[float, ...]:
+        return self._buckets
+
+    def count(self, **labels: str) -> int:
+        return self._n.get(_label_key(labels), 0)
+
+    def sum(self, **labels: str) -> float:
+        return self._sum.get(_label_key(labels), 0.0)
+
+    def label_sets(self) -> list[dict]:
+        with self._lock:
+            return [dict(k) for k in self._counts]
+
+    def state(self, **labels: str) -> tuple[list[int], float, int]:
+        """(per-bucket counts copy, sum, n) for one label set — the
+        subtraction token for windowed readings: two states taken
+        around a phase delta to that phase's own histogram (histograms
+        are process-lifetime cumulative by design)."""
+        key = _label_key(labels)
+        with self._lock:
+            counts = list(self._counts.get(key, ()))
+            return (counts, self._sum.get(key, 0.0),
+                    self._n.get(key, 0))
+
     def quantile(self, q: float, **labels: str) -> float:
         """Approximate quantile from bucket counts (upper bound of the
         bucket containing the q-th observation)."""
-        key = _label_key(labels)
-        counts = self._counts.get(key)
-        if not counts or self._n[key] == 0:
-            return 0.0
-        target = q * self._n[key]
-        cum = 0
-        for i, c in enumerate(counts):
-            cum += c
-            if cum >= target:
-                return self._buckets[i] if i < len(self._buckets) else float("inf")
-        return float("inf")
+        counts, _, n = self.state(**labels)
+        return quantile_from_counts(self._buckets, counts, n, q)
 
     def expose(self) -> Iterable[str]:
+        if self.help:
+            yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
         with self._lock:
             items = sorted((k, list(v), self._sum[k], self._n[k])
                            for k, v in self._counts.items())
+        if not items:
+            # exposition conformance: a histogram with no observations
+            # must still emit its full zero series (_bucket ladder with
+            # le="+Inf", _sum, _count) — scrapers treat a bare # TYPE
+            # line with no samples as a malformed family
+            items = [((), [0] * (len(self._buckets) + 1), 0.0, 0)]
         for key, counts, total, n in items:
             cum = 0
             for i, c in enumerate(counts[:-1]):
@@ -124,6 +169,55 @@ class Histogram:
             yield f"{self.name}_bucket{_fmt_labels(_label_key(lk))} {n}"
             yield f"{self.name}_sum{_fmt_labels(key)} {total}"
             yield f"{self.name}_count{_fmt_labels(key)} {n}"
+
+
+class SlidingWindow:
+    """Rolling window over the last `capacity` observations with exact
+    quantiles computed on read (the live-p99 counterpart of Histogram's
+    bucket-bounded quantile()). observe() is hot-path cheap (deque
+    append under a lock); quantile() sorts a snapshot and is meant for
+    scrape-rate readers (the introspect server, bench scrapes)."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self._buf: collections.deque[float] = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._buf.append(value)
+            self._total += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    @property
+    def total(self) -> int:
+        """Observations ever seen (not just the ones still windowed)."""
+        with self._lock:
+            return self._total
+
+    def reset(self) -> None:
+        with self._lock:
+            self._buf.clear()
+
+    def quantile(self, q: float) -> float:
+        qs = self.quantiles((q,))
+        return qs[0]
+
+    def quantiles(self, qs: Iterable[float]) -> list[float]:
+        """Exact quantiles over the current window (one sort for all of
+        them); empty window → zeros."""
+        with self._lock:
+            data = sorted(self._buf)
+        if not data:
+            return [0.0 for _ in qs]
+        n = len(data)
+        return [data[min(int(q * n), n - 1)] for q in qs]
 
 
 class Registry:

@@ -44,6 +44,80 @@ class MemoryReporter:
             self.spans.append(span)
 
 
+class RingReporter:
+    """Bounded ring of the most recent finished spans — the backing
+    store of the introspect server's /debug/traces endpoint (ControlZ's
+    recent-activity role). Dropping the oldest under load is the
+    point: introspection must never grow without bound."""
+
+    def __init__(self, capacity: int = 256):
+        import collections
+        self._buf: "collections.deque[dict]" = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self._closed = False
+
+    def __call__(self, span: dict) -> None:
+        with self._lock:
+            if self._closed:   # detached ring still in a live chain
+                return
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append(span)
+
+    def snapshot(self, limit: int = 0) -> list[dict]:
+        """Most-recent-last copy (capped at `limit` when > 0)."""
+        with self._lock:
+            out = list(self._buf)
+        return out[-limit:] if limit else out
+
+
+def enable_ring(capacity: int = 256) -> RingReporter:
+    """Attach a RingReporter to the GLOBAL tracer: composed with the
+    existing reporter when one is configured, or installed as the sole
+    reporter on the noop tracer (turning span recording ON — the
+    introspect server wants recent spans even when no zipkin/log
+    reporter is wired). A later configure() replaces the global tracer
+    and detaches the ring; re-enable after reconfiguring. Undo with
+    disable_ring(ring) — a closed introspect server must not leave
+    span construction on the hot path."""
+    global _global
+    ring = RingReporter(capacity)
+    prev = _global
+    if prev.reporter is None:
+        tracer = Tracer(service_name=prev.service_name, reporter=ring)
+    else:
+        tracer = Tracer(service_name=prev.service_name,
+                        reporter=composite_reporter(ring,
+                                                    prev.reporter))
+    # restore tokens for disable_ring: the back-pointer chain lets a
+    # later disable unwind past rings closed out of order. configure()
+    # installs a tracer with no _ring back-pointer, so a newer owner's
+    # stack is never unwound.
+    ring._installed_over = prev
+    tracer._ring = ring
+    _global = tracer
+    return ring
+
+
+def disable_ring(ring: RingReporter) -> None:
+    """Detach a ring installed by enable_ring: mark it closed (it may
+    still sit inside a LIVE composite — a later-installed ring's
+    chain) and unwind the global tracer past every tracer whose
+    installing ring is closed. Handles non-LIFO close order: closing
+    the last introspect server walks back past earlier-closed rings,
+    so no dead ring is left constructing spans on the hot path. No-op
+    when configure()/another owner has replaced the tracer."""
+    global _global
+    ring._closed = True
+    while True:
+        owner = getattr(_global, "_ring", None)
+        if owner is None or not owner._closed:
+            return
+        _global = owner._installed_over
+
+
 def _http_post_json(url: str, payload: bytes,
                     timeout_s: float = 5.0) -> int:
     req = urllib.request.Request(
@@ -133,12 +207,19 @@ class Tracer:
     def _current(self) -> dict | None:
         return getattr(self._local, "span", None)
 
-    @contextlib.contextmanager
-    def span(self, name: str, **tags: Any):
-        if self.reporter is None:   # disabled: zero hot-path work
-            yield None
-            return
-        parent = self._current()
+    # start_span/finish_span are the ONLY span-construction and
+    # report sites; span() and emit() are thin wrappers (one place to
+    # change the span shape, one place that guards the reporter).
+
+    def start_span(self, name: str, parent: dict | None = None,
+                   **tags: Any) -> dict | None:
+        """Detached open span — for code that cannot hold a `with`
+        block (asyncio handlers: a thread-local span held across an
+        await would leak onto interleaved tasks). Does NOT touch the
+        thread-local stack; pass the dict around explicitly
+        (span(parent=...), finish_span). None when tracing is off."""
+        if self.reporter is None:
+            return None
         span = {
             "traceId": parent["traceId"] if parent
             else uuid.uuid4().hex[:16],
@@ -147,47 +228,62 @@ class Tracer:
             "localEndpoint": {"serviceName": self.service_name},
             "timestamp": int(time.time() * 1e6),
             "tags": {k: str(v) for k, v in tags.items()},
+            "_t0": time.perf_counter(),
         }
         if parent:
             span["parentId"] = parent["id"]
+        return span
+
+    def finish_span(self, span: dict | None, **tags: Any) -> None:
+        """Close + report a start_span() span (None-safe). Duration is
+        measured from the open timestamp unless the span already
+        carries one (emit's backdated intervals)."""
+        if span is None or self.reporter is None:
+            return
+        t0 = span.pop("_t0", None)
+        if t0 is not None and "duration" not in span:
+            span["duration"] = int((time.perf_counter() - t0) * 1e6)
+        if tags:
+            span["tags"].update(
+                {k: str(v) for k, v in tags.items()})
+        try:
+            self.reporter(span)
+        except Exception:
+            log.exception("span reporter failed")
+
+    @contextlib.contextmanager
+    def span(self, name: str, parent: dict | None = None, **tags: Any):
+        """`parent` overrides the thread-local parent — cross-thread
+        attribution (the batcher parenting its serve.batch span under
+        the API layer's rpc.check root, which lives on the handler
+        thread)."""
+        if self.reporter is None:   # disabled: zero hot-path work
+            yield None
+            return
+        prev = self._current()      # this THREAD's restore point —
+        if parent is None:          # distinct from the LINK parent,
+            parent = prev           # which may come from another
+        span = self.start_span(name, parent=parent, **tags)
         self._local.span = span
-        t0 = time.perf_counter()
         try:
             yield span
         except Exception as exc:
             span["tags"]["error"] = str(exc)
             raise
         finally:
-            span["duration"] = int((time.perf_counter() - t0) * 1e6)
-            self._local.span = parent
-            try:
-                self.reporter(span)
-            except Exception:
-                log.exception("span reporter failed")
+            self._local.span = prev
+            self.finish_span(span)
 
     def emit(self, name: str, duration_s: float, **tags: Any) -> None:
         """Fire-and-forget span for an already-measured interval —
         exception-safe instrumentation of code that cannot nest in a
         `with` block (multiple exits, hot paths)."""
-        if self.reporter is None:
+        span = self.start_span(name, parent=self._current(), **tags)
+        if span is None:
             return
-        parent = self._current()
-        span = {
-            "traceId": parent["traceId"] if parent
-            else uuid.uuid4().hex[:16],
-            "id": uuid.uuid4().hex[:16],
-            "name": name,
-            "localEndpoint": {"serviceName": self.service_name},
-            "timestamp": int((time.time() - duration_s) * 1e6),
-            "duration": int(duration_s * 1e6),
-            "tags": {k: str(v) for k, v in tags.items()},
-        }
-        if parent:
-            span["parentId"] = parent["id"]
-        try:
-            self.reporter(span)
-        except Exception:
-            log.exception("span reporter failed")
+        span["timestamp"] = int((time.time() - duration_s) * 1e6)
+        span["duration"] = int(duration_s * 1e6)
+        self.finish_span(span)
 
 
 # -- global tracer (pkg/tracing's ot.SetGlobalTracer side effect) -----

@@ -1,0 +1,96 @@
+"""Introspect smoke: boot a small ruleset, fire checks, scrape
+/metrics over real HTTP, and FAIL (nonzero exit) if the live p99
+gauge or any serving stage histogram is absent from the exposition.
+
+The observability contract this pins: every future perf/robustness PR
+can prove its hot-path effect from a live scrape — if the stage
+decomposition ever silently stops populating, CI catches it here, not
+three perf rounds later. Runnable under JAX_PLATFORMS=cpu; tier-1
+invokes main() in-process (tests/test_introspect_smoke.py).
+
+Usage: JAX_PLATFORMS=cpu python scripts/introspect_smoke.py \
+           [--rules N] [--checks N]
+"""
+import argparse
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REQUIRED_STAGES = ("queue_wait", "tensorize", "h2d", "device_step",
+                   "fold", "respond")
+REQUIRED_GAUGES = ("mixer_check_p99_ms", "check_p99_under_target")
+
+
+def main(n_rules: int = 32, n_checks: int = 100) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.testing import workloads
+    from istio_tpu.utils import tracing
+
+    store = workloads.make_store(n_rules)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=64, buckets=(16, 64),
+        default_manifest=workloads.MESH_MANIFEST))
+    intro = IntrospectServer(runtime=srv)
+    failures: list[str] = []
+    try:
+        plan = srv.controller.dispatcher.fused
+        if plan is not None:
+            plan.prewarm((16, 64))
+        port = intro.start()
+        bags = workloads.make_bags(max(n_checks, 1))
+        # half through the pre-batched entry, half through the batcher
+        # — both serving entries must feed the decomposition
+        srv.check_many(bags[: len(bags) // 2])
+        for bag in bags[len(bags) // 2:]:
+            srv.check(bag)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+
+        for stage in REQUIRED_STAGES:
+            needle = f'stage="{stage}"'
+            count_ok = any(
+                line.startswith("mixer_check_stage_seconds_count")
+                and needle in line
+                and float(line.rsplit(" ", 1)[1]) > 0
+                for line in text.splitlines())
+            if not count_ok:
+                failures.append(
+                    f"stage histogram absent/empty: {stage}")
+        for gauge in REQUIRED_GAUGES:
+            if not any(line.startswith(gauge)
+                       for line in text.splitlines()):
+                failures.append(f"gauge absent: {gauge}")
+        p99_lines = [line for line in text.splitlines()
+                     if line.startswith("mixer_check_p99_ms ")]
+        if p99_lines and float(p99_lines[0].rsplit(" ", 1)[1]) <= 0:
+            failures.append("mixer_check_p99_ms is zero after "
+                            f"{n_checks} served checks")
+        if "mixer_runtime_resolve_count" not in text:
+            failures.append("prometheus_client registry missing from "
+                            "the merged exposition")
+    finally:
+        intro.close()
+        srv.close()
+        tracing.shutdown()
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"introspect smoke ok: {len(REQUIRED_STAGES)} stages + "
+              f"{len(REQUIRED_GAUGES)} gauges live after "
+              f"{n_checks} checks")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=32)
+    ap.add_argument("--checks", type=int, default=100)
+    args = ap.parse_args()
+    sys.exit(main(args.rules, args.checks))

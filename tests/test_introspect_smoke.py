@@ -1,0 +1,23 @@
+"""Tier-1 hook for scripts/introspect_smoke.py: the CI gate that the
+serving stage decomposition and live p99 gauge stay scrapable. Runs
+main() in-process (a subprocess would pay a second jax import for no
+extra coverage; the script itself stays runnable standalone under
+JAX_PLATFORMS=cpu)."""
+import importlib.util
+import os
+import sys
+
+
+def test_introspect_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "introspect_smoke.py")
+    spec = importlib.util.spec_from_file_location(
+        "introspect_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(n_rules=24, n_checks=40)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0

@@ -1,0 +1,182 @@
+"""Prometheus text-exposition conformance for the homegrown registry
+(utils/metrics.py) — the half of the merged /metrics surface that does
+NOT come from prometheus_client and so gets no conformance for free.
+
+Lint contract (ISSUE satellite): every Histogram family must emit
+`_bucket` lines ending in le="+Inf", a `_sum` line and a `_count` line
+— for every label set it has seen, AND as an explicit zero series when
+it has seen none (a bare `# TYPE` line with no samples is a malformed
+family to real scrapers).
+"""
+import re
+
+from istio_tpu.utils.metrics import (Counter, Gauge, Histogram,
+                                     Registry, SlidingWindow)
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$")
+
+
+def _parse(text: str):
+    """exposition text → {metric name: [(labels dict, float value)]}"""
+    out: dict = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = {}
+        if m.group("labels"):
+            for pair in m.group("labels").split(","):
+                k, v = pair.split("=", 1)
+                assert v.startswith('"') and v.endswith('"'), line
+                labels[k] = v[1:-1]
+        out.setdefault(m.group("name"), []).append(
+            (labels, float(m.group("value"))))
+    return out
+
+
+def _histogram_families(samples: dict) -> set:
+    return {n[:-len("_bucket")] for n in samples if n.endswith("_bucket")}
+
+
+def lint_histograms(text: str, expect: set | None = None) -> None:
+    """Assert the satellite's conformance contract over an exposition
+    blob; `expect` adds the requirement that those families appear."""
+    samples = _parse(text)
+    fams = _histogram_families(samples)
+    if expect is not None:
+        missing = expect - fams
+        assert not missing, f"histogram families absent: {missing}"
+    for fam in fams:
+        buckets = samples[fam + "_bucket"]
+        sums = samples.get(fam + "_sum")
+        counts = samples.get(fam + "_count")
+        assert sums, f"{fam}: no _sum line"
+        assert counts, f"{fam}: no _count line"
+        # group bucket lines per label set (minus le)
+        by_series: dict = {}
+        for labels, value in buckets:
+            le = labels.get("le")
+            assert le is not None, f"{fam}: bucket without le"
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            by_series.setdefault(key, []).append((le, value))
+        count_by = {tuple(sorted(lb.items())): v for lb, v in counts}
+        for key, series in by_series.items():
+            les = [le for le, _ in series]
+            assert les[-1] == "+Inf", \
+                f"{fam}{dict(key)}: bucket ladder must end at +Inf " \
+                f"(got {les})"
+            vals = [v for _, v in series]
+            assert vals == sorted(vals), \
+                f"{fam}{dict(key)}: cumulative buckets not monotone"
+            assert key in count_by, f"{fam}: _count missing for {key}"
+            assert vals[-1] == count_by[key], \
+                f"{fam}{dict(key)}: +Inf bucket != _count"
+
+
+def test_observed_histogram_conformance():
+    reg = Registry()
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.001, 0.01, 1.0))
+    for v in (0.0005, 0.005, 0.5, 2.0):
+        h.observe(v)
+        h.observe(v, stage="device")
+    text = reg.expose_text()
+    lint_histograms(text, expect={"lat_seconds"})
+    samples = _parse(text)
+    # per-series counts: 4 observations each for {} and {stage=device}
+    counts = dict((tuple(sorted(lb.items())), v)
+                  for lb, v in samples["lat_seconds_count"])
+    assert counts[()] == 4
+    assert counts[(("stage", "device"),)] == 4
+    # sum carries through
+    sums = dict((tuple(sorted(lb.items())), v)
+                for lb, v in samples["lat_seconds_sum"])
+    assert abs(sums[()] - 2.5055) < 1e-9
+
+
+def test_unobserved_histogram_emits_zero_series():
+    """The conformance fix this PR ships: an unobserved histogram used
+    to expose only its # TYPE header — no samples at all."""
+    reg = Registry()
+    reg.histogram("never_seen_seconds", "nothing yet",
+                  buckets=(0.1, 1.0))
+    text = reg.expose_text()
+    lint_histograms(text, expect={"never_seen_seconds"})
+    samples = _parse(text)
+    assert samples["never_seen_seconds_count"] == [({}, 0.0)]
+    assert samples["never_seen_seconds_sum"] == [({}, 0.0)]
+    inf = [v for lb, v in samples["never_seen_seconds_bucket"]
+           if lb.get("le") == "+Inf"]
+    assert inf == [0.0]
+
+
+def test_counter_gauge_exposition_and_help():
+    reg = Registry()
+    c = reg.counter("reqs_total", "requests")
+    g = reg.gauge("depth", "queue depth")
+    c.inc(3, front="grpc")
+    g.set(7.5)
+    text = reg.expose_text()
+    assert "# HELP reqs_total requests" in text
+    assert "# TYPE reqs_total counter" in text
+    assert 'reqs_total{front="grpc"} 3.0' in text
+    assert "depth 7.5" in text
+
+
+def test_runtime_monitor_registry_lints():
+    """The real serving registry (stage decomposition, e2e, live
+    gauges) passes the same lint — including before any traffic, when
+    every family must still emit its zero series."""
+    from istio_tpu.runtime import monitor
+    from istio_tpu.utils.metrics import default_registry
+
+    monitor.refresh_latency_gauges()
+    text = default_registry.expose_text()
+    lint_histograms(text, expect={"mixer_check_stage_seconds",
+                                  "mixer_check_e2e_seconds"})
+    assert "mixer_check_p99_ms" in text
+    assert "check_p99_under_target" in text
+
+
+def test_latency_snapshot_windowed_delta():
+    """Per-scenario readings must delta against a baseline token —
+    the histograms are process-lifetime cumulative, and a bench phase
+    must not inherit the previous phase's observations."""
+    from istio_tpu.runtime import monitor
+
+    monitor.observe_stage("tensorize", 0.010)      # pre-window noise
+    base = monitor.stage_baseline()
+    monitor.observe_stage("tensorize", 0.020)
+    monitor.observe_stage("device_step", 0.040)
+    monitor.observe_check_e2e(0.050)
+    snap = monitor.latency_snapshot(since=base)
+    assert snap["stages"]["tensorize"]["count"] == 1
+    assert abs(snap["stages"]["tensorize"]["sum_ms"] - 20.0) < 1e-6
+    assert snap["stages"]["device_step"]["count"] == 1
+    assert snap["e2e_count"] == 1
+    # windowed quantile comes from DELTA bucket counts: the 10ms
+    # pre-window observation must not drag p50 down
+    assert snap["stages"]["tensorize"]["p50_ms"] >= 20.0
+    # unwindowed reading still sees everything
+    full = monitor.latency_snapshot()
+    assert full["stages"]["tensorize"]["count"] >= 2
+
+
+def test_sliding_window_quantiles():
+    w = SlidingWindow(100)
+    assert w.quantile(0.99) == 0.0
+    for i in range(1, 101):
+        w.observe(i / 1000.0)
+    p50, p99 = w.quantiles((0.5, 0.99))
+    assert 0.045 <= p50 <= 0.055
+    assert 0.095 <= p99 <= 0.100
+    # window slides: old observations age out
+    for _ in range(100):
+        w.observe(1.0)
+    assert w.quantile(0.5) == 1.0
+    assert w.total == 200
+    w.reset()
+    assert len(w) == 0 and w.quantile(0.5) == 0.0
