@@ -52,6 +52,20 @@ def _roundtrip_s() -> float:
     return float(np.median(ts))
 
 
+def _resilience_delta(mon, base: dict) -> dict:
+    """Shed/expired/fallback counter deltas vs a
+    monitor.resilience_counters() baseline — the per-served-scenario
+    overload record (a throughput number means something different
+    when part of the offered load was shed or answered off the oracle
+    path). Single home for both served benches."""
+    r = mon.resilience_counters()
+    out = {k: r[k] - base.get(k, 0)
+           for k in ("shed_total", "expired_total", "fallback_total",
+                     "batch_failures_total", "cancelled_shed_total")}
+    out["breaker_state"] = r["breaker_state"]
+    return out
+
+
 def _med3(ts) -> tuple:
     """Sorted window times → (median, min, max), clamped positive.
     Headline numbers are judged on the median (VERDICT r4 item 5);
@@ -946,9 +960,18 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
     try:
         from istio_tpu.runtime import monitor
         counters0 = monitor.serving_counters()
+        resil0 = monitor.resilience_counters()
     except Exception:   # counters are diagnostics, never a crash
         monitor = None
         counters0 = {}
+        resil0 = {}
+
+    def resilience_fields() -> dict:
+        """Shed / expired / fallback deltas for THIS scenario."""
+        if monitor is None:
+            return {}
+        return {f"served_srv_{k}": v
+                for k, v in _resilience_delta(monitor, resil0).items()}
 
     def counter_fields() -> dict:
         """Server-side counters since this bench began — emitted on
@@ -958,6 +981,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             return {}
         c = monitor.serving_counters()
         return {
+            **resilience_fields(),
             "served_srv_requests_decoded":
                 c["requests_decoded"] - counters0["requests_decoded"],
             "served_srv_responses_sent":
@@ -1360,8 +1384,10 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                 from istio_tpu.runtime import monitor as _mon
                 _mon.reset_latency_window()
                 native_stage_base = _mon.stage_baseline()
+                native_resil0 = _mon.resilience_counters()
             except Exception:
                 _mon, native_stage_base = None, None
+                native_resil0 = {}
             dicts = workloads.make_request_dicts(512)
             payloads = perf.make_check_payloads(dicts, quota_every=4)
 
@@ -1453,6 +1479,10 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                             since=native_stage_base)["stages"]} \
                     if _mon is not None else {}
                 if _mon is not None:
+                    # overload behavior for THIS scenario (shed /
+                    # expired / fallback deltas)
+                    stage_fields["served_native_resilience"] = \
+                        _resilience_delta(_mon, native_resil0)
                     _mon.reset_latency_window()
             except Exception:
                 stage_fields = {}

@@ -14,10 +14,14 @@ from __future__ import annotations
 import datetime
 import logging
 import threading
+import time
 from concurrent import futures
 from typing import Any
 
 import grpc
+
+from istio_tpu.runtime import resilience
+from istio_tpu.runtime.resilience import CheckRejected
 
 from istio_tpu.adapters.sdk import QuotaArgs
 from istio_tpu.api import mixer_pb2 as pb
@@ -33,6 +37,19 @@ from istio_tpu.runtime.server import RuntimeServer
 log = logging.getLogger("istio_tpu.api")
 
 _CLAMP_DURATION_S = 3600.0
+
+# typed serving rejections (runtime/resilience.py) → wire status codes:
+# overload and degradation must surface as DEADLINE_EXCEEDED /
+# RESOURCE_EXHAUSTED / UNAVAILABLE, never a generic INTERNAL
+_REJECT_CODES = {
+    resilience.DEADLINE_EXCEEDED: grpc.StatusCode.DEADLINE_EXCEEDED,
+    resilience.RESOURCE_EXHAUSTED: grpc.StatusCode.RESOURCE_EXHAUSTED,
+    resilience.UNAVAILABLE: grpc.StatusCode.UNAVAILABLE,
+}
+
+
+def _reject_status(exc: CheckRejected) -> "grpc.StatusCode":
+    return _REJECT_CODES.get(exc.grpc_code, grpc.StatusCode.UNKNOWN)
 
 
 class MixerGrpcServer:
@@ -86,6 +103,25 @@ class MixerGrpcServer:
 
     # -- RPCs --
 
+    def _deadline_from(self, context) -> float | None:
+        """Absolute perf_counter deadline for one Check: the client's
+        RPC deadline when it sent one, else the server-side default
+        (ServerArgs.default_check_deadline_ms; the native front's
+        --default-check-deadline-ms knob), else None."""
+        remaining = None
+        if context is not None:
+            try:
+                remaining = context.time_remaining()
+            except Exception:   # a front without deadline support
+                remaining = None
+        if remaining is not None:
+            return time.perf_counter() + max(remaining, 0.0)
+        d_ms = getattr(self.runtime.args, "default_check_deadline_ms",
+                       0.0)
+        if d_ms:
+            return time.perf_counter() + d_ms / 1e3
+        return None
+
     def _check(self, request: RawCheckRequest,
                context) -> "pb.CheckResponse":
         # ROOT span at RPC decode (pkg/tracing's interceptor role):
@@ -94,9 +130,15 @@ class MixerGrpcServer:
         # attributed to a REQUEST, not anonymously to a batch
         from istio_tpu.utils import tracing
         with tracing.get_tracer().span("rpc.check"):
-            bag = self._check_bag(request)
-            result = self.runtime.check_preprocessed(bag)
-            return self._check_response(request, bag, result)
+            try:
+                bag = self._check_bag(request)
+                result = self.runtime.check_preprocessed(
+                    bag, deadline=self._deadline_from(context))
+                return self._check_response(request, bag, result)
+            except CheckRejected as exc:
+                # abort() raises — the typed rejection becomes the
+                # RPC's status instead of an INTERNAL stack trace
+                context.abort(_reject_status(exc), str(exc))
 
     def _batch_check(self, request: RawBatchCheckRequest,
                      context) -> bytes:
@@ -105,12 +147,24 @@ class MixerGrpcServer:
         unary Check without quotas/dedup. The batch is padded to the
         server's prewarmed bucket shapes so arbitrary client batch
         sizes never re-trace."""
+        try:
+            return self._batch_check_body(request,
+                                          self._deadline_from(context))
+        except CheckRejected as exc:
+            context.abort(_reject_status(exc), str(exc))
+
+    def _batch_check_body(self, request: RawBatchCheckRequest,
+                          deadline: float | None) -> bytes:
+        """Span + dispatch, shared by the sync front (which aborts
+        inline) and the aio front (whose abort must be awaited on the
+        loop, not called from the executor thread)."""
         from istio_tpu.utils import tracing
         with tracing.get_tracer().span(
                 "rpc.batch_check", items=len(request.attributes_raw)):
-            return self._batch_check_traced(request)
+            return self._batch_check_traced(request, deadline=deadline)
 
-    def _batch_check_traced(self, request: RawBatchCheckRequest) -> bytes:
+    def _batch_check_traced(self, request: RawBatchCheckRequest,
+                            deadline: float | None = None) -> bytes:
         gwc = request.global_word_count
         native = gwc in (0, len(GLOBAL_WORD_LIST))
         bags = [self.runtime.preprocess(
@@ -119,32 +173,56 @@ class MixerGrpcServer:
         if not bags:
             return b""
         monitor.CHECK_REQUESTS.inc(len(bags))
-        results = self._check_bags_chunked(bags)
+        results = self._check_bags_chunked(bags, deadline=deadline)
         blobs = [
             self._check_response(None, bag, result,
                                  quotas=[]).SerializeToString()
             for bag, result in zip(bags, results)]
         return encode_batch_check_response(blobs)
 
-    def _check_bags_chunked(self, bags: list) -> list:
+    @staticmethod
+    def _expired_response():
+        """CheckResponse for a request whose deadline expired before
+        its chunk dispatched: the precondition status carries
+        DEADLINE_EXCEEDED and zero TTLs (nothing was evaluated, so
+        nothing may be cached)."""
+        from istio_tpu.runtime.dispatcher import CheckResponse
+        from istio_tpu.runtime.resilience import DEADLINE_EXCEEDED
+        return CheckResponse(status_code=DEADLINE_EXCEEDED,
+                             status_message="deadline expired before "
+                                            "dispatch",
+                             valid_duration_s=0.0, valid_use_count=0)
+
+    def _check_bags_chunked(self, bags: list,
+                            deadline: float | None = None) -> list:
         """Preprocessed bags → results, in largest-bucket CHUNKS padded
         to the prewarmed bucket shapes — an arbitrary over-bucket shape
         would force a fresh device compile per distinct size (client-
         controlled stalls). Single home of the rule: the BatchCheck
-        front and the native front-end pump both ride it."""
+        front and the native front-end pump both ride it. `deadline`:
+        chunks reached after it expire pre-tensorize — every remaining
+        row answers DEADLINE_EXCEEDED instead of queueing device work
+        the caller already abandoned."""
         from istio_tpu.runtime.batcher import pad_to_bucket
 
         buckets = self.runtime.batcher.buckets
         results: list = []
         for lo in range(0, len(bags), buckets[-1]):
             chunk = bags[lo:lo + buckets[-1]]
+            if deadline is not None and \
+                    time.perf_counter() >= deadline:
+                monitor.CHECK_DEADLINE_EXPIRED.inc(len(chunk))
+                results.extend(self._expired_response()
+                               for _ in chunk)
+                continue
             padded = pad_to_bucket(chunk, buckets)
             results.extend(
                 self.runtime.check_batch_preprocessed(padded)[:len(chunk)])
         return results
 
     def _check_bags_quota_instep(self, bags: list, qspecs: list,
-                                 target) -> tuple[list, dict]:
+                                 target, deadline: float | None = None
+                                 ) -> tuple[list, dict]:
         """_check_bags_chunked with each chunk's quota rows allocated
         IN its check trip (ServerArgs.quota_in_step; the pool-flush
         trip disappears — FusedPlan.packed_check_instep). qspecs[i] is
@@ -152,7 +230,10 @@ class MixerGrpcServer:
         RuntimeServer.instep_quota_target(). Returns (results,
         {global row → QuotaResult}); rows whose check was denied keep
         their entry but callers must NOT attach it (the device gate
-        consumed nothing for them — grpcServer.go:188)."""
+        consumed nothing for them — grpcServer.go:188). `deadline`:
+        chunks reached after it expire pre-tensorize like the
+        non-quota chunked path — their quota rows allocate NOTHING
+        (nothing was evaluated, nothing may be consumed)."""
         from istio_tpu.runtime.batcher import pad_to_bucket
 
         buckets = self.runtime.batcher.buckets
@@ -161,6 +242,12 @@ class MixerGrpcServer:
         cap = buckets[-1]
         for lo in range(0, len(bags), cap):
             chunk = bags[lo:lo + cap]
+            if deadline is not None and \
+                    time.perf_counter() >= deadline:
+                monitor.CHECK_DEADLINE_EXPIRED.inc(len(chunk))
+                results.extend(self._expired_response()
+                               for _ in chunk)
+                continue
             padded = pad_to_bucket(chunk, buckets)
             qrows = [(i, qspecs[lo + i][0], qspecs[lo + i][1])
                      for i in range(len(chunk))
@@ -306,9 +393,15 @@ class MixerAioGrpcServer(MixerGrpcServer):
     async def _abatch_check(self, request: RawBatchCheckRequest,
                             context) -> bytes:
         import asyncio
-        # tensorize + device step block — off the loop
-        return await asyncio.get_running_loop().run_in_executor(
-            None, self._batch_check, request, context)
+        deadline = self._deadline_from(context)
+        try:
+            # tensorize + device step block — off the loop
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self._batch_check_body, request, deadline)
+        except CheckRejected as exc:
+            # aio abort is a coroutine and must run ON the loop — the
+            # sync _batch_check's inline abort would no-op here
+            await context.abort(_reject_status(exc), str(exc))
 
     async def _acheck(self, request: RawCheckRequest,
                       context) -> "pb.CheckResponse":
@@ -323,12 +416,18 @@ class MixerAioGrpcServer(MixerGrpcServer):
         tr = tracing.get_tracer()
         root = tr.start_span("rpc.check")
         try:
-            return await self._acheck_traced(request, loop, root)
+            return await self._acheck_traced(
+                request, loop, root,
+                deadline=self._deadline_from(context))
+        except CheckRejected as exc:
+            await context.abort(_reject_status(exc), str(exc))
         finally:
             tr.finish_span(root)
 
     async def _acheck_traced(self, request: RawCheckRequest, loop,
-                             root) -> "pb.CheckResponse":
+                             root,
+                             deadline: float | None = None
+                             ) -> "pb.CheckResponse":
         import asyncio
         d = self.runtime.controller.dispatcher
         if self.runtime.args.preprocess and d.has_apa:
@@ -343,7 +442,8 @@ class MixerAioGrpcServer(MixerGrpcServer):
         # the shared batcher future (a cancelled batch-mate would
         # otherwise poison result distribution for the whole batch)
         result = await asyncio.shield(asyncio.wrap_future(
-            self.runtime.submit_check_preprocessed(bag, trace=root)))
+            self.runtime.submit_check_preprocessed(
+                bag, trace=root, deadline=deadline)))
         if request.quotas and result.status_code == 0:
             # fused-path quota futures bridge to the loop via
             # callbacks — an in-flight quota holds NO thread (an

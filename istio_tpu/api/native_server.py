@@ -304,6 +304,12 @@ class NativeMixerServer(MixerGrpcServer):
     def _run_checks(self, checks: list, completions: list,
                     deferred: set) -> None:
         monitor.CHECK_REQUESTS.inc(len(checks))
+        # the C++ wire carries no per-RPC deadline — apply the
+        # server-side default (--default-check-deadline-ms) from the
+        # moment the pump took the batch: under saturation, chunks
+        # this batch can't reach in time answer DEADLINE_EXCEEDED
+        # pre-tensorize instead of queueing dead device work
+        deadline = self._deadline_from(None)
         bags = []
         for _, _, payload, gwc, _, _ in checks:
             native = gwc in (0, len(GLOBAL_WORD_LIST))
@@ -332,12 +338,23 @@ class NativeMixerServer(MixerGrpcServer):
                 qspecs.append(spec)
             if not any(qspecs):
                 qspecs = None
-        if qspecs is not None:
-            results, inres = self._check_bags_quota_instep(
-                bags, qspecs, target)
-        else:
-            results = self._check_bags_chunked(bags)
-            inres = {}
+        from istio_tpu.runtime.resilience import CheckRejected
+        try:
+            if qspecs is not None:
+                results, inres = self._check_bags_quota_instep(
+                    bags, qspecs, target, deadline=deadline)
+            else:
+                results = self._check_bags_chunked(bags,
+                                                   deadline=deadline)
+                inres = {}
+        except CheckRejected as exc:
+            # typed serving rejection (fail-closed UNAVAILABLE, shed):
+            # answer every row with the honest status code instead of
+            # letting the belt degrade it to a blanket INTERNAL
+            msg = str(exc).encode()
+            for tag, _, _, _, _, _ in checks:
+                completions.append((tag, exc.grpc_code, msg))
+            return
         memo_hits = 0
         for row, (item, bag, result) in enumerate(
                 zip(checks, bags, results)):

@@ -31,7 +31,15 @@ def cmd_mixs(args: argparse.Namespace) -> int:
     store = FsStore(args.config_store) if args.config_store else MemStore()
     runtime = RuntimeServer(store, ServerArgs(
         batch_window_s=args.batch_window_us / 1e6,
-        max_batch=args.max_batch))
+        max_batch=args.max_batch,
+        # overload resilience (runtime/resilience.py + batcher
+        # admission control)
+        default_check_deadline_ms=args.default_check_deadline_ms,
+        check_queue_cap=args.check_queue_cap,
+        brownout=args.brownout,
+        check_fail_policy=args.check_fail_policy,
+        breaker_failures=args.breaker_failures,
+        breaker_reset_s=args.breaker_reset_ms / 1e3))
     server = MixerGrpcServer(runtime, f"{args.address}:{args.port}")
     port = server.start()
     print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
@@ -53,7 +61,7 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         print(f"mixs: introspection on "
               f"{args.monitoring_host}:{intro.port} "
               "(/metrics /healthz /readyz /debug/config /debug/queues"
-              " /debug/cache /debug/traces)")
+              " /debug/cache /debug/traces /debug/resilience)")
     _serve_forever()
     server.stop()
     if intro is not None:
@@ -617,6 +625,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="YAML config dir (FsStore); empty = memory")
     s.add_argument("--batch-window-us", type=int, default=300)
     s.add_argument("--max-batch", type=int, default=1024)
+    s.add_argument("--default-check-deadline-ms", type=float,
+                   default=0.0,
+                   help="server-side Check deadline for fronts whose "
+                        "wire carries none (the native front); "
+                        "expired requests answer DEADLINE_EXCEEDED "
+                        "before tensorize. 0 = off")
+    s.add_argument("--check-queue-cap", type=int, default=None,
+                   help="check batcher queue cap: submits past it "
+                        "shed RESOURCE_EXHAUSTED (default "
+                        "8*max-batch; 0 = unbounded)")
+    s.add_argument("--brownout", action="store_true",
+                   help="shed the newest check requests while the "
+                        "live p99 gauge is over the SLO target and "
+                        "the queue is half full")
+    s.add_argument("--check-fail-policy", default="closed",
+                   choices=("open", "closed"),
+                   help="answer when device AND oracle check paths "
+                        "are down: open = OK (Mixer-client fail-"
+                        "open), closed = UNAVAILABLE")
+    s.add_argument("--breaker-failures", type=int, default=3,
+                   help="consecutive failed device batches that trip "
+                        "the circuit breaker onto the CPU oracle path")
+    s.add_argument("--breaker-reset-ms", type=float, default=5000.0,
+                   help="how long the breaker stays open before a "
+                        "half-open device probe")
     s.add_argument("--trace-zipkin-url", default="",
                    help="zipkin v2 collector (POST /api/v2/spans)")
     s.add_argument("--trace-log-spans", action="store_true",

@@ -55,6 +55,7 @@ per-rule attribute bitmaps (SURVEY.md §2.2 translation note).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Callable, Mapping, Sequence
 
 import jax
@@ -306,6 +307,64 @@ class RuleSetProgram:
             return v, not v, False
         except Exception:
             return False, False, True
+
+
+class SnapshotOracle:
+    """Whole-snapshot CPU oracle executor — the graceful-degradation
+    resolve path the device circuit breaker falls back to
+    (runtime/resilience.py).
+
+    Per-rule OracleProgram evaluation with the same namespace-targeting
+    semantics as the device RuleSetProgram (default-namespace rules
+    apply to everyone; rules in other namespaces only to requests
+    addressed there). Correctness over speed by design: every rule runs
+    interpreted python per request, which is exactly the conformance
+    oracle the compiler tests pin the device programs against — so a
+    tripped breaker degrades latency, never answers.
+
+    Oracle programs compile lazily per rule (a breaker trip must not
+    pay a whole-snapshot compile before answering its first batch) and
+    are seeded with the ruleset's existing host-fallback programs.
+    Thread-safe: fallback batches run concurrently on the batcher's
+    worker pool."""
+
+    def __init__(self, rules: Sequence[Rule],
+                 finder: AttributeDescriptorFinder,
+                 seed: Mapping[int, OracleProgram] | None = None):
+        self.rules = list(rules)
+        self.finder = finder
+        self._progs: dict[int, OracleProgram] = dict(seed or {})
+        self._lock = threading.Lock()
+
+    def _prog(self, ridx: int) -> OracleProgram:
+        prog = self._progs.get(ridx)
+        if prog is None:
+            prog = _rule_oracle(self.rules[ridx], self.finder)
+            with self._lock:
+                self._progs.setdefault(ridx, prog)
+        return prog
+
+    def resolve(self, bag, request_ns: str
+                ) -> tuple[list[int], list[int], int]:
+        """→ (active rule idxs, namespace-visible rule idxs, n_errors)
+        for one request — the per-bag shape Dispatcher._check_one
+        consumes. A predicate that raises counts as not-matched plus
+        one resolve error (host_eval parity)."""
+        active: list[int] = []
+        visible: list[int] = []
+        errs = 0
+        for ridx, rule in enumerate(self.rules):
+            if rule.namespace and rule.namespace != request_ns:
+                continue
+            visible.append(ridx)
+            try:
+                matched = bool(self._prog(ridx).evaluate(bag))
+            except Exception:
+                errs += 1
+                continue
+            if matched:
+                active.append(ridx)
+        return active, visible, errs
 
 
 def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,

@@ -125,6 +125,7 @@ class IntrospectServer:
         "/debug/queues": "_h_queues",
         "/debug/cache": "_h_cache",
         "/debug/traces": "_h_traces",
+        "/debug/resilience": "_h_resilience",
     }
 
     def _route(self, req: BaseHTTPRequestHandler) -> None:
@@ -173,8 +174,29 @@ class IntrospectServer:
             return True, ""
         return self.probe_controller.status()
 
+    def _batcher_health(self) -> tuple[bool, str]:
+        """Flusher-thread watchdog (check + report coalescers): a dead
+        flusher means new submits fail fast and health must go red —
+        the load balancer has to stop sending traffic to a server that
+        can no longer answer it."""
+        if self.runtime is None:
+            return True, ""
+        for name, b in (("check", self.runtime.batcher),
+                        ("report", self.runtime._report_batcher)):
+            if b is None:
+                continue
+            healthy = getattr(b, "healthy", None)
+            if healthy is None:
+                continue
+            ok, err = healthy()
+            if not ok:
+                return False, f"{name} batcher: {err}"
+        return True, ""
+
     def _h_healthz(self, req: BaseHTTPRequestHandler) -> None:
         ok, err = self._probe_status()
+        if ok:
+            ok, err = self._batcher_health()
         payload = {"status": "ok" if ok else "unavailable"}
         if err:
             payload["error"] = err
@@ -198,6 +220,8 @@ class IntrospectServer:
                 ok, err = False, f"no published snapshot: {exc}"
             if self.runtime.batcher._closed:
                 ok, err = False, "batcher closed"
+            elif ok:
+                ok, err = self._batcher_health()
         payload["status"] = "ready" if ok else "unready"
         if err:
             payload["error"] = err
@@ -269,6 +293,43 @@ class IntrospectServer:
         if self.native is not None:
             payload["native_resp_memo"] = len(self.native._resp_memo)
             payload["native_ref_cache"] = len(self.native._ref_cache)
+        self._send_json(req, payload)
+
+    def _h_resilience(self, req: BaseHTTPRequestHandler) -> None:
+        """Overload-resilience view: breaker state machine, shed /
+        expired / fallback counters, admission-control config and the
+        batcher watchdog — the page an on-call loads when the shed
+        counters start moving."""
+        from istio_tpu.runtime import monitor
+
+        payload: dict[str, Any] = {
+            "counters": monitor.resilience_counters(),
+        }
+        if self.runtime is not None:
+            res = getattr(self.runtime, "resilience", None)
+            if res is not None:
+                payload.update(res.snapshot())
+            args = self.runtime.args
+            payload["policy"] = {
+                "default_check_deadline_ms":
+                    getattr(args, "default_check_deadline_ms", 0.0),
+                "check_queue_cap":
+                    getattr(args, "check_queue_cap", None),
+                "brownout": getattr(args, "brownout", False),
+                "check_fail_policy":
+                    getattr(args, "check_fail_policy", "closed"),
+                "breaker_failures":
+                    getattr(args, "breaker_failures", None),
+                "breaker_reset_s":
+                    getattr(args, "breaker_reset_s", None),
+            }
+            # stats() is the single home of batcher state (depth read
+            # under the queue mutex, watchdog health included)
+            st = self.runtime.batcher.stats()
+            payload["batcher"] = {
+                k: st.get(k) for k in ("depth", "max_queue",
+                                       "brownout", "healthy",
+                                       "health_error")}
         self._send_json(req, payload)
 
     def _h_traces(self, req: BaseHTTPRequestHandler) -> None:

@@ -15,6 +15,7 @@ batches fill instantly and the window never matters.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -23,6 +24,11 @@ from typing import Any, Callable, Sequence
 
 from istio_tpu.attribute.bag import Bag
 from istio_tpu.runtime import monitor
+from istio_tpu.runtime.resilience import (DeadlineExceededError,
+                                          ResourceExhaustedError,
+                                          UnavailableError)
+
+log = logging.getLogger("istio_tpu.runtime.batcher")
 
 
 def default_buckets(max_batch: int) -> tuple[int, ...]:
@@ -88,8 +94,25 @@ class CheckBatcher:
                  hold_at: int | None = None,
                  size_hist=None,
                  pad_batches: bool = True,
-                 observe_latency: bool = True):
+                 observe_latency: bool = True,
+                 max_queue: int | None = None,
+                 brownout: bool = False):
         self.run_batch = run_batch
+        # bounded admission (DAGOR-style front-door shedding): a submit
+        # that would push the queue past max_queue resolves
+        # RESOURCE_EXHAUSTED instead of growing queue_wait without
+        # bound. None = unbounded (the seed behavior; RuntimeServer
+        # passes a cap).
+        self.max_queue = max_queue if max_queue and max_queue > 0 \
+            else None
+        # brownout: while the LIVE p99 gauge is over the SLO target and
+        # the queue is already half full, shed the newest arrivals
+        # first — protecting the requests already queued instead of
+        # growing everyone's tail (Tail at Scale §"latency-induced
+        # brownout"). Off by default: it reads the global p99 window,
+        # which is only meaningful on the check path.
+        self.brownout = brownout
+        self._p99_refreshed = 0.0
         # False for non-Check coalescers (the report batcher): their
         # batches must not feed the Check() stage decomposition or the
         # live p99 window
@@ -138,24 +161,98 @@ class CheckBatcher:
         # can't be read): >0 → a device trip is in flight
         self._inflight_n = 0
         self._inflight_lock = threading.Lock()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        self._thread = threading.Thread(target=self._loop_guard,
+                                        daemon=True,
                                         name="check-batcher")
         self._closed = False
+        # watchdog: set to the fatal exception if the flusher thread
+        # ever dies — submit() then fails fast (an orphaned Future
+        # would block its caller forever) and /healthz goes unhealthy
+        self._dead: BaseException | None = None
         self._thread.start()
 
-    def check(self, bag: Bag) -> Any:
-        return self.submit(bag).result()
+    def check(self, bag: Bag, deadline: float | None = None) -> Any:
+        return self.submit(bag, deadline=deadline).result()
 
-    def submit(self, bag: Bag, trace: Any = None) -> Future:
+    def healthy(self) -> tuple[bool, str]:
+        """(ok, reason) for /healthz: the flusher thread must be alive
+        (or deliberately closed) and must not have died on an
+        exception."""
+        if self._dead is not None:
+            return False, (f"check-batcher flusher died: "
+                           f"{type(self._dead).__name__}: {self._dead}")
+        if not self._closed and not self._thread.is_alive():
+            return False, "check-batcher flusher thread not running"
+        return True, ""
+
+    def _admission_error(self, deadline: float | None
+                         ) -> Exception | None:
+        """Front-door shedding decision for one submit(). Returns the
+        typed rejection to resolve the future with, or None to admit.
+        Counter increments are gated on _observe_latency so the report
+        coalescer (which shares this class) never pollutes the CHECK
+        resilience counters."""
+        observe = self._observe_latency
+        if self._dead is not None or \
+                (not self._closed and not self._thread.is_alive()):
+            if observe:
+                monitor.CHECK_SHED.labels(reason="batcher_dead").inc()
+            return UnavailableError(
+                "check batcher flusher thread is dead")
+        if deadline is not None and time.perf_counter() >= deadline:
+            if observe:
+                monitor.CHECK_DEADLINE_EXPIRED.inc()
+            return DeadlineExceededError(
+                "deadline expired before enqueue")
+        depth = self._queue.qsize()
+        if self.max_queue is not None and depth >= self.max_queue:
+            if observe:
+                monitor.CHECK_SHED.labels(reason="queue_full").inc()
+            return ResourceExhaustedError(
+                f"check queue full ({depth} >= {self.max_queue})")
+        if self.brownout and self._brownout_active(depth):
+            if observe:
+                monitor.CHECK_SHED.labels(reason="brownout").inc()
+            return ResourceExhaustedError(
+                "brownout: live p99 over SLO target, shedding newest")
+        return None
+
+    def _brownout_active(self, depth: int) -> bool:
+        """Brownout trips only when BOTH hold: the queue is past its
+        soft threshold (half the cap, or half a max_batch when
+        uncapped) AND the live p99 gauge is over the SLO target. The
+        gauge refresh (a window sort) runs at most every 50ms, never
+        per submit."""
+        soft = (self.max_queue // 2) if self.max_queue is not None \
+            else max(self.max_batch // 2, 1)
+        if depth < soft:
+            return False
+        now = time.perf_counter()
+        if now - self._p99_refreshed > 0.05:
+            self._p99_refreshed = now
+            monitor.refresh_latency_gauges()
+        return monitor.CHECK_P99_MS.value() > monitor.CHECK_P99_TARGET_MS
+
+    def submit(self, bag: Bag, trace: Any = None,
+               deadline: float | None = None) -> Future:
         """`trace`: the caller's root span dict (API-layer rpc.check) —
         the batch span parents under it so queue-wait is attributed to
         a request, not a batch. None captures the submitting thread's
         current span (the sync fronts, which submit inside their root
-        span's `with` block)."""
+        span's `with` block). `deadline`: absolute time.perf_counter()
+        instant after which this request must not be dispatched —
+        expired requests resolve DEADLINE_EXCEEDED before tensorize,
+        and admission-control sheds resolve RESOURCE_EXHAUSTED; both
+        surface on the returned future, never as a hang."""
         if self._closed:
             raise RuntimeError("batcher is closed")
         fut: Future = Future()
+        err = self._admission_error(deadline)
+        if err is not None:
+            fut.set_exception(err)
+            return fut
         fut._t_enq = time.perf_counter()   # queue-wait span tag
+        fut._deadline = deadline
         if trace is None:
             try:
                 from istio_tpu.utils import tracing
@@ -166,7 +263,65 @@ class CheckBatcher:
                 trace = None   # tracing must never break submission
         fut._trace = trace
         self._queue.put((bag, fut))
+        # TOCTOU vs the watchdog: the flusher may have died (and
+        # drained the queue) between the admission check above and the
+        # put — a future landing in a consumer-less queue would hang
+        # its caller forever, the exact failure the watchdog exists to
+        # prevent. InvalidStateError means the drain already got it
+        # (and already counted the shed).
+        if self._dead is not None:
+            try:
+                fut.set_exception(UnavailableError(
+                    "check batcher flusher thread is dead"))
+            except InvalidStateError:
+                pass
+            else:
+                if self._observe_latency:
+                    monitor.CHECK_SHED.labels(
+                        reason="batcher_dead").inc()
         return fut
+
+    def _loop_guard(self) -> None:
+        """Flusher-thread watchdog: the loop must never die silently —
+        an orphaned queue blocks every future submitter forever. On a
+        fatal loop exception, mark the batcher dead (healthz +
+        fail-fast submits) and resolve everything still queued."""
+        try:
+            self._loop()
+        except BaseException as exc:   # noqa: BLE001 — watchdog belt
+            self._dead = exc
+            log.exception("check-batcher flusher thread died")
+            err = UnavailableError(
+                f"check batcher flusher died: "
+                f"{type(exc).__name__}: {exc}")
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    return
+                if item is None:
+                    continue
+                try:
+                    item[1].set_exception(err)
+                except InvalidStateError:
+                    pass
+                else:
+                    # every client-visible rejection must show in the
+                    # shed counters — an on-call diagnosing this exact
+                    # incident reads them first
+                    if self._observe_latency:
+                        monitor.CHECK_SHED.labels(
+                            reason="batcher_dead").inc()
+
+    @staticmethod
+    def _min_deadline(current: float | None, item) -> float | None:
+        """Running-minimum fold over batch items' deadlines — O(1) per
+        appended item (rescanning the batch per hold iteration was
+        O(max_batch²) on the only flusher thread)."""
+        d = getattr(item[1], "_deadline", None)
+        if d is None:
+            return current
+        return d if current is None or d < current else current
 
     def _loop(self) -> None:
         """Collect batches under an OCCUPANCY-ADAPTIVE window: with
@@ -185,6 +340,7 @@ class CheckBatcher:
                 self._drain_on_close()
                 return
             batch = [item]
+            dmin = self._min_deadline(None, item)
             deadline = time.perf_counter() + self.window_s
             while len(batch) < self.max_batch:
                 busy = self._inflight_n >= hold_at
@@ -192,7 +348,18 @@ class CheckBatcher:
                 if timeout <= 0:
                     if not busy:
                         break
-                    timeout = 0.002   # busy: hold, re-check occupancy
+                    # busy: hold, re-check occupancy — but NEVER hold a
+                    # request past its deadline: flush while the
+                    # earliest batch deadline still has a hold quantum
+                    # of slack (flushing AT expiry would guarantee the
+                    # row is shed in _run_one instead of served), and
+                    # never sleep past that flush point
+                    timeout = 0.002
+                    if dmin is not None:
+                        slack = dmin - time.perf_counter()
+                        if slack <= 0.002:
+                            break
+                        timeout = min(timeout, slack - 0.002)
                 try:
                     nxt = self._queue.get(timeout=timeout)
                 except queue.Empty:
@@ -204,6 +371,7 @@ class CheckBatcher:
                     self._drain_on_close()
                     return
                 batch.append(nxt)
+                dmin = self._min_deadline(dmin, nxt)
             self._flush(batch)
 
     def _drain_on_close(self) -> None:
@@ -225,10 +393,63 @@ class CheckBatcher:
         self._inflight.acquire()
         with self._inflight_lock:
             self._inflight_n += 1
-        self._pool.submit(self._run_one, batch)
+        try:
+            self._pool.submit(self._run_one, batch)
+        except BaseException as exc:
+            # pool.submit can fail (shutdown race, thread-spawn
+            # failure) — the in-hand futures must resolve before the
+            # exception propagates to the watchdog, or their callers
+            # block forever on a batch nobody owns
+            with self._inflight_lock:
+                self._inflight_n -= 1
+            self._inflight.release()
+            err = UnavailableError(
+                f"check batch dispatch failed: "
+                f"{type(exc).__name__}: {exc}")
+            for _, fut in batch:
+                try:
+                    fut.set_exception(err)
+                except InvalidStateError:
+                    pass
+                else:
+                    if self._observe_latency:
+                        monitor.CHECK_SHED.labels(
+                            reason="batcher_dead").inc()
+            raise
+
+    def _shed_stale(self, batch: list[tuple[Bag, Future]]
+                    ) -> list[tuple[Bag, Future]]:
+        """Drop rows that must not reach tensorize: futures the caller
+        already cancelled (an aio client disconnect — tensorizing and
+        dispatching them is pure waste) and rows whose deadline expired
+        in the queue (resolved DEADLINE_EXCEEDED; dispatching work the
+        caller already timed out on only steals device time from live
+        requests)."""
+        now = time.perf_counter()
+        keep: list[tuple[Bag, Future]] = []
+        for bag, fut in batch:
+            if fut.cancelled():
+                if self._observe_latency:
+                    monitor.CHECK_CANCELLED_SHED.inc()
+                continue
+            dl = getattr(fut, "_deadline", None)
+            if dl is not None and now >= dl:
+                if self._observe_latency:
+                    monitor.CHECK_DEADLINE_EXPIRED.inc()
+                try:
+                    fut.set_exception(DeadlineExceededError(
+                        "deadline expired in the check queue"))
+                except InvalidStateError:
+                    pass
+                continue
+            keep.append((bag, fut))
+        return keep
 
     def _run_one(self, batch: list[tuple[Bag, Future]]) -> None:
         try:
+            batch = self._shed_stale(batch)
+            if not batch:
+                return
             self._size_hist.observe(len(batch))
             bags = [bag for bag, _ in batch]
             padded = pad_to_bucket(bags, self.buckets) \
@@ -261,6 +482,11 @@ class CheckBatcher:
                 with span_ctx:
                     results = self.run_batch(padded)
             except Exception as exc:
+                # failed batches are excluded from the stage
+                # decomposition by design — this counter is their only
+                # trace in /metrics
+                if self._observe_latency:
+                    monitor.CHECK_BATCH_FAILURES.inc()
                 for _, fut in batch:
                     try:
                         fut.set_exception(exc)
@@ -296,6 +522,8 @@ class CheckBatcher:
             # unresolved future hangs its caller forever (observed r4:
             # a NameError in the tracing-span line left every request
             # of the batch timing out)
+            if self._observe_latency:
+                monitor.CHECK_BATCH_FAILURES.inc()
             for _, fut in batch:
                 try:
                     fut.set_exception(exc)
@@ -319,6 +547,7 @@ class CheckBatcher:
             t = getattr(head[1], "_t_enq", None)
             if t is not None:
                 oldest_wait_ms = (time.perf_counter() - t) * 1e3
+        healthy, health_err = self.healthy()
         return {
             "depth": depth,
             "oldest_wait_ms": round(oldest_wait_ms, 3),
@@ -329,6 +558,10 @@ class CheckBatcher:
             "max_batch": self.max_batch,
             "buckets": list(self.buckets),
             "closed": self._closed,
+            "max_queue": self.max_queue,
+            "brownout": self.brownout,
+            "healthy": healthy,
+            "health_error": health_err,
         }
 
     def close(self) -> None:

@@ -239,6 +239,11 @@ class Dispatcher:
             t1 = time.perf_counter()
             if observe:
                 monitor.observe_stage("tensorize", t1 - t0)
+                # chaos seam at the generic path's device boundary
+                # (check traffic only — observe gates out report/
+                # quota/APA resolves), mirroring packed_check's
+                from istio_tpu.runtime.resilience import CHAOS
+                CHAOS.device_step()
             matched, _, err = snap.ruleset(batch)
             matched = np.array(matched)
             err = np.array(err)
@@ -500,6 +505,52 @@ class Dispatcher:
             resp.status_message = (resp.status_message + "; " +
                                    plan.message_for(dev_rule, dev_status)
                                    ).strip("; ")
+
+    def check_host_oracle(self, bags: Sequence[Bag]
+                          ) -> list[CheckResponse]:
+        """Graceful-degradation check path: resolve every rule on the
+        CPU via the whole-snapshot oracle (compiler/ruleset.py
+        SnapshotOracle) and run the generic host adapter loop — NO
+        device step anywhere, so a tripped circuit breaker
+        (runtime/resilience.py) can keep answering correctly while the
+        device is down. Deliberately does not feed the stage
+        decomposition: fallback latency is not serving latency, and
+        attributing it to device_step/tensorize would corrupt the
+        decomposition the SLO gauges are judged against (the e2e
+        histogram still covers these requests via the batcher)."""
+        from istio_tpu.runtime.batcher import trim_pads
+
+        bags = trim_pads(list(bags))
+        oracle = self._oracle()
+        out: list[CheckResponse] = []
+        n_err = 0
+        for bag in bags:
+            ns = _namespace_of(bag, self.identity_attr)
+            active, visible, errs = oracle.resolve(bag, ns)
+            n_err += errs
+            out.append(self._check_one(bag, active, visible))
+        if n_err:
+            monitor.RESOLVE_ERRORS.inc(n_err)
+        return out
+
+    def _oracle(self):
+        """Lazily-built whole-snapshot oracle, cached per dispatcher
+        (per snapshot: a config swap publishes a fresh Dispatcher).
+        Seeded with the ruleset's host-fallback programs so those
+        rules never recompile. Only CONFIG rules participate — ruleset
+        rows past len(snapshot.rules) are rbac pseudo-rules whose
+        actions live on their owning config rule."""
+        cached = getattr(self, "_snapshot_oracle", None)
+        if cached is None:
+            from istio_tpu.compiler.ruleset import SnapshotOracle
+            rs = self.snapshot.ruleset
+            n_cfg = len(self.snapshot.rules)
+            cached = SnapshotOracle(
+                rs.rules[:n_cfg], self.snapshot.finder,
+                seed={r: p for r, p in rs.host_fallback.items()
+                      if r < n_cfg})
+            self._snapshot_oracle = cached
+        return cached
 
     def _check_one(self, bag: Bag, rule_idxs: list[int],
                    visible: list[int]) -> CheckResponse:

@@ -163,6 +163,14 @@ class FusedPlan:
 
         if self._packer is None:
             self._packer = jax.jit(self._base_packer())
+        if observe:
+            # fault-injection seam at the device boundary (chaos suite
+            # + scripts/chaos_smoke.py): an injected exception here
+            # unwinds exactly like a real device-step failure. Gated
+            # on observe so prewarm dummy trips and the fused report
+            # fallback never trip the breaker.
+            from istio_tpu.runtime.resilience import CHAOS
+            CHAOS.device_step()
         # h2d = host->device staging + async program dispatch;
         # device_step = the blocking pull (execution + D2H transfer,
         # carries the transport RTT). Together they decompose the trip

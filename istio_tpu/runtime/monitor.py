@@ -60,6 +60,75 @@ CHECK_RESPONSES = prometheus_client.Counter(
     "mixer_grpc_check_responses", "Check responses sent",
     registry=REGISTRY)
 
+# -- overload-resilience counters (runtime/resilience.py + batcher
+# admission control). Per-REQUEST counts except batch_failures (per
+# batch); label series are pre-touched below so every reason exposes
+# at zero from the first scrape (a dashboard must distinguish "never
+# shed" from "counter missing").
+CHECK_SHED_REASONS = ("queue_full", "brownout", "batcher_dead")
+CHECK_FALLBACK_REASONS = ("breaker_open", "device_error", "fail_open")
+CHECK_SHED = prometheus_client.Counter(
+    "mixer_check_shed_total",
+    "check requests shed by admission control (RESOURCE_EXHAUSTED / "
+    "UNAVAILABLE), by reason", ["reason"], registry=REGISTRY)
+CHECK_DEADLINE_EXPIRED = prometheus_client.Counter(
+    "mixer_check_deadline_expired_total",
+    "check requests rejected DEADLINE_EXCEEDED before tensorize",
+    registry=REGISTRY)
+CHECK_FALLBACK = prometheus_client.Counter(
+    "mixer_check_fallback_total",
+    "check requests answered off the device path (CPU oracle "
+    "fallback, or fail-open OK), by reason", ["reason"],
+    registry=REGISTRY)
+CHECK_BATCH_FAILURES = prometheus_client.Counter(
+    "mixer_check_batch_failures_total",
+    "check batches that failed outright (excluded from the stage "
+    "decomposition by design — this counter is their only trace)",
+    registry=REGISTRY)
+CHECK_CANCELLED_SHED = prometheus_client.Counter(
+    "mixer_check_cancelled_shed_total",
+    "check rows dropped at batch build because the caller already "
+    "cancelled (aio client disconnect)", registry=REGISTRY)
+CHECK_DEVICE_RETRIES = prometheus_client.Counter(
+    "mixer_check_device_retries_total",
+    "device check steps retried after a transient failure",
+    registry=REGISTRY)
+BREAKER_STATE = prometheus_client.Gauge(
+    "mixer_check_breaker_state",
+    "device circuit breaker state: 0=closed 1=half_open 2=open",
+    registry=REGISTRY)
+BREAKER_TRANSITIONS = prometheus_client.Counter(
+    "mixer_check_breaker_transitions_total",
+    "device circuit breaker state transitions, by target state",
+    ["to"], registry=REGISTRY)
+for _r in CHECK_SHED_REASONS:
+    CHECK_SHED.labels(reason=_r)
+for _r in CHECK_FALLBACK_REASONS:
+    CHECK_FALLBACK.labels(reason=_r)
+for _s in ("closed", "half_open", "open"):
+    BREAKER_TRANSITIONS.labels(to=_s)
+
+
+def resilience_counters() -> dict:
+    """Resilience counter snapshot as one JSON-able dict — read by
+    /debug/resilience, the chaos smoke and bench.py (per served
+    scenario, so overload behavior lands in the BENCH artifact)."""
+    shed = {r: int(CHECK_SHED.labels(reason=r)._value.get())
+            for r in CHECK_SHED_REASONS}
+    fb = {r: int(CHECK_FALLBACK.labels(reason=r)._value.get())
+          for r in CHECK_FALLBACK_REASONS}
+    return {
+        "shed": shed,
+        "shed_total": sum(shed.values()),
+        "expired_total": int(CHECK_DEADLINE_EXPIRED._value.get()),
+        "fallback": fb,
+        "fallback_total": sum(fb.values()),
+        "batch_failures_total": int(CHECK_BATCH_FAILURES._value.get()),
+        "cancelled_shed_total": int(CHECK_CANCELLED_SHED._value.get()),
+        "device_retries_total": int(CHECK_DEVICE_RETRIES._value.get()),
+        "breaker_state": int(BREAKER_STATE._value.get()),
+    }
+
 
 # -- end-to-end Check() latency decomposition ------------------------
 #

@@ -1,0 +1,361 @@
+"""Overload resilience for the Check() serving path.
+
+The BASELINE tail SLO (<1ms p99 at 10k rules) only means something if
+it survives the bad day: an unbounded batcher queue turns overload
+into unbounded queue_wait, a request with no deadline is work the
+caller stopped wanting long ago, and a single device-step exception
+used to fail every batch-mate with a raw INTERNAL. The pieces here are
+the standard overload-control toolkit ("The Tail at Scale", CACM 2013;
+DAGOR, SOSP'18; Istio's Mixer client fail-open semantics):
+
+  * typed rejections (CheckRejected) that the API fronts map onto real
+    gRPC status codes — DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED /
+    UNAVAILABLE instead of INTERNAL for every failure shape;
+  * a device CIRCUIT BREAKER (closed → open → half-open) in front of
+    the fused device step: transient failures retry once with jittered
+    backoff, consecutive failures trip the breaker and whole batches
+    route to the CPU SnapshotOracle path (compiler/ruleset.py) — the
+    same per-rule oracles the compiler tests conformance against, so
+    degraded answers are CORRECT answers, just slower;
+  * a fail policy for when even the oracle path is down: fail-open
+    answers OK (Mixer client `policyCheckFailOpen`), fail-closed
+    answers UNAVAILABLE;
+  * ChaosHooks — the fault-injection seam the chaos suite and
+    scripts/chaos_smoke.py drive (injected device-step exceptions,
+    added device latency, oracle failures). The hooks sit at the real
+    device boundary (FusedPlan.packed_check / Dispatcher._resolve), so
+    an injected failure exercises exactly the production unwind path.
+
+Admission control (queue cap, brownout, deadline expiry) lives in
+runtime/batcher.py; this module owns what happens once a batch reaches
+the device. Counters for every shed/expired/fallback decision are in
+runtime/monitor.py and exported through /metrics and the introspect
+server's /debug/resilience.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+log = logging.getLogger("istio_tpu.runtime.resilience")
+
+# gRPC status codes the serving path rejects with (google.rpc.Code)
+DEADLINE_EXCEEDED = 4
+RESOURCE_EXHAUSTED = 8
+UNAVAILABLE = 14
+
+
+class CheckRejected(RuntimeError):
+    """A request the serving path refused to answer — carries the gRPC
+    status code the API fronts must surface (INTERNAL is reserved for
+    genuine bugs; overload and degradation get honest codes)."""
+    grpc_code = 2   # UNKNOWN; subclasses override
+
+
+class DeadlineExceededError(CheckRejected):
+    grpc_code = DEADLINE_EXCEEDED
+
+
+class ResourceExhaustedError(CheckRejected):
+    grpc_code = RESOURCE_EXHAUSTED
+
+
+class UnavailableError(CheckRejected):
+    grpc_code = UNAVAILABLE
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Knobs for the ResilientChecker (ServerArgs mirrors these; the
+    mixs CLI exposes them as --check-fail-policy / --breaker-*)."""
+    # "open": when device AND oracle paths are down, answer OK (the
+    # Mixer client's fail-open posture — policy must not take the mesh
+    # down with it). "closed": answer UNAVAILABLE.
+    fail_policy: str = "closed"
+    # consecutive failed batches (after the in-batch retry) that trip
+    # the breaker
+    breaker_failures: int = 3
+    # how long the breaker stays open before a half-open probe
+    breaker_reset_s: float = 5.0
+    # retry a failed device step once, with jittered backoff, before
+    # counting it as a breaker failure
+    retry: bool = True
+    retry_backoff_s: float = 0.005
+    retry_jitter_s: float = 0.010
+
+
+class CircuitBreaker:
+    """closed → open (N consecutive failures) → half-open (one probe
+    after reset_s) → closed on probe success / open on probe failure.
+
+    Thread-safe: batches run concurrently on the batcher's worker pool,
+    and state transitions must be decided under one lock (two probes in
+    flight would double-count a flapping device)."""
+
+    CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+
+    def __init__(self, failures: int = 3, reset_s: float = 5.0):
+        self.failure_threshold = max(int(failures), 1)
+        self.reset_s = reset_s
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._publish()
+
+    def _publish(self) -> None:
+        from istio_tpu.runtime import monitor
+        monitor.BREAKER_STATE.set(
+            {self.CLOSED: 0, self.HALF_OPEN: 1, self.OPEN: 2}[self._state])
+
+    def _transition(self, to: str) -> None:
+        if to == self._state:
+            return
+        from istio_tpu.runtime import monitor
+        log.warning("device circuit breaker: %s -> %s", self._state, to)
+        self._state = to
+        monitor.BREAKER_TRANSITIONS.labels(to=to).inc()
+        self._publish()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow_device(self) -> bool:
+        """May this batch try the device? OPEN past the reset window
+        admits exactly ONE half-open probe; everyone else falls back
+        until the probe verdict lands."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN and \
+                    time.monotonic() - self._opened_at >= self.reset_s:
+                self._transition(self.HALF_OPEN)
+            if self._state == self.HALF_OPEN and not self._probe_inflight:
+                self._probe_inflight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probe_inflight = False
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._state == self.HALF_OPEN:
+                # the probe failed: back to open, restart the window
+                self._probe_inflight = False
+                self._opened_at = time.monotonic()
+                self._transition(self.OPEN)
+            elif self._state == self.CLOSED and \
+                    self._consecutive >= self.failure_threshold:
+                self._opened_at = time.monotonic()
+                self._transition(self.OPEN)
+
+    def release_probe(self) -> None:
+        """A batch that got a device slot ended with NO verdict (a
+        typed rejection or a non-Exception unwind rode out of the
+        device call). The probe slot must be returned or a half-open
+        breaker wedges with probe_inflight forever and never tries the
+        device again."""
+        with self._lock:
+            self._probe_inflight = False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {
+                "state": self._state,
+                "consecutive_failures": self._consecutive,
+                "failure_threshold": self.failure_threshold,
+                "reset_s": self.reset_s,
+                "probe_inflight": self._probe_inflight,
+            }
+            if self._state == self.OPEN:
+                out["open_for_s"] = round(
+                    time.monotonic() - self._opened_at, 3)
+            return out
+
+
+class ChaosHooks:
+    """Fault-injection seams for the chaos suite. All fields default to
+    inert; production code pays one attribute read per batch. The
+    device seam fires at the REAL device boundary (packed_check /
+    the generic resolve step) so injected failures exercise the same
+    unwind the hardware would."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            # fail the next N device steps (a huge N = hard outage)
+            self.device_failures = 0
+            # exception factory for injected device failures
+            self.device_exception: Callable[[], BaseException] | None = None
+            # sleep added to every device step (queue-saturation lever:
+            # a slow device backs the batcher queue up to its cap)
+            self.device_latency_s = 0.0
+            # fail the next N oracle-fallback batches (drives the
+            # fail-open/fail-closed policy paths)
+            self.oracle_failures = 0
+            self.injected_device = 0
+            self.injected_oracle = 0
+
+    def device_step(self) -> None:
+        """Called immediately before a real check device step."""
+        lat = self.device_latency_s
+        if lat:
+            time.sleep(lat)
+        if self.device_failures <= 0:
+            return
+        with self._lock:
+            if self.device_failures <= 0:
+                return
+            self.device_failures -= 1
+            self.injected_device += 1
+        exc = self.device_exception
+        raise exc() if exc is not None else \
+            RuntimeError("chaos: injected device-step failure")
+
+    def oracle_step(self) -> None:
+        """Called before an oracle-fallback batch executes."""
+        if self.oracle_failures <= 0:
+            return
+        with self._lock:
+            if self.oracle_failures <= 0:
+                return
+            self.oracle_failures -= 1
+            self.injected_oracle += 1
+        raise RuntimeError("chaos: injected oracle failure")
+
+    def snapshot(self) -> dict:
+        return {
+            "device_failures_pending": self.device_failures,
+            "oracle_failures_pending": self.oracle_failures,
+            "device_latency_s": self.device_latency_s,
+            "injected_device": self.injected_device,
+            "injected_oracle": self.injected_oracle,
+        }
+
+
+# process-wide chaos seam: tests/scripts arm it, serving code probes it
+CHAOS = ChaosHooks()
+
+
+class ResilientChecker:
+    """Wraps the dispatcher's device check with retry, the circuit
+    breaker, the CPU oracle fallback and the fail policy. This is
+    RuntimeServer._run_check_batch's implementation — every serving
+    entry (batcher, BatchCheck chunks, the native pump, check_many)
+    rides it."""
+
+    def __init__(self, device: Callable[[Sequence[Any]], Sequence[Any]],
+                 oracle: Callable[[Sequence[Any]], Sequence[Any]],
+                 config: ResilienceConfig | None = None,
+                 chaos: ChaosHooks | None = None):
+        self.device = device
+        self.oracle = oracle
+        self.config = config or ResilienceConfig()
+        self.chaos = chaos if chaos is not None else CHAOS
+        self.breaker = CircuitBreaker(self.config.breaker_failures,
+                                      self.config.breaker_reset_s)
+
+    def _n_real(self, bags: Sequence[Any]) -> int:
+        from istio_tpu.runtime.batcher import trim_pads
+        return len(trim_pads(list(bags)))
+
+    def run_batch(self, bags: Sequence[Any]) -> Sequence[Any]:
+        from istio_tpu.runtime import monitor
+
+        if not self.breaker.allow_device():
+            return self._fallback(bags, "breaker_open")
+        # every exit below must leave the breaker with a verdict
+        # (success/failure) — or release the probe slot: an unwound
+        # half-open probe with no verdict would wedge the breaker in
+        # half_open and never try the device again
+        recorded = False
+        try:
+            try:
+                out = self.device(bags)
+            except CheckRejected:
+                raise           # typed rejections are answers, not faults
+            except Exception as exc:
+                first = exc
+                if self.config.retry:
+                    # one jittered retry absorbs transient device
+                    # faults (a dropped tunnel frame, a preempted
+                    # step) without involving the breaker
+                    time.sleep(self.config.retry_backoff_s +
+                               random.random() *
+                               self.config.retry_jitter_s)
+                    monitor.CHECK_DEVICE_RETRIES.inc()
+                    try:
+                        out = self.device(bags)
+                    except CheckRejected:
+                        raise
+                    except Exception as exc2:
+                        first = exc2
+                    else:
+                        self.breaker.record_success()
+                        recorded = True
+                        return out
+                self.breaker.record_failure()
+                recorded = True
+                log.warning("device check batch failed (%s: %s); "
+                            "serving via the CPU oracle path",
+                            type(first).__name__, first)
+                return self._fallback(bags, "device_error")
+            self.breaker.record_success()
+            recorded = True
+            return out
+        finally:
+            if not recorded:
+                self.breaker.release_probe()
+
+    def _fallback(self, bags: Sequence[Any], reason: str) -> Sequence[Any]:
+        from istio_tpu.runtime import monitor
+
+        n = self._n_real(bags)
+        try:
+            self.chaos.oracle_step()
+            out = self.oracle(bags)
+        except Exception as exc:
+            if self.config.fail_policy == "open":
+                # Mixer-client fail-open: policy outage must not take
+                # the data plane down — answer OK, but with a 1s/1-use
+                # TTL so sidecars re-check promptly instead of caching
+                # the blanket allow for a normal success's 5s/10k uses
+                # (the policy-bypass window must close with the outage)
+                from istio_tpu.runtime.dispatcher import CheckResponse
+                monitor.CHECK_FALLBACK.labels(reason="fail_open").inc(n)
+                log.error("oracle fallback failed (%s: %s); policy is "
+                          "fail-open, answering OK",
+                          type(exc).__name__, exc)
+                return [CheckResponse(valid_duration_s=1.0,
+                                      valid_use_count=1)
+                        for _ in range(n)]
+            raise UnavailableError(
+                f"device and oracle check paths both failed "
+                f"({type(exc).__name__}: {exc})") from exc
+        monitor.CHECK_FALLBACK.labels(reason=reason).inc(n)
+        return out
+
+    def snapshot(self) -> dict:
+        """/debug/resilience payload fragment."""
+        return {
+            "breaker": self.breaker.snapshot(),
+            "fail_policy": self.config.fail_policy,
+            "retry": self.config.retry,
+            "chaos": self.chaos.snapshot(),
+        }

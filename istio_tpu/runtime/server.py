@@ -64,6 +64,29 @@ class ServerArgs:
     # None = single device. Requires fused=True and every serving
     # bucket divisible by dp.
     mesh_shape: tuple[int, int] | None = None
+    # -- overload resilience (runtime/resilience.py + batcher
+    #    admission control; mixs exposes these as CLI flags) ----------
+    # default Check() deadline for fronts whose wire carries none (the
+    # native front; the gRPC fronts prefer the client's RPC deadline).
+    # 0 = no default deadline.
+    default_check_deadline_ms: float = 0.0
+    # check batcher queue cap: submits past it shed RESOURCE_EXHAUSTED.
+    # None → 8×max_batch; 0 → unbounded (the pre-resilience behavior).
+    check_queue_cap: int | None = None
+    # brownout mode: when the live p99 gauge breaches the SLO target
+    # and the queue is half full, shed the NEWEST requests first
+    brownout: bool = False
+    # what Check() answers when BOTH the device path and the CPU
+    # oracle fallback are down: "open" → OK (Mixer-client fail-open),
+    # "closed" → UNAVAILABLE
+    check_fail_policy: str = "closed"
+    # consecutive failed device batches that trip the circuit breaker,
+    # and how long it stays open before a half-open probe
+    breaker_failures: int = 3
+    breaker_reset_s: float = 5.0
+    # retry a failed device step once (jittered backoff) before it
+    # counts as a breaker failure
+    device_retry: bool = True
 
 
 class RuntimeServer:
@@ -93,12 +116,34 @@ class RuntimeServer:
             fused=self.args.fused,
             prewarm_buckets=buckets,
             mesh=mesh)
+        # resilience layer in front of the device step: retry, circuit
+        # breaker with CPU-oracle fallback, fail-open/closed policy
+        # (runtime/resilience.py). Every serving entry routes its
+        # batches through _run_check_batch and therefore through this.
+        from istio_tpu.runtime.resilience import (ResilienceConfig,
+                                                  ResilientChecker)
+        if self.args.check_fail_policy not in ("open", "closed"):
+            raise ValueError(
+                f"check_fail_policy must be 'open' or 'closed', got "
+                f"{self.args.check_fail_policy!r}")
+        self.resilience = ResilientChecker(
+            device=self._run_check_batch_device,
+            oracle=self._run_check_batch_oracle,
+            config=ResilienceConfig(
+                fail_policy=self.args.check_fail_policy,
+                breaker_failures=self.args.breaker_failures,
+                breaker_reset_s=self.args.breaker_reset_s,
+                retry=self.args.device_retry))
+        cap = self.args.check_queue_cap
+        max_queue = 8 * self.args.max_batch if cap is None else cap
         self.batcher = CheckBatcher(self._run_check_batch,
                                     window_s=self.args.batch_window_s,
                                     max_batch=self.args.max_batch,
                                     pipeline=self.args.pipeline,
                                     buckets=buckets,
-                                    hold_at=self.args.hold_at)
+                                    hold_at=self.args.hold_at,
+                                    max_queue=max_queue,
+                                    brownout=self.args.brownout)
         # the REPORT coalescer: records from concurrent Report RPCs
         # share packed device trips (see report()). Separate instance
         # so report trips are separately counted and the two queues
@@ -135,7 +180,20 @@ class RuntimeServer:
 
     def _run_check_batch(self,
                          bags: Sequence[Bag]) -> Sequence[CheckResponse]:
+        return self.resilience.run_batch(bags)
+
+    def _run_check_batch_device(self, bags: Sequence[Bag]
+                                ) -> Sequence[CheckResponse]:
+        """The device serving path (ResilientChecker's primary).
+        Resolved per call: a config swap publishes a new dispatcher and
+        the breaker/fallback must follow it."""
         return self.controller.dispatcher.check(bags)
+
+    def _run_check_batch_oracle(self, bags: Sequence[Bag]
+                                ) -> Sequence[CheckResponse]:
+        """The CPU oracle fallback (ResilientChecker's degraded path —
+        no device step anywhere)."""
+        return self.controller.dispatcher.check_host_oracle(bags)
 
     def _run_report_batch(self, bags: Sequence[Bag]) -> Sequence[None]:
         """Report batcher hook: dispatch the coalesced record batch
@@ -144,22 +202,32 @@ class RuntimeServer:
         self.controller.dispatcher.report(bags)
         return [None] * len(bags)
 
-    def check(self, bag: Bag) -> CheckResponse:
-        """One request; coalesced into a device batch."""
-        return self.batcher.check(self.preprocess(bag))
+    def check(self, bag: Bag,
+              deadline: float | None = None) -> CheckResponse:
+        """One request; coalesced into a device batch. `deadline`:
+        absolute time.perf_counter() instant (see CheckBatcher.submit);
+        expired/shed requests raise the typed CheckRejected errors from
+        runtime/resilience.py."""
+        return self.batcher.check(self.preprocess(bag),
+                                  deadline=deadline)
 
-    def check_preprocessed(self, bag: Bag) -> CheckResponse:
+    def check_preprocessed(self, bag: Bag,
+                           deadline: float | None = None
+                           ) -> CheckResponse:
         """Batcher entry for callers that already ran preprocess()
         (the gRPC server, which reuses the bag for the quota loop)."""
-        return self.batcher.check(bag)
+        return self.batcher.check(bag, deadline=deadline)
 
-    def submit_check_preprocessed(self, bag: Bag, trace=None):
+    def submit_check_preprocessed(self, bag: Bag, trace=None,
+                                  deadline: float | None = None):
         """Non-blocking batcher entry → concurrent.futures.Future.
         The async gRPC front awaits it so an in-flight check holds no
         thread (the sync front burns one blocked thread per RPC for
         the whole batch round-trip). `trace`: the RPC's root span dict
-        (the batch span parents under it — API-layer root spans)."""
-        return self.batcher.submit(bag, trace=trace)
+        (the batch span parents under it — API-layer root spans).
+        `deadline`: absolute perf_counter instant; expired requests
+        resolve DEADLINE_EXCEEDED before tensorize."""
+        return self.batcher.submit(bag, trace=trace, deadline=deadline)
 
     def check_many(self, bags: Sequence[Bag]) -> list[CheckResponse]:
         """Pre-batched entry (load tests / the C++ shim's batches).

@@ -1,0 +1,203 @@
+"""Chaos smoke: the CI gate that overload resilience actually works.
+
+Boots a small serving stack and drives the three failure modes the
+resilience ISSUE pins, failing (nonzero exit) unless each degrades the
+way the design says it must:
+
+  (a) DEVICE OUTAGE — injected device-step failures (ChaosHooks) trip
+      the circuit breaker and Check() keeps answering CORRECTLY via
+      the CPU oracle fallback: conformance parity is asserted against
+      the clean-path statuses on a corpus sample that includes denials,
+      and the half-open probe recovers the breaker once the fault
+      clears.
+  (b) QUEUE SATURATION — with a slow device (injected latency) and a
+      small queue cap, excess submits shed RESOURCE_EXHAUSTED instead
+      of growing queue_wait without bound; everything admitted still
+      resolves.
+  (c) EXPIRED DEADLINES — requests whose deadline already passed are
+      rejected DEADLINE_EXCEEDED before tensorize (the tensorize stage
+      count must not move).
+
+Breaker state and the shed/expired/fallback counters must be visible
+over real HTTP in /metrics AND /debug/resilience. Runnable under
+JAX_PLATFORMS=cpu; tier-1 invokes main() in-process
+(tests/test_chaos_smoke.py).
+
+Usage: JAX_PLATFORMS=cpu python scripts/chaos_smoke.py [--rules N]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REQUIRED_METRICS = ("mixer_check_shed_total",
+                    "mixer_check_deadline_expired_total",
+                    "mixer_check_fallback_total",
+                    "mixer_check_batch_failures_total",
+                    "mixer_check_breaker_state")
+
+
+def _deny_bags(n: int = 4) -> list:
+    """Bags that deterministically hit deny rules of the
+    workloads.make_store ruleset (every 3rd rule denies), so the
+    conformance sample carries non-OK statuses — parity over an all-OK
+    sample would prove nothing about the fallback's verdict logic."""
+    from istio_tpu.attribute.bag import bag_from_mapping
+    return [bag_from_mapping({
+        "destination.service": f"svc{3 * i}.ns{(3 * i) % 23}"
+                               ".svc.cluster.local",
+        "source.namespace": "ns1",
+        "request.method": "GET",
+    }) for i in range(n)]
+
+
+def main(n_rules: int = 24, n_checks: int = 40) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.runtime import monitor
+    from istio_tpu.runtime.resilience import (CHAOS,
+                                              DeadlineExceededError,
+                                              ResourceExhaustedError)
+    from istio_tpu.testing import workloads
+    from istio_tpu.utils import tracing
+
+    failures: list[str] = []
+    CHAOS.reset()
+    store = workloads.make_store(n_rules)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=16, buckets=(8, 16),
+        check_queue_cap=32, breaker_failures=2, breaker_reset_s=0.3,
+        default_manifest=workloads.MESH_MANIFEST))
+    intro = IntrospectServer(runtime=srv)
+    try:
+        plan = srv.controller.dispatcher.fused
+        if plan is not None:
+            plan.prewarm((8, 16))
+        port = intro.start()
+        bags = workloads.make_bags(n_checks) + _deny_bags()
+
+        # clean-path statuses = the conformance baseline
+        clean = [srv.check(b).status_code for b in bags]
+        if not any(clean):
+            failures.append("corpus sample carries no denials — the "
+                            "parity assertion would be vacuous")
+
+        # (a) device outage → breaker trips → oracle fallback parity
+        CHAOS.device_failures = 10**9
+        degraded = [srv.check(b).status_code for b in bags]
+        if degraded != clean:
+            failures.append(
+                f"oracle fallback lost conformance parity: "
+                f"{sum(a != b for a, b in zip(degraded, clean))}/"
+                f"{len(clean)} statuses changed")
+        if srv.resilience.breaker.state != "open":
+            failures.append(
+                f"breaker did not trip under device outage "
+                f"(state={srv.resilience.breaker.state})")
+        c = monitor.resilience_counters()
+        if c["fallback_total"] < len(bags):
+            failures.append(
+                f"fallback counter undercounts: {c['fallback_total']} "
+                f"< {len(bags)}")
+        # fault clears → half-open probe recovers the breaker
+        CHAOS.reset()
+        time.sleep(0.35)
+        if srv.check(bags[0]).status_code != clean[0]:
+            failures.append("post-recovery answer diverged")
+        if srv.resilience.breaker.state != "closed":
+            failures.append(
+                f"breaker did not recover via half-open probe "
+                f"(state={srv.resilience.breaker.state})")
+
+        # (b) queue saturation → RESOURCE_EXHAUSTED sheds, bounded depth
+        CHAOS.device_latency_s = 0.05
+        shed0 = monitor.resilience_counters()["shed"]["queue_full"]
+        futs = [srv.batcher.submit(bags[i % len(bags)])
+                for i in range(200)]
+        depth = srv.batcher.stats()["depth"]
+        if depth > 32:
+            failures.append(f"queue depth {depth} exceeded its 32 cap")
+        n_shed = n_ok = 0
+        for f in futs:
+            try:
+                f.result(timeout=30)
+                n_ok += 1
+            except ResourceExhaustedError:
+                n_shed += 1
+            except Exception as exc:
+                failures.append(f"unexpected submit outcome: "
+                                f"{type(exc).__name__}: {exc}")
+                break
+        CHAOS.reset()
+        if n_shed == 0:
+            failures.append("saturation shed nothing "
+                            f"(ok={n_ok} of {len(futs)})")
+        c = monitor.resilience_counters()
+        if c["shed"]["queue_full"] - shed0 != n_shed:
+            failures.append(
+                f"shed counter mismatch: counter moved "
+                f"{c['shed']['queue_full'] - shed0}, clients saw "
+                f"{n_shed}")
+
+        # (c) expired deadline → rejected pre-tensorize
+        tz0 = monitor.CHECK_STAGE_SECONDS.count(stage="tensorize")
+        exp0 = monitor.resilience_counters()["expired_total"]
+        for b in bags[:5]:
+            try:
+                srv.check(b, deadline=time.perf_counter() - 1.0)
+                failures.append("expired-deadline check was answered")
+            except DeadlineExceededError:
+                pass
+        c = monitor.resilience_counters()
+        if c["expired_total"] - exp0 != 5:
+            failures.append(
+                f"expired counter moved {c['expired_total'] - exp0}, "
+                "expected 5")
+        if monitor.CHECK_STAGE_SECONDS.count(stage="tensorize") != tz0:
+            failures.append("expired requests were tensorized")
+
+        # counters + breaker visible over real HTTP
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for name in REQUIRED_METRICS:
+            if name not in text:
+                failures.append(f"metric absent from /metrics: {name}")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/resilience",
+                timeout=10) as r:
+            dbg = json.load(r)
+        for key in ("counters", "breaker", "policy", "batcher"):
+            if key not in dbg:
+                failures.append(f"/debug/resilience missing {key!r}")
+        if dbg.get("counters", {}).get("shed_total", 0) < n_shed:
+            failures.append("/debug/resilience shed_total below the "
+                            "observed sheds")
+    finally:
+        CHAOS.reset()
+        intro.close()
+        srv.close()
+        tracing.shutdown()
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"chaos smoke ok: breaker tripped+recovered, "
+              f"oracle parity held on {n_checks + 4} checks, "
+              f"saturation shed RESOURCE_EXHAUSTED, expired deadlines "
+              f"rejected pre-tensorize")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=24)
+    ap.add_argument("--checks", type=int, default=40)
+    args = ap.parse_args()
+    sys.exit(main(args.rules, args.checks))

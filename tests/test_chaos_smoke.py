@@ -1,0 +1,25 @@
+"""Tier-1 hook for scripts/chaos_smoke.py: the CI gate that overload
+resilience keeps working — injected device failures trip the breaker
+and the oracle fallback stays conformant, saturation sheds
+RESOURCE_EXHAUSTED, expired deadlines reject pre-tensorize, and the
+counters stay scrapable. Runs main() in-process (the
+introspect_smoke pattern: a subprocess would pay a second jax import
+for no extra coverage; the script stays runnable standalone under
+JAX_PLATFORMS=cpu)."""
+import importlib.util
+import os
+import sys
+
+
+def test_chaos_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "chaos_smoke.py")
+    spec = importlib.util.spec_from_file_location("chaos_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(n_rules=18, n_checks=24)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0
