@@ -210,6 +210,7 @@ def main() -> None:
     overlay = _overlay_bench(on_tpu)
     capacity = _capacity_bench(on_tpu)
     mesh_scaling = _mesh_scaling_bench(on_tpu)
+    analysis = _analysis_bench(on_tpu)
 
     baseline_cps = 1e9 / (PER_PREDICATE_NS * n_rules)
     out = {
@@ -292,6 +293,7 @@ def main() -> None:
     out.update(overlay)
     out.update(capacity)
     out.update(mesh_scaling)
+    out.update(analysis)
     print(json.dumps(out))
 
 
@@ -717,6 +719,68 @@ out["mesh_overhead_interpretation"] = (
     "distinct from mesh_scaling_ratio, which the 1-core wall caps")
 print(json.dumps(out))
 """
+
+
+def _analysis_bench(on_tpu: bool) -> dict:
+    """Snapshot-analyzer cost alongside the serving numbers: static
+    verification (istio_tpu/analysis) runs at every admission/CLI
+    gate and per config generation on /debug/analysis, so its
+    wall-time and finding counts are tracked per snapshot scenario —
+    an analysis-cost regression must name itself in the BENCH json
+    the same way a serving regression does."""
+    try:
+        from istio_tpu.analysis import (analyze_route_table,
+                                        analyze_rules,
+                                        analyze_snapshot)
+        from istio_tpu.expr.checker import AttributeDescriptorFinder
+        from istio_tpu.pilot.route_nfa import RouteTable
+        from istio_tpu.runtime.config import SnapshotBuilder
+        from istio_tpu.testing import corpus, workloads
+
+        out: dict = {}
+        # scenario 1: the golden serving store (clean — 0 findings)
+        n_rules = 400 if on_tpu else 120
+        snap = SnapshotBuilder(workloads.MESH_MANIFEST).build(
+            workloads.make_store(n_rules))
+        t0 = time.perf_counter()
+        rep = analyze_snapshot(snap)
+        out["analysis_store_rules"] = n_rules
+        out["analysis_store_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        out["analysis_store_findings"] = len(rep.findings)
+
+        # scenario 2: a route table (random world: real shadows may
+        # exist and are counted, not hidden)
+        n_routes = 200 if on_tpu else 60
+        services, rules_by_host = workloads.make_route_world(n_routes)
+        rt = RouteTable(services, rules_by_host)
+        t0 = time.perf_counter()
+        rep = analyze_route_table(rt, pair_budget=50_000)
+        out["analysis_route_rules"] = n_routes
+        out["analysis_route_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        out["analysis_route_findings"] = len(rep.findings)
+
+        # scenario 3: the seeded fault corpus — detection wall-time +
+        # the detected/seeded ratio (must stay 1.0; the analyze_gate
+        # CI gate fails otherwise, this just tracks the cost)
+        finder = AttributeDescriptorFinder(corpus.ANALYZER_MANIFEST)
+        cases = corpus.make_analyzer_faults(20260803)
+        t0 = time.perf_counter()
+        detected = 0
+        for case in cases:
+            rep = analyze_rules(case.rules, finder,
+                                deny_idx=case.deny_idx,
+                                allow_idx=case.allow_idx,
+                                check_totality=False)
+            if any(f.code == case.kind for f in rep.errors):
+                detected += 1
+        out["analysis_faults_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        out["analysis_faults_detected"] = f"{detected}/{len(cases)}"
+        return out
+    except Exception as exc:   # bench sections never sink the artifact
+        return {"analysis_error": f"{type(exc).__name__}: {exc}"}
 
 
 def _capacity_bench(on_tpu: bool) -> dict:

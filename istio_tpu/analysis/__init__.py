@@ -1,0 +1,25 @@
+"""Static verification of rule snapshots before they reach the TPU.
+
+Batfish-for-the-mesh: config faults that today surface as a compile
+blow-up or a silently-wrong answer under live traffic — ill-typed
+expressions, fully-shadowed rules, ALLOW/DENY overlaps, regexes that
+explode the padded NFA state budget, Pilot/Mixer plane divergence —
+are statically decidable from the compiled artifacts. This package
+decides them (see `analysis/analyzer.py` for the pass inventory) and
+reports structured, witness-carrying findings (`analysis/findings.py`)
+that the `mixs analyze` CLI, the admission webhook and the introspect
+`/debug/analysis` view all consume.
+"""
+from istio_tpu.analysis.analyzer import (analyze_route_table,
+                                         analyze_rules,
+                                         analyze_snapshot,
+                                         analyze_store)
+from istio_tpu.analysis.findings import (AnalysisReport, Finding,
+                                         Severity)
+from istio_tpu.analysis.planes import check_plane_pairs
+
+__all__ = [
+    "AnalysisReport", "Finding", "Severity",
+    "analyze_rules", "analyze_snapshot", "analyze_route_table",
+    "analyze_store", "check_plane_pairs",
+]

@@ -1,0 +1,228 @@
+"""Snapshot analyzer — orchestrates every static pass over a config.
+
+Runs BEFORE a snapshot is trusted: `compiler/ruleset.compile_ruleset`
+tolerates bad rules by degrading them (host fallback, 'false'
+replacement), and PR 2's resilience layer only degrades gracefully —
+neither can reject a snapshot that is wrong by construction. The
+passes, in order:
+
+  1. expression checking — manifest-aware type/arity/extern validation
+     on every match clause (`expr/checker.eval_type`), plus totality;
+  2. reachability — fully-shadowed rules and ALLOW/DENY overlaps via
+     DNF implication + product-DFA reasoning (`analysis/reach`), every
+     semantic claim witness-confirmed through `expr/oracle`;
+  3. budget prediction — DFA state caps, one-hot bank tiers, padded
+     index-tensor footprint (`analysis/budget`);
+  4. cross-plane consistency — Pilot route matchers vs Mixer
+     predicates compiled from the same source (`analysis/planes`).
+
+Consumers: `mixs analyze` (cmd/__main__.py, non-zero exit on ERROR),
+`kube/admission.register_analysis_admission` (reject at write time),
+and the introspect server's `/debug/analysis` view.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+from istio_tpu.analysis import budget as budget_mod
+from istio_tpu.analysis import planes as planes_mod
+from istio_tpu.analysis import reach
+from istio_tpu.analysis.findings import (AnalysisReport, CONFIG_ERROR,
+                                         Finding, HOST_FALLBACK,
+                                         SHADOWED_ROUTE, Severity,
+                                         TYPE_ERROR)
+from istio_tpu.compiler.ruleset import Rule, _rule_ast
+from istio_tpu.expr.checker import (AttributeDescriptorFinder,
+                                    DEFAULT_FUNCS, TypeError_, eval_type)
+from istio_tpu.expr.parser import ParseError
+from istio_tpu.attribute.types import ValueType
+
+
+def analyze_rules(rules: Sequence[Rule],
+                  finder: AttributeDescriptorFinder,
+                  *,
+                  deny_idx: Sequence[int] = (),
+                  allow_idx: Sequence[int] = (),
+                  shadow_eligible: Callable[[int, int], bool] | None = None,
+                  check_totality: bool = True,
+                  pair_budget: int = reach.DEFAULT_PAIR_CHECK_BUDGET
+                  ) -> AnalysisReport:
+    """Static verification of a bare rule list (no action wiring —
+    callers supply the deny/allow classification and, optionally, a
+    shadow-eligibility gate; default: all pairs eligible)."""
+    t0 = time.perf_counter()
+    report = AnalysisReport(n_rules=len(rules))
+
+    parsed: list[tuple[str, str, Any]] = []
+    ok_index: dict[int, int] = {}        # original idx → parsed idx
+    for idx, rule in enumerate(rules):
+        try:
+            ast = _rule_ast(rule)
+            rtype = eval_type(ast, finder, DEFAULT_FUNCS)
+            if rtype != ValueType.BOOL:
+                raise TypeError_(f"match must be BOOL, got {rtype.name}")
+        except (ParseError, TypeError_) as exc:
+            report.add(Finding(
+                code=TYPE_ERROR, severity=Severity.ERROR,
+                message=f"rule {rule.name!r}: {exc}",
+                rules=(rule.name,)))
+            continue
+        ok_index[idx] = len(parsed)
+        parsed.append((rule.name, rule.namespace, ast))
+
+    report.extend(budget_mod.check_budgets(parsed, finder))
+    if check_totality:
+        report.extend(reach.find_non_total(parsed, finder))
+
+    uni = reach.RuleUniverse(parsed, finder)
+    remap = lambda idxs: [ok_index[i] for i in idxs if i in ok_index]
+    eligible = shadow_eligible or (lambda i, j: True)
+    shadows, trunc1 = reach.find_shadowed(uni, eligible,
+                                          pair_budget=pair_budget)
+    report.extend(shadows)
+    conflicts, trunc2 = reach.find_conflicts(
+        uni, remap(deny_idx), remap(allow_idx),
+        pair_budget=pair_budget)
+    report.extend(conflicts)
+    report.truncated = trunc1 or trunc2
+    report.wall_ms = (time.perf_counter() - t0) * 1e3
+    return report
+
+
+# ---------------------------------------------------------------------------
+# snapshot-level (action-aware) analysis
+# ---------------------------------------------------------------------------
+
+def _action_classes(snapshot) -> tuple[list[int], list[int], list[frozenset]]:
+    """(deny rule idxs, allow rule idxs, per-rule action signatures)
+    from the snapshot's handler wiring: denier adapters and blacklist
+    lists deny; whitelist lists allow."""
+    deny: list[int] = []
+    allow: list[int] = []
+    sigs: list[frozenset] = []
+    for ridx, rc in enumerate(snapshot.rules):
+        sig = set()
+        is_deny = is_allow = False
+        for action in rc.actions:
+            hc = snapshot.handlers.get(action.handler)
+            if hc is None:
+                continue
+            sig.add((action.handler, tuple(sorted(action.instances))))
+            if hc.adapter == "denier":
+                is_deny = True
+            elif hc.adapter == "list":
+                if bool(hc.params.get("blacklist", False)):
+                    is_deny = True
+                else:
+                    is_allow = True
+            elif hc.adapter == "opa":
+                is_deny = True
+        if is_deny:
+            deny.append(ridx)
+        if is_allow:
+            allow.append(ridx)
+        sigs.append(frozenset(sig))
+    return deny, allow, sigs
+
+
+def analyze_snapshot(snapshot, *,
+                     pair_budget: int = reach.DEFAULT_PAIR_CHECK_BUDGET,
+                     check_totality: bool = False) -> AnalysisReport:
+    """Full static verification of a built `runtime/config.Snapshot`.
+
+    Shadow analysis is ACTION-AWARE here: rule j is only shadow-
+    eligible under rule i when j's action set is a subset of i's (a
+    narrower rule with different actions is layered policy, not dead
+    config). Totality is off by default at snapshot level: real mesh
+    predicates routinely reference optional attributes and the runtime
+    accounts those as resolve errors by design."""
+    t0 = time.perf_counter()
+    report = AnalysisReport(n_rules=len(snapshot.rules))
+
+    for err in snapshot.errors:
+        text = str(err)
+        sev = Severity.INFO if "unknown refs" in text else Severity.ERROR
+        report.add(Finding(code=CONFIG_ERROR, severity=sev,
+                           message=text))
+
+    n_cfg = snapshot.n_config_rules
+    preds = snapshot.ruleset.rules[:n_cfg]
+    deny, allow, sigs = _action_classes(snapshot)
+
+    for ridx, reason in snapshot.ruleset.fallback_reason.items():
+        if ridx < n_cfg:
+            report.add(Finding(
+                code=HOST_FALLBACK, severity=Severity.INFO,
+                message=(f"rule {preds[ridx].name!r} serves via the "
+                         f"CPU oracle: {reason}"),
+                rules=(preds[ridx].name,)))
+
+    def eligible(i: int, j: int) -> bool:
+        return bool(sigs[j]) and sigs[j] <= sigs[i]
+
+    sub = analyze_rules(preds, snapshot.finder,
+                        deny_idx=deny, allow_idx=allow,
+                        shadow_eligible=eligible,
+                        check_totality=check_totality,
+                        pair_budget=pair_budget)
+    report.extend(sub.findings)
+    report.truncated = sub.truncated
+    report.wall_ms = (time.perf_counter() - t0) * 1e3
+    return report
+
+
+def analyze_route_table(route_table, *,
+                        pair_budget: int = reach.DEFAULT_PAIR_CHECK_BUDGET
+                        ) -> AnalysisReport:
+    """Static verification of a compiled `pilot/route_nfa.RouteTable`:
+    (a) cross-plane consistency — each entry's compiled predicate must
+    stay language-equivalent to what `match_to_predicate` derives from
+    its source rule spec today; (b) precedence shadowing — a route row
+    covered by a higher-weight row can never win selection."""
+    from istio_tpu.pilot.route_nfa import (ROUTE_FINDER,
+                                           match_to_predicate)
+
+    t0 = time.perf_counter()
+    report = AnalysisReport(n_rules=len(route_table.entries))
+
+    pairs = []
+    parsed: list[tuple[str, str, Any]] = []
+    weights: list[int] = []
+    for i, entry in enumerate(route_table.entries):
+        name = f"route{i}:{entry.rule.meta.name}"
+        src = entry.rule.spec.get("match", {}).get("source") \
+            if entry.rule.spec.get("match") else None
+        derived = match_to_predicate(entry.service.hostname,
+                                     entry.rule.spec.get("match"), src)
+        pairs.append((name, derived, entry.predicate))
+        try:
+            parsed.append((name, "", _rule_ast(
+                Rule(name=name, match=entry.predicate))))
+            weights.append(int(route_table._weight[i]))
+        except (ParseError, TypeError_):
+            pass         # unparseable predicates already reported below
+    report.extend(planes_mod.check_plane_pairs(pairs, ROUTE_FINDER))
+
+    uni = reach.RuleUniverse(parsed, ROUTE_FINDER)
+    shadows, truncated = reach.find_shadowed(
+        uni, lambda i, j: True, code=SHADOWED_ROUTE, weight=weights,
+        pair_budget=pair_budget)
+    report.extend(shadows)
+    report.truncated = truncated
+    report.wall_ms = (time.perf_counter() - t0) * 1e3
+    return report
+
+
+def analyze_store(store, *,
+                  default_manifest=None,
+                  pair_budget: int = reach.DEFAULT_PAIR_CHECK_BUDGET
+                  ) -> AnalysisReport:
+    """Build a snapshot from a config store and analyze it — the
+    one-call form the CLI and admission hook share."""
+    from istio_tpu.attribute.global_dict import GLOBAL_MANIFEST
+    from istio_tpu.runtime.config import SnapshotBuilder
+
+    builder = SnapshotBuilder(default_manifest or GLOBAL_MANIFEST)
+    snapshot = builder.build(store)
+    return analyze_snapshot(snapshot, pair_budget=pair_budget)

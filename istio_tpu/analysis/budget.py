@@ -1,0 +1,133 @@
+"""State/tile budget prediction — reject explosions BEFORE device compile.
+
+Hyperscan-style ahead-of-time feasibility: every constant regex in a
+snapshot is compiled to its dense DFA on the host (cheap — subset
+construction is capped) and the analyzer predicts what the device
+compile would pay: per-pattern state counts against the
+`ops/regex_dfa` state cap, per-subject bank totals against the one-hot
+packing tiers, and the ruleset's padded conjunction/rule index-tensor
+footprint against a device budget. A pattern that would blow the state
+cap is an ERROR before `compiler/ruleset.compile_ruleset` ever runs;
+a bank that degrades to the latency-bound gather scan is a WARNING.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from istio_tpu.analysis.findings import (BANK_BUDGET, DNF_BUDGET, Finding,
+                                         Severity, STATE_BUDGET,
+                                         TILE_BUDGET)
+from istio_tpu.compiler.ruleset import (DEFAULT_DNF_CAP, DnfBlowup,
+                                        _AtomTable, _decompose)
+from istio_tpu.compiler.tensor_expr import HostFallback
+from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.expr.exprs import Expression
+from istio_tpu.ops.regex_dfa import (UnsupportedRegex, _MAX_DFA_STATES,
+                                     compile_regex)
+
+# one-hot packing feasibility (mirrors ops/regex_dfa.pack_dfas_tiered)
+DENSE_ONEHOT_BUDGET = 4_000_000
+BLOCKED_ONEHOT_BUDGET = 8_000_000
+# padded conjunction/rule index tensors (lit_idx + conj matrices),
+# int32 entries — beyond this the snapshot's HLO params stop being
+# "small" for remote compilation
+TILE_ENTRY_BUDGET = 16_000_000
+
+
+def _regex_atoms(ast: Expression, out: list) -> None:
+    """(subject text, pattern) per constant-pattern `matches` atom."""
+    f = ast.fn
+    if f is None:
+        return
+    if f.name == "matches" and f.target is not None \
+            and f.target.const_ is not None and f.args:
+        out.append((str(f.args[0]), str(f.target.const_.value)))
+    if f.target is not None:
+        _regex_atoms(f.target, out)
+    for a in f.args:
+        _regex_atoms(a, out)
+
+
+def check_budgets(rules: Sequence[tuple[str, str, Expression]],
+                  finder: AttributeDescriptorFinder,
+                  dnf_cap: int = DEFAULT_DNF_CAP) -> list[Finding]:
+    findings: list[Finding] = []
+    # --- per-pattern DFA state prediction + per-subject bank totals ---
+    banks: dict[str, dict[str, object]] = {}   # subject → pattern → DFA
+    seen_patterns: dict[str, object] = {}
+    for name, _ns, ast in rules:
+        pats: list = []
+        _regex_atoms(ast, pats)
+        for subject, pattern in pats:
+            if pattern not in seen_patterns:
+                try:
+                    seen_patterns[pattern] = compile_regex(pattern)
+                except UnsupportedRegex as exc:
+                    seen_patterns[pattern] = None
+                    if "exceeds" in str(exc):
+                        findings.append(Finding(
+                            code=STATE_BUDGET, severity=Severity.ERROR,
+                            message=(f"rule {name!r}: regex "
+                                     f"{pattern!r} explodes past the "
+                                     f"{_MAX_DFA_STATES}-state DFA "
+                                     f"budget ({exc})"),
+                            rules=(name,)))
+                except Exception:
+                    seen_patterns[pattern] = None
+            dfa = seen_patterns[pattern]
+            if dfa is not None:
+                banks.setdefault(subject, {})[pattern] = dfa
+    for subject, by_pattern in banks.items():
+        dfas = list(by_pattern.values())
+        # EXACT feasibility — the same class computation and tier
+        # thresholds ops/regex_dfa.pack_dfas_tiered applies at compile
+        from istio_tpu.ops.regex_dfa import pack_dfas_classes
+        classes = pack_dfas_classes(dfas)
+        s_tot, n_cls = classes["n_states"], classes["n_classes"]
+        s_max = max(d.n_states for d in dfas)
+        dense_ok = s_tot ** 2 * n_cls <= DENSE_ONEHOT_BUDGET
+        blocked_ok = len(dfas) * s_max ** 2 * n_cls \
+            <= BLOCKED_ONEHOT_BUDGET
+        if not dense_ok and not blocked_ok:
+            findings.append(Finding(
+                code=BANK_BUDGET, severity=Severity.WARNING,
+                message=(f"DFA bank over {subject!r} totals {s_tot} "
+                         f"states x {n_cls} classes: past both "
+                         f"one-hot packing tiers, matching degrades "
+                         f"to the latency-bound gather scan")))
+
+    # --- DNF conjunction growth + padded index-tensor footprint ---
+    table = _AtomTable()
+    n_conjs = 0
+    l_max = 1
+    k_max = 1
+    for name, _ns, ast in rules:
+        try:
+            mark = table.mark()
+            m, n = _decompose(ast, table, dnf_cap)
+        except DnfBlowup as exc:
+            table.revert(mark)
+            findings.append(Finding(
+                code=DNF_BUDGET, severity=Severity.WARNING,
+                message=(f"rule {name!r}: predicate DNF exceeds "
+                         f"dnf_cap={dnf_cap} ({exc}); the rule will "
+                         f"serve via the CPU oracle"),
+                rules=(name,)))
+            continue
+        except HostFallback:
+            table.revert(mark)
+            continue
+        conjs = m | n
+        n_conjs += len(conjs)
+        l_max = max(l_max, max((len(c) for c in conjs), default=1))
+        k_max = max(k_max, max(len(m), len(n)))
+    n_rows = max(len(rules), 1)
+    tile_entries = n_conjs * l_max + 2 * n_rows * k_max
+    if tile_entries > TILE_ENTRY_BUDGET:
+        findings.append(Finding(
+            code=TILE_BUDGET, severity=Severity.ERROR,
+            message=(f"predicted index tensors need {tile_entries} "
+                     f"int32 entries ({n_conjs} conjs × {l_max} "
+                     f"literals + {n_rows} rules × {k_max} conjs), "
+                     f"past the {TILE_ENTRY_BUDGET} device budget")))
+    return findings

@@ -61,7 +61,8 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         print(f"mixs: introspection on "
               f"{args.monitoring_host}:{intro.port} "
               "(/metrics /healthz /readyz /debug/config /debug/queues"
-              " /debug/cache /debug/traces /debug/resilience)")
+              " /debug/cache /debug/traces /debug/resilience"
+              " /debug/analysis)")
     _serve_forever()
     server.stop()
     if intro is not None:
@@ -94,6 +95,34 @@ def cmd_rule_dump(args: argparse.Namespace) -> int:
         print(Stepper(snapshot.ruleset, snapshot.finder).explain(
             bag_from_mapping(values)), end="")
     return 0
+
+
+def cmd_analyze(args: argparse.Namespace) -> int:
+    """Static snapshot verification (istio_tpu/analysis): build the
+    snapshot a server would serve from this config store and run the
+    full analyzer — expression checking, rule shadowing/conflicts with
+    oracle-confirmed witnesses, NFA/tile budget prediction. Exits 1
+    when any ERROR-severity finding is present (CI-gateable), 0 on a
+    clean or warning-only config."""
+    from istio_tpu.analysis import analyze_store
+    from istio_tpu.runtime import FsStore
+
+    store = FsStore(args.config_store)
+    report = analyze_store(store)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1, default=str))
+    else:
+        for f in sorted(report.findings,
+                        key=lambda f: -int(f.severity)):
+            rules = f" [{', '.join(f.rules)}]" if f.rules else ""
+            print(f"{f.severity.name:7s} {f.code}{rules}: {f.message}")
+            if f.witness:
+                print(f"        witness: {f.witness}")
+        print(f"analyze: {len(report.errors)} error(s), "
+              f"{len(report.warnings)} warning(s), "
+              f"{len(report.findings)} finding(s) over "
+              f"{report.n_rules} rule(s) in {report.wall_ms:.0f}ms")
+    return 1 if report.has_errors else 0
 
 
 def cmd_mixc(args: argparse.Namespace) -> int:
@@ -664,6 +693,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="step one request (string attrs) through the "
                         "ruleset and show per-atom/per-rule verdicts")
     s.set_defaults(fn=cmd_rule_dump)
+
+    s = sub.add_parser("analyze",
+                       help="static snapshot verification (exit 1 on "
+                            "ERROR findings)")
+    s.add_argument("--config-store", required=True,
+                   help="config directory (k8s-style YAML docs)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    s.set_defaults(fn=cmd_analyze)
 
     s = sub.add_parser("mixc", help="mixer client")
     s.add_argument("command", choices=["check", "report"])

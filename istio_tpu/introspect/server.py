@@ -62,6 +62,10 @@ class IntrospectServer:
         self._ring = None
         # extra cache-stat providers: name -> zero-arg callable
         self._cache_stats: dict[str, Callable[[], Any]] = {}
+        # /debug/analysis memo: (snapshot revision, report dict) — the
+        # analyzer runs on first request per config generation, never
+        # on the serving path or at swap time
+        self._analysis_cache: tuple[int, dict] | None = None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -126,6 +130,7 @@ class IntrospectServer:
         "/debug/cache": "_h_cache",
         "/debug/traces": "_h_traces",
         "/debug/resilience": "_h_resilience",
+        "/debug/analysis": "_h_analysis",
     }
 
     def _route(self, req: BaseHTTPRequestHandler) -> None:
@@ -331,6 +336,24 @@ class IntrospectServer:
                                        "brownout", "healthy",
                                        "health_error")}
         self._send_json(req, payload)
+
+    def _h_analysis(self, req: BaseHTTPRequestHandler) -> None:
+        """Static-analysis report for the LAST published snapshot
+        (istio_tpu/analysis): findings with severities, rule ids and
+        oracle-confirmed witnesses. Computed on first request per
+        config generation and memoized — an admin page must never put
+        analysis cost on the serving path."""
+        if self.runtime is None:
+            self._send_json(req, {"error": "no runtime attached"}, 503)
+            return
+        snap = self.runtime.controller.dispatcher.snapshot
+        cached = self._analysis_cache
+        if cached is None or cached[0] != snap.revision:
+            from istio_tpu.analysis import analyze_snapshot
+            report = analyze_snapshot(snap, pair_budget=50_000)
+            cached = (snap.revision, report.to_dict())
+            self._analysis_cache = cached
+        self._send_json(req, {"generation": cached[0], **cached[1]})
 
     def _h_traces(self, req: BaseHTTPRequestHandler) -> None:
         if self._ring is None:

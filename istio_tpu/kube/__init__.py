@@ -14,12 +14,21 @@ from istio_tpu.kube.fake import AdmissionDenied, FakeKubeCluster, WatchEvent
 from istio_tpu.kube.crd import CrdStore, KubeConfigStore, ISTIO_CRD_KINDS
 from istio_tpu.kube.registry import KubeServiceRegistry
 from istio_tpu.kube.ingress import IngressController
-from istio_tpu.kube.admission import register_istio_admission
-from istio_tpu.kube.secrets import ServiceAccountSecretController
+from istio_tpu.kube.admission import (register_analysis_admission,
+                                      register_istio_admission)
 
 __all__ = [
     "AdmissionDenied", "FakeKubeCluster", "WatchEvent",
     "CrdStore", "KubeConfigStore", "ISTIO_CRD_KINDS",
     "KubeServiceRegistry", "IngressController",
-    "register_istio_admission", "ServiceAccountSecretController",
+    "register_istio_admission", "register_analysis_admission",
 ]
+
+try:
+    # the SA-secret controller needs the PKI stack (`cryptography`);
+    # containers without it keep the rest of the kube layer — config
+    # watch, registries, admission (incl. the snapshot analyzer hook)
+    from istio_tpu.kube.secrets import ServiceAccountSecretController
+    __all__.append("ServiceAccountSecretController")
+except ImportError:  # pragma: no cover - dependency-gated
+    pass

@@ -3,6 +3,13 @@
 Reference: pilot/pkg/kube/admit/admit.go (ValidatingAdmissionWebhook
 over pilot's schema validators) + mixer/pkg/config/crd/admit — bad
 config is rejected at write time, before any controller sees it.
+Beyond the reference's per-object schema checks, the snapshot
+analyzer hook (`register_analysis_admission`) runs the whole-snapshot
+static verification from istio_tpu/analysis on the PROSPECTIVE store
+(current CRD state + the incoming object) and rejects writes that
+introduce ERROR-severity findings — shadowed rules, ALLOW/DENY
+conflicts, type errors, NFA budget explosions — before any controller
+compiles them toward the device.
 """
 from __future__ import annotations
 
@@ -56,6 +63,84 @@ def register_istio_admission(cluster: FakeKubeCluster) -> None:
                                kinds=tuple(IstioConfigTypes))
     cluster.register_admission(_validate_mixer_kind,
                                kinds=ISTIO_CRD_KINDS)
+
+
+def _store_from_cluster(cluster: FakeKubeCluster,
+                        extra: Mapping[str, Any] | None = None):
+    """Materialize the cluster's istio CRD objects (plus one incoming
+    object, key-overriding) as a MemStore the SnapshotBuilder reads."""
+    from istio_tpu.runtime.store import MemStore
+
+    store = MemStore()
+    for kind in ISTIO_CRD_KINDS:
+        for obj in cluster.list(kind):
+            meta = obj.get("metadata") or {}
+            store.set((kind, str(meta.get("namespace", "")),
+                       str(meta.get("name", ""))),
+                      dict(obj.get("spec") or {}))
+    if extra is not None:
+        meta = extra.get("metadata") or {}
+        store.set((str(extra.get("kind")),
+                   str(meta.get("namespace", "")),
+                   str(meta.get("name", ""))),
+                  dict(extra.get("spec") or {}))
+    return store
+
+
+def register_analysis_admission(cluster: FakeKubeCluster,
+                                default_manifest: Mapping[str, Any]
+                                | None = None,
+                                kinds: tuple[str, ...] = ("rule",),
+                                pair_budget: int = 50_000) -> None:
+    """Install the snapshot analyzer as a validating webhook.
+
+    On every rule CREATE/UPDATE the PROSPECTIVE snapshot (current CRD
+    state + the incoming object) is built and statically verified
+    (istio_tpu/analysis); the write is denied when it introduces NEW
+    ERROR-severity findings relative to the current state — so a
+    shadowed rule, an ALLOW/DENY conflict, an ill-typed match or an
+    NFA-budget explosion never reaches a controller. Pre-existing
+    findings never block unrelated writes (delta semantics), and
+    cross-resource "unknown refs" stay soft (creation order must keep
+    working)."""
+    from istio_tpu.analysis import analyze_store
+
+    def _key(f) -> tuple:
+        # message participates: config-error findings carry rules=()
+        # and would otherwise collapse to one key, letting a NEW bad
+        # rule ride in behind any pre-existing config error
+        return (f.code, f.rules, f.message)
+
+    # before-report memo keyed on the cluster's mutation counter: the
+    # current-state analysis only changes when a write LANDS, so
+    # applying N rules costs N analyses, not 2N (the before/after pair
+    # re-analyzed the identical state on every admission otherwise)
+    memo: dict[str, Any] = {}
+
+    def validate(verb: str, obj: Mapping[str, Any]) -> None:
+        if verb not in ("CREATE", "UPDATE"):
+            return
+        rv = getattr(cluster, "_rv", None)
+        if rv is None or memo.get("rv") != rv:
+            memo["report"] = analyze_store(
+                _store_from_cluster(cluster),
+                default_manifest=default_manifest,
+                pair_budget=pair_budget)
+            memo["rv"] = rv
+        before = memo["report"]
+        after = analyze_store(
+            _store_from_cluster(cluster, extra=obj),
+            default_manifest=default_manifest, pair_budget=pair_budget)
+        seen = {_key(f) for f in before.errors}
+        fresh = [f for f in after.errors if _key(f) not in seen]
+        if fresh:
+            lead = fresh[0]
+            raise AdmissionDenied(
+                f"snapshot analysis: {lead.code}: {lead.message}"
+                + (f" (+{len(fresh) - 1} more)" if len(fresh) > 1
+                   else ""))
+
+    cluster.register_admission(validate, kinds=kinds)
 
 
 def register_sidecar_injector(cluster: FakeKubeCluster,

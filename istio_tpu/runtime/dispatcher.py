@@ -126,8 +126,9 @@ class Dispatcher:
         n = batch.ids.shape[0]
         if slot is None:
             return np.zeros(n, np.int32)
-        ids = np.asarray(batch.ids[:, slot])
-        present = np.asarray(batch.present[:, slot])
+        # hotpath: sync-ok — tensorizer output is host numpy
+        ids = np.asarray(batch.ids[:, slot])      # hotpath: sync-ok
+        present = np.asarray(batch.present[:, slot])  # hotpath: sync-ok
         interner = rs.interner
         out = np.zeros(n, np.int32)
         # vectorized over DISTINCT service ids — per-row python here
@@ -215,8 +216,9 @@ class Dispatcher:
                 err[b, ridx] = e
         matched = matched[:, :n_cfg]
         err = err[:, :n_cfg]
-        ns_ok = np.asarray(rs.namespace_mask(ns_ids))[:, :n_cfg]
-        n_err = int((err & ns_ok).sum())
+        # hotpath: sync-ok — generic path's designated ns-mask pull
+        ns_ok = np.asarray(rs.namespace_mask(ns_ids))[:, :n_cfg]  # hotpath: sync-ok
+        n_err = int((err & ns_ok).sum())   # hotpath: sync-ok (host numpy)
         if n_err:
             monitor.RESOLVE_ERRORS.inc(n_err)
         return matched & ns_ok, ns_ok
@@ -245,8 +247,9 @@ class Dispatcher:
                 from istio_tpu.runtime.resilience import CHAOS
                 CHAOS.device_step()
             matched, _, err = snap.ruleset(batch)
-            matched = np.array(matched)
-            err = np.array(err)
+            # hotpath: sync-ok — the generic path's designated pull
+            matched = np.array(matched)    # hotpath: sync-ok
+            err = np.array(err)            # hotpath: sync-ok
             if observe:
                 monitor.observe_stage("device_step",
                                       time.perf_counter() - t1)
@@ -325,7 +328,7 @@ class Dispatcher:
                     on_dispatch(new_counts)
                     t_pull = time.perf_counter()
                     monitor.observe_stage("h2d", t_pull - t_d)
-                    packed = np.asarray(packed_dev)   # the pull
+                    packed = np.asarray(packed_dev)   # the pull — hotpath: sync-ok
                     monitor.observe_stage(
                         "device_step", time.perf_counter() - t_pull)
                     # granted/gate are the LAST two rows; everything
@@ -367,8 +370,9 @@ class Dispatcher:
         # oracle-evaluated into their subset positions
         # (_overlay_active, shared with the fused report path).
         active_sub, col_pos = self._overlay_active(packed, bags, ns_ids)
-        present_np = np.asarray(batch.present)[:n_real]
-        map_present_np = np.asarray(batch.map_present)[:n_real]
+        # hotpath: sync-ok x2 — tensorizer planes are host numpy
+        present_np = np.asarray(batch.present)[:n_real]        # hotpath: sync-ok
+        map_present_np = np.asarray(batch.map_present)[:n_real]  # hotpath: sync-ok
         lay = rs.layout
 
         ha = plan.host_rule_idx
@@ -706,7 +710,8 @@ class Dispatcher:
                     else plan.packed_check(batch, ns_ids,
                                            observe=False)
             active_sub, col_pos = self._overlay_active(
-                packed, chunk, np.asarray(ns_ids)[:len(chunk)])
+                packed, chunk,
+                np.asarray(ns_ids)[:len(chunk)])  # hotpath: sync-ok (host ids)
             if rcols is None:
                 rcols = [(ridx, col_pos[ridx])
                          for ridx in sorted(plan.report_rules)
@@ -714,7 +719,7 @@ class Dispatcher:
             if fctx is not None:
                 # skip the unique-id decode for chunks with no active
                 # report rule anywhere — their planes are never read
-                any_active = bool(rcols) and bool(
+                any_active = bool(rcols) and bool(   # hotpath: sync-ok
                     active_sub[:, [p for _, p in rcols]].any())
                 fctx.add_chunk(packed, base, len(chunk), batch,
                                decode=any_active)

@@ -180,9 +180,11 @@ class FusedPlan:
         # fallback): only check trips feed the Check() decomposition.
         t0 = time.perf_counter()
         verdict = self.engine.check(batch, ns_ids)
-        dev = self._packer(verdict, np.asarray(ns_ids))
+        dev = self._packer(
+            verdict, np.asarray(ns_ids))   # hotpath: sync-ok (host ids)
         t1 = time.perf_counter()
-        out = np.asarray(dev)          # the single host<->device sync
+        # the single host<->device sync — hotpath: sync-ok
+        out = np.asarray(dev)              # hotpath: sync-ok
         if observe:
             monitor.observe_stage("h2d", t1 - t0)
             monitor.observe_stage("device_step",
@@ -286,8 +288,11 @@ class FusedPlan:
 
             self._report_packer = jax.jit(packr)
         verdict = self.engine.check(batch, ns_ids)
-        return np.asarray(self._report_packer(verdict,
-                                              np.asarray(ns_ids), batch))
+        return np.asarray(                 # hotpath: sync-ok (the pull)
+            self._report_packer(
+                verdict,
+                np.asarray(ns_ids),        # hotpath: sync-ok (host ids)
+                batch))
 
     def packed_check_instep(self, batch, ns_ids, q: Mapping[str, Any],
                             counts) -> tuple[Any, Any]:
@@ -354,7 +359,9 @@ class FusedPlan:
         # onto new_counts at dispatch (the next trip chains on-device)
         # and pulls `packed` with the counter token already released
         return self._instep_packer(
-            verdict, np.asarray(ns_ids), counts,
+            verdict,
+            np.asarray(ns_ids),            # hotpath: sync-ok (host ids)
+            counts,
             q["buckets"], q["amounts"], q["be"], q["mx"], q["active"],
             q["ticks"], q["lasts"], q["rolling"], q["rule_idx"])
 

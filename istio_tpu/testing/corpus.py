@@ -705,3 +705,178 @@ CORPUS: list[Case] = [
          input={"ab": True, "as": "v"}, result=True,
          name="mixed-shortcircuit-chain"),
 ]
+
+
+# ---------------------------------------------------------------------------
+# Seeded analyzer corpora (istio_tpu/analysis)
+# ---------------------------------------------------------------------------
+#
+# Snapshot/rule generation here takes an EXPLICIT seed end-to-end (the
+# rng is created from it and every drawn constant derives from that
+# rng), so the analyzer gate (scripts/analyze_gate.py), the property
+# tests (tests/test_analysis.py) and any chaos corpus built on top
+# replay identically across CI runs. `make_analyzer_clean_rules` is
+# clean BY CONSTRUCTION (distinct services per rule ⇒ pairwise-disjoint
+# predicates ⇒ no shadow/conflict findings possible); the fault
+# injectors below each plant exactly one detectable defect class at an
+# rng-chosen position.
+
+ANALYZER_MANIFEST = {
+    "destination.service": V.STRING,
+    "source.namespace": V.STRING,
+    "source.user": V.STRING,
+    "request.path": V.STRING,
+    "request.method": V.STRING,
+    "request.host": V.STRING,
+    "request.headers": V.STRING_MAP,
+    "connection.mtls": V.BOOL,
+}
+
+
+@dataclasses.dataclass
+class FaultCase:
+    """One seeded defect for the analyzer gate: the faulted rule list
+    (fault always LAST so admission can replay creation order), the
+    finding code that must be reported, and which rules carry
+    deny/allow actions when built into a snapshot."""
+    kind: str                      # finding code expected from analysis
+    description: str
+    rules: list                    # list[compiler.ruleset.Rule]
+    deny_idx: tuple = ()
+    allow_idx: tuple = ()
+    fault_rule: str = ""           # name of the planted rule
+
+
+def make_analyzer_clean_rules(seed: int, n_rules: int = 24) -> list:
+    """Seeded CLEAN rule world: one distinct service per rule (so no
+    two predicates can overlap), varied secondary conjuncts and
+    namespaces drawn from the seed's rng."""
+    import numpy as np
+
+    from istio_tpu.compiler.ruleset import Rule
+
+    rng = np.random.default_rng(seed)
+    rules = []
+    for i in range(n_rules):
+        ns = f"ns{int(rng.integers(9))}"
+        svc = f"svc{i}.{ns}.svc.cluster.local"
+        parts = [f'destination.service == "{svc}"']
+        k = int(rng.integers(5))
+        if k == 0:
+            parts.append(f'source.namespace != '
+                         f'"locked{int(rng.integers(7))}"')
+        elif k == 1:
+            parts.append(f'request.method == '
+                         f'"{("GET", "POST")[int(rng.integers(2))]}"')
+        elif k == 2:
+            parts.append(f'request.path.startsWith('
+                         f'"/api/v{int(rng.integers(4))}/")')
+        elif k == 3:
+            parts.append(f'"^/items/[0-9]+/r{int(rng.integers(9))}$"'
+                         f'.matches(request.path)')
+        # k == 4: service-only match
+        rules.append(Rule(name=f"clean{i}", match=" && ".join(parts),
+                          namespace=ns))
+    return rules
+
+
+def make_analyzer_faults(seed: int, n_rules: int = 24) -> list:
+    """The seeded-fault corpus: one FaultCase per defect class the
+    acceptance criteria pin — shadowed rule, ALLOW/DENY conflict, type
+    error, NFA state-budget blow-up. (Plane divergence is exercised by
+    `make_plane_divergence_pairs` — it is a pair-of-planes fault, not
+    a single rule list.)"""
+    import numpy as np
+
+    from istio_tpu.compiler.ruleset import Rule
+
+    rng = np.random.default_rng(seed)
+    out = []
+
+    def world():
+        # independent clean world per case, same seed family
+        return make_analyzer_clean_rules(int(rng.integers(1 << 30)),
+                                         n_rules)
+
+    # 1. shadowed rule: duplicate an rng-chosen rule with an EXTRA
+    #    conjunct — strictly narrower, fully covered
+    base = world()
+    victim = base[int(rng.integers(len(base)))]
+    shadowed = Rule(name="fault-shadowed",
+                    match=victim.match + ' && request.method == "GET"'
+                    if 'request.method' not in victim.match
+                    else victim.match + ' && connection.mtls',
+                    namespace=victim.namespace)
+    out.append(FaultCase(
+        kind="shadowed-rule",
+        description=f"narrower copy of {victim.name} (same actions)",
+        rules=base + [shadowed],
+        deny_idx=tuple(range(len(base) + 1)),
+        fault_rule=shadowed.name))
+
+    # 2. ALLOW/DENY conflict: a deny rule and an allow rule whose
+    #    byte-level path constraints overlap (regex ∩ prefix ≠ ∅ —
+    #    decided by product-DFA construction, witnessed)
+    base = world()
+    svc = f"svcX.ns{int(rng.integers(9))}.svc.cluster.local"
+    v = int(rng.integers(4))
+    deny = Rule(name="fault-deny",
+                match=f'destination.service == "{svc}" && '
+                      f'"^/api/v[0-9]+/".matches(request.path)',
+                namespace="")
+    allow = Rule(name="fault-allow",
+                 match=f'destination.service == "{svc}" && '
+                       f'request.path.startsWith("/api/v{v}/")',
+                 namespace="")
+    out.append(FaultCase(
+        kind="allow-deny-conflict",
+        description="deny regex overlaps allow prefix on one service",
+        rules=base + [deny, allow],
+        deny_idx=(len(base),), allow_idx=(len(base) + 1,),
+        fault_rule=allow.name))
+
+    # 3. type error: undefined attribute drawn from the rng
+    base = world()
+    attr = f"nope{int(rng.integers(100))}.attr"
+    bad = Rule(name="fault-typed", match=f'{attr} == "x"')
+    out.append(FaultCase(
+        kind="type-error",
+        description=f"undefined attribute {attr}",
+        rules=base + [bad], fault_rule=bad.name))
+
+    # 4. state-budget blow-up: (a|b)*a(a|b)^m needs 2^m DFA states —
+    #    m ≥ 12 explodes past the 2048-state device budget
+    base = world()
+    m = 12 + int(rng.integers(4))
+    boom = Rule(name="fault-boom",
+                match=f'"(a|b)*a(a|b){{{m}}}$".matches(request.path)')
+    out.append(FaultCase(
+        kind="state-budget",
+        description=f"regex with 2^{m} DFA states",
+        rules=base + [boom], fault_rule=boom.name))
+
+    return out
+
+
+def make_plane_divergence_pairs(seed: int, n_pairs: int = 6
+                                ) -> tuple[list, int]:
+    """(pairs for analysis.check_plane_pairs, index of the diverged
+    pair): n_pairs route-style predicates where pilot and mixer sides
+    agree everywhere except one rng-chosen pair whose mixer side was
+    compiled from a DIFFERENT constant (the stale-recompile defect)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    diverge_at = int(rng.integers(n_pairs))
+    pairs = []
+    for i in range(n_pairs):
+        svc = f"svc{i}.default.svc.cluster.local"
+        v = int(rng.integers(7))
+        pilot = (f'destination.service == "{svc}" && '
+                 f'request.path.startsWith("/api/v{v}/")')
+        mixer = pilot
+        if i == diverge_at:
+            mixer = (f'destination.service == "{svc}" && '
+                     f'request.path.startsWith("/api/v{(v + 1) % 7}/")')
+        pairs.append((f"route{i}", pilot, mixer))
+    return pairs, diverge_at

@@ -40,31 +40,48 @@ MESH_FINDER = AttributeDescriptorFinder(MESH_MANIFEST)
 
 
 def make_rules(n_rules: int, n_services: int | None = None,
-               with_regex: bool = True) -> list[Rule]:
+               with_regex: bool = True,
+               seed: int | None = None) -> list[Rule]:
     """Bookinfo/authz-flavored rule mix: mostly EQ/NEQ conjunctions
     (the vectorized tier), a sprinkling of header glob/regex and path
-    prefix predicates (the byte-DFA tier)."""
+    prefix predicates (the byte-DFA tier).
+
+    `seed` (explicit, end-to-end reproducible): varies the per-branch
+    CONSTANTS (locked namespaces, methods, session ids, path/regex
+    versions) from a named rng so analyzer and chaos corpora differ
+    across seeds but replay identically for one seed. The svc/ns/
+    branch STRUCTURE stays i-based under any seed — consumers key on
+    it (every-3rd-rule deny wiring, chaos_smoke's deny bags). None =
+    the legacy fixed constants, byte-identical to pre-seed output."""
     n_services = n_services or max(n_rules // 2, 1)
+    rng = np.random.default_rng(seed) if seed is not None else None
+
+    def draw(legacy, hi):
+        return legacy if rng is None else int(rng.integers(hi))
+
     rules = []
     for i in range(n_rules):
         svc = f"svc{i % n_services}.ns{i % 23}.svc.cluster.local"
         parts = [f'destination.service == "{svc}"']
         k = i % 10
         if k < 4:
-            parts.append(f'source.namespace != "locked{i % 5}"')
+            parts.append(f'source.namespace != "locked{draw(i % 5, 5)}"')
         elif k == 4:
-            parts.append(f'request.method == "{"GET" if i % 2 else "POST"}"')
+            parts.append(f'request.method == '
+                         f'"{"GET" if draw(i % 2, 2) else "POST"}"')
         elif k == 5:
-            parts.append(f'request.headers["cookie"] == "session={i % 97}"')
+            parts.append(f'request.headers["cookie"] == '
+                         f'"session={draw(i % 97, 97)}"')
         elif k == 6:
             parts.append('connection.mtls')
         elif k == 7 and with_regex:
-            parts.append(f'request.path.startsWith("/api/v{i % 3}/")')
+            parts.append(f'request.path.startsWith('
+                         f'"/api/v{draw(i % 3, 3)}/")')
         elif k == 8 and with_regex:
             parts.append(f'match(request.host, "*.ns{i % 23}.cluster.local")')
         elif k == 9 and with_regex:
             parts.append(
-                f'"/(products|reviews)/[0-9]+/v{i % 4}"'
+                f'"/(products|reviews)/[0-9]+/v{draw(i % 4, 4)}"'
                 '.matches(request.path)')
         rules.append(Rule(name=f"rule{i}", match=" && ".join(parts),
                           namespace=f"ns{i % 23}"))
@@ -86,7 +103,8 @@ def make_engine(n_rules: int = 1024,
 
 def make_store(n_rules: int, n_services: int | None = None,
                with_regex: bool = True,
-               host_overlay_every: int | None = None):
+               host_overlay_every: int | None = None,
+               seed: int | None = None):
     """A MemStore carrying the make_rules() workload as REAL config
     kinds (handlers/instances/rules), for serving-path benches and the
     perf rig: every 3rd rule deny + every 97th a whitelist, mirroring
@@ -96,7 +114,11 @@ def make_store(n_rules: int, n_services: int | None = None,
     `host_overlay_every`: every Nth rule additionally carries a
     REGEX-entry list action the device cannot absorb — the
     host-overlay-heavy shape (VERDICT r2 weak #4) whose per-request
-    python cost the overlay bench measures."""
+    python cost the overlay bench measures.
+
+    `seed` forwards to make_rules (explicit, reproducible constant
+    variation; None = legacy fixed constants). Action wiring stays
+    i-based under any seed."""
     from istio_tpu.runtime.store import MemStore
 
     s = MemStore()
@@ -149,7 +171,8 @@ def make_store(n_rules: int, n_services: int | None = None,
         s.set(("instance", "istio-system", "pathinst"), {
             "template": "listentry",
             "params": {"value": "request.path"}})
-    for i, rule in enumerate(make_rules(n_rules, n_services, with_regex)):
+    for i, rule in enumerate(make_rules(n_rules, n_services, with_regex,
+                                        seed=seed)):
         actions = []
         if i % 3 == 0:
             actions.append({"handler": "denyall.istio-system",

@@ -1,0 +1,278 @@
+"""Analyzer CI gate: seeded faults MUST be caught, clean configs MUST
+be silent.
+
+Drives istio_tpu/analysis over two corpora (tests run main()
+in-process via tests/test_analyze_smoke.py; standalone under
+JAX_PLATFORMS=cpu):
+
+  CLEAN — the golden configs (workloads.make_store snapshot, a seeded
+  clean rule world, a crafted clean route table): ANY finding fails
+  the gate (a noisy analyzer cannot gate admission).
+
+  SEEDED FAULTS — testing/corpus.make_analyzer_faults plants one
+  defect per class at an rng-chosen position: a fully-shadowed rule,
+  an ALLOW/DENY overlap, a type error, an NFA state-budget blow-up,
+  plus make_plane_divergence_pairs' Pilot/Mixer divergence. Every
+  fault must surface as an ERROR finding naming the planted rule;
+  shadow/conflict/divergence findings must carry an oracle-confirmed
+  witness. The same faults are then replayed through the OTHER two
+  surfaces: `mixs analyze` must exit non-zero on a faulted FsStore
+  (and zero on the clean one), and the kube admission hook must reject
+  the faulted rule objects at CREATE.
+
+Usage: JAX_PLATFORMS=cpu python scripts/analyze_gate.py [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _clean_leg(seed: int, failures: list[str]) -> None:
+    from istio_tpu.analysis import (analyze_route_table, analyze_rules,
+                                    analyze_snapshot)
+    from istio_tpu.expr.checker import AttributeDescriptorFinder
+    from istio_tpu.pilot.model import Config, ConfigMeta, Port, Service
+    from istio_tpu.pilot.route_nfa import RouteTable
+    from istio_tpu.runtime.config import SnapshotBuilder
+    from istio_tpu.testing import corpus, workloads
+
+    # golden snapshot (the serving benches' config shape)
+    snap = SnapshotBuilder(workloads.MESH_MANIFEST).build(
+        workloads.make_store(45))
+    rep = analyze_snapshot(snap)
+    if rep.findings:
+        failures.append(f"clean make_store snapshot raised "
+                        f"{[f.code for f in rep.findings]}")
+
+    # seeded clean rule world
+    finder = AttributeDescriptorFinder(corpus.ANALYZER_MANIFEST)
+    rules = corpus.make_analyzer_clean_rules(seed)
+    rep = analyze_rules(rules, finder,
+                        deny_idx=tuple(range(len(rules))),
+                        check_totality=False)
+    if rep.findings:
+        failures.append(f"clean seeded rules raised "
+                        f"{[f.code for f in rep.findings]}")
+
+    # crafted clean route table: distinct hosts, one rule each
+    services = [Service(hostname=f"svc{i}.default.svc.cluster.local",
+                        address=f"10.9.0.{i + 1}",
+                        ports=(Port("http", 9080, "HTTP"),))
+                for i in range(4)]
+    rules_by_host = {
+        s.hostname: [Config(ConfigMeta(type="route-rule", name=f"rr{i}",
+                                       namespace="default"),
+                            {"destination": {"name": f"svc{i}"},
+                             "precedence": 1,
+                             "match": {"request": {"headers": {
+                                 "uri": {"prefix": f"/api/v{i}/"}}}},
+                             "route": [{"labels": {"version": "v1"}}]})]
+        for i, s in enumerate(services)}
+    rep = analyze_route_table(RouteTable(services, rules_by_host))
+    if rep.findings:
+        failures.append(f"clean route table raised "
+                        f"{[f.code for f in rep.findings]}")
+
+
+def _fault_leg(seed: int, failures: list[str]) -> None:
+    from istio_tpu.analysis import analyze_rules, check_plane_pairs
+    from istio_tpu.attribute.bag import DictBag
+    from istio_tpu.expr.checker import AttributeDescriptorFinder
+    from istio_tpu.expr.oracle import OracleProgram
+    from istio_tpu.compiler.ruleset import _rule_ast
+    from istio_tpu.testing import corpus
+
+    finder = AttributeDescriptorFinder(corpus.ANALYZER_MANIFEST)
+    witness_codes = ("shadowed-rule", "allow-deny-conflict")
+    for case in corpus.make_analyzer_faults(seed):
+        rep = analyze_rules(case.rules, finder,
+                            deny_idx=case.deny_idx,
+                            allow_idx=case.allow_idx,
+                            check_totality=False)
+        hits = [f for f in rep.errors if f.code == case.kind
+                and any(case.fault_rule in r for r in f.rules)]
+        if not hits:
+            failures.append(
+                f"seeded {case.kind} ({case.description}) went "
+                f"UNDETECTED: report codes {sorted(rep.codes())}")
+            continue
+        stray = [f for f in rep.errors
+                 if not any(case.fault_rule in r for r in f.rules)]
+        if stray:
+            failures.append(f"{case.kind} world raised stray errors "
+                            f"{[f.code for f in stray]}")
+        if case.kind not in witness_codes:
+            continue
+        f = hits[0]
+        if f.witness is None or not f.confirmed:
+            failures.append(f"{case.kind} finding shipped no "
+                            f"confirmed witness")
+            continue
+        # independent oracle replay (the property the findings claim)
+        by_name = {r.name: r for r in case.rules}
+        for rname in f.rules:
+            rule = by_name[rname]
+            try:
+                v = OracleProgram.from_ast(
+                    _rule_ast(rule), finder).evaluate(
+                        DictBag(dict(f.witness)))
+            except Exception as exc:
+                failures.append(f"{case.kind} witness errors on "
+                                f"{rname}: {exc}")
+                break
+            if v is not True:
+                failures.append(f"{case.kind} witness does not match "
+                                f"{rname}")
+                break
+
+    pairs, diverge_at = corpus.make_plane_divergence_pairs(seed)
+    fs = check_plane_pairs(pairs, finder)
+    div = [f for f in fs if f.code == "plane-divergence"]
+    if len(div) != 1 or f"route{diverge_at}" not in div[0].rules:
+        failures.append(f"plane divergence at pair {diverge_at} not "
+                        f"isolated: {[f.to_dict() for f in fs]}")
+    elif div[0].witness is None or not div[0].confirmed:
+        failures.append("plane-divergence finding shipped no witness")
+
+
+def _store_dir(tmp: str, name: str, rules, allow_rules=()) -> str:
+    """Write a rule world as an FsStore directory (denyall on every
+    rule; whitelist on `allow_rules`)."""
+    import yaml
+
+    root = os.path.join(tmp, name)
+    os.makedirs(root, exist_ok=True)
+    docs = [
+        {"kind": "handler",
+         "metadata": {"name": "denyall", "namespace": "istio-system"},
+         "spec": {"adapter": "denier", "params": {}}},
+        {"kind": "handler",
+         "metadata": {"name": "wl", "namespace": "istio-system"},
+         "spec": {"adapter": "list",
+                  "params": {"overrides": ["ns1"],
+                             "blacklist": False}}},
+    ]
+    allow = set(allow_rules)
+    for r in rules:
+        handler = "wl.istio-system" if r.name in allow \
+            else "denyall.istio-system"
+        docs.append({"kind": "rule",
+                     "metadata": {"name": r.name,
+                                  "namespace": r.namespace
+                                  or "istio-system"},
+                     "spec": {"match": r.match,
+                              "actions": [{"handler": handler,
+                                           "instances": []}]}})
+    with open(os.path.join(root, "world.yaml"), "w",
+              encoding="utf-8") as f:
+        yaml.safe_dump_all(docs, f, sort_keys=False)
+    return root
+
+
+def _cli_leg(seed: int, failures: list[str]) -> None:
+    import contextlib
+    import io
+
+    from istio_tpu.cmd.__main__ import main as cli_main
+    from istio_tpu.testing import corpus
+
+    def run(argv) -> int:
+        with contextlib.redirect_stdout(io.StringIO()):
+            return cli_main(argv)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        clean = _store_dir(tmp, "clean",
+                           corpus.make_analyzer_clean_rules(seed))
+        rc = run(["analyze", "--config-store", clean, "--json"])
+        if rc != 0:
+            failures.append(f"`mixs analyze` exited {rc} on the clean "
+                            f"store")
+        for case in corpus.make_analyzer_faults(seed):
+            root = _store_dir(
+                tmp, case.kind, case.rules,
+                allow_rules=[case.rules[i].name
+                             for i in case.allow_idx])
+            rc = run(["analyze", "--config-store", root, "--json"])
+            if rc == 0:
+                failures.append(f"`mixs analyze` exited 0 on the "
+                                f"seeded {case.kind} store")
+
+
+def _admission_leg(seed: int, failures: list[str]) -> None:
+    from istio_tpu.kube.admission import (register_analysis_admission,
+                                          register_istio_admission)
+    from istio_tpu.kube.fake import AdmissionDenied, FakeKubeCluster
+    from istio_tpu.testing import corpus
+
+    def obj(kind, name, ns, spec):
+        return {"kind": kind,
+                "metadata": {"name": name, "namespace": ns},
+                "spec": spec}
+
+    for case in corpus.make_analyzer_faults(seed):
+        cluster = FakeKubeCluster()
+        register_istio_admission(cluster)
+        register_analysis_admission(
+            cluster, default_manifest=corpus.ANALYZER_MANIFEST)
+        cluster.create(obj("handler", "denyall", "istio-system",
+                           {"adapter": "denier", "params": {}}))
+        cluster.create(obj("handler", "wl", "istio-system",
+                           {"adapter": "list",
+                            "params": {"overrides": ["ns1"],
+                                       "blacklist": False}}))
+        allow = {case.rules[i].name for i in case.allow_idx}
+        *setup, fault = case.rules
+        try:
+            for r in setup:
+                handler = "wl.istio-system" if r.name in allow \
+                    else "denyall.istio-system"
+                cluster.create(obj(
+                    "rule", r.name, r.namespace or "istio-system",
+                    {"match": r.match,
+                     "actions": [{"handler": handler,
+                                  "instances": []}]}))
+        except AdmissionDenied as exc:
+            failures.append(f"{case.kind}: clean setup rule rejected "
+                            f"at admission: {exc}")
+            continue
+        try:
+            handler = "wl.istio-system" if fault.name in allow \
+                else "denyall.istio-system"
+            cluster.create(obj(
+                "rule", fault.name, fault.namespace or "istio-system",
+                {"match": fault.match,
+                 "actions": [{"handler": handler, "instances": []}]}))
+            failures.append(f"{case.kind}: admission ADMITTED the "
+                            f"seeded fault rule {fault.name}")
+        except AdmissionDenied:
+            pass
+
+
+def main(seed: int = 20260803) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    failures: list[str] = []
+    _clean_leg(seed, failures)
+    _fault_leg(seed, failures)
+    _cli_leg(seed, failures)
+    _admission_leg(seed, failures)
+    for f in failures:
+        print(f"analyze_gate: FAIL: {f}")
+    if not failures:
+        print(f"analyze_gate: ok (seed={seed}: 4 fault classes + "
+              f"plane divergence detected on every surface, clean "
+              f"configs silent)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=20260803,
+                    help="reproducible corpus seed")
+    args = ap.parse_args()
+    sys.exit(main(seed=args.seed))

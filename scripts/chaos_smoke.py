@@ -56,7 +56,11 @@ def _deny_bags(n: int = 4) -> list:
     }) for i in range(n)]
 
 
-def main(n_rules: int = 24, n_checks: int = 40) -> int:
+def main(n_rules: int = 24, n_checks: int = 40,
+         seed: int | None = None) -> int:
+    """`seed` threads end-to-end into the workload generators
+    (rule constants + request bags) so a chaos corpus replays
+    identically across CI runs; None = the legacy fixed corpus."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from istio_tpu.introspect import IntrospectServer
     from istio_tpu.runtime import RuntimeServer, ServerArgs
@@ -69,7 +73,7 @@ def main(n_rules: int = 24, n_checks: int = 40) -> int:
 
     failures: list[str] = []
     CHAOS.reset()
-    store = workloads.make_store(n_rules)
+    store = workloads.make_store(n_rules, seed=seed)
     srv = RuntimeServer(store, ServerArgs(
         batch_window_s=0.0005, max_batch=16, buckets=(8, 16),
         check_queue_cap=32, breaker_failures=2, breaker_reset_s=0.3,
@@ -80,7 +84,9 @@ def main(n_rules: int = 24, n_checks: int = 40) -> int:
         if plan is not None:
             plan.prewarm((8, 16))
         port = intro.start()
-        bags = workloads.make_bags(n_checks) + _deny_bags()
+        bags = workloads.make_bags(
+            n_checks, seed=1 if seed is None else seed) \
+            + _deny_bags()
 
         # clean-path statuses = the conformance baseline
         clean = [srv.check(b).status_code for b in bags]
@@ -199,5 +205,7 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--rules", type=int, default=24)
     ap.add_argument("--checks", type=int, default=40)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="reproducible corpus seed (rules + bags)")
     args = ap.parse_args()
-    sys.exit(main(args.rules, args.checks))
+    sys.exit(main(args.rules, args.checks, seed=args.seed))

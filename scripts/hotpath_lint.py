@@ -1,0 +1,207 @@
+"""Hot-path lint: ban host-sync calls in the serving batch-build/step
+sections.
+
+The serving hot path (batch build in `runtime/batcher.py`, the fused
+check/report paths in `runtime/dispatcher.py`, the packed device trips
+in `runtime/fused.py`) is engineered around ONE host<->device sync per
+batch — every extra pull costs a full transport RTT (~120ms behind the
+axon tunnel) and a stray `.item()` or `float(jnp_sum(...))` silently
+serializes the pipeline. This AST lint walks the configured hot
+functions and flags:
+
+  * `.item()` calls and `jax.device_get` / `block_until_ready` —
+    always a device sync;
+  * `np.asarray(...)` / `np.array(...)` — a device pull when fed a
+    device buffer (list/list-comp literals are auto-allowed);
+  * `float()` / `int()` / `bool()` whose argument is a CALL expression
+    (`float(x.sum())` syncs the computation it wraps);
+  * blocking I/O on the flusher/dispatcher threads: `open`, `print`,
+    `input`, `time.sleep`, subprocess/urllib/requests use.
+
+Deliberate boundary crossings — THE designated pull, host-numpy work
+after it — carry a `# hotpath: sync-ok` pragma on the offending line;
+the lint enforces that every crossing is annotated, so a new sync in a
+hot section is a conscious, reviewable decision, never an accident.
+
+Usage: python scripts/hotpath_lint.py [--root DIR]   (exit 1 on
+violations; tier-1 runs main() via tests/test_hotpath_lint.py)
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+PRAGMA = "hotpath: sync-ok"
+
+# file (repo-relative) → hot function qualnames (Class.method); the
+# batch-build/step sections of the serving path. Additions here are
+# the review surface when the hot path grows.
+HOT_SECTIONS: dict[str, frozenset[str]] = {
+    "istio_tpu/runtime/batcher.py": frozenset({
+        "CheckBatcher.submit", "CheckBatcher._loop",
+        "CheckBatcher._flush", "CheckBatcher._shed_stale",
+        "CheckBatcher._run_one", "CheckBatcher._min_deadline",
+        "CheckBatcher._drain_on_close",
+    }),
+    "istio_tpu/runtime/dispatcher.py": frozenset({
+        "Dispatcher.check", "Dispatcher._check_fused",
+        "Dispatcher._resolve", "Dispatcher._overlay_fallback",
+        "Dispatcher._overlay_active",
+        "Dispatcher._tensorize_for_device",
+        "Dispatcher._ns_ids_from_batch",
+        "Dispatcher._request_ns_ids",
+        "Dispatcher._report_active_fused",
+        "Dispatcher._apply_device_status", "Dispatcher._combine",
+    }),
+    "istio_tpu/runtime/fused.py": frozenset({
+        "FusedPlan.packed_check", "FusedPlan.packed_report",
+        "FusedPlan.packed_check_instep",
+    }),
+}
+
+_SYNC_ATTRS = ("item", "block_until_ready")
+_PULL_FUNCS = {("np", "asarray"), ("np", "array"),
+               ("numpy", "asarray"), ("numpy", "array"),
+               ("jax", "device_get")}
+_CAST_FUNCS = {"float", "int", "bool"}
+_BLOCKING_NAMES = {"open", "input", "print", "breakpoint"}
+_BLOCKING_ATTRS = {("time", "sleep")}
+_BLOCKING_MODULES = {"subprocess", "urllib", "requests", "socket"}
+
+
+@dataclasses.dataclass
+class Violation:
+    path: str
+    line: int
+    func: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.func}] {self.message}"
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    """Attribute/Name chain → ('np', 'asarray') etc."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+class _HotVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, func: str, lines: list[str],
+                 out: list[Violation]):
+        self.path = path
+        self.func = func
+        self.lines = lines
+        self.out = out
+
+    def _pragma(self, node: ast.AST) -> bool:
+        line = self.lines[node.lineno - 1] \
+            if 0 < node.lineno <= len(self.lines) else ""
+        return PRAGMA in line
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        if not self._pragma(node):
+            self.out.append(Violation(self.path, node.lineno,
+                                      self.func, message))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _SYNC_ATTRS:
+                self._flag(node, f".{fn.attr}() is a host sync")
+            chain = _dotted(fn)
+            if chain is not None:
+                if chain[-2:] in _PULL_FUNCS or chain in _PULL_FUNCS:
+                    # list/list-comp literals are provably host-side
+                    arg = node.args[0] if node.args else None
+                    if not isinstance(arg, (ast.List, ast.ListComp)):
+                        self._flag(node,
+                                   f"{'.'.join(chain)}() pulls device "
+                                   f"buffers to host")
+                if chain[:2] in _BLOCKING_ATTRS \
+                        or chain[0] in _BLOCKING_MODULES:
+                    self._flag(node, f"blocking call "
+                                     f"{'.'.join(chain)}()")
+        elif isinstance(fn, ast.Name):
+            if fn.id in _CAST_FUNCS and node.args \
+                    and isinstance(node.args[0], ast.Call):
+                self._flag(node, f"{fn.id}(<call>) syncs the wrapped "
+                                 f"computation")
+            if fn.id in _BLOCKING_NAMES:
+                self._flag(node, f"blocking builtin {fn.id}()")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, hot_names: frozenset[str],
+                path: str = "<memory>") -> list[Violation]:
+    """AST-lint one module's hot functions; importable for tests."""
+    tree = ast.parse(source)
+    lines = source.splitlines()
+    out: list[Violation] = []
+
+    def walk(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                if qual in hot_names:
+                    _HotVisitor(path, qual, lines, out).visit(child)
+                else:
+                    # nested defs inside a hot function are covered by
+                    # the visitor above; nested hot names still match
+                    walk(child, f"{qual}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def main(root: str | None = None) -> int:
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    violations: list[Violation] = []
+    for rel, hot in sorted(HOT_SECTIONS.items()):
+        path = os.path.join(root, rel)
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        found = {name.split(".")[-1] for name in hot}
+        present = set()
+        tree = ast.parse(source)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                present.add(node.name)
+        missing = found - present
+        if missing:
+            violations.append(Violation(
+                rel, 1, "<config>",
+                f"hot functions {sorted(missing)} no longer exist — "
+                f"update HOT_SECTIONS"))
+        violations.extend(lint_source(source, hot, rel))
+    for v in violations:
+        print(f"hotpath_lint: {v}")
+    if not violations:
+        n = sum(len(v) for v in HOT_SECTIONS.values())
+        print(f"hotpath_lint: ok ({n} hot functions across "
+              f"{len(HOT_SECTIONS)} files clean)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=None)
+    sys.exit(main(root=ap.parse_args().root))

@@ -1,0 +1,375 @@
+"""Snapshot analyzer (istio_tpu/analysis) unit + property tests.
+
+The load-bearing property (ISSUE 3 satellite): every conflict/shadow
+finding the analyzer reports ships a concrete witness attribute bag,
+and replaying that witness through expr/oracle.py independently
+confirms the verdict — over seeded worlds, not hand-picked examples.
+Plus decision-procedure units (product-DFA emptiness/inclusion over
+ops/regex_dfa tables, atom implication, witness solving), budget
+prediction, plane divergence, namespace scoping, the route-table
+precedence shadow, and the /debug/analysis introspect view.
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from istio_tpu.analysis import (Severity, analyze_route_table,
+                                analyze_rules, analyze_snapshot,
+                                check_plane_pairs)
+from istio_tpu.analysis import atoms as A
+from istio_tpu.analysis import dfa_ops
+from istio_tpu.analysis.findings import (ALLOW_DENY_CONFLICT, DNF_BUDGET,
+                                         NON_TOTAL, PLANE_DIVERGENCE,
+                                         SHADOWED_ROUTE, SHADOWED_RULE,
+                                         STATE_BUDGET)
+from istio_tpu.attribute.bag import DictBag
+from istio_tpu.attribute.types import ValueType as V
+from istio_tpu.compiler.ruleset import Rule, _rule_ast
+from istio_tpu.expr.checker import AttributeDescriptorFinder
+from istio_tpu.expr.oracle import OracleProgram
+from istio_tpu.expr.parser import parse
+from istio_tpu.ops.regex_dfa import compile_regex
+from istio_tpu.testing import corpus
+
+FINDER = AttributeDescriptorFinder(corpus.ANALYZER_MANIFEST)
+
+
+# ---------------------------------------------------------------------------
+# product-DFA decision procedures
+# ---------------------------------------------------------------------------
+
+def test_product_intersect_witness_replays():
+    a = compile_regex("^/api/v[0-9]+/")
+    b = compile_regex("^/api/v2/items")
+    r = dfa_ops.product_intersect(a, b)
+    assert r.empty is False
+    from istio_tpu.ops.regex_dfa import dfa_matches_host
+    assert dfa_matches_host(a, r.witness)
+    assert dfa_matches_host(b, r.witness)
+
+
+def test_product_disjoint_and_inclusion():
+    a = compile_regex("^/api/")
+    b = compile_regex("^/static/")
+    assert dfa_ops.languages_disjoint(a, b) is True
+    narrow = compile_regex("^/api/v1/")
+    assert dfa_ops.language_includes(a, narrow) is True
+    assert dfa_ops.language_includes(narrow, a) is False
+
+
+def test_complement_flips_membership():
+    a = compile_regex("^abc$")
+    na = dfa_ops.complement(a)
+    from istio_tpu.ops.regex_dfa import dfa_matches_host
+    assert dfa_matches_host(a, b"abc") and not dfa_matches_host(na, b"abc")
+    assert not dfa_matches_host(a, b"zz") and dfa_matches_host(na, b"zz")
+
+
+def test_accepted_strings_respects_forbid():
+    a = compile_regex("^x[ab]$")
+    ws = dfa_ops.accepted_strings(a, limit=4,
+                                  forbid=frozenset({"xa"}))
+    decoded = [w.decode() for w in ws]
+    assert "xa" not in decoded and "xb" in decoded
+
+
+# ---------------------------------------------------------------------------
+# atom semantics
+# ---------------------------------------------------------------------------
+
+def _sem(text: str) -> A.AtomSem:
+    return A.atom_sem(parse(text), FINDER)
+
+
+def test_atom_eq_disjoint_and_implies():
+    a = _sem('request.method == "GET"')
+    b = _sem('request.method == "POST"')
+    assert A.atoms_disjoint(a, b) is True
+    assert A.atom_implies(a, a) is True
+    neq = _sem('request.method != "POST"')
+    assert A.atom_implies(a, neq) is True
+    assert A.atoms_disjoint(b, neq) is True
+
+
+def test_opaque_polarity_never_self_implies():
+    """The m- and n-literals of ONE undecidable atom share a source
+    but are mutually exclusive — implication across polarities would
+    let a predicate shadow its own negation (unsound)."""
+    sem = A.atom_sem(parse('request.path.startsWith(source.user)'),
+                     FINDER)
+    assert sem.kind == "opaque"
+    neg = A.negate(sem)
+    assert A.atom_implies(sem, neg) is None
+    assert A.atom_implies(neg, sem) is None
+    assert A.atom_implies(sem, sem) is True
+    assert A.atoms_disjoint(sem, neg) is True
+    # eqv literals: same guarantee
+    ev = _sem("source.namespace == source.user")
+    nev = A.negate(ev)
+    assert ev.kind == "eqv"
+    assert A.atom_implies(ev, nev) is None
+    assert A.atom_implies(ev, ev) is True
+
+
+def test_atom_eq_implies_regex():
+    eq = _sem('request.path == "/api/v1/x"')
+    rx = _sem('"^/api/".matches(request.path)')
+    assert A.atom_implies(eq, rx) is True
+    assert A.atom_implies(rx, eq) is None      # not decidable that way
+
+
+def test_probe_subject_default_semantics():
+    sem = _sem('(request.headers["k"] | "dflt") == "v"')
+    assert sem.kind == "eq" and sem.subject.kind == "map"
+    assert sem.subject.has_default and sem.subject.default == "dflt"
+    bag = A.solve_subjects([sem], FINDER)
+    assert bag == {"request.headers": {"k": "v"}}
+    # satisfying eq-to-the-default keeps the key ABSENT
+    sem2 = _sem('(request.headers["k"] | "dflt") == "dflt"')
+    assert A.solve_subjects([sem2], FINDER) == {}
+
+
+def test_solve_unsat_and_slot_slot():
+    a = _sem('request.method == "GET"')
+    b = _sem('request.method == "POST"')
+    with pytest.raises(A.WitnessUnsat):
+        A.solve_subjects([a, b], FINDER)
+    eqv = _sem("source.namespace == source.user")
+    bag = A.solve_subjects([eqv, _sem('source.user == "sa1"')], FINDER)
+    assert bag["source.namespace"] == bag["source.user"] == "sa1"
+
+
+# ---------------------------------------------------------------------------
+# the witness property (seeded, satellite requirement)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [3, 11, 20260803])
+def test_every_shadow_conflict_finding_ships_replayable_witness(seed):
+    for case in corpus.make_analyzer_faults(seed):
+        rep = analyze_rules(case.rules, FINDER,
+                            deny_idx=case.deny_idx,
+                            allow_idx=case.allow_idx,
+                            check_totality=False)
+        sem_findings = [f for f in rep.findings
+                        if f.code in (SHADOWED_RULE,
+                                      ALLOW_DENY_CONFLICT)]
+        if case.kind in (SHADOWED_RULE, ALLOW_DENY_CONFLICT):
+            assert sem_findings, f"seed {seed}: {case.kind} missed"
+        by_name = {r.name: r for r in case.rules}
+        for f in sem_findings:
+            assert f.witness is not None and f.confirmed
+            for rname in f.rules:
+                prog = OracleProgram.from_ast(
+                    _rule_ast(by_name[rname]), FINDER)
+                assert prog.evaluate(DictBag(dict(f.witness))) is True, \
+                    f"witness does not replay for {rname}"
+
+
+def test_clean_world_raises_nothing():
+    rules = corpus.make_analyzer_clean_rules(5)
+    rep = analyze_rules(rules, FINDER,
+                        deny_idx=tuple(range(len(rules))),
+                        check_totality=False)
+    assert rep.findings == []
+
+
+# ---------------------------------------------------------------------------
+# scoping, totality, budget
+# ---------------------------------------------------------------------------
+
+def test_namespace_scoping_blocks_cross_ns_shadow():
+    r1 = Rule(name="a", match='request.method == "GET"',
+              namespace="ns1")
+    r2 = Rule(name="b", match='request.method == "GET"',
+              namespace="ns2")
+    rep = analyze_rules([r1, r2], FINDER, check_totality=False)
+    assert not [f for f in rep.findings if f.code == SHADOWED_RULE]
+    # default-ns rule covers every namespace → shadow fires
+    r0 = Rule(name="g", match='request.method == "GET"')
+    rep = analyze_rules([r0, r1], FINDER, check_totality=False)
+    hits = [f for f in rep.findings if f.code == SHADOWED_RULE]
+    assert hits and hits[0].rules == ("g", "a")
+
+
+def test_non_total_flagged_and_guarded_predicate_clean():
+    hard = Rule(name="hard", match='request.method == "GET"')
+    guarded = Rule(name="soft",
+                   match='(request.method | "GET") == "GET"')
+    rep = analyze_rules([hard, guarded], FINDER)
+    nt = {f.rules[0] for f in rep.findings if f.code == NON_TOTAL}
+    assert nt == {"hard"}
+
+
+def test_budget_findings():
+    boom = Rule(name="boom",
+                match='"(a|b)*a(a|b){13}$".matches(request.path)')
+    rep = analyze_rules([boom], FINDER, check_totality=False)
+    assert [f.code for f in rep.errors] == [STATE_BUDGET]
+
+    # DNF blowup: (a1||b1)&&(a2||b2)&&... doubles conjunctions per
+    # clause — 9 clauses > the cap of 128 → WARNING + host fallback
+    clause = '(request.method == "m{i}" || source.namespace == "n{i}")'
+    match = " && ".join(clause.replace("{i}", str(i)) for i in range(9))
+    rep = analyze_rules([Rule(name="wide", match=match)], FINDER,
+                        check_totality=False)
+    assert DNF_BUDGET in {f.code for f in rep.warnings}
+
+
+# ---------------------------------------------------------------------------
+# planes
+# ---------------------------------------------------------------------------
+
+def test_plane_equivalence_proved_for_reordered_conjuncts():
+    p1 = ('destination.service == "a.ns1.svc" && '
+          'request.method == "GET"')
+    p2 = ('request.method == "GET" && '
+          'destination.service == "a.ns1.svc"')
+    assert check_plane_pairs([("r", p1, p2)], FINDER) == []
+
+
+def test_plane_divergence_isolated_with_witness():
+    pairs, diverge_at = corpus.make_plane_divergence_pairs(17)
+    fs = check_plane_pairs(pairs, FINDER)
+    div = [f for f in fs if f.code == PLANE_DIVERGENCE]
+    assert len(div) == 1
+    assert f"route{diverge_at}" in div[0].rules
+    assert div[0].witness is not None and div[0].confirmed
+
+
+# ---------------------------------------------------------------------------
+# route table + snapshot orchestration
+# ---------------------------------------------------------------------------
+
+def _route_world(specs):
+    from istio_tpu.pilot.model import Config, ConfigMeta, Port, Service
+    from istio_tpu.pilot.route_nfa import RouteTable
+
+    host = "svc0.default.svc.cluster.local"
+    services = [Service(hostname=host, address="10.9.1.1",
+                        ports=(Port("http", 9080, "HTTP"),))]
+    rules = [Config(ConfigMeta(type="route-rule", name=f"rr{i}",
+                               namespace="default"), spec)
+             for i, spec in enumerate(specs)]
+    return RouteTable(services, {host: rules})
+
+
+def test_route_precedence_shadow_detected():
+    rt = _route_world([
+        {"destination": {"name": "svc0"}, "precedence": 2,
+         "match": {"request": {"headers": {
+             "uri": {"prefix": "/api/"}}}},
+         "route": [{"labels": {"version": "v1"}}]},
+        {"destination": {"name": "svc0"}, "precedence": 1,
+         "match": {"request": {"headers": {
+             "uri": {"prefix": "/api/v1/"}}}},
+         "route": [{"labels": {"version": "v2"}}]},
+    ])
+    rep = analyze_route_table(rt)
+    hits = [f for f in rep.findings if f.code == SHADOWED_ROUTE]
+    assert len(hits) == 1 and "rr1" in hits[0].rules[1]
+    assert hits[0].witness is not None
+    # disjoint prefixes at equal precedence: clean
+    rt2 = _route_world([
+        {"destination": {"name": "svc0"}, "precedence": 1,
+         "match": {"request": {"headers": {
+             "uri": {"prefix": "/api/"}}}},
+         "route": [{"labels": {"version": "v1"}}]},
+        {"destination": {"name": "svc0"}, "precedence": 1,
+         "match": {"request": {"headers": {
+             "uri": {"prefix": "/static/"}}}},
+         "route": [{"labels": {"version": "v2"}}]},
+    ])
+    assert analyze_route_table(rt2).findings == []
+
+
+def test_snapshot_analysis_action_aware():
+    """A narrower rule with DIFFERENT actions is layered policy (no
+    shadow); with the SAME action it is dead config (shadow)."""
+    from istio_tpu.runtime.config import SnapshotBuilder
+    from istio_tpu.runtime.store import MemStore
+    from istio_tpu.testing.workloads import MESH_MANIFEST
+
+    def build(narrow_handler):
+        s = MemStore()
+        s.set(("handler", "istio-system", "denyall"),
+              {"adapter": "denier", "params": {}})
+        s.set(("handler", "istio-system", "prom"),
+              {"adapter": "prometheus", "params": {"metrics": []}})
+        s.set(("rule", "istio-system", "broad"), {
+            "match": 'destination.service == "a.ns1.svc"',
+            "actions": [{"handler": "denyall", "instances": []}]})
+        s.set(("rule", "istio-system", "narrow"), {
+            "match": 'destination.service == "a.ns1.svc" && '
+                     'connection.mtls',
+            "actions": [{"handler": narrow_handler, "instances": []}]})
+        return SnapshotBuilder(MESH_MANIFEST).build(s)
+
+    same = analyze_snapshot(build("denyall"))
+    assert SHADOWED_RULE in same.codes()
+    layered = analyze_snapshot(build("prom"))
+    assert SHADOWED_RULE not in layered.codes()
+
+
+def test_admission_delta_not_masked_by_preexisting_error():
+    """A pre-existing config error (landed before the hook) must not
+    mask NEW errors: the delta key includes the finding message, so
+    two distinct ill-typed rules never collapse to one key."""
+    from istio_tpu.kube.admission import (register_analysis_admission,
+                                          register_istio_admission)
+    from istio_tpu.kube.fake import AdmissionDenied, FakeKubeCluster
+
+    cluster = FakeKubeCluster()
+    # 'old-bad' lands UNGATED (before the analyzer hook registers)
+    cluster.create({"kind": "rule",
+                    "metadata": {"name": "old-bad",
+                                 "namespace": "istio-system"},
+                    "spec": {"match": 'ghost.attr == "x"',
+                             "actions": []}})
+    register_istio_admission(cluster)
+    register_analysis_admission(
+        cluster, default_manifest=corpus.ANALYZER_MANIFEST)
+    with pytest.raises(AdmissionDenied):
+        cluster.create({"kind": "rule",
+                        "metadata": {"name": "new-bad",
+                                     "namespace": "istio-system"},
+                        "spec": {"match": 'other.attr == "y"',
+                                 "actions": []}})
+    # and a clean write still passes despite the pre-existing error
+    cluster.create({"kind": "rule",
+                    "metadata": {"name": "fine",
+                                 "namespace": "istio-system"},
+                    "spec": {"match": 'request.method == "GET"',
+                             "actions": []}})
+
+
+def test_debug_analysis_endpoint():
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.testing import workloads
+    from istio_tpu.utils import tracing
+
+    store = workloads.make_store(18)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=16,
+        default_manifest=workloads.MESH_MANIFEST))
+    intro = IntrospectServer(runtime=srv, trace_capacity=0)
+    intro.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{intro.port}/debug/analysis",
+                timeout=30) as r:
+            payload = json.loads(r.read())
+        assert payload["generation"] >= 1
+        assert payload["n_errors"] == 0 and payload["n_warnings"] == 0
+        assert "findings" in payload and "wall_ms" in payload
+        # memoized per revision: second scrape is the cached report
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{intro.port}/debug/analysis",
+                timeout=30) as r:
+            assert json.loads(r.read()) == payload
+    finally:
+        intro.close()
+        srv.close()
+        tracing.shutdown()

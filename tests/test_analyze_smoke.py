@@ -1,0 +1,25 @@
+"""Tier-1 hook for scripts/analyze_gate.py: the CI gate that the
+snapshot analyzer flags 100% of the seeded fault corpus (shadowed
+rule, ALLOW/DENY conflict, type error, NFA state-budget blow-up,
+Pilot/Mixer plane divergence) with oracle-confirmed witnesses, raises
+ZERO findings on the golden/clean configs, exits `mixs analyze`
+non-zero on ERROR findings, and rejects the same snapshots at kube
+admission. Runs main() in-process (the introspect_smoke pattern; the
+script stays runnable standalone under JAX_PLATFORMS=cpu)."""
+import importlib.util
+import os
+import sys
+
+
+def test_analyze_gate_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "analyze_gate.py")
+    spec = importlib.util.spec_from_file_location("analyze_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(seed=20260803)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0

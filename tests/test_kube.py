@@ -16,7 +16,6 @@ from istio_tpu.attribute.bag import bag_from_mapping
 from istio_tpu.kube import (AdmissionDenied, CrdStore, FakeKubeCluster,
                             IngressController, KubeConfigStore,
                             KubeServiceRegistry,
-                            ServiceAccountSecretController,
                             register_istio_admission)
 from istio_tpu.models.policy_engine import OK, PERMISSION_DENIED
 from istio_tpu.pilot.model import Config, ConfigMeta, MemoryConfigStore
@@ -262,6 +261,11 @@ def test_ingress_controller_emits_rules():
 # ---------------------------------------------------------------------------
 
 def test_service_account_secret_controller():
+    # the SA-secret controller needs the PKI stack; containers without
+    # `cryptography` keep the REST of this module's coverage (config
+    # watch, registries, admission) instead of dying at collection
+    pytest.importorskip("cryptography")
+    from istio_tpu.kube import ServiceAccountSecretController
     from istio_tpu.security import IstioCA
     from istio_tpu.security.pki import load_cert, san_uris, verify_chain
 
