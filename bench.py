@@ -74,6 +74,53 @@ def _med3(ts) -> tuple:
     return ts[len(ts) // 2], ts[0], ts[-1]
 
 
+def _telemetry_overhead_fields(srv, prefix: str, n_reqs: int = 256,
+                               steps: int = 4) -> dict:
+    """Rule-telemetry cost ledger for a SERVED scenario: checks/sec
+    through the in-process serving path with the on-device per-rule
+    accumulators ON vs OFF, plus one drain's wall time (the device→
+    host delta pull). Fail-soft by contract (ISSUE 4): a scenario
+    without a fused plan/telemetry — or any measurement error — emits
+    a note, never takes the scenario's headline numbers down."""
+    try:
+        from istio_tpu.testing import workloads
+
+        plan = srv.controller.dispatcher.fused
+        tele = getattr(plan, "telemetry", None) if plan is not None \
+            else None
+        if tele is None:
+            return {prefix + "telemetry_note":
+                    "no fused plan / telemetry disabled"}
+        bags = workloads.make_bags(n_reqs)
+
+        def cps() -> float:
+            srv.check_many(bags)            # warm (jit, memo paths)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                srv.check_many(bags)
+            return steps * len(bags) / (time.perf_counter() - t0)
+
+        on = cps()
+        plan.telemetry = None
+        try:
+            off = cps()
+        finally:
+            plan.telemetry = tele
+        t0 = time.perf_counter()
+        srv.rulestats.drain()
+        drain_ms = (time.perf_counter() - t0) * 1e3
+        overhead = (off - on) / off * 100.0 if off > 0 else 0.0
+        return {
+            prefix + "telemetry_overhead_pct": round(overhead, 2),
+            prefix + "telemetry_on_checks_per_sec": round(on, 1),
+            prefix + "telemetry_off_checks_per_sec": round(off, 1),
+            prefix + "telemetry_drain_ms": round(drain_ms, 3),
+        }
+    except Exception as exc:
+        return {prefix + "telemetry_error":
+                f"{type(exc).__name__}: {exc}"}
+
+
 def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -545,6 +592,56 @@ def _full_mesh_bench(on_tpu: bool) -> dict:
         med, t_min, t_max = _med3(ts)
         denied = float(np.asarray(status != 0).mean())
         routed = float(np.asarray(route != default_route).mean())
+        # rule-telemetry overhead at full-mesh scale (ISSUE 4
+        # acceptance gate: ≤ 5%): the same verdict step chained with
+        # vs without the per-rule accumulator fold. Engine-level — the
+        # full_mesh scenario has no served front, so the fold rides
+        # the raw step exactly as packed_check would carry it.
+        tele_fields: dict = {}
+        try:
+            from istio_tpu.runtime.rulestats import RuleTelemetry
+
+            tele = RuleTelemetry(engine.ruleset,
+                                 engine.ruleset.n_rules)
+            vstep = jax.jit(raw)
+            ns_np = np.zeros(batch, np.int32)
+            real = np.ones(batch, bool)
+
+            def window(observe: bool) -> float:
+                c = counts
+                v, c = vstep(params, ab, ns, c)     # warm
+                if observe:
+                    tele.observe(v, ns_np, real)
+                    tele.wait()
+                jax.block_until_ready(v.status)
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    v, c = vstep(params, ab, ns, c)
+                    if observe:
+                        tele.observe(v, ns_np, real)
+                if observe:
+                    tele.wait()
+                jax.block_until_ready(v.status)
+                return (time.perf_counter() - t0 - sync_s) / steps
+
+            t_off = _med3([window(False) for _ in range(3)])[0]
+            t_on = _med3([window(True) for _ in range(3)])[0]
+            t0 = time.perf_counter()
+            tele.drain()
+            drain_ms = (time.perf_counter() - t0) * 1e3
+            overhead = (t_on - t_off) / t_off * 100.0
+            tele_fields = {
+                "full_mesh_telemetry_overhead_pct": round(overhead, 2),
+                "full_mesh_telemetry_overhead_ok":
+                    bool(overhead <= 5.0),
+                "full_mesh_telemetry_step_on_ms": round(t_on * 1e3, 3),
+                "full_mesh_telemetry_step_off_ms": round(
+                    t_off * 1e3, 3),
+                "full_mesh_telemetry_drain_ms": round(drain_ms, 3),
+            }
+        except Exception as exc:   # fail-soft like the served fields
+            tele_fields = {"full_mesh_telemetry_error":
+                           f"{type(exc).__name__}: {exc}"}
         n_preds = n_services + meta["n_routes"] + meta["n_triples"]
         baseline = 1e9 / (PER_PREDICATE_NS * n_preds + 1000.0)
         cps = batch / med
@@ -566,7 +663,8 @@ def _full_mesh_bench(on_tpu: bool) -> dict:
                 # routed+rbac-denied, conformant SAN/authz, random)
                 "full_mesh_traffic_mix": list(workloads.FULL_MESH_MIX),
                 "full_mesh_baseline_checks_per_sec": round(baseline, 1),
-                "full_mesh_vs_baseline": round(cps / baseline, 2)}
+                "full_mesh_vs_baseline": round(cps / baseline, 2),
+                **tele_fields}
     except Exception as exc:
         return {"full_mesh_error": f"{type(exc).__name__}: {exc}"}
 
@@ -1373,6 +1471,9 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             except Exception as exc:
                 report_fields = {"served_report_error":
                                  f"{type(exc).__name__}: {exc}"}
+            # rule-telemetry cost for THIS served scenario (ISSUE 4
+            # acceptance: accumulators-on vs off + drain wall)
+            tele_fields = _telemetry_overhead_fields(srv, "served_")
         finally:
             g.stop()
             srv.close()
@@ -1394,6 +1495,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             **light_fields,
             **batched_fields,
             **report_fields,
+            **tele_fields,
             "device_sync_ms": round(sync_ms, 1),
             **_grpc_ceiling_fields(),
             **counter_fields(),
@@ -1550,6 +1652,8 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                     _mon.reset_latency_window()
             except Exception:
                 stage_fields = {}
+            tele_fields = _telemetry_overhead_fields(
+                srv, "served_native_")
         finally:
             native.stop()
             srv.close()
@@ -1598,6 +1702,7 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
             "served_native_srv": counters,
             "served_native_batch_hist": hist,
             **stage_fields,
+            **tele_fields,
             # phase_errors: failures during a phase (retried once,
             # except the *-final entries whose retry also failed) —
             # phases listed in served_native_stubbed_phases emit -1.0

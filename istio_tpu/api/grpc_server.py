@@ -122,22 +122,54 @@ class MixerGrpcServer:
             return time.perf_counter() + d_ms / 1e3
         return None
 
+    @staticmethod
+    def _traceparent_from(context):
+        """Incoming W3C traceparent (grpc metadata) → parent-span dict
+        for the rpc.check root, so exemplar/server trace ids are
+        join-able with the client's trace; None (self-generated ids,
+        the previous behavior) when absent or malformed."""
+        from istio_tpu.utils import tracing
+        if context is None:
+            return None
+        try:
+            md = context.invocation_metadata()
+        except Exception:
+            return None
+        for item in md or ():
+            key, value = item[0], item[1]
+            if key == "traceparent":
+                return tracing.parent_from_traceparent(value)
+        return None
+
+    @staticmethod
+    def _tag_status(span, code) -> None:
+        """`status` tag on a check span — "ok" or the google.rpc /
+        grpc code — so /debug/traces?status=failed can filter."""
+        if span is not None:
+            span["tags"]["status"] = "ok" if code in (0, "0") \
+                else str(code)
+
     def _check(self, request: RawCheckRequest,
                context) -> "pb.CheckResponse":
         # ROOT span at RPC decode (pkg/tracing's interceptor role):
         # the batcher's serve.batch span parents under it (submit
         # captures this thread's current span), so queue-wait is
-        # attributed to a REQUEST, not anonymously to a batch
+        # attributed to a REQUEST, not anonymously to a batch. The
+        # client's traceparent (when sent) becomes the root's parent.
         from istio_tpu.utils import tracing
-        with tracing.get_tracer().span("rpc.check"):
+        with tracing.get_tracer().span(
+                "rpc.check",
+                parent=self._traceparent_from(context)) as root:
             try:
                 bag = self._check_bag(request)
                 result = self.runtime.check_preprocessed(
                     bag, deadline=self._deadline_from(context))
+                self._tag_status(root, result.status_code)
                 return self._check_response(request, bag, result)
             except CheckRejected as exc:
                 # abort() raises — the typed rejection becomes the
                 # RPC's status instead of an INTERNAL stack trace
+                self._tag_status(root, exc.grpc_code)
                 context.abort(_reject_status(exc), str(exc))
 
     def _batch_check(self, request: RawBatchCheckRequest,
@@ -148,23 +180,35 @@ class MixerGrpcServer:
         server's prewarmed bucket shapes so arbitrary client batch
         sizes never re-trace."""
         try:
-            return self._batch_check_body(request,
-                                          self._deadline_from(context))
+            return self._batch_check_body(
+                request, self._deadline_from(context),
+                parent=self._traceparent_from(context))
         except CheckRejected as exc:
             context.abort(_reject_status(exc), str(exc))
 
     def _batch_check_body(self, request: RawBatchCheckRequest,
-                          deadline: float | None) -> bytes:
+                          deadline: float | None,
+                          parent: dict | None = None) -> bytes:
         """Span + dispatch, shared by the sync front (which aborts
         inline) and the aio front (whose abort must be awaited on the
         loop, not called from the executor thread)."""
         from istio_tpu.utils import tracing
         with tracing.get_tracer().span(
-                "rpc.batch_check", items=len(request.attributes_raw)):
-            return self._batch_check_traced(request, deadline=deadline)
+                "rpc.batch_check", parent=parent,
+                items=len(request.attributes_raw)) as span:
+            try:
+                return self._batch_check_traced(
+                    request, deadline=deadline, span=span)
+            except CheckRejected as exc:
+                # tag BEFORE the span closes: a rejected batch must
+                # show in /debug/traces?status=failed (the unary
+                # fronts tag in their own handlers)
+                self._tag_status(span, exc.grpc_code)
+                raise
 
     def _batch_check_traced(self, request: RawBatchCheckRequest,
-                            deadline: float | None = None) -> bytes:
+                            deadline: float | None = None,
+                            span: dict | None = None) -> bytes:
         gwc = request.global_word_count
         native = gwc in (0, len(GLOBAL_WORD_LIST))
         bags = [self.runtime.preprocess(
@@ -174,6 +218,9 @@ class MixerGrpcServer:
             return b""
         monitor.CHECK_REQUESTS.inc(len(bags))
         results = self._check_bags_chunked(bags, deadline=deadline)
+        first_bad = next((r.status_code for r in results
+                          if r.status_code), 0)
+        self._tag_status(span, first_bad)
         blobs = [
             self._check_response(None, bag, result,
                                  quotas=[]).SerializeToString()
@@ -397,7 +444,8 @@ class MixerAioGrpcServer(MixerGrpcServer):
         try:
             # tensorize + device step block — off the loop
             return await asyncio.get_running_loop().run_in_executor(
-                None, self._batch_check_body, request, deadline)
+                None, self._batch_check_body, request, deadline,
+                self._traceparent_from(context))
         except CheckRejected as exc:
             # aio abort is a coroutine and must run ON the loop — the
             # sync _batch_check's inline abort would no-op here
@@ -414,12 +462,14 @@ class MixerAioGrpcServer(MixerGrpcServer):
         # via the thread-local stack. The batcher parents its batch
         # span under this dict (submit trace=).
         tr = tracing.get_tracer()
-        root = tr.start_span("rpc.check")
+        root = tr.start_span("rpc.check",
+                             parent=self._traceparent_from(context))
         try:
             return await self._acheck_traced(
                 request, loop, root,
                 deadline=self._deadline_from(context))
         except CheckRejected as exc:
+            self._tag_status(root, exc.grpc_code)
             await context.abort(_reject_status(exc), str(exc))
         finally:
             tr.finish_span(root)
@@ -444,6 +494,7 @@ class MixerAioGrpcServer(MixerGrpcServer):
         result = await asyncio.shield(asyncio.wrap_future(
             self.runtime.submit_check_preprocessed(
                 bag, trace=root, deadline=deadline)))
+        self._tag_status(root, result.status_code)
         if request.quotas and result.status_code == 0:
             # fused-path quota futures bridge to the loop via
             # callbacks — an in-flight quota holds NO thread (an

@@ -224,7 +224,7 @@ class NativeMixerServer(MixerGrpcServer):
     @staticmethod
     def _parse_take(blob: bytes) -> list[tuple]:
         """→ [(tag, kind, payload, gwc, dedup, quotas{name: (amount,
-        best_effort)})]."""
+        best_effort)}, traceparent)]."""
         items = []
         (_, n) = struct.unpack_from("<II", blob, 0)
         off = 8
@@ -241,6 +241,11 @@ class NativeMixerServer(MixerGrpcServer):
             off += 8
             dedup = blob[off:off + dlen].decode("utf-8", "replace")
             off += dlen
+            (tplen,) = struct.unpack_from("<I", blob, off)
+            off += 4
+            traceparent = blob[off:off + tplen].decode(
+                "utf-8", "replace")
+            off += tplen
             (nq,) = struct.unpack_from("<H", blob, off)
             off += 2
             quotas = {}
@@ -252,7 +257,8 @@ class NativeMixerServer(MixerGrpcServer):
                 amount, be = struct.unpack_from("<qB", blob, off)
                 off += 9
                 quotas[qname] = (amount, bool(be))
-            items.append((tag, kind, payload, gwc, dedup, quotas))
+            items.append((tag, kind, payload, gwc, dedup, quotas,
+                          traceparent))
         return items
 
     def _run_batch(self, blob: bytes) -> None:
@@ -286,13 +292,24 @@ class NativeMixerServer(MixerGrpcServer):
             # the grpc fronts' rpc.check): downstream engine spans on
             # this pump thread parent under it via the thread-local
             # stack, so the batch's queue/tensorize/device time is
-            # attributed to the RPC group that paid it
+            # attributed to the RPC group that paid it. The batch
+            # parents under the FIRST row's W3C traceparent (wire
+            # header, decoded in C++) when one was sent — the same
+            # oldest-request attribution rule the batcher uses.
+            # first row whose header PARSES (a malformed header in an
+            # earlier row must not suppress a valid one behind it)
+            parent = next(
+                (p for p in (tracing.parent_from_traceparent(it[6])
+                             for it in checks if it[6])
+                 if p is not None), None)
             span_ctx = tracing.get_tracer().span(
-                "rpc.check", transport="native", batch=len(checks))
-            with span_ctx:
-                self._run_checks(checks, completions, deferred)
+                "rpc.check", parent=parent, transport="native",
+                batch=len(checks))
+            with span_ctx as span:
+                self._run_checks(checks, completions, deferred,
+                                 span=span)
 
-        for tag, _, payload, _, _, _ in reports:
+        for tag, _, payload, _, _, _, _ in reports:
             try:
                 req = pb.ReportRequest.FromString(payload)
                 self._report(req, None)
@@ -302,7 +319,7 @@ class NativeMixerServer(MixerGrpcServer):
                     (tag, 13, f"report failed: {exc}".encode()))
 
     def _run_checks(self, checks: list, completions: list,
-                    deferred: set) -> None:
+                    deferred: set, span: dict | None = None) -> None:
         monitor.CHECK_REQUESTS.inc(len(checks))
         # the C++ wire carries no per-RPC deadline — apply the
         # server-side default (--default-check-deadline-ms) from the
@@ -311,7 +328,7 @@ class NativeMixerServer(MixerGrpcServer):
         # pre-tensorize instead of queueing dead device work
         deadline = self._deadline_from(None)
         bags = []
-        for _, _, payload, gwc, _, _ in checks:
+        for _, _, payload, gwc, _, _, _ in checks:
             native = gwc in (0, len(GLOBAL_WORD_LIST))
             bags.append(self.runtime.preprocess(
                 LazyWireBag(payload, gwc or None,
@@ -326,7 +343,7 @@ class NativeMixerServer(MixerGrpcServer):
         if target is not None:
             _, by_name = target
             qspecs = []
-            for _, _, _, _, dedup, quotas in checks:
+            for _, _, _, _, dedup, quotas, _ in checks:
                 spec = None
                 if len(quotas) == 1:
                     (qname, (amount, be)), = quotas.items()
@@ -352,13 +369,22 @@ class NativeMixerServer(MixerGrpcServer):
             # answer every row with the honest status code instead of
             # letting the belt degrade it to a blanket INTERNAL
             msg = str(exc).encode()
-            for tag, _, _, _, _, _ in checks:
+            for tag, _, _, _, _, _, _ in checks:
                 completions.append((tag, exc.grpc_code, msg))
+            if span is not None:
+                span["tags"]["status"] = str(exc.grpc_code)
             return
+        # `status` tag (batch-level: ok or the first non-OK code) so
+        # /debug/traces can filter failing check spans on this front
+        if span is not None:
+            first_bad = next((r.status_code for r in results
+                              if r.status_code), 0)
+            span["tags"]["status"] = "ok" if first_bad == 0 \
+                else str(first_bad)
         memo_hits = 0
         for row, (item, bag, result) in enumerate(
                 zip(checks, bags, results)):
-            tag, _, _, _, dedup, quotas = item
+            tag, _, _, _, dedup, quotas, _ = item
             try:
                 if row in inres:
                     # quota already allocated in the check trip;

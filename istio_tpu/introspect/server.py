@@ -131,7 +131,17 @@ class IntrospectServer:
         "/debug/traces": "_h_traces",
         "/debug/resilience": "_h_resilience",
         "/debug/analysis": "_h_analysis",
+        "/debug/rulestats": "_h_rulestats",
     }
+
+    @staticmethod
+    def _query(req: BaseHTTPRequestHandler) -> dict:
+        """?k=v&... of the request path (single values, last wins)."""
+        from urllib.parse import parse_qsl
+        parts = req.path.split("?", 1)
+        if len(parts) < 2:
+            return {}
+        return dict(parse_qsl(parts[1]))
 
     def _route(self, req: BaseHTTPRequestHandler) -> None:
         path = req.path.split("?", 1)[0]
@@ -337,6 +347,18 @@ class IntrospectServer:
                                        "health_error")}
         self._send_json(req, payload)
 
+    def _analysis_for(self, snap) -> dict:
+        """Memoized analyzer report for `snap` (one run per config
+        generation — shared by /debug/analysis and the rulestats
+        never-hit cross-check)."""
+        cached = self._analysis_cache
+        if cached is None or cached[0] != snap.revision:
+            from istio_tpu.analysis import analyze_snapshot
+            report = analyze_snapshot(snap, pair_budget=50_000)
+            cached = (snap.revision, report.to_dict())
+            self._analysis_cache = cached
+        return cached[1]
+
     def _h_analysis(self, req: BaseHTTPRequestHandler) -> None:
         """Static-analysis report for the LAST published snapshot
         (istio_tpu/analysis): findings with severities, rule ids and
@@ -347,20 +369,74 @@ class IntrospectServer:
             self._send_json(req, {"error": "no runtime attached"}, 503)
             return
         snap = self.runtime.controller.dispatcher.snapshot
-        cached = self._analysis_cache
-        if cached is None or cached[0] != snap.revision:
-            from istio_tpu.analysis import analyze_snapshot
-            report = analyze_snapshot(snap, pair_budget=50_000)
-            cached = (snap.revision, report.to_dict())
-            self._analysis_cache = cached
-        self._send_json(req, {"generation": cached[0], **cached[1]})
+        payload = self._analysis_for(snap)
+        self._send_json(req, {"generation": snap.revision, **payload})
+
+    def _h_rulestats(self, req: BaseHTTPRequestHandler) -> None:
+        """Rule-level telemetry view (runtime/rulestats.py): top-K hot
+        rules with per-namespace deny rates and decision exemplars
+        (trace ids join /debug/traces), plus never-hit rules
+        cross-checked against the static analyzer's shadowed-rule
+        findings — a dead rule shows whether it is provably dead
+        (analyzer agrees) or merely unexercised. Query params:
+        `k` (top-K size, default 10), `shadow=0` (skip the analyzer
+        cross-check — it runs the memoized per-generation analysis).
+        The handler drains on demand, so the view is current even
+        between the background drainer's intervals."""
+        if self.runtime is None:
+            self._send_json(req, {"error": "no runtime attached"}, 503)
+            return
+        agg = getattr(self.runtime, "rulestats", None)
+        if agg is None:
+            self._send_json(req,
+                            {"error": "rule telemetry not wired"}, 503)
+            return
+        q = self._query(req)
+        try:
+            agg.drain()
+        except Exception:
+            log.exception("on-demand rulestats drain failed")
+        shadowed: set = set()
+        if q.get("shadow", "1") != "0":
+            try:
+                snap = self.runtime.controller.dispatcher.snapshot
+                report = self._analysis_for(snap)
+                for f in report.get("findings", ()):
+                    if f.get("code") == "shadowed-rule" and \
+                            f.get("rules"):
+                        # rules=(covering, shadowed); analyzer names
+                        # are bare — snapshot() matches them against
+                        # qualified names with an ambiguity guard
+                        shadowed.add(f["rules"][-1])
+            except Exception:
+                log.exception("rulestats analyzer cross-check failed")
+        payload = agg.snapshot(
+            top_k=int(q.get("k", 0) or 0) or None, shadowed=shadowed)
+        self._send_json(req, payload)
 
     def _h_traces(self, req: BaseHTTPRequestHandler) -> None:
+        """Recent finished spans, chronological (RingReporter).
+        `?status=X` filters by the span `status` tag: `status=failed`
+        keeps every span whose status is set and not ok/0 (the check
+        spans tag their google.rpc code), a specific value keeps exact
+        matches."""
         if self._ring is None:
             self._send_json(req, {"error": "trace ring not installed"},
                             503)
             return
+        # filter over the FULL retained ring, THEN truncate: a failed
+        # span must stay visible in ?status=failed for as long as the
+        # ring holds it, even behind a burst of newer ok spans
+        spans = self._ring.snapshot()
+        want = self._query(req).get("status")
+        if want == "failed":
+            spans = [s for s in spans
+                     if (s.get("tags") or {}).get("status")
+                     not in (None, "ok", "0")]
+        elif want:
+            spans = [s for s in spans
+                     if (s.get("tags") or {}).get("status") == want]
         self._send_json(req, {
             "dropped": self._ring.dropped,
-            "spans": self._ring.snapshot(limit=128),
+            "spans": spans[-128:],
         })

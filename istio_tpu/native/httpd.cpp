@@ -181,10 +181,12 @@ bool hpack_str(const uint8_t*& p, const uint8_t* end, std::string* out) {
 }
 
 // Decode a complete header block; collects every header (table state
-// depends on all of them) and reports the few the server routes on.
+// depends on all of them) and reports the few the server routes on —
+// plus the W3C traceparent, which rides the take blob so the python
+// engine's rpc.check root span joins the client's trace.
 bool hpack_block(HpackDecoder* dec, const uint8_t* p, size_t n,
                  std::string* path, std::string* content_type,
-                 std::string* te) {
+                 std::string* te, std::string* traceparent) {
   const uint8_t* end = p + n;
   while (p < end) {
     uint8_t b = *p;
@@ -217,6 +219,7 @@ bool hpack_block(HpackDecoder* dec, const uint8_t* p, size_t n,
     if (name == ":path") *path = value;
     else if (name == "content-type") *content_type = value;
     else if (name == "te") *te = value;
+    else if (name == "traceparent" && traceparent) *traceparent = value;
   }
   return true;
 }
@@ -369,6 +372,7 @@ bool parse_check_envelope(const uint8_t* p, size_t n, CheckEnvelope* out) {
 
 struct Stream {
   std::string path;
+  std::string traceparent;   // W3C trace context request header
   std::string body;          // gRPC-framed request bytes
   bool headers_done = false;
   bool dispatched = false;   // handed to the pump queue
@@ -384,6 +388,7 @@ struct PendingItem {
   uint8_t kind;   // 0 Check, 1 Report
   CheckEnvelope env;
   std::string report_raw;   // kind 1: full ReportRequest bytes
+  std::string traceparent;  // request's W3C trace context (may be "")
   int64_t t_enq_ns;
 };
 
@@ -596,6 +601,7 @@ void enqueue_request(Server* srv, Conn* c, uint32_t stream_id,
 
   item.tag = (static_cast<uint64_t>(c->gen) << 32) | stream_id;
   item.kind = kind;
+  item.traceparent = st->traceparent;
   item.t_enq_ns = mono_ns();
   {
     std::lock_guard<std::mutex> lk(srv->mu);
@@ -618,7 +624,7 @@ bool finish_header_block(Server* srv, Conn* c, uint32_t stream_id,
     if (!hpack_block(&c->hpack,
                      reinterpret_cast<const uint8_t*>(
                          c->cont_block.data()),
-                     c->cont_block.size(), &a, &b2, &d))
+                     c->cont_block.size(), &a, &b2, &d, nullptr))
       return false;
     if ((flags & FL_END_STREAM) && !st.dispatched)
       enqueue_request(srv, c, stream_id, &st);
@@ -628,7 +634,8 @@ bool finish_header_block(Server* srv, Conn* c, uint32_t stream_id,
   if (!hpack_block(&c->hpack,
                    reinterpret_cast<const uint8_t*>(
                        c->cont_block.data()),
-                   c->cont_block.size(), &st.path, &ct, &te))
+                   c->cont_block.size(), &st.path, &ct, &te,
+                   &st.traceparent))
     return false;
   st.headers_done = true;
   st.send_window = c->remote_initial_window;
@@ -1029,9 +1036,10 @@ int64_t h2srv_take(void* h, int32_t timeout_ms, uint8_t* buf,
   int64_t need = 8;
   for (int32_t i = 0; i < n; i++) {
     const PendingItem& it = srv->queue[i];
-    need += 8 + 1 + 4 + 4 + 4 + 2;
+    need += 8 + 1 + 4 + 4 + 4 + 4 + 2;
     need += it.kind ? it.report_raw.size() : it.env.attributes.size();
     need += it.env.dedup.size();
+    need += it.traceparent.size();
     for (const auto& q : it.env.quotas) need += 4 + q.name.size() + 9;
   }
   if (need > cap) return -need;
@@ -1051,6 +1059,8 @@ int64_t h2srv_take(void* h, int32_t timeout_ms, uint8_t* buf,
     put_u32(&out, it.env.global_word_count);
     put_u32(&out, static_cast<uint32_t>(it.env.dedup.size()));
     out += it.env.dedup;
+    put_u32(&out, static_cast<uint32_t>(it.traceparent.size()));
+    out += it.traceparent;
     uint16_t nq = static_cast<uint16_t>(it.env.quotas.size());
     out.append(reinterpret_cast<char*>(&nq), 2);
     for (const auto& q : it.env.quotas) {

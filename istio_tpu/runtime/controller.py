@@ -33,12 +33,14 @@ class Controller:
                  on_publish: Callable[[Dispatcher], None] | None = None,
                  fused: bool = True,
                  prewarm_buckets: tuple[int, ...] = (),
-                 mesh=None):
+                 mesh=None,
+                 rule_telemetry: bool = True):
         self.store = store
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
         self.on_publish = on_publish
         self.fused_enabled = fused
+        self.rule_telemetry = rule_telemetry
         self.mesh = mesh    # jax.sharding.Mesh for multi-chip serving
         self.prewarm_buckets = tuple(prewarm_buckets)
         self._builder = SnapshotBuilder(default_manifest,
@@ -92,7 +94,8 @@ class Controller:
         plan = None
         if self.fused_enabled:
             from istio_tpu.runtime.fused import build_fused_plan
-            plan = build_fused_plan(snapshot, mesh=self.mesh)
+            plan = build_fused_plan(snapshot, mesh=self.mesh,
+                                    rule_telemetry=self.rule_telemetry)
             if plan is not None and self.prewarm_buckets:
                 if self._dispatcher is not None:
                     # shadow-compile the serving shapes before the swap

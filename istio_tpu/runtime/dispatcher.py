@@ -158,13 +158,17 @@ class Dispatcher:
         return batch, ns_ids
 
     def _overlay_active(self, packed: np.ndarray, bags: Sequence[Bag],
-                        ns_ids: np.ndarray
+                        ns_ids: np.ndarray, observe: bool = False
                         ) -> tuple[np.ndarray, dict]:
         """Decode the packed step's bitpacked overlay plane →
         (ns-masked active bits [len(bags), n_overlay_cols], rule idx →
         column position). Host-fallback rules' bits are oracle-patched;
         device + host resolve errors are accounted. `bags`/`ns_ids`
-        must already be trimmed of padding rows."""
+        must already be trimmed of padding rows. `observe`: feed
+        host-fallback hits/errors into the rule-telemetry plane — set
+        only by the CHECK path (the device accumulators can't see
+        fallback rules, so their counts patch in here, exactly where
+        their verdicts do)."""
         plan, rs = self.fused, self.snapshot.ruleset
         n_err = int(packed[4, 0]) if packed.shape[1] else 0
         if n_err:
@@ -180,21 +184,35 @@ class Dispatcher:
             packed[5 + n_words:5 + n_words + n_ov_words, :n_real],
             len(cols))
         col_pos = {int(r): i for i, r in enumerate(cols)}
+        rns = rs.rule_ns[cols]
+        ns_ok_sub = (rns[None, :] == rs.ns_ids[""]) | \
+                    (rns[None, :] == ns_ids[:, None])
         host_errs = 0
+        fb_cols: list[int] = []
+        fb_pos: list[int] = []
+        err_by_rule: dict[int, int] = {}
         for ridx in rs.host_fallback:
             pos = col_pos.get(ridx)
             if pos is None:   # rbac pseudo-rule row: no overlay col
                 continue
+            fb_cols.append(ridx)
+            fb_pos.append(pos)
+            vis_errs = 0
             for b, bag in enumerate(bags):
                 m, _, e = rs.host_eval(ridx, bag)
                 active_sub[b, pos] = m
                 host_errs += e
+                if e and ns_ok_sub[b, pos]:
+                    vis_errs += 1   # oracle parity: ns-visible errors
+            if vis_errs:
+                err_by_rule[ridx] = vis_errs
         if host_errs:
             monitor.RESOLVE_ERRORS.inc(host_errs)
-        rns = rs.rule_ns[cols]
-        ns_ok_sub = (rns[None, :] == rs.ns_ids[""]) | \
-                    (rns[None, :] == ns_ids[:, None])
         active_sub &= ns_ok_sub
+        tele = plan.telemetry
+        if observe and tele is not None and (fb_cols or err_by_rule):
+            tele.add_host(fb_cols, active_sub[:, fb_pos],
+                          err_by_rule, tele.ns_slots(ns_ids))
         return active_sub, col_pos
 
     def _overlay_fallback(self, matched: np.ndarray, err: np.ndarray,
@@ -301,6 +319,11 @@ class Dispatcher:
 
         snap, plan = self.snapshot, self.fused
         tr = tracing.get_tracer()
+        # real (non-padding) prefix length, known BEFORE the device
+        # call: the telemetry fold masks padding rows on device, and
+        # every host-side pass below runs on the real prefix only
+        from istio_tpu.runtime.batcher import trim_pads
+        n_real = len(trim_pads(bags))
         with monitor.resolve_timer():
             if pre_tensorized is not None:
                 batch, ns_ids = pre_tensorized
@@ -320,7 +343,8 @@ class Dispatcher:
                     t_d = time.perf_counter()
                     q_arrays, counts, on_dispatch, on_pull = instep
                     packed_dev, new_counts = plan.packed_check_instep(
-                        batch, ns_ids, q_arrays, counts)
+                        batch, ns_ids, q_arrays, counts,
+                        n_real=n_real)
                     # the program is IN FLIGHT: on_dispatch swaps the
                     # pool onto the device-future counters and drops
                     # the token, so the next trip chains on-device
@@ -335,7 +359,8 @@ class Dispatcher:
                     # the overlay decode reads sits before them
                     on_pull(packed[-2], packed[-1] != 0)
                 else:
-                    packed = plan.packed_check(batch, ns_ids)
+                    packed = plan.packed_check(batch, ns_ids,
+                                               n_real=n_real)
             status = packed[0]
             dur = packed[1].view(np.float32)
             uses = packed[2]
@@ -348,9 +373,7 @@ class Dispatcher:
         # PadBags at the tail and zips results against real requests)
         # — at small arrival rates a 512-bucket batch is mostly
         # padding, and per-row python here is the serving CPU budget
-        from istio_tpu.runtime.batcher import trim_pads
-        bags = trim_pads(bags)
-        n_real = len(bags)
+        bags = bags[:n_real]
         ns_ids = ns_ids[:n_real]
 
         # referenced-attribute item bits (rows 5..5+W): the device
@@ -369,7 +392,8 @@ class Dispatcher:
         # subset happens in numpy; host-fallback rules are
         # oracle-evaluated into their subset positions
         # (_overlay_active, shared with the fused report path).
-        active_sub, col_pos = self._overlay_active(packed, bags, ns_ids)
+        active_sub, col_pos = self._overlay_active(packed, bags, ns_ids,
+                                                   observe=True)
         # hotpath: sync-ok x2 — tensorizer planes are host numpy
         present_np = np.asarray(batch.present)[:n_real]        # hotpath: sync-ok
         map_present_np = np.asarray(batch.map_present)[:n_real]  # hotpath: sync-ok
@@ -445,6 +469,12 @@ class Dispatcher:
         # together they are the span the serve.overlay emit reports
         t_respond = time.perf_counter()
         monitor.observe_stage("fold", t_respond - t_overlay)
+        # decision exemplars: denied/errored rows reservoir-sample into
+        # the telemetry plane (host-side, post-fold, from the already-
+        # decoded verdict) with the batch's active span so a
+        # /debug/rulestats entry links to its RingReporter trace
+        tele = plan.telemetry
+        tele_span = tr._current() if tele is not None else None
         out = []
         for b, bag in enumerate(bags):
             resp = CheckResponse()
@@ -479,6 +509,8 @@ class Dispatcher:
             if not dev_applied:
                 self._apply_device_status(resp, plan, dev_rule,
                                           int(status[b]))
+            if tele is not None and status[b] != OK:
+                tele.sample(dev_rule, int(status[b]), bag, tele_span)
             # referenced/presence: precomputed per unique signature
             if ref_of is not None:
                 resp.referenced, resp.referenced_presence = ref_of[b]

@@ -132,6 +132,12 @@ class FusedPlan:
     # — None when no report instance lowered; the dispatcher then keeps
     # the host InstanceBuilder.build for every instance
     report_lowering: Any = None
+    # on-device per-rule hit/deny/err accumulators + exemplar
+    # reservoirs (runtime/rulestats.RuleTelemetry) — None when rule
+    # telemetry is disabled (ServerArgs.rule_telemetry=False / bench
+    # off-phase). Folded by packed_check/packed_check_instep on check
+    # batches only; drained off the hot path by the aggregator.
+    telemetry: Any = None
     _report_packer: Any = None
     _instep_packer: Any = None
 
@@ -143,8 +149,8 @@ class FusedPlan:
     def n_overlay_words(self) -> int:
         return (len(self.overlay_cols) + 31) // 32
 
-    def packed_check(self, batch, ns_ids,
-                     observe: bool = True) -> np.ndarray:
+    def packed_check(self, batch, ns_ids, observe: bool = True,
+                     n_real: int | None = None) -> np.ndarray:
         """engine.check + device-side packing into ONE int32 array
         [5 + W + C, B] pulled with a single host↔device sync (W =
         n_ref_words, C = len(overlay_cols)). Pulling plane-by-plane
@@ -156,7 +162,11 @@ class FusedPlan:
         5..5+W referenced-item bits (little-endian within each int32),
         then matched[:, overlay_cols] BITPACKED the same way (raw,
         ns-unmasked) — a 1k-column overlay plane shipped as int32 was
-        8 MB/batch of D2H, ~1.6 s behind the tunnel."""
+        8 MB/batch of D2H, ~1.6 s behind the tunnel.
+
+        `n_real`: count of non-padding rows (the leading prefix);
+        rows past it are bucket padding the rule-telemetry fold must
+        ignore. None = every row is real."""
         import jax
 
         from istio_tpu.runtime import monitor
@@ -180,8 +190,16 @@ class FusedPlan:
         # fallback): only check trips feed the Check() decomposition.
         t0 = time.perf_counter()
         verdict = self.engine.check(batch, ns_ids)
-        dev = self._packer(
-            verdict, np.asarray(ns_ids))   # hotpath: sync-ok (host ids)
+        ns_arr = np.asarray(ns_ids)        # hotpath: sync-ok (host ids)
+        if observe and self.telemetry is not None:
+            # per-rule hit/deny/err fold into the resident device
+            # accumulators — async dispatch only, the drain thread
+            # pays the pull. Check traffic only (observe gates out
+            # prewarm dummies and the fused report fallback).
+            b = ns_arr.shape[0]
+            real = np.arange(b) < (b if n_real is None else n_real)
+            self.telemetry.observe(verdict, ns_arr, real)
+        dev = self._packer(verdict, ns_arr)
         t1 = time.perf_counter()
         # the single host<->device sync — hotpath: sync-ok
         out = np.asarray(dev)              # hotpath: sync-ok
@@ -295,7 +313,8 @@ class FusedPlan:
                 batch))
 
     def packed_check_instep(self, batch, ns_ids, q: Mapping[str, Any],
-                            counts) -> tuple[Any, Any]:
+                            counts,
+                            n_real: int | None = None) -> tuple[Any, Any]:
         """packed_check's rows PLUS an IN-STEP quota allocation in the
         SAME device program — the quota-carrying batch pays ONE trip
         instead of check-trip + pool-flush-trip serialized on the
@@ -355,12 +374,20 @@ class FusedPlan:
 
             self._instep_packer = jax.jit(packq)
         verdict = self.engine.check(batch, ns_ids)
+        ns_arr = np.asarray(ns_ids)        # hotpath: sync-ok (host ids)
+        if self.telemetry is not None:
+            # in-step quota batches ARE check traffic — same per-rule
+            # fold as packed_check (prewarm_instep passes n_real=0 so
+            # its dummy trips fold all-masked, counting nothing)
+            b = ns_arr.shape[0]
+            real = np.arange(b) < (b if n_real is None else n_real)
+            self.telemetry.observe(verdict, ns_arr, real)
         # DEVICE handles, not host arrays: the caller swaps the pool
         # onto new_counts at dispatch (the next trip chains on-device)
         # and pulls `packed` with the counter token already released
         return self._instep_packer(
             verdict,
-            np.asarray(ns_ids),            # hotpath: sync-ok (host ids)
+            ns_arr,
             counts,
             q["buckets"], q["amounts"], q["be"], q["mx"], q["active"],
             q["ticks"], q["lasts"], q["rolling"], q["rule_idx"])
@@ -464,7 +491,8 @@ class FusedPlan:
                  "rolling": np.zeros(b, bool),
                  "rule_idx": np.full(b, -1, np.int32)}
             packed, _cnt = self.packed_check_instep(
-                batch, np.zeros(b, np.int32), q, zero_counts)
+                batch, np.zeros(b, np.int32), q, zero_counts,
+                n_real=0)   # dummy rows must not feed rule telemetry
             np.asarray(packed)   # force compile + execute
 
     def message_for(self, rule_idx: int, status: int) -> str:
@@ -488,13 +516,17 @@ class FusedPlan:
 
 
 def build_fused_plan(snapshot: Snapshot,
-                     mesh=None) -> FusedPlan | None:
+                     mesh=None,
+                     rule_telemetry: bool = True) -> FusedPlan | None:
     """Extract fusable CHECK actions and build the snapshot's engine.
 
     `mesh` (jax.sharding.Mesh, dp×mp) re-jits the engine step under the
     multi-chip serving layout (parallel/mesh.py shard_engine_check):
     requests shard over dp, rule rows over mp, one psum on the verdict
-    fold — the SAME serving path, scaled across chips."""
+    fold — the SAME serving path, scaled across chips.
+
+    `rule_telemetry` wires per-rule hit/deny/err accumulators
+    (runtime/rulestats.RuleTelemetry) into the packed check step."""
     rs = snapshot.ruleset
     if rs.n_rules == 0:
         return None
@@ -688,7 +720,16 @@ def build_fused_plan(snapshot: Snapshot,
     real_fallback = {r for r in rs.host_fallback if r < n_real}
     overlay = set(host_actions) | real_fallback | set(unmapped) \
         | quota_rules | report_rules
+    telemetry = None
+    if rule_telemetry:
+        try:
+            from istio_tpu.runtime.rulestats import RuleTelemetry
+            telemetry = RuleTelemetry(rs, n_real)
+        except Exception:
+            log.exception("rule telemetry unavailable; serving "
+                          "without per-rule accumulators")
     return FusedPlan(engine=engine, native=native,
+                     telemetry=telemetry,
                      host_actions=host_actions,
                      host_rule_idx=np.asarray(sorted(host_actions),
                                               np.int64),

@@ -87,6 +87,15 @@ class ServerArgs:
     # retry a failed device step once (jittered backoff) before it
     # counts as a breaker failure
     device_retry: bool = True
+    # -- rule-level telemetry (runtime/rulestats.py) -------------------
+    # fold per-rule hit/deny/err counts into on-device accumulators
+    # inside the fused check step (requires fused=True to do anything)
+    rule_telemetry: bool = True
+    # accumulator drain cadence: the background thread pulls deltas
+    # device→host every this many seconds and feeds the aggregator /
+    # counter families / adapter exporters. 0 disables the thread
+    # (drains then happen only on demand: /debug/rulestats, tests).
+    rulestats_drain_s: float = 0.5
 
 
 class RuntimeServer:
@@ -109,13 +118,26 @@ class RuntimeServer:
                 raise ValueError(
                     f"serving buckets {bad} not divisible by dp={dp}")
             mesh = MeshSpec(dp=dp, mp=mp).build()
+        # rule-level telemetry aggregator (runtime/rulestats.py):
+        # created BEFORE the controller so the initial publish can
+        # attach it; the drain thread below pulls the device
+        # accumulators on the snapshot interval
+        from istio_tpu.runtime.rulestats import (RuleStatsAggregator,
+                                                 RuleStatsDrainer)
+        self.rulestats = RuleStatsAggregator()
         self.controller = Controller(
             store, default_manifest=manifest,
             identity_attr=self.args.identity_attr,
             max_str_len=self.args.max_str_len,
             fused=self.args.fused,
             prewarm_buckets=buckets,
-            mesh=mesh)
+            mesh=mesh,
+            rule_telemetry=self.args.rule_telemetry,
+            on_publish=self._on_config_publish)
+        self._rulestats_drainer = RuleStatsDrainer(
+            self.rulestats, self.args.rulestats_drain_s) \
+            if (self.args.rule_telemetry and self.args.fused
+                and self.args.rulestats_drain_s > 0) else None
         # resilience layer in front of the device step: retry, circuit
         # breaker with CPU-oracle fallback, fail-open/closed policy
         # (runtime/resilience.py). Every serving entry routes its
@@ -169,6 +191,18 @@ class RuntimeServer:
     # Preprocessing (the APA phase) happens exactly ONCE per request, in
     # the caller-facing entry points; everything downstream of the
     # batcher operates on already-preprocessed bags.
+
+    def _on_config_publish(self, dispatcher) -> None:
+        """Controller publish hook: rebind the rulestats aggregator to
+        the fresh snapshot (draining the outgoing plan first so a
+        config swap never drops in-flight counts). Must never raise —
+        telemetry is an observer of the publish, not a participant."""
+        try:
+            self.rulestats.attach(dispatcher)
+        except Exception:
+            import logging
+            logging.getLogger("istio_tpu.runtime.server").exception(
+                "rulestats attach failed")
 
     def preprocess(self, bag: Bag) -> Bag:
         d = self.controller.dispatcher
@@ -518,4 +552,10 @@ class RuntimeServer:
         self.batcher.close()
         if self._report_batcher is not None:
             self._report_batcher.close()
+        if self._rulestats_drainer is not None:
+            self._rulestats_drainer.close()
+            try:   # flush whatever the last interval left on device
+                self.rulestats.drain()
+            except Exception:
+                pass
         self.controller.close()

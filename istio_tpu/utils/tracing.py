@@ -67,9 +67,18 @@ class RingReporter:
             self._buf.append(span)
 
     def snapshot(self, limit: int = 0) -> list[dict]:
-        """Most-recent-last copy (capped at `limit` when > 0)."""
+        """Most-recent-last copy (capped at `limit` when > 0),
+        ordered by span START time. The deque holds FINISH order —
+        children land before their parents, and once the ring wraps a
+        long-lived root can sit after spans that started (and
+        finished) much later, so finish order is not chronological.
+        Sorting by (timestamp, id) makes the view stable and
+        chronological under wrap-around; the limit keeps the NEWEST
+        spans, applied after the sort."""
         with self._lock:
             out = list(self._buf)
+        out.sort(key=lambda s: (s.get("timestamp", 0),
+                                str(s.get("id", ""))))
         return out[-limit:] if limit else out
 
 
@@ -116,6 +125,33 @@ def disable_ring(ring: RingReporter) -> None:
         if owner is None or not owner._closed:
             return
         _global = owner._installed_over
+
+
+def parent_from_traceparent(header: str | None) -> dict | None:
+    """W3C `traceparent` header → a parent-span dict usable as the
+    `parent` of span()/start_span(), so server-side rpc.check roots
+    (and every exemplar trace id hanging off them) join the CLIENT'S
+    trace. Format (https://www.w3.org/TR/trace-context/):
+    `version-traceid(32 hex)-parentid(16 hex)-flags`; malformed or
+    all-zero ids return None and the caller self-generates ids as
+    before."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    _ver, trace_id, span_id = parts[0], parts[1].lower(), \
+        parts[2].lower()
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16)
+        int(span_id, 16)
+    except ValueError:
+        return None
+    if set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    return {"traceId": trace_id, "id": span_id}
 
 
 def _http_post_json(url: str, payload: bytes,

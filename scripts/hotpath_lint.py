@@ -63,6 +63,13 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
         "FusedPlan.packed_check", "FusedPlan.packed_report",
         "FusedPlan.packed_check_instep",
     }),
+    # rule-telemetry fold + drain (PR 4): observe/add_host/sample run
+    # inside the batch step; drain's device→host pull is THE designated
+    # boundary and carries the only sync-ok pragmas in the file
+    "istio_tpu/runtime/rulestats.py": frozenset({
+        "RuleTelemetry.observe", "RuleTelemetry.add_host",
+        "RuleTelemetry.sample", "RuleTelemetry.drain",
+    }),
 }
 
 _SYNC_ATTRS = ("item", "block_until_ready")

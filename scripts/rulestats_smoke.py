@@ -1,0 +1,332 @@
+"""Rulestats smoke: serve a seeded check mix through BOTH real fronts
+(python gRPC + the C++ native wire), drain the on-device per-rule
+accumulators, and FAIL (nonzero exit) unless
+
+  1. the drained per-rule hit/deny/error counts EXACTLY equal an
+     independent oracle recount of the same traffic (telemetry is a
+     measurement, not an estimate),
+  2. the /debug/rulestats introspect view agrees with the aggregator
+     (top-rule counts, never-hit bookkeeping), and
+  3. the adapter export path agrees: a prometheus adapter handler
+     registered as a rulestats exporter ends up with the same per-rule
+     totals in its scrape output.
+
+The oracle recount walks every request through the compiler's
+SnapshotOracle (the same conformance oracle the device programs are
+pinned against) and re-derives deny attribution from the snapshot's
+fused action metadata — denier statuses and STRINGS-list membership —
+in device combine order (lowest rule index wins). The native front is
+fail-soft: a missing C++ toolchain skips that half with a note (the
+grpc half must still pass).
+
+Runnable under JAX_PLATFORMS=cpu; tier-1 invokes main() in-process
+(tests/test_rulestats_smoke.py).
+
+Usage: JAX_PLATFORMS=cpu python scripts/rulestats_smoke.py \
+           [--rules N] [--checks N] [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def oracle_recount(snapshot, plan, bags,
+                   identity_attr: str = "destination.service"
+                   ) -> tuple[dict, dict, dict]:
+    """Independent per-rule recount over `bags` → ({rule idx: hits},
+    {rule idx: denies}, {rule idx: errors}), matching the telemetry
+    plane's semantics exactly:
+
+      hits    — rule namespace-visible AND predicate matched
+      denies  — rule is the LOWEST-index active rule whose fused check
+                action produces a non-OK status (device combine order)
+      errors  — rule namespace-visible AND predicate raised
+
+    Deny attribution re-derives the fused action semantics from the
+    snapshot (denier params, STRINGS list membership with the
+    blacklist→PERMISSION_DENIED / whitelist-miss→NOT_FOUND / absent→
+    INTERNAL codes of models/policy_engine) — independent of the
+    device path being verified. Shared by this smoke and the
+    tests/test_rulestats.py property tests."""
+    from istio_tpu.compiler.ruleset import SnapshotOracle
+    from istio_tpu.runtime.dispatcher import _namespace_of
+    from istio_tpu.templates import Variety
+
+    rs = snapshot.ruleset
+    n_cfg = len(snapshot.rules)
+    oracle = SnapshotOracle(
+        rs.rules[:n_cfg], snapshot.finder,
+        seed={r: p for r, p in rs.host_fallback.items() if r < n_cfg})
+    hits: dict[int, int] = {}
+    denies: dict[int, int] = {}
+    errors: dict[int, int] = {}
+
+    def fused_status(ridx: int, bag) -> int:
+        info = plan.deny_info.get(ridx)
+        if info is not None:
+            return info[0]
+        if ridx in plan.list_rules:
+            for hc, _template, inst_names in snapshot.actions_for(
+                    ridx, Variety.CHECK):
+                if hc.adapter != "list":
+                    continue
+                entries = set(map(str, hc.params.get("overrides", ())))
+                blacklist = bool(hc.params.get("blacklist", False))
+                for iname in inst_names:
+                    ref = snapshot.instances[iname].value_attr_ref()
+                    if isinstance(ref, tuple):
+                        c, ok = bag.get(ref[0])
+                        v = c.get(ref[1]) if ok and \
+                            isinstance(c, dict) else None
+                        ok = v is not None
+                    else:
+                        v, ok = bag.get(ref)
+                    if not ok or not isinstance(v, str):
+                        return 13            # INTERNAL: absent value
+                    member = v in entries
+                    if member and blacklist:
+                        return 7             # PERMISSION_DENIED
+                    if not member and not blacklist:
+                        return 5             # NOT_FOUND
+        return 0
+
+    for bag in bags:
+        req_ns = _namespace_of(bag, identity_attr)
+        deny_done = False
+        for ridx, rule in enumerate(oracle.rules):
+            if rule.namespace and rule.namespace != req_ns:
+                continue
+            try:
+                m = bool(oracle._prog(ridx).evaluate(bag))
+            except Exception:
+                errors[ridx] = errors.get(ridx, 0) + 1
+                continue
+            if not m:
+                continue
+            hits[ridx] = hits.get(ridx, 0) + 1
+            if not deny_done and fused_status(ridx, bag) != 0:
+                denies[ridx] = denies.get(ridx, 0) + 1
+                deny_done = True
+    return hits, denies, errors
+
+
+def make_traffic(n_rules: int, n_checks: int, seed: int) -> list[dict]:
+    """Seeded request mix: random mesh traffic + crafted rows that
+    deterministically exercise the deny and whitelist rules (random
+    traffic alone rarely matches the per-rule predicates)."""
+    from istio_tpu.testing import workloads
+
+    dicts = workloads.make_request_dicts(n_checks, seed=seed)
+    n_srv = max(n_rules // 2, 1)
+    for i in range(n_rules):
+        dicts.append({
+            "destination.service":
+                f"svc{i % n_srv}.ns{i % 23}.svc.cluster.local",
+            "source.namespace": f"ns{(i * 5) % 25}",
+            "request.method": "GET",
+            "request.path": "/api/v0/products/1",
+            "request.host": f"x.ns{i % 23}.cluster.local",
+            "connection.mtls": True,
+            "request.headers": {"cookie": "session=0"},
+        })
+    return dicts
+
+
+def main(n_rules: int = 24, n_checks: int = 32, seed: int = 3) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import prometheus_client
+
+    from istio_tpu.adapters.prometheus_adapter import PrometheusHandler
+    from istio_tpu.adapters.sdk import Env
+    from istio_tpu.api.client import MixerClient
+    from istio_tpu.api.grpc_server import MixerGrpcServer
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.testing import workloads
+    from istio_tpu.utils import tracing
+
+    failures: list[str] = []
+    store = workloads.make_store(n_rules, seed=seed)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=32, buckets=(8, 32),
+        # exercise the background drain cadence too; final counts come
+        # from an explicit drain at the end (cumulative either way)
+        rulestats_drain_s=0.05,
+        default_manifest=workloads.MESH_MANIFEST))
+    # adapter-driven export: a real prometheus adapter handler is one
+    # of the drain's consumers — its scrape must agree with the
+    # aggregator at the end
+    prom = PrometheusHandler(
+        {"metrics": [
+            {"name": "rulestats.hits", "kind": "COUNTER",
+             "label_names": ["rule", "namespace"]},
+            {"name": "rulestats.denies", "kind": "COUNTER",
+             "label_names": ["rule", "namespace"]},
+            {"name": "rulestats.errors", "kind": "COUNTER",
+             "label_names": ["rule"]},
+        ]}, Env("rulestats-smoke"))
+    srv.rulestats.add_exporter(prom)
+    intro = IntrospectServer(runtime=srv)
+    g = MixerGrpcServer(runtime=srv)
+    client = None
+    native = None
+    native_client = None
+    try:
+        plan = srv.controller.dispatcher.fused
+        if plan is not None:
+            plan.prewarm((8, 32))
+        intro_port = intro.start()
+        grpc_port = g.start()
+        # verdict caching OFF: a client-cached verdict never reaches
+        # the server, and the recount covers every sent request
+        client = MixerClient(f"127.0.0.1:{grpc_port}",
+                             enable_check_cache=False)
+        dicts = make_traffic(n_rules, n_checks, seed)
+        served: list[dict] = []
+        for d in dicts:
+            client.check(d)
+            served.append(d)
+
+        # native front (fail-soft: toolchain may be absent)
+        native_note = "served"
+        try:
+            from istio_tpu.api.native_server import NativeMixerServer
+            native = NativeMixerServer(srv, pumps=1)
+            nport = native.start()
+            native_client = MixerClient(f"127.0.0.1:{nport}",
+                                        enable_check_cache=False)
+            for d in dicts[: max(len(dicts) // 2, 1)]:
+                native_client.check(d)
+                served.append(d)
+        except Exception as exc:
+            native_note = f"skipped: {type(exc).__name__}: {exc}"
+            print(f"rulestats smoke: native front {native_note}",
+                  file=sys.stderr)
+
+        # final drain + exact recount
+        srv.rulestats.drain()
+        got = srv.rulestats.counts()
+        snap = srv.controller.dispatcher.snapshot
+        names = [f"{r.namespace}/{r.name}" if r.namespace else r.name
+                 for r in snap.rules]
+        bags = [bag_from_mapping(d) for d in served]
+        hits, denies, errors = oracle_recount(snap, plan, bags)
+        for ridx, name in enumerate(names):
+            gotr = got.get(name, {"hits": 0, "denies": 0, "errors": 0})
+            want = (hits.get(ridx, 0), denies.get(ridx, 0),
+                    errors.get(ridx, 0))
+            have = (gotr["hits"], gotr["denies"], gotr["errors"])
+            if have != want:
+                failures.append(
+                    f"count mismatch rule {name}: drained "
+                    f"hit/deny/err {have} != oracle {want}")
+        if not hits:
+            failures.append("oracle recount saw zero hits — the "
+                            "traffic mix no longer exercises rules")
+        if not denies:
+            failures.append("oracle recount saw zero denies — the "
+                            "crafted deny rows no longer fire")
+
+        # /debug/rulestats agreement + exemplar trace links
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{intro_port}/debug/rulestats?k=64",
+                timeout=30) as r:
+            view = json.loads(r.read().decode())
+        by_rule = {t["rule"]: t for t in view.get("top", ())}
+        for name, c in got.items():
+            if not (c["hits"] or c["denies"] or c["errors"]):
+                continue
+            t = by_rule.get(name)
+            if t is None:
+                failures.append(f"/debug/rulestats missing hot rule "
+                                f"{name}")
+            elif (t["hits"], t["denies"], t["errors"]) != \
+                    (c["hits"], c["denies"], c["errors"]):
+                failures.append(
+                    f"/debug/rulestats disagrees for {name}: view "
+                    f"{t['hits']}/{t['denies']}/{t['errors']} vs "
+                    f"aggregator {c['hits']}/{c['denies']}/"
+                    f"{c['errors']}")
+        never_names = {e["rule"] for e in view.get("never_hit", ())}
+        for name, c in got.items():
+            if c["hits"] and name in never_names:
+                failures.append(f"{name} listed never-hit with "
+                                f"{c['hits']} hits")
+        deny_rules = [n for n, c in got.items() if c["denies"]]
+        ex_rules = set(view.get("exemplar_rules", ()))
+        if deny_rules and not ex_rules & set(deny_rules):
+            failures.append("no decision exemplars for any denying "
+                            f"rule (denied: {deny_rules})")
+        for t in view.get("top", ()):
+            for ex in t.get("exemplars", ()):
+                if not ex.get("trace_id"):
+                    failures.append(
+                        f"exemplar for {t['rule']} carries no trace "
+                        f"id — not joinable with /debug/traces")
+                break
+
+        # adapter agreement: the prometheus exporter's scrape must sum
+        # to the aggregator's totals per rule
+        text = prometheus_client.generate_latest(
+            prom.registry).decode()
+        adapter_hits: dict[str, float] = {}
+        for line in text.splitlines():
+            if line.startswith("istio_tpu_rulestats_hits_total{"):
+                labels, value = line.rsplit(" ", 1)
+                rule = labels.split('rule="', 1)[1].split('"', 1)[0]
+                adapter_hits[rule] = adapter_hits.get(rule, 0.0) + \
+                    float(value)
+        for name, c in got.items():
+            if c["hits"] and \
+                    int(adapter_hits.get(name, 0)) != c["hits"]:
+                failures.append(
+                    f"prometheus adapter disagrees for {name}: "
+                    f"{adapter_hits.get(name)} vs {c['hits']}")
+
+        # counter families on the merged /metrics surface
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{intro_port}/metrics",
+                timeout=30) as r:
+            mtext = r.read().decode()
+        for fam in ("mixer_rule_check_hits_total",
+                    "mixer_rule_check_denies_total",
+                    "mixer_rulestats_drains_total"):
+            if fam not in mtext:
+                failures.append(f"counter family absent from "
+                                f"/metrics: {fam}")
+    finally:
+        if native_client is not None:
+            native_client.close()
+        if native is not None:
+            native.stop()
+        if client is not None:
+            client.close()
+        g.stop()
+        intro.close()
+        srv.close()
+        tracing.shutdown()
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"rulestats smoke ok: {len(served)} checks over "
+              f"grpc+native, drained counts == oracle recount, "
+              f"introspect + adapter agree (native: {native_note})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=24)
+    ap.add_argument("--checks", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=3)
+    args = ap.parse_args()
+    sys.exit(main(args.rules, args.checks, args.seed))

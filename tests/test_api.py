@@ -243,3 +243,48 @@ def test_aio_server_check_parity():
         assert sorted(codes) == [0] * 8 + [5] * 8
     finally:
         client.close(); server.stop(); runtime.close()
+
+
+def test_traceparent_joins_root_span_and_status_tag(rig):
+    """W3C traceparent satellite: a client-sent traceparent header
+    becomes the rpc.check root's trace/parent ids (exemplar trace ids
+    join the client's trace), and every check span carries a `status`
+    tag (ok / google.rpc code) for /debug/traces filtering."""
+    import grpc
+
+    from istio_tpu.api.wire import bag_to_compressed
+    from istio_tpu.utils import tracing
+
+    runtime, server, _, _ = rig
+    mem, restore = tracing.capture("api-test")
+    try:
+        ch = grpc.insecure_channel(f"127.0.0.1:{server.port}")
+        check = ch.unary_unary(
+            "/istio.mixer.v1.Mixer/Check",
+            request_serializer=pb.CheckRequest.SerializeToString,
+            response_deserializer=pb.CheckResponse.FromString)
+        tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+        sid = "00f067aa0ba902b7"
+        req = pb.CheckRequest()
+        req.attributes.CopyFrom(bag_to_compressed(
+            {"source.labels": {"version": "v1"}}))
+        ok = check(req, timeout=30,
+                   metadata=(("traceparent", f"00-{tid}-{sid}-01"),))
+        assert ok.precondition.status.code == 0
+        req2 = pb.CheckRequest()
+        req2.attributes.CopyFrom(bag_to_compressed(
+            {"source.labels": {"version": "v9"}}))
+        bad = check(req2, timeout=30)
+        assert bad.precondition.status.code == 5
+        ch.close()
+    finally:
+        restore()
+    roots = [s for s in mem.spans if s["name"] == "rpc.check"]
+    joined = [s for s in roots if s["traceId"] == tid]
+    assert joined, "traceparent did not join the rpc.check root"
+    assert joined[0]["parentId"] == sid
+    assert joined[0]["tags"].get("status") == "ok"
+    # the denied RPC (no traceparent) self-generates ids but tags its
+    # google.rpc code
+    assert any(s["traceId"] != tid and s["tags"].get("status") == "5"
+               for s in roots)

@@ -201,6 +201,61 @@ def test_debug_traces_and_root_span_parenting(served):
     assert batch_spans[-1]["parentId"] == root["id"]
 
 
+def test_debug_rulestats_view(served):
+    """/debug/rulestats: drains on demand and serves top-K hot rules
+    with per-namespace deny rates, never-hit bookkeeping (with the
+    analyzer cross-check flag present) and decision exemplars whose
+    trace ids join /debug/traces."""
+    srv, intro = served
+    # crafted deny traffic: rule 0 (deny action) of make_store(24),
+    # through the batcher so exemplars sample the serve.batch span
+    from istio_tpu.attribute.bag import bag_from_mapping
+    for _ in range(4):
+        srv.check(bag_from_mapping({
+            "destination.service": "svc0.ns0.svc.cluster.local",
+            "source.namespace": "ns9"}))
+    status, payload = _get_json(intro, "/debug/rulestats?k=50")
+    assert status == 200
+    assert payload["drains"] >= 1
+    assert payload["rules_tracked"] == 26    # 24 mesh + quota + report
+    top = {t["rule"]: t for t in payload["top"]}
+    entry = top.get("ns0/rule0")
+    assert entry is not None, sorted(top)
+    assert entry["hits"] >= 4 and entry["denies"] >= 4
+    assert entry["deny_rate_by_namespace"].get("ns0") == 1.0
+    assert entry["exemplars"] and entry["exemplars"][0]["trace_id"]
+    # never-hit entries carry the analyzer cross-check flag
+    assert payload["never_hit"], "some rules never fire in this mix"
+    assert all("analyzer_shadowed" in e for e in payload["never_hit"])
+    hot = {t["rule"] for t in payload["top"]}
+    assert hot.isdisjoint({e["rule"] for e in payload["never_hit"]})
+    # the counter families surface on the merged /metrics exposition
+    _, _, body = _get(intro, "/metrics")
+    text = body.decode()
+    assert "mixer_rule_check_hits_total" in text
+    assert "mixer_rulestats_drains_total" in text
+
+
+def test_debug_traces_status_filter(served):
+    """?status=failed keeps only spans whose status tag is set and not
+    ok — the failure-filter satellite over the check spans' new status
+    tags."""
+    _, intro = served
+    tr = tracing.get_tracer()
+    with tr.span("rpc.check") as s_ok:
+        s_ok["tags"]["status"] = "ok"
+    with tr.span("rpc.check") as s_bad:
+        s_bad["tags"]["status"] = "7"
+    status, payload = _get_json(intro, "/debug/traces?status=failed")
+    assert status == 200
+    statuses = {(s["tags"] or {}).get("status")
+                for s in payload["spans"]}
+    assert "7" in statuses and "ok" not in statuses
+    status, payload = _get_json(intro, "/debug/traces?status=7")
+    assert {(s["tags"] or {}).get("status")
+            for s in payload["spans"]} == {"7"}
+
+
 def test_close_without_start_does_not_hang():
     """shutdown() blocks on serve_forever()'s event — close() on a
     never-started server (a pre-start failure's cleanup path, e.g. the

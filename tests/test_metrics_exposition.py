@@ -10,6 +10,8 @@ family to real scrapers).
 """
 import re
 
+import numpy as np
+
 from istio_tpu.utils.metrics import (Counter, Gauge, Histogram,
                                      Registry, SlidingWindow)
 
@@ -163,6 +165,89 @@ def test_latency_snapshot_windowed_delta():
     # unwindowed reading still sees everything
     full = monitor.latency_snapshot()
     assert full["stages"]["tensorize"]["count"] >= 2
+
+
+def test_rulestats_families_zero_series_before_first_drain():
+    """The rule-telemetry counter families (runtime/rulestats.py) must
+    expose a zero series BEFORE the first drain — a dashboard has to
+    distinguish 'no rule ever fired' from 'telemetry missing'. Private
+    registry: the module-level families may already carry traffic from
+    other tests."""
+    from istio_tpu.runtime import rulestats
+
+    reg = Registry()
+    rulestats.register_families(reg)
+    samples = _parse(reg.expose_text())
+    for fam in ("mixer_rule_check_hits_total",
+                "mixer_rule_check_denies_total",
+                "mixer_rule_check_errors_total",
+                "mixer_rulestats_drains_total"):
+        assert samples.get(fam) == [({}, 0.0)], fam
+    # the drain-wall histogram emits its zero ladder too
+    lint_histograms(reg.expose_text(),
+                    expect={"mixer_rulestats_drain_seconds"})
+
+
+def test_rulestats_families_monotone_across_drains():
+    """Per-rule counters are cumulative: two successive drains with
+    activity in between must only ever increase each labeled series
+    (prometheus counter semantics)."""
+    from istio_tpu.runtime import rulestats
+
+    reg = Registry()
+    fams = rulestats.register_families(reg)
+    agg = rulestats.RuleStatsAggregator(metrics=fams)
+
+    class _Rule:
+        def __init__(self, name):
+            self.name, self.namespace = name, "ns1"
+
+    class _Tele:
+        """Scripted telemetry: each drain yields one hit/deny for
+        rule 0 in slot 0."""
+        def __init__(self):
+            self.generation = 0
+
+        def drain(self):
+            self.generation += 1
+            return {"generation": self.generation,
+                    "hit": np.array([[2, 0]]),
+                    "deny": np.array([[1, 0]]),
+                    "err": np.array([1, 0]),
+                    "exemplars": {}, "exemplars_seen": {},
+                    "wall_s": 0.001}
+
+    class _Plan:
+        telemetry = _Tele()
+
+    class _Snap:
+        rules = [_Rule("r0"), _Rule("r1")]
+        revision = 1
+
+        class ruleset:
+            ns_ids = {"": 0}
+
+    class _Dispatcher:
+        snapshot = _Snap()
+        fused = _Plan()
+
+    # attach() drains once (old plan = none), then two live drains
+    agg.attach(_Dispatcher())
+    readings = []
+    for _ in range(2):
+        agg.drain()
+        samples = _parse(reg.expose_text())
+        hits = {tuple(sorted(lb.items())): v for lb, v in
+                samples["mixer_rule_check_hits_total"]}
+        readings.append(hits.get((("rule", "ns1/r0"),), 0.0))
+    assert readings[0] == 2.0 and readings[1] == 4.0, readings
+    samples = _parse(reg.expose_text())
+    denies = {tuple(sorted(lb.items())): v for lb, v in
+              samples["mixer_rule_check_denies_total"]}
+    assert denies[(("rule", "ns1/r0"),)] == 2.0
+    drains = dict((tuple(sorted(lb.items())), v) for lb, v in
+                  samples["mixer_rulestats_drains_total"])
+    assert drains[()] >= 2.0
 
 
 def test_sliding_window_quantiles():

@@ -1,0 +1,32 @@
+"""Tier-1 hook for scripts/rulestats_smoke.py: the CI gate that
+rule-level telemetry keeps being a measurement — served checks through
+the real grpc (and, toolchain permitting, native) fronts drain
+per-rule counts that EXACTLY equal an oracle recount, the
+/debug/rulestats view and the adapter export agree with the
+aggregator, and denied requests leave trace-linked exemplars. Runs
+main() in-process (the introspect_smoke pattern: a subprocess would
+pay a second jax import for no extra coverage; the script stays
+runnable standalone under JAX_PLATFORMS=cpu)."""
+import importlib.util
+import os
+import sys
+
+
+def _load():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "rulestats_smoke.py")
+    spec = importlib.util.spec_from_file_location("rulestats_smoke",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_rulestats_smoke_main():
+    mod = _load()
+    try:
+        rc = mod.main(n_rules=18, n_checks=16)
+    finally:
+        sys.modules.pop("rulestats_smoke", None)
+    assert rc == 0

@@ -89,6 +89,49 @@ def test_configure_composes_and_noop_default():
         assert s is None
 
 
+def test_parent_from_traceparent():
+    """W3C trace-context parsing: valid headers become parent dicts
+    span()/start_span() can chain under; malformed/all-zero ids fall
+    back to None (self-generated ids, the previous behavior)."""
+    tid = "4bf92f3577b34da6a3ce929d0e0e4736"
+    sid = "00f067aa0ba902b7"
+    p = tracing.parent_from_traceparent(f"00-{tid}-{sid}-01")
+    assert p == {"traceId": tid, "id": sid}
+    # case-normalized
+    p = tracing.parent_from_traceparent(f"00-{tid.upper()}-{sid}-01")
+    assert p["traceId"] == tid
+    for bad in (None, "", "00-zz-xx-01", f"00-{tid}-{sid}",
+                f"00-{tid[:-2]}-{sid}-01",
+                "00-" + "0" * 32 + f"-{sid}-01",
+                f"00-{tid}-" + "0" * 16 + "-01"):
+        assert tracing.parent_from_traceparent(bad) is None, bad
+    # a span opened under the parsed parent joins the client's trace
+    tr = tracing.Tracer(reporter=tracing.MemoryReporter())
+    with tr.span("rpc.check",
+                 parent=tracing.parent_from_traceparent(
+                     f"00-{tid}-{sid}-01")) as s:
+        assert s["traceId"] == tid and s["parentId"] == sid
+
+
+def test_ring_snapshot_chronological_under_wraparound():
+    """The ring holds FINISH order (children land before parents, and
+    wrap-around evicts arbitrary prefixes); snapshot() must return
+    START-time order, newest last — the satellite fix."""
+    ring = tracing.RingReporter(capacity=4)
+    # spans reported out of start order (a long-lived root finishing
+    # after its children), then enough to wrap the ring
+    for ts, name in ((50, "child-b"), (10, "root"), (40, "child-a"),
+                     (60, "late-1"), (70, "late-2")):
+        ring({"timestamp": ts, "id": name, "name": name})
+    snap = ring.snapshot()
+    assert [s["timestamp"] for s in snap] == \
+        sorted(s["timestamp"] for s in snap)
+    assert ring.dropped == 1
+    # limit keeps the NEWEST spans after sorting
+    assert [s["name"] for s in ring.snapshot(limit=2)] == \
+        ["late-1", "late-2"]
+
+
 def test_serving_pipeline_stage_spans():
     """Served checks decompose: batch → queue-wait tag + tensorize /
     device / overlay child spans from the fused dispatcher."""
