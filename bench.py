@@ -258,6 +258,7 @@ def main() -> None:
     capacity = _capacity_bench(on_tpu)
     mesh_scaling = _mesh_scaling_bench(on_tpu)
     analysis = _analysis_bench(on_tpu)
+    canary = _canary_bench(on_tpu)
 
     baseline_cps = 1e9 / (PER_PREDICATE_NS * n_rules)
     out = {
@@ -341,6 +342,7 @@ def main() -> None:
     out.update(capacity)
     out.update(mesh_scaling)
     out.update(analysis)
+    out.update(canary)
     print(json.dumps(out))
 
 
@@ -879,6 +881,149 @@ def _analysis_bench(on_tpu: bool) -> dict:
         return out
     except Exception as exc:   # bench sections never sink the artifact
         return {"analysis_error": f"{type(exc).__name__}: {exc}"}
+
+
+def _canary_bench(on_tpu: bool) -> dict:
+    """Config-canary cost alongside the serving numbers: replay
+    throughput (rows/s through a candidate plan), measured divergence
+    rates for an identical-semantics and a deliberately divergent
+    swap, the gate verdicts, the publish delay the whole evaluation
+    added, and the recorder tap's throughput overhead — the canary
+    must stay a swap-time cost, never a serving-path one."""
+    try:
+        from istio_tpu.runtime import RuntimeServer, ServerArgs
+        from istio_tpu.runtime.batcher import pad_to_bucket
+        from istio_tpu.attribute.bag import bag_from_mapping
+        from istio_tpu.testing import workloads
+
+        out: dict = {}
+        n_rules = 256 if on_tpu else 48
+        n_reqs = 512 if on_tpu else 128
+        buckets = (64, 256) if on_tpu else (32, 64)
+        store = workloads.make_store(n_rules, seed=11)
+        srv = RuntimeServer(store, ServerArgs(
+            batch_window_s=0.0003, max_batch=buckets[-1],
+            buckets=buckets, canary="gate", rulestats_drain_s=0,
+            default_manifest=workloads.MESH_MANIFEST))
+        # the bench drives rebuilds explicitly — a debounce-timer
+        # rebuild racing them would run the replay twice and inflate
+        # the measured publish delay (the smoke does the same)
+        srv.controller.debounce_s = 600.0
+        try:
+            dicts = workloads.make_request_dicts(n_reqs, seed=4)
+            n_srv = max(n_rules // 2, 1)
+            for i in range(0, n_rules, 3):     # deny rules fire too
+                dicts.append({
+                    "destination.service": f"svc{i % n_srv}.ns"
+                    f"{i % 23}.svc.cluster.local",
+                    "source.namespace": f"ns{(i * 5) % 25}",
+                    "request.method": "GET",
+                    "request.path": "/api/v0/products/1",
+                    "connection.mtls": True})
+            bags = [bag_from_mapping(d) for d in dicts]
+
+            def serve_all() -> float:
+                t0 = time.perf_counter()
+                for lo in range(0, len(bags), buckets[-1]):
+                    srv.check_batch_preprocessed(pad_to_bucket(
+                        bags[lo:lo + buckets[-1]], buckets))
+                return time.perf_counter() - t0
+
+            serve_all()                        # warm + record
+            # recorder overhead: same padded batch, tap on vs off,
+            # INTERLEAVED per-batch samples so drift hits both sides
+            # equally; judged on the p99 (the acceptance budget is a
+            # tail budget: recorder ≤2% p99 on served traffic)
+            d = srv.controller.dispatcher
+            probe = pad_to_bucket(bags[:buckets[-1]], buckets)
+            rec = d.recorder
+            t_on: list = []
+            t_off: list = []
+            for _ in range(30):
+                d.recorder = rec
+                t0 = time.perf_counter()
+                srv.check_batch_preprocessed(probe)
+                t_on.append(time.perf_counter() - t0)
+                d.recorder = None
+                t0 = time.perf_counter()
+                srv.check_batch_preprocessed(probe)
+                t_off.append(time.perf_counter() - t0)
+            d.recorder = rec
+            p99 = lambda ts: sorted(ts)[  # noqa: E731
+                min(len(ts) - 1, int(len(ts) * 0.99))]
+            med = lambda ts: sorted(ts)[len(ts) // 2]  # noqa: E731
+            ov_p99 = (p99(t_on) - p99(t_off)) / p99(t_off) * 100.0
+            ov_med = (med(t_on) - med(t_off)) / med(t_off) * 100.0
+            # differential end-to-end overheads (informational —
+            # single-batch walls swing ±15% on a contended box)
+            out["canary_recorder_overhead_p99_pct"] = round(
+                max(ov_p99, 0.0), 2)
+            out["canary_recorder_overhead_median_pct"] = round(
+                max(ov_med, 0.0), 2)
+            # the acceptance gate (ISSUE 5): recorder tap ≤2% of the
+            # served batch p99. Judged on a DIRECT tap timing over the
+            # real served batch shape divided by the measured batch
+            # wall — the tap is deterministic host python, so the
+            # direct measure is noise-immune where the differential
+            # walls are not
+            chunk = bags[:buckets[-1]]
+            resps = srv.check_batch_preprocessed(probe)[:len(chunk)]
+            snap = d.snapshot
+            dev = (np.array([r.status_code for r in resps], np.int32),
+                   np.array([r.valid_duration_s for r in resps],
+                            np.float32),
+                   np.array([r.valid_use_count for r in resps],
+                            np.int32),
+                   np.array([r.deny_rule for r in resps], np.int32))
+            t0 = time.perf_counter()
+            for _ in range(50):
+                rec.tap(chunk, resps, snap, d.identity_attr,
+                        device=dev)
+            tap_wall = (time.perf_counter() - t0) / 50
+            out["canary_recorder_tap_us_per_batch"] = round(
+                tap_wall * 1e6, 1)
+            out["canary_recorder_overhead_ok"] = bool(
+                tap_wall / p99(t_on) * 100.0 <= 2.0)
+            # the probe/tap loops overwrote the ring with probe-only
+            # rows; restore a representative corpus (crafted deny
+            # rows included) before the swap scenarios below
+            serve_all()
+
+            # identical-semantics swap: same store contents → rebuild
+            t0 = time.perf_counter()
+            srv.controller.rebuild()
+            out["canary_publish_delay_identical_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 1)
+            rep = srv.canary.reports()[-1]
+            out["canary_replay_rows_per_s"] = rep.replay_rows_per_s
+            out["canary_identical_divergence_rate"] = \
+                rep.divergence_rate
+            verdicts = {"identical": rep.verdict}
+
+            # divergent swap: tighten a firing deny rule's match
+            ridx = 3 * ((n_rules // 2) // 3)   # a deny rule (i % 3==0)
+            key = ("rule", f"ns{ridx % 23}", f"rule{ridx}")
+            spec = dict(store.get(key) or {})
+            spec["match"] = (spec.get("match", "") +
+                             ' && request.method == "DELETE"').lstrip(
+                                 " &")
+            store.set(key, spec)
+            t0 = time.perf_counter()
+            srv.controller.rebuild()
+            out["canary_publish_delay_divergent_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 1)
+            rep = srv.canary.reports()[-1]
+            verdicts["divergent"] = rep.verdict
+            out["canary_divergent_divergence_rate"] = \
+                rep.divergence_rate
+            out["canary_gate_verdicts"] = verdicts
+            out["canary_recorded_rows"] = \
+                srv.canary.recorder.stats()["entries"]
+        finally:
+            srv.close()
+        return out
+    except Exception as exc:   # bench sections never sink the artifact
+        return {"canary_error": f"{type(exc).__name__}: {exc}"}
 
 
 def _capacity_bench(on_tpu: bool) -> dict:

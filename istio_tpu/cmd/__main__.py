@@ -39,7 +39,15 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         brownout=args.brownout,
         check_fail_policy=args.check_fail_policy,
         breaker_failures=args.breaker_failures,
-        breaker_reset_s=args.breaker_reset_ms / 1e3))
+        breaker_reset_s=args.breaker_reset_ms / 1e3,
+        # config canary (istio_tpu/canary): record live traffic,
+        # shadow-replay rebuilt snapshots, veto divergent swaps
+        canary=args.canary,
+        canary_max_divergence=args.canary_max_divergence,
+        canary_capacity=args.canary_capacity,
+        canary_sample_every=args.canary_sample_every,
+        canary_replay_limit=args.canary_replay_limit,
+        canary_waivers=tuple(args.canary_waive or ())))
     server = MixerGrpcServer(runtime, f"{args.address}:{args.port}")
     port = server.start()
     print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
@@ -62,7 +70,7 @@ def cmd_mixs(args: argparse.Namespace) -> int:
               f"{args.monitoring_host}:{intro.port} "
               "(/metrics /healthz /readyz /debug/config /debug/queues"
               " /debug/cache /debug/traces /debug/resilience"
-              " /debug/analysis)")
+              " /debug/analysis /debug/rulestats /debug/canary)")
     _serve_forever()
     server.stop()
     if intro is not None:
@@ -123,6 +131,61 @@ def cmd_analyze(args: argparse.Namespace) -> int:
               f"{len(report.findings)} finding(s) over "
               f"{report.n_rules} rule(s) in {report.wall_ms:.0f}ms")
     return 1 if report.has_errors else 0
+
+
+def cmd_canary(args: argparse.Namespace) -> int:
+    """Offline canary replay (the dynamic sibling of `analyze`): load
+    a recorded live-traffic corpus (saved by a serving mixs via
+    /debug/canary tooling or canary.save_corpus) and shadow-replay it
+    through the candidate config store's compiled snapshot. Prints the
+    divergence report; exits 1 when the non-waived divergence rate
+    exceeds --max-divergence (CI-gateable: a config PR that flips
+    recorded production decisions fails before rollout)."""
+    from istio_tpu.canary import (diff_decisions, load_corpus,
+                                  replay_entries)
+    from istio_tpu.runtime import FsStore
+    from istio_tpu.runtime.config import SnapshotBuilder
+    from istio_tpu.runtime.fused import build_fused_plan
+    from istio_tpu.attribute.global_dict import GLOBAL_MANIFEST
+
+    entries = load_corpus(args.corpus)
+    if args.limit and len(entries) > args.limit:
+        entries = entries[-args.limit:]
+    if not entries:
+        print("canary: corpus is empty", file=sys.stderr)
+        return 2
+    store = FsStore(args.config_store)
+    snapshot = SnapshotBuilder(GLOBAL_MANIFEST).build(store)
+    for err in snapshot.errors:
+        print(f"# config error: {err}", file=sys.stderr)
+    plan = build_fused_plan(snapshot, rule_telemetry=False)
+    if plan is None:
+        print("canary: candidate snapshot has no rules to replay "
+              "against", file=sys.stderr)
+        return 2
+    replay = replay_entries(snapshot, plan, entries,
+                            identity_attr=args.identity_attr)
+    report = diff_decisions(entries, replay,
+                            waivers=tuple(args.waive or ()))
+    report.mode = "gate"
+    report.threshold = args.max_divergence
+    gated = report.divergence_rate > args.max_divergence
+    report.verdict = "veto" if gated else "publish"
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1, default=str))
+    else:
+        for rule in report.diverging_rules():
+            c = report.per_rule[rule]
+            print(f"DIVERGE {rule}: {c['total']} rows "
+                  f"(status_flip={c['status_flip']} "
+                  f"precondition={c['precondition']} "
+                  f"quota={c['quota']})")
+        print(f"canary: {report.n_divergent}/{report.n_rows} rows "
+              f"diverge (rate {report.divergence_rate:.4f}, "
+              f"{report.n_waived} waived) at "
+              f"{report.replay_rows_per_s:.0f} rows/s — "
+              f"{report.verdict.upper()}")
+    return 1 if gated else 0
 
 
 def cmd_mixc(args: argparse.Namespace) -> int:
@@ -679,6 +742,28 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--breaker-reset-ms", type=float, default=5000.0,
                    help="how long the breaker stays open before a "
                         "half-open device probe")
+    s.add_argument("--canary", default="off",
+                   choices=("off", "warn", "gate"),
+                   help="config canary: shadow-replay recorded live "
+                        "traffic through every rebuilt snapshot "
+                        "before the atomic publish; gate vetoes "
+                        "divergent swaps (the old config keeps "
+                        "serving), warn publishes but records the "
+                        "report on /debug/canary")
+    s.add_argument("--canary-max-divergence", type=float, default=0.0,
+                   help="divergence rate (non-waived divergent rows /"
+                        " replayed rows) beyond which gate mode "
+                        "vetoes; 0 = any divergence vetoes")
+    s.add_argument("--canary-capacity", type=int, default=2048,
+                   help="recorder sampling-ring capacity")
+    s.add_argument("--canary-sample-every", type=int, default=1,
+                   help="record every k-th check request")
+    s.add_argument("--canary-replay-limit", type=int, default=1024,
+                   help="newest recorded rows replayed per candidate "
+                        "evaluation")
+    s.add_argument("--canary-waive", action="append", metavar="RULE",
+                   help="qualified rule name (ns/name) whose "
+                        "divergences never gate (repeatable)")
     s.add_argument("--trace-zipkin-url", default="",
                    help="zipkin v2 collector (POST /api/v2/spans)")
     s.add_argument("--trace-log-spans", action="store_true",
@@ -702,6 +787,30 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--json", action="store_true",
                    help="machine-readable report")
     s.set_defaults(fn=cmd_analyze)
+
+    s = sub.add_parser("canary",
+                       help="offline shadow replay: recorded corpus "
+                            "vs candidate config (exit 1 on "
+                            "divergence past the threshold)")
+    s.add_argument("--config-store", required=True,
+                   help="candidate config directory (k8s-style YAML)")
+    s.add_argument("--corpus", required=True,
+                   help="recorded corpus file (canary.save_corpus)")
+    s.add_argument("--max-divergence", type=float, default=0.0,
+                   help="gate threshold (0 = any divergence fails)")
+    s.add_argument("--limit", type=int, default=0,
+                   help="replay only the newest N corpus rows")
+    s.add_argument("--waive", action="append", metavar="RULE",
+                   help="qualified rule name excluded from gating "
+                        "(repeatable)")
+    s.add_argument("--identity-attr", default="destination.service",
+                   help="namespace-targeting identity attribute — "
+                        "must match the serving server's "
+                        "ServerArgs.identity_attr the corpus was "
+                        "recorded under")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    s.set_defaults(fn=cmd_canary)
 
     s = sub.add_parser("mixc", help="mixer client")
     s.add_argument("command", choices=["check", "report"])

@@ -309,6 +309,50 @@ class RuleSetProgram:
             return False, False, True
 
 
+def fused_check_status(snapshot, plan, ridx: int, bag) -> int:
+    """The status the FUSED device lowering of rule `ridx`'s check
+    actions produces for `bag`, re-derived host-side from the
+    snapshot's action metadata: denier codes via plan.deny_info,
+    STRINGS-list membership with the blacklist→PERMISSION_DENIED /
+    whitelist-miss→NOT_FOUND / absent→INTERNAL codes of
+    models/policy_engine. THE shared decision-status derivation —
+    next to SnapshotOracle because both are the host-side semantic
+    truth device paths are judged against: the rulestats smoke gate's
+    oracle recount (scripts/rulestats_smoke.py) and the config
+    canary's exemplar confirmation (istio_tpu/canary/differ.py) both
+    import it, so the two verification surfaces can never silently
+    disagree."""
+    from istio_tpu.templates import Variety
+
+    info = plan.deny_info.get(ridx) if plan is not None else None
+    if info is not None:
+        return info[0]
+    if plan is not None and ridx in plan.list_rules:
+        for hc, _template, inst_names in snapshot.actions_for(
+                ridx, Variety.CHECK):
+            if hc.adapter != "list":
+                continue
+            entries = set(map(str, hc.params.get("overrides", ())))
+            blacklist = bool(hc.params.get("blacklist", False))
+            for iname in inst_names:
+                ref = snapshot.instances[iname].value_attr_ref()
+                if isinstance(ref, tuple):
+                    c, ok = bag.get(ref[0])
+                    v = c.get(ref[1]) if ok and \
+                        isinstance(c, Mapping) else None
+                    ok = v is not None
+                else:
+                    v, ok = bag.get(ref)
+                if not ok or not isinstance(v, str):
+                    return 13            # INTERNAL: absent value
+                member = v in entries
+                if member and blacklist:
+                    return 7             # PERMISSION_DENIED
+                if not member and not blacklist:
+                    return 5             # NOT_FOUND
+    return 0
+
+
 class SnapshotOracle:
     """Whole-snapshot CPU oracle executor — the graceful-degradation
     resolve path the device circuit breaker falls back to

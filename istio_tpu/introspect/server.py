@@ -132,6 +132,7 @@ class IntrospectServer:
         "/debug/resilience": "_h_resilience",
         "/debug/analysis": "_h_analysis",
         "/debug/rulestats": "_h_rulestats",
+        "/debug/canary": "_h_canary",
     }
 
     @staticmethod
@@ -412,6 +413,70 @@ class IntrospectServer:
                 log.exception("rulestats analyzer cross-check failed")
         payload = agg.snapshot(
             top_k=int(q.get("k", 0) or 0) or None, shadowed=shadowed)
+        self._send_json(req, payload)
+
+    def _h_canary(self, req: BaseHTTPRequestHandler) -> None:
+        """Config-canary view (istio_tpu/canary): recorder occupancy,
+        gate config, and the last N shadow-replay reports — per-rule
+        divergence counts with exemplars whose trace ids join
+        /debug/traces and whose `bag` field replays via `mixs canary`.
+        Diverging rules are cross-checked against the memoized static
+        analysis (`analyzer_overlap`): a rule that both flips recorded
+        decisions AND carries a shadow/overlap/plane finding is drift
+        with independent static evidence. `?shadow=0` skips the
+        cross-check (the analysis run is memoized per generation but
+        not free)."""
+        if self.runtime is None:
+            self._send_json(req, {"error": "no runtime attached"}, 503)
+            return
+        canary = getattr(self.runtime, "canary", None)
+        if canary is None:
+            self._send_json(
+                req, {"error": "canary not enabled "
+                               "(ServerArgs.canary / --canary)"}, 503)
+            return
+        payload = canary.snapshot()
+        ctl = self.runtime.controller
+        rej = getattr(ctl, "last_canary_rejection", None)
+        if rej is not None:
+            payload["last_rejection"] = str(rej)
+        if self._query(req).get("shadow", "1") != "0":
+            try:
+                snap = ctl.dispatcher.snapshot
+                analysis = self._analysis_for(snap)
+                # analyzer findings name compiler rules "name.ns"
+                # (config._qualify); canary per_rule keys are "ns/name"
+                # (Snapshot.qualified_rule_names) — index findings
+                # under both forms plus the bare name so the join
+                # works regardless of which surface produced the id
+                def _canon(rid: str) -> str:
+                    name, sep, ns = rid.rpartition(".")
+                    return f"{ns}/{name}" if sep else rid
+
+                flagged: dict[str, list] = {}
+                for f in analysis.get("findings", ()):
+                    if f.get("code") not in (
+                            "shadowed-rule", "allow-deny-conflict",
+                            "plane-divergence"):
+                        continue
+                    for r in f.get("rules") or ():
+                        for key in {r, _canon(r)}:
+                            flagged.setdefault(key, []).append(
+                                f["code"])
+                for rep in payload["reports"]:
+                    overlap = []
+                    for name in rep.get("per_rule", {}):
+                        # exact forms only: a bare-name fallback would
+                        # attach a default-namespace finding to a
+                        # same-named rule in ANY namespace — a wrong
+                        # cross-link an operator may act on
+                        codes = flagged.get(name)
+                        if codes:
+                            overlap.append({"rule": name,
+                                            "codes": sorted(set(codes))})
+                    rep["analyzer_overlap"] = overlap
+            except Exception:
+                log.exception("canary analyzer cross-check failed")
         self._send_json(req, payload)
 
     def _h_traces(self, req: BaseHTTPRequestHandler) -> None:

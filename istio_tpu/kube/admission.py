@@ -143,6 +143,114 @@ def register_analysis_admission(cluster: FakeKubeCluster,
     cluster.register_admission(validate, kinds=kinds)
 
 
+def register_canary_admission(cluster: FakeKubeCluster,
+                              corpus_fn,
+                              default_manifest: Mapping[str, Any]
+                              | None = None,
+                              kinds: tuple[str, ...] = ("rule",
+                                                        "handler",
+                                                        "instance"),
+                              max_divergence_rate: float = 0.0,
+                              waivers: tuple[str, ...] = (),
+                              buckets: tuple[int, ...] = (),
+                              replay_limit: int = 1024,
+                              identity_attr: str =
+                              "destination.service") -> None:
+    """Install the config canary's DYNAMIC replay check as a
+    validating webhook, next to the static analysis admission.
+
+    `kinds` defaults to every mixer kind that can flip a served
+    decision — a handler doc edit (a denier's TTL, a list's overrides)
+    diverges just as hard as a rule edit. DELETEs bypass this hook by
+    FakeKubeCluster construction (delete() runs no admission), same as
+    the static analysis admission; the Controller gate still catches a
+    divergent post-delete snapshot. `identity_attr` must match the
+    serving ServerArgs.identity_attr the corpus was recorded under —
+    namespace visibility during replay follows it.
+
+    On every covered CREATE/UPDATE the PROSPECTIVE snapshot (current
+    CRD state + the incoming object) is compiled to a FusedPlan and the
+    recorded live corpus (`corpus_fn()` → list[CanaryEntry]; typically
+    a live runtime's `canary.recorder.corpus`, or `canary.load_corpus`
+    over a saved file) is shadow-replayed through it. The write is
+    denied when it introduces FRESH diverging rules relative to the
+    current state — delta semantics like the analysis admission, so
+    creation order keeps working: while the store is half-built the
+    recorded corpus legitimately diverges, and only a write that makes
+    a NEW rule flip recorded decisions (beyond `max_divergence_rate`
+    of replayed rows) is rejected, with the typed CanaryRejected as
+    the cause."""
+    from istio_tpu.canary import (CanaryRejected, diff_decisions,
+                                  replay_entries)
+    from istio_tpu.runtime.config import SnapshotBuilder
+
+    def _report(store, entries):
+        from istio_tpu.runtime.fused import build_fused_plan
+        snap = SnapshotBuilder(default_manifest).build(store)
+        plan = build_fused_plan(snap, rule_telemetry=False)
+        if plan is None:
+            # zero-rule snapshot: everything checks OK. Diff against
+            # the shared synthetic allow-everything replay so the
+            # BEFORE baseline still names which recorded decisions a
+            # rule-less store fails to reproduce — creation order then
+            # admits each base rule (its divergence was already
+            # "seen") while a genuinely fresh flip still registers as
+            # new.
+            from istio_tpu.canary.replay import allow_everything_replay
+            replay = allow_everything_replay(len(entries))
+        else:
+            replay = replay_entries(snap, plan, entries,
+                                    buckets=buckets,
+                                    identity_attr=identity_attr)
+        return diff_decisions(entries, replay, waivers=waivers)
+
+    memo: dict[str, Any] = {}
+
+    def validate(verb: str, obj: Mapping[str, Any]) -> None:
+        if verb not in ("CREATE", "UPDATE"):
+            return
+        entries = list(corpus_fn() or ())[-replay_limit:]
+        if not entries:
+            return          # nothing recorded: nothing to judge
+        rv = getattr(cluster, "_rv", None)
+        # corpus fingerprint: a live ring at capacity keeps a constant
+        # length while its CONTENT rotates under traffic — the memoed
+        # 'before' must be diffed against the same rows, or rotated-in
+        # divergences get misattributed to the incoming write
+        fp = (len(entries), entries[0].t, entries[-1].t)
+        if rv is None or memo.get("rv") != rv or \
+                memo.get("fp") != fp:
+            memo["before"] = _report(_store_from_cluster(cluster),
+                                     entries)
+            memo["rv"] = rv
+            memo["fp"] = fp
+        before = memo["before"]
+        after = _report(_store_from_cluster(cluster, extra=obj),
+                        entries)
+        seen = set(before.per_rule)
+        fresh = [r for r in after.diverging_rules() if r not in seen]
+        fresh_rows = sum(after.per_rule[r]["total"] for r in fresh)
+        rate = fresh_rows / max(after.n_rows, 1)
+        if not fresh or rate <= max_divergence_rate:
+            # admitted: the prospective state becomes the current one
+            # at commit (FakeKubeCluster bumps _rv by 1), so this
+            # `after` report IS the next write's `before` — seeding
+            # the memo halves admission cost on ordered creates
+            memo["before"] = after
+            memo["rv"] = (rv or 0) + 1
+            memo["fp"] = fp
+            return
+        rej = CanaryRejected(
+            f"canary replay: {obj.get('kind')} "
+            f"{(obj.get('metadata') or {}).get('name')} flips "
+            f"{fresh_rows}/{after.n_rows} recorded live decisions "
+            f"(rate {rate:.4f} > {max_divergence_rate}) — fresh "
+            f"diverging rules: {', '.join(fresh[:5])}", after)
+        raise AdmissionDenied(str(rej)) from rej
+
+    cluster.register_admission(validate, kinds=kinds)
+
+
 def register_sidecar_injector(cluster: FakeKubeCluster,
                               params: "InjectParams | None" = None,
                               namespaces: "tuple[str, ...] | None" = None

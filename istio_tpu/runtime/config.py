@@ -111,6 +111,19 @@ class Snapshot:
                 return i
         raise KeyError((namespace, name))
 
+    def qualified_rule_names(self) -> list[str]:
+        """Positional rule index → "ns/name" (bare name for the
+        default namespace) — THE rule naming convention every
+        index-keyed surface renders through (rulestats aggregation,
+        canary diff attribution, waiver matching). Memoized: the
+        snapshot is immutable."""
+        names = getattr(self, "_qnames", None)
+        if names is None:
+            names = [f"{r.namespace}/{r.name}" if r.namespace
+                     else r.name for r in self.rules]
+            self._qnames = names
+        return names
+
     def actions_for(self, rule_idx: int,
                     variety: Variety) -> list[tuple[HandlerConfig, str, list[str]]]:
         """[(handler cfg, template, instance names)] of one variety —

@@ -34,13 +34,24 @@ class Controller:
                  fused: bool = True,
                  prewarm_buckets: tuple[int, ...] = (),
                  mesh=None,
-                 rule_telemetry: bool = True):
+                 rule_telemetry: bool = True,
+                 canary=None,
+                 on_canary_reject: Callable[..., None] | None = None):
         self.store = store
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
         self.on_publish = on_publish
         self.fused_enabled = fused
         self.rule_telemetry = rule_telemetry
+        # config canary (istio_tpu/canary.ConfigCanary): shadow-replay
+        # recorded live traffic through every rebuilt snapshot before
+        # the atomic swap; in gate mode a divergent candidate VETOES
+        # the publish (the old dispatcher keeps serving) and the typed
+        # CanaryRejected surfaces via last_canary_rejection /
+        # on_canary_reject / the introspect /debug/canary view
+        self.canary = canary
+        self.on_canary_reject = on_canary_reject
+        self.last_canary_rejection = None
         self.mesh = mesh    # jax.sharding.Mesh for multi-chip serving
         self.prewarm_buckets = tuple(prewarm_buckets)
         self._builder = SnapshotBuilder(default_manifest,
@@ -88,7 +99,6 @@ class Controller:
 
     def _rebuild_locked(self) -> Dispatcher:
         snapshot = self._builder.build(self.store)
-        handlers, orphans = self._handler_table.rebuild(snapshot)
         for err in snapshot.errors:
             log.warning("config: %s", err)
         plan = None
@@ -111,14 +121,44 @@ class Controller:
                     threading.Thread(
                         target=plan.prewarm, args=(self.prewarm_buckets,),
                         daemon=True, name="prewarm-initial").start()
+        # config canary: replay recorded live traffic through the
+        # candidate BEFORE any publish side effect (the handler table
+        # and quota pools below mutate shared state toward the new
+        # snapshot; a vetoed candidate must leave them untouched so
+        # the old dispatcher keeps serving unchanged). The gate never
+        # raises — internal canary failures fail open.
+        if self.canary is not None and self._dispatcher is not None:
+            rejection = self.canary.gate(self._dispatcher, snapshot,
+                                         plan, self.prewarm_buckets)
+            if rejection is not None:
+                self.last_canary_rejection = rejection
+                log.error("config publish VETOED (generation %d kept "
+                          "serving): %s", self._dispatcher.snapshot
+                          .revision, rejection)
+                if self.on_canary_reject is not None:
+                    try:
+                        self.on_canary_reject(rejection)
+                    except Exception:
+                        log.exception("on_canary_reject hook failed")
+                return self._dispatcher
+        handlers, orphans = self._handler_table.rebuild(snapshot)
         quota_orphans: list = []
         if self._quota_table is not None:
             self.device_quotas, quota_orphans = \
                 self._quota_table.rebuild(snapshot)
         dispatcher = Dispatcher(snapshot, handlers, self.identity_attr,
                                 fused=plan,
-                                buckets=self.prewarm_buckets)
+                                buckets=self.prewarm_buckets,
+                                recorder=self.canary.recorder
+                                if self.canary is not None else None)
         self._dispatcher = dispatcher      # atomic publish (GIL ref swap)
+        # a successful publish supersedes any earlier veto: introspect
+        # must not report a stale rejection against the live config
+        self.last_canary_rejection = None
+        if self.canary is not None:
+            # post-swap hook: re-baselines the recorder when the
+            # published candidate was divergent (gate.on_published)
+            self.canary.on_published(dispatcher)
         if quota_orphans:
             # same delayed drain as handler orphans: in-flight quota
             # loops may still hold the old pool (alloc() on a closed

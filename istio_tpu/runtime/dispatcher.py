@@ -26,6 +26,7 @@ by design (SURVEY.md §7 layer 4):
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
 import time
@@ -67,6 +68,12 @@ class CheckResponse:
     # positional within ONE snapshot, so the quota loop must read the
     # same plan even if a config swap republished mid-request
     quota_context: Any = None
+    # DEVICE deny attribution: the lowest-index rule whose fused check
+    # action produced the non-OK device status (-1 when the device
+    # answered OK — host-overlay adapters may still set a non-OK
+    # status, which stays unattributed here). The canary recorder and
+    # shadow replay (istio_tpu/canary) key their per-rule diff on it.
+    deny_rule: int = -1
 
 
 def _namespace_of(bag: Bag, identity_attr: str) -> str:
@@ -86,13 +93,25 @@ class Dispatcher:
     def __init__(self, snapshot: Snapshot, handlers: Mapping[str, Handler],
                  identity_attr: str = DEFAULT_IDENTITY_ATTR,
                  fused=None,
-                 buckets: tuple[int, ...] = ()):
+                 buckets: tuple[int, ...] = (),
+                 recorder=None,
+                 observe: bool = True):
         self.snapshot = snapshot
         self.handlers = dict(handlers)
         self.identity_attr = identity_attr
         # FusedPlan (runtime/fused.py) — when present, check() runs the
         # fused device engine and overlays only host-only actions
         self.fused = fused
+        # canary TrafficRecorder (istio_tpu/canary/recorder.py): when
+        # present, check batches tap their served decisions into the
+        # sampling ring at this boundary — the same verdicts callers
+        # receive, so a recorded decision is replayable evidence
+        self.recorder = recorder
+        # False = shadow-replay mode (istio_tpu/canary/replay.py): no
+        # stage histograms, no e2e/live-p99 feeds, no rule-telemetry
+        # folds, no chaos seam, no recorder tap — a canary replay must
+        # not pollute the serving metrics it is judged against
+        self.observe = observe
         # prewarmed serving batch shapes: device work OUTSIDE the
         # batcher (the fused report resolve) pads to these so arbitrary
         # arrival counts never compile in-band
@@ -171,7 +190,7 @@ class Dispatcher:
         their verdicts do)."""
         plan, rs = self.fused, self.snapshot.ruleset
         n_err = int(packed[4, 0]) if packed.shape[1] else 0
-        if n_err:
+        if n_err and self.observe:   # replay mode: no counter feeds
             monitor.RESOLVE_ERRORS.inc(n_err)
         cols = plan.overlay_cols
         if not len(cols):
@@ -206,7 +225,7 @@ class Dispatcher:
                     vis_errs += 1   # oracle parity: ns-visible errors
             if vis_errs:
                 err_by_rule[ridx] = vis_errs
-        if host_errs:
+        if host_errs and self.observe:
             monitor.RESOLVE_ERRORS.inc(host_errs)
         active_sub &= ns_ok_sub
         tele = plan.telemetry
@@ -296,13 +315,20 @@ class Dispatcher:
         if self.fused is not None:
             return self._check_fused(bags, instep=instep,
                                      pre_tensorized=pre_tensorized)
-        actives, visibles = self._resolve(bags, observe=True)
+        actives, visibles = self._resolve(bags, observe=self.observe)
         t_respond = time.perf_counter()
         out = []
         for bag, rule_idxs, vis in zip(bags, actives, visibles):
             out.append(self._check_one(bag, rule_idxs, vis))
-        monitor.observe_stage("respond",
-                              time.perf_counter() - t_respond)
+        if self.observe:
+            monitor.observe_stage("respond",
+                                  time.perf_counter() - t_respond)
+        # NO recorder tap here: the generic path's statuses include
+        # host-adapter results the shadow replay (empty handlers,
+        # device surface only) can never reproduce — a corpus recorded
+        # on a non-fused server would diff as permanently divergent
+        # against an identical config. Canary recording is fused-only,
+        # like the replay itself.
         return out
 
     def _check_fused(self, bags: Sequence[Bag], instep: Any = None,
@@ -324,7 +350,9 @@ class Dispatcher:
         # every host-side pass below runs on the real prefix only
         from istio_tpu.runtime.batcher import trim_pads
         n_real = len(trim_pads(bags))
-        with monitor.resolve_timer():
+        observe = self.observe
+        with (monitor.resolve_timer() if observe
+              else contextlib.nullcontext()):
             if pre_tensorized is not None:
                 batch, ns_ids = pre_tensorized
             else:
@@ -333,8 +361,9 @@ class Dispatcher:
                     # C++ wire→tensor decode when possible: no
                     # per-request python work
                     batch, ns_ids = self._tensorize_for_device(bags)
-                monitor.observe_stage("tensorize",
-                                      time.perf_counter() - t_tz)
+                if observe:
+                    monitor.observe_stage("tensorize",
+                                          time.perf_counter() - t_tz)
             # ONE device→host pull for the whole verdict: each extra
             # pull costs a full RTT (~120ms behind the axon tunnel),
             # and plane-by-plane conversion was 6 RTTs per batch
@@ -360,6 +389,7 @@ class Dispatcher:
                     on_pull(packed[-2], packed[-1] != 0)
                 else:
                     packed = plan.packed_check(batch, ns_ids,
+                                               observe=observe,
                                                n_real=n_real)
             status = packed[0]
             dur = packed[1].view(np.float32)
@@ -393,7 +423,7 @@ class Dispatcher:
         # oracle-evaluated into their subset positions
         # (_overlay_active, shared with the fused report path).
         active_sub, col_pos = self._overlay_active(packed, bags, ns_ids,
-                                                   observe=True)
+                                                   observe=observe)
         # hotpath: sync-ok x2 — tensorizer planes are host numpy
         present_np = np.asarray(batch.present)[:n_real]        # hotpath: sync-ok
         map_present_np = np.asarray(batch.map_present)[:n_real]  # hotpath: sync-ok
@@ -468,13 +498,16 @@ class Dispatcher:
         # signature dedup); respond = the per-row CheckResponse loop —
         # together they are the span the serve.overlay emit reports
         t_respond = time.perf_counter()
-        monitor.observe_stage("fold", t_respond - t_overlay)
+        if observe:
+            monitor.observe_stage("fold", t_respond - t_overlay)
         # decision exemplars: denied/errored rows reservoir-sample into
         # the telemetry plane (host-side, post-fold, from the already-
         # decoded verdict) with the batch's active span so a
-        # /debug/rulestats entry links to its RingReporter trace
-        tele = plan.telemetry
-        tele_span = tr._current() if tele is not None else None
+        # /debug/rulestats entry links to its RingReporter trace; the
+        # canary recorder shares the span so its samples join traces
+        tele = plan.telemetry if observe else None
+        tele_span = tr._current() \
+            if tele is not None or self.recorder is not None else None
         out = []
         for b, bag in enumerate(bags):
             resp = CheckResponse()
@@ -509,8 +542,11 @@ class Dispatcher:
             if not dev_applied:
                 self._apply_device_status(resp, plan, dev_rule,
                                           int(status[b]))
-            if tele is not None and status[b] != OK:
-                tele.sample(dev_rule, int(status[b]), bag, tele_span)
+            if status[b] != OK:
+                resp.deny_rule = dev_rule
+                if tele is not None:
+                    tele.sample(dev_rule, int(status[b]), bag,
+                                tele_span)
             # referenced/presence: precomputed per unique signature
             if ref_of is not None:
                 resp.referenced, resp.referenced_presence = ref_of[b]
@@ -522,10 +558,21 @@ class Dispatcher:
             else:
                 resp.active_quota_rules = ()
             out.append(resp)
-        monitor.observe_stage("respond",
-                              time.perf_counter() - t_respond)
-        tr.emit("serve.overlay", time.perf_counter() - t_overlay,
-                batch=len(bags))
+        if observe:
+            monitor.observe_stage("respond",
+                                  time.perf_counter() - t_respond)
+            tr.emit("serve.overlay", time.perf_counter() - t_overlay,
+                    batch=len(bags))
+        if self.recorder is not None:
+            # canary tap: bags/out are already padding-trimmed; one
+            # stride check per batch, bounded appends for sampled rows
+            # (istio_tpu/canary/recorder.py — off the device path).
+            # The DEVICE planes are recorded, not the merged response:
+            # the shadow replay compares device-decidable decisions
+            # (host adapters never fire in shadow)
+            self.recorder.tap(bags, out, snap, self.identity_attr,
+                              tele_span,
+                              device=(status, dur, uses, deny_rule))
         return out
 
     @staticmethod

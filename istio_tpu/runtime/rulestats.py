@@ -83,6 +83,23 @@ def register_families(reg: hostmetrics.Registry) -> dict:
 FAMILIES = register_families(hostmetrics.default_registry)
 
 
+def preview_attributes(bag, limit: int = 16,
+                       value_len: int = 128) -> dict:
+    """Bounded attribute preview of one sampled request — THE exemplar
+    rendering contract shared by /debug/rulestats and /debug/canary
+    (istio_tpu/canary/differ.py): first `limit` attributes, reprs
+    truncated to `value_len`, decode failures sentineled."""
+    attrs: dict = {}
+    try:
+        for name in list(bag.names())[:limit]:
+            v, ok = bag.get(name)
+            if ok:
+                attrs[str(name)] = repr(v)[:value_len]
+    except Exception:
+        attrs = {"<decode-failed>": "1"}
+    return attrs
+
+
 class RuleTelemetry:
     """Per-snapshot on-device rule accumulators.
 
@@ -323,11 +340,6 @@ class RuleStatsAggregator:
 
     # -- wiring --
 
-    @staticmethod
-    def _qualified(rule) -> str:
-        ns = getattr(rule, "namespace", "") or ""
-        return f"{ns}/{rule.name}" if ns else rule.name
-
     # how long a swapped-out plan's telemetry keeps being swept by
     # subsequent drains: batches in flight on the OLD dispatcher may
     # still fold into it after the rebind (mirrors the controller's
@@ -354,7 +366,14 @@ class RuleStatsAggregator:
             has_tele = plan is not None and \
                 getattr(plan, "telemetry", None) is not None
             self._plan = plan if has_tele else None
-            self._names = [self._qualified(r) for r in snap.rules]
+            # index→name mapping shared with the canary differ
+            # (runtime/config.Snapshot.qualified_rule_names); test
+            # doubles may hand bare rule lists without the method
+            qn = getattr(snap, "qualified_rule_names", None)
+            self._names = list(qn()) if qn is not None else [
+                f"{r.namespace}/{r.name}"
+                if getattr(r, "namespace", "") else r.name
+                for r in snap.rules]
             by_id = {v: k for k, v in rs.ns_ids.items()}
             n_slots = len(rs.ns_ids) + 1
             self._slot_names = [
@@ -491,16 +510,8 @@ class RuleStatsAggregator:
         """Decode a sampled request off the hot path: the compressed
         attribute bag renders to a bounded attribute preview, the
         trace/span ids pass through for /debug/traces joins."""
-        attrs: dict = {}
-        bag = e.get("bag")
-        try:
-            for name in list(bag.names())[:16]:
-                v, ok = bag.get(name)
-                if ok:
-                    attrs[str(name)] = repr(v)[:128]
-        except Exception:
-            attrs = {"<decode-failed>": "1"}
-        return {"status": e["status"], "attributes": attrs,
+        return {"status": e["status"],
+                "attributes": preview_attributes(e.get("bag")),
                 "trace_id": e.get("trace_id"),
                 "span_id": e.get("span_id"), "t": e.get("t")}
 

@@ -96,6 +96,27 @@ class ServerArgs:
     # counter families / adapter exporters. 0 disables the thread
     # (drains then happen only on demand: /debug/rulestats, tests).
     rulestats_drain_s: float = 0.5
+    # -- config canary (istio_tpu/canary/) -----------------------------
+    # shadow-replay recorded live Check() traffic through every
+    # rebuilt snapshot before the atomic publish: "off" disables the
+    # recorder + replay entirely; "warn" replays and records the diff
+    # report but always publishes; "gate" VETOES a publish whose
+    # divergence rate exceeds canary_max_divergence (the old
+    # dispatcher keeps serving; CanaryRejected surfaces via
+    # /debug/canary and Controller.last_canary_rejection)
+    canary: str = "off"
+    # recorder sampling ring: capacity bounds memory, sample_every=k
+    # keeps every k-th request (uniform stride across batches)
+    canary_capacity: int = 2048
+    canary_sample_every: int = 1
+    # newest recorded rows replayed per candidate evaluation
+    canary_replay_limit: int = 1024
+    # non-waived divergent rows / replayed rows beyond which `gate`
+    # vetoes (strictly greater-than; 0.0 = any divergence vetoes)
+    canary_max_divergence: float = 0.0
+    # qualified rule names ("ns/name") whose divergences are reported
+    # but never gate — the "this rule is SUPPOSED to change" hatch
+    canary_waivers: tuple = ()
 
 
 class RuntimeServer:
@@ -125,6 +146,20 @@ class RuntimeServer:
         from istio_tpu.runtime.rulestats import (RuleStatsAggregator,
                                                  RuleStatsDrainer)
         self.rulestats = RuleStatsAggregator()
+        # config canary (istio_tpu/canary): built before the
+        # controller so the very first dispatcher already carries the
+        # recorder tap — the gate itself only engages from the second
+        # rebuild on (there is nothing recorded before traffic flows)
+        self.canary = None
+        if self.args.canary != "off":
+            from istio_tpu.canary import CanaryConfig, ConfigCanary
+            self.canary = ConfigCanary(CanaryConfig(
+                mode=self.args.canary,
+                max_divergence_rate=self.args.canary_max_divergence,
+                waivers=tuple(self.args.canary_waivers),
+                capacity=self.args.canary_capacity,
+                sample_every=self.args.canary_sample_every,
+                replay_limit=self.args.canary_replay_limit))
         self.controller = Controller(
             store, default_manifest=manifest,
             identity_attr=self.args.identity_attr,
@@ -133,6 +168,7 @@ class RuntimeServer:
             prewarm_buckets=buckets,
             mesh=mesh,
             rule_telemetry=self.args.rule_telemetry,
+            canary=self.canary,
             on_publish=self._on_config_publish)
         self._rulestats_drainer = RuleStatsDrainer(
             self.rulestats, self.args.rulestats_drain_s) \

@@ -1,0 +1,413 @@
+"""Config-canary CI gate: seeded divergent swaps MUST be vetoed with
+correct per-rule attribution, identical-semantics swaps MUST publish
+with zero divergences — on every surface.
+
+Drives istio_tpu/canary over testing/corpus.make_canary_snapshot_pairs
+(seeded pairs planting one divergence class each: tightened deny match
+→ status flip, denier TTL change → precondition, tightened quota rule
+→ quota delta):
+
+  CONTROLLER — a RuntimeServer in --canary=gate serves the seeded
+  traffic (the recorder fills at the dispatcher boundary), then the
+  store swaps to the DIVERGENT snapshot: the publish must be vetoed
+  (old dispatcher object keeps serving, typed CanaryRejected recorded)
+  with the planted rule named in the report under the planted
+  divergence kind; status-flip exemplars must carry replayable bags
+  whose ORACLE RE-EVALUATION (SnapshotOracle over both snapshots)
+  confirms the flip. Traffic served after the veto must answer with
+  base semantics — zero dropped requests. The IDENTICAL-semantics
+  swap (conjuncts reordered, store order reversed) must publish with
+  zero reported divergences. Warn mode must publish the divergent
+  candidate but record the report.
+
+  INTROSPECT — /debug/canary lists the reports (veto + publish) and
+  /metrics carries the mixer_canary_* families.
+
+  CLI — the recorded corpus saves to a file; `canary --config-store
+  <divergent dir> --corpus <file>` must exit 1 naming the planted
+  rule, and exit 0 against the base dir.
+
+  ADMISSION — kube.admission.register_canary_admission must admit the
+  base world in creation order (delta semantics), DENY the divergent
+  rule update, and admit the identical rewrite.
+
+Runnable under JAX_PLATFORMS=cpu; tier-1 invokes main() in-process
+(tests/test_canary_smoke.py).
+
+Usage: JAX_PLATFORMS=cpu python scripts/canary_smoke.py [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BUCKETS = (16, 32)
+
+
+def _serve(srv, bags) -> list:
+    from istio_tpu.runtime.batcher import pad_to_bucket
+
+    out = []
+    for lo in range(0, len(bags), BUCKETS[-1]):
+        out.extend(srv.check_batch_preprocessed(pad_to_bucket(
+            bags[lo:lo + BUCKETS[-1]], BUCKETS))[
+                :len(bags[lo:lo + BUCKETS[-1]])])
+    return out
+
+
+def _swap_store(store, old_docs, new_docs) -> None:
+    """Replace the store contents doc-set → doc-set (deleting keys the
+    new set no longer carries)."""
+    from istio_tpu.runtime.store import Event
+
+    old_keys = {k for k, _ in old_docs}
+    new_keys = {k for k, _ in new_docs}
+    events = [Event(k, None) for k in old_keys - new_keys]
+    events += [Event(k, dict(s)) for k, s in new_docs]
+    store.apply_events(events)
+
+
+def _controller_leg(pair, seed: int, failures: list[str],
+                    save_corpus_to: str | None = None) -> None:
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.canary import CanaryRejected, save_corpus
+    from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+    from istio_tpu.testing import corpus
+
+    tag = f"[{pair.kind}]"
+    store = MemStore()
+    for k, s in pair.base_docs:
+        store.set(k, s)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=BUCKETS[-1], buckets=BUCKETS,
+        canary="gate", rulestats_drain_s=0,
+        default_manifest=corpus.ANALYZER_MANIFEST))
+    # the smoke drives rebuilds explicitly — keep the debounce timer
+    # from racing a second rebuild mid-assertion
+    srv.controller.debounce_s = 60.0
+    try:
+        bags = [bag_from_mapping(d)
+                for d in corpus.make_canary_traffic(pair, seed)]
+        recorded = _serve(srv, bags)
+        if save_corpus_to:
+            save_corpus(save_corpus_to, srv.canary.recorder.corpus())
+        d0 = srv.controller.dispatcher
+
+        # -- divergent swap: must veto ---------------------------------
+        _swap_store(store, pair.base_docs, pair.divergent_docs)
+        d1 = srv.controller.rebuild()
+        rej = srv.controller.last_canary_rejection
+        if d1 is not d0:
+            failures.append(f"{tag} divergent candidate PUBLISHED in "
+                            f"gate mode")
+            return
+        if not isinstance(rej, CanaryRejected):
+            failures.append(f"{tag} veto recorded no typed "
+                            f"CanaryRejected")
+            return
+        rep = rej.report
+        c = rep.per_rule.get(pair.divergent_rule)
+        if c is None:
+            failures.append(
+                f"{tag} report misattributes: planted rule "
+                f"{pair.divergent_rule} absent "
+                f"(got {sorted(rep.per_rule)})")
+            return
+        if not c.get(pair.expected):
+            failures.append(f"{tag} planted divergence classified as "
+                            f"{c}, expected kind {pair.expected}")
+        stray = [r for r in rep.diverging_rules()
+                 if r != pair.divergent_rule]
+        if pair.kind != "ttl-change" and stray:
+            # ttl-change legitimately names every firing deny rule
+            # (the shared denier handler's TTL changed for all)
+            failures.append(f"{tag} stray diverging rules {stray}")
+        if not c["exemplars"]:
+            failures.append(f"{tag} no exemplars for the planted rule")
+        for ex in c["exemplars"]:
+            if not ex.get("bag"):
+                failures.append(f"{tag} exemplar carries no "
+                                f"replayable bag")
+            if ex["kind"] == "status_flip" and \
+                    ex.get("oracle_confirmed") is not True:
+                failures.append(
+                    f"{tag} status-flip exemplar NOT oracle-"
+                    f"confirmed: {ex.get('oracle_error', ex.get('oracle_status'))}")
+
+        # -- old dispatcher keeps serving: zero dropped requests -------
+        after = _serve(srv, bags)
+        for i, (a, b) in enumerate(zip(recorded, after)):
+            if a.status_code != b.status_code:
+                failures.append(
+                    f"{tag} post-veto serving diverged from base at "
+                    f"row {i}: {a.status_code} -> {b.status_code}")
+                break
+
+        # -- identical-semantics swap: must publish, zero divergences --
+        _swap_store(store, pair.divergent_docs, pair.identical_docs)
+        d2 = srv.controller.rebuild()
+        if d2 is d0:
+            failures.append(f"{tag} identical-semantics candidate did "
+                            f"not publish")
+            return
+        last = srv.canary.reports()[-1]
+        if last.verdict != "publish" or last.n_divergent:
+            failures.append(
+                f"{tag} identical-semantics swap reported "
+                f"{last.n_divergent}/{last.n_rows} divergences "
+                f"(verdict {last.verdict}); diff: "
+                f"{json.dumps(last.per_rule, default=str)[:400]}")
+    finally:
+        srv.close()
+
+
+def _warn_mode_leg(pair, seed: int, failures: list[str]) -> None:
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+    from istio_tpu.testing import corpus
+
+    store = MemStore()
+    for k, s in pair.base_docs:
+        store.set(k, s)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=BUCKETS[-1], buckets=BUCKETS,
+        canary="warn", rulestats_drain_s=0,
+        default_manifest=corpus.ANALYZER_MANIFEST))
+    srv.controller.debounce_s = 60.0
+    try:
+        bags = [bag_from_mapping(d)
+                for d in corpus.make_canary_traffic(pair, seed)]
+        _serve(srv, bags)
+        d0 = srv.controller.dispatcher
+        _swap_store(store, pair.base_docs, pair.divergent_docs)
+        d1 = srv.controller.rebuild()
+        if d1 is d0:
+            failures.append("[warn] divergent candidate was VETOED in "
+                            "warn mode")
+        reports = srv.canary.reports()
+        if not reports or reports[-1].verdict != "warn" or \
+                pair.divergent_rule not in reports[-1].per_rule:
+            failures.append("[warn] warn-mode publish recorded no "
+                            "divergence report naming the planted "
+                            "rule")
+    finally:
+        srv.close()
+
+
+def _introspect_leg(pair, seed: int, failures: list[str]) -> None:
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+    from istio_tpu.testing import corpus
+    from istio_tpu.utils import tracing
+
+    store = MemStore()
+    for k, s in pair.base_docs:
+        store.set(k, s)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=BUCKETS[-1], buckets=BUCKETS,
+        canary="gate", rulestats_drain_s=0,
+        default_manifest=corpus.ANALYZER_MANIFEST))
+    srv.controller.debounce_s = 60.0
+    intro = IntrospectServer(runtime=srv)
+    try:
+        port = intro.start()
+        bags = [bag_from_mapping(d)
+                for d in corpus.make_canary_traffic(pair, seed)]
+        _serve(srv, bags)
+        _swap_store(store, pair.base_docs, pair.divergent_docs)
+        srv.controller.rebuild()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/canary?shadow=0",
+                timeout=30) as r:
+            view = json.loads(r.read().decode())
+        if view.get("mode") != "gate" or not view.get("reports"):
+            failures.append(f"[introspect] /debug/canary empty: "
+                            f"{str(view)[:200]}")
+        else:
+            last = view["reports"][-1]
+            if last.get("verdict") != "veto" or \
+                    pair.divergent_rule not in last.get("per_rule", {}):
+                failures.append("[introspect] /debug/canary last "
+                                "report is not the veto naming the "
+                                "planted rule")
+            if "last_rejection" not in view:
+                failures.append("[introspect] /debug/canary carries "
+                                "no last_rejection")
+        if not view.get("recorder", {}).get("entries"):
+            failures.append("[introspect] recorder stats empty")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=30) as r:
+            mtext = r.read().decode()
+        for fam in ("mixer_canary_replays_total",
+                    "mixer_canary_divergences_total",
+                    "mixer_canary_verdicts_total",
+                    "mixer_canary_last_divergence_rate"):
+            if fam not in mtext:
+                failures.append(f"[introspect] metric family absent "
+                                f"from /metrics: {fam}")
+    finally:
+        intro.close()
+        srv.close()
+        tracing.shutdown()
+
+
+def _docs_to_fsstore(tmp: str, name: str, docs) -> str:
+    """Write [(key, spec)] docs as an FsStore YAML directory."""
+    import yaml
+
+    root = os.path.join(tmp, name)
+    os.makedirs(root, exist_ok=True)
+    payload = [{"kind": kind,
+                "metadata": {"name": n, "namespace": ns},
+                "spec": spec}
+               for (kind, ns, n), spec in docs]
+    with open(os.path.join(root, "world.yaml"), "w",
+              encoding="utf-8") as f:
+        yaml.safe_dump_all(payload, f, sort_keys=False)
+    return root
+
+
+def _cli_leg(pair, corpus_path: str, failures: list[str]) -> None:
+    import contextlib
+    import io
+
+    from istio_tpu.cmd.__main__ import main as cli_main
+
+    def run(argv) -> tuple[int, str]:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main(argv)
+        return rc, buf.getvalue()
+
+    with tempfile.TemporaryDirectory() as tmp:
+        base = _docs_to_fsstore(tmp, "base", pair.base_docs)
+        div = _docs_to_fsstore(tmp, "divergent", pair.divergent_docs)
+        rc, out = run(["canary", "--config-store", base,
+                       "--corpus", corpus_path])
+        if rc != 0:
+            failures.append(f"[cli] exit {rc} against the BASE store "
+                            f"(expected 0): {out[:200]}")
+        rc, out = run(["canary", "--config-store", div,
+                       "--corpus", corpus_path, "--json"])
+        if rc != 1:
+            failures.append(f"[cli] exit {rc} against the divergent "
+                            f"store (expected 1)")
+        else:
+            rep = json.loads(out)
+            if pair.divergent_rule not in rep.get("per_rule", {}):
+                failures.append(f"[cli] report misses the planted "
+                                f"rule {pair.divergent_rule}")
+        # waiving the planted rule must flip the verdict back to 0
+        rc, _out = run(["canary", "--config-store", div,
+                        "--corpus", corpus_path,
+                        "--waive", pair.divergent_rule])
+        if rc != 0:
+            failures.append(f"[cli] exit {rc} with the planted rule "
+                            f"waived (expected 0)")
+
+
+def _admission_leg(pair, corpus_path: str, failures: list[str]) -> None:
+    from istio_tpu.canary import load_corpus
+    from istio_tpu.kube.admission import register_canary_admission
+    from istio_tpu.kube.fake import AdmissionDenied, FakeKubeCluster
+    from istio_tpu.testing import corpus as tcorpus
+
+    entries = load_corpus(corpus_path)
+    cluster = FakeKubeCluster()
+    register_canary_admission(
+        cluster, corpus_fn=lambda: entries,
+        default_manifest=tcorpus.ANALYZER_MANIFEST, buckets=BUCKETS)
+
+    def obj(key, spec):
+        kind, ns, name = key
+        return {"kind": kind,
+                "metadata": {"name": name, "namespace": ns},
+                "spec": spec}
+
+    try:
+        for key, spec in pair.base_docs:
+            cluster.create(obj(key, spec))
+    except AdmissionDenied as exc:
+        failures.append(f"[admission] base world rejected in creation "
+                        f"order (delta semantics broken): {exc}")
+        return
+    base_by_key = {k: s for k, s in pair.base_docs}
+    changed = [(k, s) for k, s in pair.divergent_docs
+               if base_by_key.get(k) != s]
+    if not changed:
+        failures.append(f"[admission] {pair.kind} pair has no changed "
+                        f"doc")
+        return
+    for key, spec in changed:
+        try:
+            cluster.update(obj(key, spec))
+            failures.append(f"[admission] divergent {key} ADMITTED")
+        except AdmissionDenied:
+            pass
+    # identical rewrite of an existing rule must stay admitted
+    ident_by_key = {k: s for k, s in pair.identical_docs}
+    rule_keys = [k for k in ident_by_key
+                 if k[0] == "rule" and k in base_by_key]
+    if not rule_keys:
+        failures.append(f"[admission] {pair.kind}: no rule doc to "
+                        f"test the identical rewrite with")
+    for key in rule_keys[:2]:
+        try:
+            cluster.update(obj(key, ident_by_key[key]))
+        except AdmissionDenied as exc:
+            failures.append(f"[admission] identical rewrite of {key} "
+                            f"rejected: {exc}")
+
+
+def main(seed: int = 20260803) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from istio_tpu.testing import corpus
+
+    failures: list[str] = []
+    pairs = corpus.make_canary_snapshot_pairs(seed)
+    corpus_paths: dict[int, str] = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for i, pair in enumerate(pairs):
+            # pairs 0 (rule-doc divergence) and 1 (handler-doc
+            # divergence) feed the CLI/admission legs too
+            save_to = os.path.join(tmp, f"corpus{i}.json") if i < 2 \
+                else None
+            _controller_leg(pair, seed, failures,
+                            save_corpus_to=save_to)
+            if save_to and os.path.exists(save_to):
+                corpus_paths[i] = save_to
+        _warn_mode_leg(pairs[0], seed, failures)
+        _introspect_leg(pairs[0], seed, failures)
+        if 0 in corpus_paths:
+            _cli_leg(pairs[0], corpus_paths[0], failures)
+        else:
+            failures.append("no corpus file was saved for the CLI leg")
+        for i in sorted(corpus_paths):
+            # i=1 is the ttl-change pair: its divergent doc is a
+            # HANDLER update — the admission hook's default kinds
+            # must cover it, not just rule docs
+            _admission_leg(pairs[i], corpus_paths[i], failures)
+    for f in failures:
+        print(f"canary_smoke: FAIL: {f}")
+    if not failures:
+        print(f"canary_smoke: ok (seed={seed}: {len(pairs)} seeded "
+              f"divergence classes vetoed in gate mode with per-rule "
+              f"attribution + oracle-confirmed flips; identical-"
+              f"semantics swaps published with zero divergences; "
+              f"warn/introspect/CLI/admission surfaces agree)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=20260803,
+                    help="reproducible corpus seed")
+    sys.exit(main(seed=ap.parse_args().seed))

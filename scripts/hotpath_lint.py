@@ -70,6 +70,15 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
         "RuleTelemetry.observe", "RuleTelemetry.add_host",
         "RuleTelemetry.sample", "RuleTelemetry.drain",
     }),
+    # canary recorder tap (PR 5): runs inside the dispatcher's check
+    # hot sections (already linted above) on every served batch —
+    # stride check + bounded tuple appends only. Corpus build / replay
+    # / diff run at config-swap time, NOT here: the replay boundary
+    # (canary/replay.py via the observe-off Dispatcher) is where the
+    # device pulls live, behind dispatcher.py's existing pragmas.
+    "istio_tpu/canary/recorder.py": frozenset({
+        "TrafficRecorder.tap",
+    }),
 }
 
 _SYNC_ATTRS = ("item", "block_until_ready")

@@ -50,14 +50,16 @@ def oracle_recount(snapshot, plan, bags,
       errors  — rule namespace-visible AND predicate raised
 
     Deny attribution re-derives the fused action semantics from the
-    snapshot (denier params, STRINGS list membership with the
-    blacklist→PERMISSION_DENIED / whitelist-miss→NOT_FOUND / absent→
-    INTERNAL codes of models/policy_engine) — independent of the
-    device path being verified. Shared by this smoke and the
+    snapshot via compiler/ruleset.fused_check_status (denier params,
+    STRINGS list membership with the blacklist→PERMISSION_DENIED /
+    whitelist-miss→NOT_FOUND / absent→INTERNAL codes of
+    models/policy_engine) — independent of the device path being
+    verified, and the SAME derivation the canary's exemplar
+    confirmation uses. Shared by this smoke and the
     tests/test_rulestats.py property tests."""
-    from istio_tpu.compiler.ruleset import SnapshotOracle
+    from istio_tpu.compiler.ruleset import (SnapshotOracle,
+                                            fused_check_status)
     from istio_tpu.runtime.dispatcher import _namespace_of
-    from istio_tpu.templates import Variety
 
     rs = snapshot.ruleset
     n_cfg = len(snapshot.rules)
@@ -69,33 +71,7 @@ def oracle_recount(snapshot, plan, bags,
     errors: dict[int, int] = {}
 
     def fused_status(ridx: int, bag) -> int:
-        info = plan.deny_info.get(ridx)
-        if info is not None:
-            return info[0]
-        if ridx in plan.list_rules:
-            for hc, _template, inst_names in snapshot.actions_for(
-                    ridx, Variety.CHECK):
-                if hc.adapter != "list":
-                    continue
-                entries = set(map(str, hc.params.get("overrides", ())))
-                blacklist = bool(hc.params.get("blacklist", False))
-                for iname in inst_names:
-                    ref = snapshot.instances[iname].value_attr_ref()
-                    if isinstance(ref, tuple):
-                        c, ok = bag.get(ref[0])
-                        v = c.get(ref[1]) if ok and \
-                            isinstance(c, dict) else None
-                        ok = v is not None
-                    else:
-                        v, ok = bag.get(ref)
-                    if not ok or not isinstance(v, str):
-                        return 13            # INTERNAL: absent value
-                    member = v in entries
-                    if member and blacklist:
-                        return 7             # PERMISSION_DENIED
-                    if not member and not blacklist:
-                        return 5             # NOT_FOUND
-        return 0
+        return fused_check_status(snapshot, plan, ridx, bag)
 
     for bag in bags:
         req_ns = _namespace_of(bag, identity_attr)

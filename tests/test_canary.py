@@ -1,0 +1,389 @@
+"""Unit tests for the config canary (istio_tpu/canary): recorder
+sampling/ring semantics, corpus codec roundtrip, divergence
+classification + waivers, and gate mode behavior. The end-to-end
+record→replay→veto path over real device plans lives in
+tests/test_canary_smoke.py."""
+import datetime
+
+import pytest
+
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.canary import (CanaryConfig, CanaryEntry, ConfigCanary,
+                              ReplayResult, TrafficRecorder,
+                              diff_decisions, entry_from_json,
+                              entry_to_json, load_corpus, save_corpus)
+from istio_tpu.attribute.compressed import encode
+from istio_tpu.runtime.dispatcher import CheckResponse
+
+
+class _Snap:
+    """Minimal snapshot stand-in for recorder name resolution."""
+
+    def __init__(self, names):
+        self._names = list(names)
+
+    def qualified_rule_names(self):
+        return self._names
+
+
+def _resp(status=0, dur=5.0, uses=10_000, deny=-1, quota=()):
+    r = CheckResponse()
+    r.status_code = status
+    r.valid_duration_s = dur
+    r.valid_use_count = uses
+    r.deny_rule = deny
+    r.active_quota_rules = tuple(quota)
+    return r
+
+
+def _bags(n):
+    return [bag_from_mapping({
+        "destination.service": f"svc{i}.ns1.svc.cluster.local",
+        "request.method": "GET"}) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# recorder
+# ---------------------------------------------------------------------------
+
+def test_recorder_stride_sampling():
+    rec = TrafficRecorder(capacity=64, sample_every=3)
+    snap = _Snap(["r0"])
+    rec.tap(_bags(10), [_resp() for _ in range(10)], snap, "destination.service")
+    # rows 0, 3, 6, 9 sampled
+    assert rec.stats()["sampled"] == 4
+    assert rec.stats()["seen"] == 10
+    # stride continues across batches: counter at 10 → first kept is 12
+    rec.tap(_bags(4), [_resp() for _ in range(4)], snap,
+            "destination.service")
+    assert rec.stats()["sampled"] == 5
+
+
+def test_recorder_ring_bounds_and_eviction():
+    rec = TrafficRecorder(capacity=8, sample_every=1)
+    snap = _Snap(["r0"])
+    for k in range(4):
+        rec.tap(_bags(4), [_resp(status=k) for _ in range(4)], snap,
+                "destination.service")
+    st = rec.stats()
+    assert st["entries"] == 8
+    assert st["evicted"] == 8
+    # ring keeps the NEWEST rows: statuses 2 and 3 only
+    statuses = {e.status for e in rec.corpus()}
+    assert statuses == {2, 3}
+
+
+def test_recorder_device_surface_overrides_merged_response():
+    """The fused tap records the DEVICE planes, not the post-host-
+    merge response: a host-overlay adapter's status must not enter
+    the corpus (the shadow replay runs with empty handlers, so
+    recording it would veto an UNCHANGED config forever)."""
+    import numpy as np
+
+    rec = TrafficRecorder(capacity=8)
+    snap = _Snap(["ns1/r0", "ns1/r1"])
+    # merged responses say DENIED (a host list adapter fired)...
+    responses = [_resp(status=5, dur=0.5, uses=1, deny=-1),
+                 _resp(status=7, deny=1)]
+    # ...but the device surface answered OK / denied-by-rule-1
+    device = (np.array([0, 7], np.int32),
+              np.array([9.0, 2.5], np.float32),
+              np.array([20_000, 500], np.int32),
+              np.array([0, 1], np.int32))
+    rec.tap(_bags(2), responses, snap, "destination.service",
+            device=device)
+    a, b = rec.corpus()
+    assert a.status == 0 and a.deny_rule == ""
+    assert a.valid_duration_s == 5.0       # clamped to the default cap
+    assert a.valid_use_count == 10_000
+    assert b.status == 7 and b.deny_rule == "ns1/r1"
+    assert b.valid_duration_s == 2.5 and b.valid_use_count == 500
+
+
+def test_recorder_corpus_resolves_names_and_namespace():
+    rec = TrafficRecorder(capacity=8)
+    snap = _Snap(["ns1/deny-rule", "ns1/quota-rule"])
+    rec.tap(_bags(1), [_resp(status=7, deny=0, quota=(1,))], snap,
+            "destination.service")
+    (e,) = rec.corpus()
+    assert e.deny_rule == "ns1/deny-rule"
+    assert e.quota_rules == ("ns1/quota-rule",)
+    assert e.namespace == "ns1"
+    assert e.status == 7
+
+
+# ---------------------------------------------------------------------------
+# corpus codec
+# ---------------------------------------------------------------------------
+
+def test_corpus_file_roundtrip(tmp_path):
+    now = datetime.datetime(2026, 8, 3, 12, 0,
+                            tzinfo=datetime.timezone.utc)
+    values = {
+        "destination.service": "a.ns1.svc.cluster.local",
+        "request.size": 123,
+        "request.time": now,
+        "response.duration": datetime.timedelta(milliseconds=250),
+        "source.ip": b"\x00" * 10 + b"\xff\xff" + bytes([9, 8, 7, 6]),
+        "request.headers": {"cookie": "session=1"},
+        "connection.mtls": True,
+    }
+    e = CanaryEntry(ca=encode(bag_from_mapping(values)), status=7,
+                    valid_duration_s=2.5, valid_use_count=42,
+                    deny_rule="ns1/r1", namespace="ns1",
+                    quota_rules=("ns1/q",), trace_id="t1", t=1.0)
+    path = str(tmp_path / "corpus.json")
+    assert save_corpus(path, [e]) == 1
+    (back,) = load_corpus(path)
+    assert back.status == 7 and back.deny_rule == "ns1/r1"
+    assert back.quota_rules == ("ns1/q",)
+    bag = back.bag()
+    for name, want in values.items():
+        got, ok = bag.get(name)
+        assert ok, name
+        assert got == want, name
+
+
+def test_entry_json_is_json_safe():
+    import json
+
+    e = CanaryEntry(ca=encode(bag_from_mapping({"a": 1})))
+    json.dumps(entry_to_json(e))
+    assert entry_from_json(entry_to_json(e)).valid_use_count == 10_000
+
+
+# ---------------------------------------------------------------------------
+# differ
+# ---------------------------------------------------------------------------
+
+def _entry(status=0, dur=5.0, uses=10_000, deny="", quota=()):
+    return CanaryEntry(ca=encode(bag_from_mapping({"a": 1})),
+                       status=status, valid_duration_s=dur,
+                       valid_use_count=uses, deny_rule=deny,
+                       quota_rules=tuple(quota))
+
+
+def _replay(rows):
+    return ReplayResult(
+        status=[r.get("status", 0) for r in rows],
+        valid_duration_s=[r.get("dur", 5.0) for r in rows],
+        valid_use_count=[r.get("uses", 10_000) for r in rows],
+        deny_rule=[r.get("deny", "") for r in rows],
+        quota_rules=[tuple(r.get("quota", ())) for r in rows],
+        n_rows=len(rows), wall_s=0.01)
+
+
+def test_diff_classifies_all_kinds():
+    entries = [
+        _entry(status=7, deny="ns/d"),            # deny → OK flip
+        _entry(),                                  # OK → deny flip
+        _entry(status=7, dur=2.5, deny="ns/d"),    # TTL change
+        _entry(quota=("ns/q",)),                   # quota drops out
+        _entry(),                                  # unchanged
+    ]
+    rep = diff_decisions(entries, _replay([
+        {},                                        # now OK
+        {"status": 7, "deny": "ns/d2"},            # now denied
+        {"status": 7, "dur": 1.25, "deny": "ns/d"},
+        {},                                        # quota gone
+        {},
+    ]))
+    assert rep.n_rows == 5 and rep.n_divergent == 4
+    assert rep.by_kind == {"status_flip": 2, "precondition": 1,
+                           "quota": 1}
+    assert rep.per_rule["ns/d"]["status_flip"] == 1
+    assert rep.per_rule["ns/d2"]["status_flip"] == 1
+    assert rep.per_rule["ns/d"]["precondition"] == 1
+    assert rep.per_rule["ns/q"]["quota"] == 1
+    assert rep.divergence_rate == pytest.approx(0.8)
+    ex = rep.per_rule["ns/q"]["exemplars"][0]
+    assert ex["kind"] == "quota" and ex["bag"]
+
+
+def test_diff_waivers_excluded_from_gating_rate():
+    entries = [_entry(status=7, deny="ns/waived"), _entry()]
+    rep = diff_decisions(entries, _replay([{}, {}]),
+                         waivers=("ns/waived",))
+    assert rep.n_divergent == 0 and rep.n_waived == 1
+    assert rep.divergence_rate == 0.0
+    # reported regardless, marked waived
+    assert rep.per_rule["ns/waived"]["waived"] is True
+    assert "ns/waived" not in rep.diverging_rules()
+
+
+def test_diff_row_mismatch_raises():
+    with pytest.raises(ValueError):
+        diff_decisions([_entry()], _replay([{}, {}]))
+
+
+# ---------------------------------------------------------------------------
+# gate
+# ---------------------------------------------------------------------------
+
+def test_canary_config_rejects_bad_mode():
+    with pytest.raises(ValueError):
+        CanaryConfig(mode="audit")
+
+
+def test_gate_off_mode_never_replays():
+    canary = ConfigCanary(CanaryConfig(mode="off"))
+    assert canary.gate(None, None, None) is None
+    assert canary.evaluations == 0
+
+
+def test_gate_abstains_without_corpus():
+    canary = ConfigCanary(CanaryConfig(mode="gate"))
+    # no recorded traffic: must publish (abstain), not veto
+    assert canary.gate(None, object(), object()) is None
+    assert canary.reports() == []
+
+
+def test_gate_threshold_is_strictly_greater_than(monkeypatch):
+    canary = ConfigCanary(CanaryConfig(mode="gate",
+                                       max_divergence_rate=0.5))
+    entries = [_entry(status=7, deny="ns/d"), _entry()]
+    monkeypatch.setattr(canary.recorder, "corpus",
+                        lambda limit=None: entries)
+    monkeypatch.setattr(
+        "istio_tpu.canary.gate.replay_entries",
+        lambda *a, **k: _replay([{}, {}]))
+    monkeypatch.setattr(
+        "istio_tpu.canary.gate.confirm_exemplars",
+        lambda *a, **k: None)
+    # rate 0.5 == threshold → publish
+    assert canary.gate(None, _Snap([]), object()) is None
+    assert canary.reports()[-1].verdict == "warn"
+    # tighter threshold → veto
+    canary2 = ConfigCanary(CanaryConfig(mode="gate",
+                                        max_divergence_rate=0.25))
+    monkeypatch.setattr(canary2.recorder, "corpus",
+                        lambda limit=None: entries)
+    rej = canary2.gate(None, _Snap([]), object())
+    assert rej is not None and "ns/d" in str(rej)
+    assert rej.report.verdict == "veto"
+
+
+def test_divergent_publish_rebaselines_recorder(monkeypatch):
+    """A divergent candidate that PUBLISHES (warn mode / sub-threshold
+    gate) becomes the live config: rows recorded under the old one
+    must not keep re-reporting the accepted divergence — the ring is
+    cleared and refills under the new config. A zero-divergence
+    publish keeps the corpus (continuity)."""
+    canary = ConfigCanary(CanaryConfig(mode="warn"))
+    snap = _Snap(["ns/d"])
+    monkeypatch.setattr(
+        "istio_tpu.canary.gate.confirm_exemplars",
+        lambda *a, **k: None)
+
+    canary.recorder.tap(_bags(2), [_resp(status=7, deny=0), _resp()],
+                        snap, "destination.service")
+    assert canary.recorder.stats()["entries"] == 2
+    # replay matches the recorded decisions → publish, ring kept
+    monkeypatch.setattr(
+        "istio_tpu.canary.gate.replay_entries",
+        lambda *a, **k: _replay([{"status": 7, "deny": "ns/d"}, {}]))
+    assert canary.gate(None, _Snap([]), object()) is None
+    assert canary.reports()[-1].verdict == "publish"
+    assert canary.recorder.stats()["entries"] == 2
+    canary.on_published()                  # clean publish: ring kept
+    assert canary.recorder.stats()["entries"] == 2
+    # replay flips the denied row → warn-mode publish; the ring is
+    # cleared only AFTER the dispatcher swap (on_published) so the
+    # old dispatcher's final taps land before the wipe
+    monkeypatch.setattr(
+        "istio_tpu.canary.gate.replay_entries",
+        lambda *a, **k: _replay([{}, {}]))
+    assert canary.gate(None, _Snap([]), object()) is None
+    assert canary.reports()[-1].verdict == "warn"
+    assert canary.recorder.stats()["entries"] == 2   # pre-swap: kept
+    canary.on_published()
+    assert canary.recorder.stats()["entries"] == 0   # post-swap wipe
+
+
+def test_gate_vetoes_rule_wipe(monkeypatch):
+    """A candidate with ZERO rules compiles to no fused plan — the
+    most catastrophic swap must not slip through the abstain path:
+    the gate diffs against a synthetic allow-everything replay and
+    vetoes when recorded denies flip."""
+    class _EmptySnap(_Snap):
+        rules = ()
+        revision = 9
+
+    canary = ConfigCanary(CanaryConfig(mode="gate"))
+    monkeypatch.setattr(
+        canary.recorder, "corpus",
+        lambda limit=None: [_entry(status=7, deny="ns/d"), _entry()])
+    monkeypatch.setattr(
+        "istio_tpu.canary.gate.confirm_exemplars",
+        lambda *a, **k: None)
+    rej = canary.gate(None, _EmptySnap([]), None)   # plan is None
+    assert rej is not None and "ns/d" in str(rej)
+    assert rej.report.by_kind == {"status_flip": 1}
+
+
+def test_gate_fails_open_on_internal_error(monkeypatch):
+    canary = ConfigCanary(CanaryConfig(mode="gate"))
+    monkeypatch.setattr(canary.recorder, "corpus",
+                        lambda limit=None: [_entry()])
+    monkeypatch.setattr(
+        "istio_tpu.canary.gate.replay_entries",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert canary.gate(None, object(), object()) is None
+
+
+def test_server_args_reject_bad_canary_mode():
+    from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+
+    with pytest.raises(ValueError):
+        RuntimeServer(MemStore(), ServerArgs(canary="audit"))
+
+
+def test_identical_config_with_host_overlay_rule_publishes():
+    """Regression: a rule whose CHECK action stays host-side (a
+    CASE_INSENSITIVE_STRINGS list — unfusable, runtime/fused.py) used
+    to record its HOST deny status while the shadow replay (empty
+    handlers) answered OK, permanently vetoing even an unchanged
+    config. The recorder now captures the device surface, so an
+    identical rebuild must publish with zero divergences."""
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.runtime import MemStore, RuntimeServer, ServerArgs
+    from istio_tpu.runtime.batcher import pad_to_bucket
+    from istio_tpu.testing import corpus
+
+    store = MemStore()
+    store.set(("handler", "istio-system", "ci"), {
+        "adapter": "list",
+        "params": {"overrides": ["ALLOWED"],
+                   "entry_type": "CASE_INSENSITIVE_STRINGS",
+                   "blacklist": False}})
+    store.set(("instance", "istio-system", "srcns"), {
+        "template": "listentry", "params": {"value": "source.namespace"}})
+    store.set(("rule", "ns1", "host-deny"), {
+        "match": 'destination.service == "a.ns1.svc.cluster.local"',
+        "actions": [{"handler": "ci.istio-system",
+                     "instances": ["srcns.istio-system"]}]})
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=8, buckets=(8,),
+        canary="gate", rulestats_drain_s=0,
+        default_manifest=corpus.ANALYZER_MANIFEST))
+    srv.controller.debounce_s = 60.0
+    try:
+        plan = srv.controller.dispatcher.fused
+        assert plan is not None and plan.host_actions, \
+            "world no longer exercises a host-overlay action"
+        bags = [bag_from_mapping({
+            "destination.service": "a.ns1.svc.cluster.local",
+            "source.namespace": "not-allowed",
+            "request.method": "GET"}) for _ in range(4)]
+        resps = srv.check_batch_preprocessed(pad_to_bucket(bags, (8,)))
+        assert resps[0].status_code != 0     # host adapter denies live
+        entries = srv.canary.recorder.corpus()
+        assert entries and all(e.status == 0 for e in entries), \
+            "recorder captured the host-merged status, not the " \
+            "device surface"
+        d0 = srv.controller.dispatcher
+        d1 = srv.controller.rebuild()        # identical config
+        assert d1 is not d0, "identical host-overlay config was vetoed"
+        assert srv.canary.reports()[-1].n_divergent == 0
+    finally:
+        srv.close()

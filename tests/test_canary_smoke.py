@@ -1,0 +1,26 @@
+"""Tier-1 hook for scripts/canary_smoke.py: the CI gate that the
+config canary (istio_tpu/canary) vetoes every seeded divergent swap in
+gate mode — with the planted rule named under the planted divergence
+kind and status-flip exemplars oracle-confirmed — publishes
+identical-semantics swaps with zero reported divergences, keeps the
+old dispatcher serving base semantics after a veto, and agrees across
+the warn-mode / introspect / CLI / admission surfaces. Runs main()
+in-process (the analyze_smoke pattern; the script stays runnable
+standalone under JAX_PLATFORMS=cpu)."""
+import importlib.util
+import os
+import sys
+
+
+def test_canary_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "canary_smoke.py")
+    spec = importlib.util.spec_from_file_location("canary_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(seed=20260803)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0

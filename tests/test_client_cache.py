@@ -1,0 +1,189 @@
+"""Direct unit tests for the MixerClient check cache (api/client.py).
+
+The mixerclient contract (check_cache.cc semantics): a Check verdict
+is reusable for a later request iff every attribute the server
+REFERENCED matches — EXACT entries by value, ABSENCE entries by
+staying absent — and only within the verdict's valid_duration /
+valid_use_count budget. Nearly every API e2e test runs with
+enable_check_cache=False (the server-side assertions need every RPC
+to land), so the cache itself is pinned here directly: the gRPC stub
+is replaced with a counting fake, no server involved.
+
+Also pins the Report delta-coding key-drop flush: the wire protocol
+accumulates deltas server-side with no removal marker, so a record
+that DROPS a key must flush the in-flight request and start fresh.
+"""
+import datetime
+import time
+
+from istio_tpu.api import MixerClient, mixer_pb2 as pb
+from istio_tpu.api.wire import referenced_to_proto
+from istio_tpu.attribute.bag import bag_from_mapping
+
+
+def _response(values, referenced, code=0, ttl_s=60.0, use_count=100):
+    """CheckResponse whose ReferencedAttributes mark each item in
+    `referenced` EXACT when present in `values`, ABSENCE otherwise
+    (exactly what the server builds via referenced_to_proto)."""
+    resp = pb.CheckResponse()
+    resp.precondition.status.code = code
+    resp.precondition.valid_duration.FromTimedelta(
+        datetime.timedelta(seconds=ttl_s))
+    resp.precondition.valid_use_count = use_count
+    resp.precondition.referenced_attributes.CopyFrom(
+        referenced_to_proto(frozenset(referenced),
+                            bag_from_mapping(values)))
+    return resp
+
+
+class _Rig:
+    """MixerClient over a fake unary stub that counts RPCs."""
+
+    def __init__(self, make_response, cache=True):
+        self.client = MixerClient("127.0.0.1:1",
+                                  enable_check_cache=cache)
+        self.calls = 0
+
+        def fake_check(req):
+            self.calls += 1
+            return make_response(req)
+
+        self.client._check = fake_check
+
+    def close(self):
+        self.client.close()
+
+
+def test_exact_hit_and_value_change_miss():
+    rig = _Rig(lambda req: _response({"a": 1}, {"a"}))
+    try:
+        rig.client.check({"a": 1})
+        assert rig.calls == 1
+        # identical referenced values → served from cache
+        rig.client.check({"a": 1})
+        rig.client.check({"a": 1, "unreferenced": "x"})
+        assert rig.calls == 1
+        # referenced value changed → signature mismatch → RPC
+        rig.client.check({"a": 2})
+        assert rig.calls == 2
+    finally:
+        rig.close()
+
+
+def test_ttl_expiry_evicts():
+    rig = _Rig(lambda req: _response({"a": 1}, {"a"}, ttl_s=0.05))
+    try:
+        rig.client.check({"a": 1})
+        rig.client.check({"a": 1})
+        assert rig.calls == 1
+        time.sleep(0.06)
+        rig.client.check({"a": 1})
+        assert rig.calls == 2          # expired entry re-fetched
+    finally:
+        rig.close()
+
+
+def test_valid_use_count_exhaustion():
+    rig = _Rig(lambda req: _response({"a": 1}, {"a"}, use_count=2))
+    try:
+        rig.client.check({"a": 1})     # RPC 1, entry budget 2
+        rig.client.check({"a": 1})     # hit (budget → 1)
+        rig.client.check({"a": 1})     # hit (budget → 0)
+        assert rig.calls == 1
+        rig.client.check({"a": 1})     # spent entry evicted → RPC 2
+        assert rig.calls == 2
+    finally:
+        rig.close()
+
+
+def test_absence_condition_blocks_reuse():
+    # server referenced "b" but the request lacked it → ABSENCE entry
+    rig = _Rig(lambda req: _response({"a": 1}, {"a", "b"}))
+    try:
+        rig.client.check({"a": 1})
+        rig.client.check({"a": 1})
+        assert rig.calls == 1
+        # "b" now present: the ABSENCE condition no longer transfers —
+        # the cached verdict must NOT serve this request
+        rig.client.check({"a": 1, "b": 9})
+        assert rig.calls == 2
+        # absent again → original entry still valid
+        rig.client.check({"a": 1})
+        assert rig.calls == 2
+    finally:
+        rig.close()
+
+
+def test_map_key_reference_semantics():
+    values = {"request.headers": {"cookie": "session=1"}}
+    ref = {("request.headers", "cookie")}
+    rig = _Rig(lambda req: _response(values, ref))
+    try:
+        rig.client.check(values)
+        rig.client.check({"request.headers": {"cookie": "session=1",
+                                              "other": "x"}})
+        assert rig.calls == 1          # referenced KEY value unchanged
+        rig.client.check({"request.headers": {"cookie": "session=2"}})
+        assert rig.calls == 2          # referenced key changed
+    finally:
+        rig.close()
+
+
+def test_quota_requests_bypass_cache():
+    rig = _Rig(lambda req: _response({"a": 1}, {"a"}))
+    try:
+        rig.client.check({"a": 1}, quotas={"rq": 1})
+        rig.client.check({"a": 1}, quotas={"rq": 1})
+        assert rig.calls == 2          # quota allocs must reach the server
+        # and quota responses must not have seeded the cache
+        rig.client.check({"a": 1})
+        assert rig.calls == 3
+        rig.client.check({"a": 1})
+        assert rig.calls == 3          # plain check cached normally
+    finally:
+        rig.close()
+
+
+def test_disabled_cache_always_rpcs():
+    rig = _Rig(lambda req: _response({"a": 1}, {"a"}), cache=False)
+    try:
+        rig.client.check({"a": 1})
+        rig.client.check({"a": 1})
+        assert rig.calls == 2
+    finally:
+        rig.close()
+
+
+# ---------------------------------------------------------------------------
+# Report delta coding: the key-drop flush
+# ---------------------------------------------------------------------------
+
+def _report_rig():
+    client = MixerClient("127.0.0.1:1", enable_check_cache=False)
+    sent = []
+    client._report = lambda req: sent.append(req) or pb.ReportResponse()
+    return client, sent
+
+
+def test_report_key_drop_flushes():
+    client, sent = _report_rig()
+    try:
+        # record 2 DROPS key "b": no removal marker exists on the wire,
+        # so the client must flush request 1 and start a fresh one
+        client.report([{"a": 1, "b": 2}, {"a": 1}])
+        assert len(sent) == 2
+        assert len(sent[0].attributes) == 1
+        assert len(sent[1].attributes) == 1
+    finally:
+        client.close()
+
+
+def test_report_consistent_keys_delta_code_into_one_request():
+    client, sent = _report_rig()
+    try:
+        client.report([{"a": 1, "b": 2}, {"a": 1, "b": 3},
+                       {"a": 2, "b": 3}])
+        assert len(sent) == 1
+        assert len(sent[0].attributes) == 3
+    finally:
+        client.close()
