@@ -39,6 +39,82 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 PER_PREDICATE_NS = 250.0   # bench.baseline:3-8 midpoint
 
 
+def _roofline_fields(engine, batch: int, step_s: float, prefix: str,
+                     plan=None) -> dict:
+    """Per-section roofline accounting (compiler/roofline.py): bytes
+    touched + op counts derived from the COMPILED shapes, the achieved
+    GB/s / TOPS vs platform peaks, `*_fraction_of_roof`, and the
+    binding resource `*_bound` (hbm|mxu|host). Fail-soft: a modeling
+    error never takes a section's measured numbers down."""
+    from istio_tpu.compiler import roofline
+
+    return roofline.bench_fields(engine, batch, step_s, prefix,
+                                 plan=plan)
+
+
+def _colocated_estimate(fields: dict, engine, small: int,
+                        small_ms: float) -> dict:
+    """served_native_colocated_p50_est_ms: the end-to-end latency a
+    latency-tier check would see on a COLOCATED chip at light load —
+    frame + decode/tensorize + h2d + device step + overlay fold +
+    respond — so the <1 ms claim is a whole-request story, not just
+    the bare device-step gate. Sources: measured native stage p50s for
+    the pure-host stages (tensorize/fold/respond — the tunnel never
+    inflates them), the sync-subtracted latency-tier device step, a
+    PCIe-bandwidth model for h2d (the measured h2d stage carries the
+    ~100ms tunnel RTT a colocated chip does not pay), and the echo
+    server's per-request wire cost for framing."""
+    try:
+        from istio_tpu.compiler.roofline import batch_plane_bytes
+
+        stages = fields.get("served_native_stage_decomposition") or \
+            fields.get("served_stage_decomposition") or {}
+
+        def p50(stage: str, default: float) -> float:
+            s = stages.get(stage)
+            return float(s["p50_ms"]) if s and "p50_ms" in s \
+                else default
+
+        # tensorize p50 is per BATCH at the serving buckets — an
+        # overstatement for a latency-tier batch, kept as the
+        # conservative side of the estimate
+        tz_ms = p50("tensorize",
+                    fields.get("host_tensorize_ms_per_req", 0.01)
+                    * small)
+        fold_ms = p50("fold", 0.1)
+        respond_ms = p50("respond", 0.1)
+        h2d_bytes = batch_plane_bytes(engine.ruleset.layout, small)
+        pcie_gbps = 12.0       # PCIe gen3 x16 effective
+        h2d_ms = h2d_bytes / (pcie_gbps * 1e9) * 1e3 + 0.05
+        ceiling = fields.get("served_native_wire_ceiling_per_sec", 0)
+        frame_ms = 1e3 / ceiling if ceiling and ceiling > 0 else 0.05
+        est = (frame_ms + tz_ms + h2d_ms + small_ms + fold_ms
+               + respond_ms)
+        return {
+            "served_native_colocated_p50_est_ms": round(est, 3),
+            "served_native_colocated_p50_est_breakdown": {
+                "frame_ms": round(frame_ms, 3),
+                "tensorize_ms": round(tz_ms, 3),
+                "h2d_ms": round(h2d_ms, 3),
+                "device_step_ms": round(small_ms, 3),
+                "fold_ms": round(fold_ms, 3),
+                "respond_ms": round(respond_ms, 3),
+                "latency_tier_batch": small,
+            },
+            "served_native_colocated_p50_est_derivation":
+                "frame (echo per-request wire cost) + tensorize/fold/"
+                "respond (measured native stage p50s, host work) + "
+                "h2d (batch plane bytes / 12 GB/s PCIe + 50us "
+                "dispatch) + latency-tier device step (sync-"
+                "subtracted median) — an ESTIMATE composed from "
+                "measured components, pending a genuinely colocated "
+                "rig",
+        }
+    except Exception as exc:
+        return {"served_native_colocated_est_error":
+                f"{type(exc).__name__}: {exc}"}
+
+
 def _roundtrip_s() -> float:
     """Median host↔device sync latency (tunnel RTT on axon)."""
     f = jax.jit(lambda x: x + 1)
@@ -313,6 +389,9 @@ def main() -> None:
         "baseline_source": "mixer/pkg/il/interpreter/bench.baseline:3-8 "
                            f"({PER_PREDICATE_NS:.0f} ns/predicate x "
                            f"{n_rules} rules)",
+        # roofline accounting for the headline step (raw engine step,
+        # no packer): bytes/ops from the compiled shapes vs v5e peaks
+        **_roofline_fields(engine, batch, t_step, "headline_"),
     }
     out.update(served)
     if "served_checks_per_sec" in served:
@@ -334,6 +413,9 @@ def main() -> None:
         out["served_native_vs_baseline"] = round(
             served_native["served_native_checks_per_sec"]
             / baseline_cps, 2)
+    # the composed end-to-end colocated-latency estimate rides next to
+    # the device-step gate it contextualizes (ISSUE 6 acceptance)
+    out.update(_colocated_estimate(out, engine, small, small_ms))
     out.update(route)
     out.update(rbac)
     out.update(quota)
@@ -528,7 +610,8 @@ def _rbac_bench(on_tpu: bool) -> dict:
                 "rbac_compile_s": round(compile_s, 2),
                 "rbac_denied_frac": round(denied, 3),
                 "rbac_baseline_checks_per_sec": round(baseline, 1),
-                "rbac_vs_baseline": round(cps / baseline, 2)}
+                "rbac_vs_baseline": round(cps / baseline, 2),
+                **_roofline_fields(engine, batch, med, "rbac_")}
     except Exception as exc:
         return {"rbac_error": f"{type(exc).__name__}: {exc}"}
 
@@ -666,6 +749,7 @@ def _full_mesh_bench(on_tpu: bool) -> dict:
                 "full_mesh_traffic_mix": list(workloads.FULL_MESH_MIX),
                 "full_mesh_baseline_checks_per_sec": round(baseline, 1),
                 "full_mesh_vs_baseline": round(cps / baseline, 2),
+                **_roofline_fields(engine, batch, med, "full_mesh_"),
                 **tele_fields}
     except Exception as exc:
         return {"full_mesh_error": f"{type(exc).__name__}: {exc}"}
@@ -721,13 +805,14 @@ import json, os, time, sys
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 import jax
 jax.config.update("jax_platforms", "cpu")   # before any backend init
+import numpy as np
 sys.path.insert(0, {repo!r})
 from istio_tpu.runtime import RuntimeServer, ServerArgs
 from istio_tpu.testing import workloads
 
 n_rules, batch, steps = {n_rules}, {batch}, {steps}
 out = {{"mesh_rules": n_rules, "mesh_batch": batch,
-        "mesh_host_cores": os.cpu_count(),
+        "mesh_host_cores": os.cpu_count() or 1,   # None on exotic hosts
         "mesh_virtual_devices": len(jax.devices())}}
 bags = workloads.make_bags(batch, seed=17)
 # (label, mesh_shape, rule count): dp1/dp4mp2 pin the strong-scaling
@@ -743,6 +828,9 @@ servers = {{}}
 for label, shape, nr in configs:
     srv = RuntimeServer(workloads.make_store(nr), ServerArgs(
         batch_window_s=0.001, mesh_shape=shape, buckets=(batch,),
+        # check_many warms the serving shape in-line below; the
+        # background initial prewarm would contend for the one core
+        initial_prewarm=False,
         default_manifest=workloads.MESH_MANIFEST))
     try:
         if label == "dp1":
@@ -773,6 +861,23 @@ for label, shape, nr in configs:
             for _ in range(steps):
                 srv.check_many(bags)
             best = min(best, (time.perf_counter() - t0) / steps)
+        if label == "dp4mp2":
+            # per-stage attribution (shard dispatch / collective-free
+            # match / verdict fold + its psum) — the number a reader
+            # can trust even where the 1-core end-to-end ratio is
+            # time-slicing noise. Diagnostics: never take the
+            # throughput measurements down with it.
+            try:
+                from istio_tpu.parallel.mesh import mesh_stage_probe
+                d = srv.controller.dispatcher
+                ab = d.snapshot.tensorizer.tensorize(bags)
+                ns = d._request_ns_ids(bags)
+                out["mesh_dp4mp2_stage_ms"] = mesh_stage_probe(
+                    srv.controller.mesh, d.fused.engine, ab, ns,
+                    steps=steps)
+            except Exception as exc:
+                out["mesh_stage_error"] = \
+                    type(exc).__name__ + ": " + str(exc)
     except BaseException:
         srv.close()
         raise
@@ -805,9 +910,26 @@ finally:
 for label, _shape, _nr in configs:
     out[f"mesh_{{label}}_checks_per_sec"] = round(
         batch / times[label], 1)
-out["mesh_scaling_ratio"] = round(
-    out["mesh_dp4mp2_checks_per_sec"] / out["mesh_dp1_checks_per_sec"],
-    3)
+# honesty gate (ISSUE 6 satellite): whenever the host has fewer
+# cores than virtual devices the shards time-slice, so the dp
+# scaling ratio is sign-flipping noise (r5 artifacts: 0.82 vs 1.07
+# across runs) — it is only printed where every virtual device has
+# a core of its own; the per-stage timers above attribute the
+# sharding overhead either way.
+out["mesh_perf_informative"] = (
+    out["mesh_host_cores"] >= out["mesh_virtual_devices"])
+if out["mesh_perf_informative"]:
+    out["mesh_scaling_ratio"] = round(
+        out["mesh_dp4mp2_checks_per_sec"]
+        / out["mesh_dp1_checks_per_sec"], 3)
+else:
+    out["mesh_scaling_note"] = (
+        f"mesh_host_cores={{out['mesh_host_cores']}} < "
+        f"{{out['mesh_virtual_devices']}} virtual devices: dp "
+        "scaling over time-sliced virtual devices is uninformative; "
+        "see mesh_dp4mp2_stage_ms for the per-stage "
+        "sharding-overhead attribution and mesh_overhead_ratio for "
+        "the weak-scaling pair")
 out["mesh_overhead_ratio"] = round(
     pair["mp2"] / (2.0 * pair["half"]), 3)
 out["mesh_overhead_interpretation"] = (
@@ -1068,6 +1190,7 @@ def _capacity_bench(on_tpu: bool) -> dict:
                "capacity_checks_per_sec_min": round(batch / t_max, 1),
                "capacity_checks_per_sec_max": round(batch / t_min, 1),
                "capacity_compile_s": round(compile_s, 2)}
+        out.update(_roofline_fields(engine, batch, med, "capacity_"))
         out.update(_capacity_parity(engine, ab, ns, status_dev,
                                     on_tpu))
         return out
@@ -1137,11 +1260,22 @@ def _mesh_scaling_bench(on_tpu: bool) -> dict:
         proc = subprocess.run(
             [sys.executable, "-c", script], env=env,
             capture_output=True, text=True, timeout=1800)
-        if proc.returncode != 0:
-            return {"mesh_error":
-                    f"child rc={proc.returncode}: "
-                    f"{proc.stderr.strip()[-300:]}"}
-        return json.loads(proc.stdout.strip().splitlines()[-1])
+        # a crash AT EXIT (e.g. a stray runtime thread aborting
+        # interpreter teardown) must not discard measurements the
+        # child already printed — parse the json line when present
+        # and carry the exit code alongside
+        lines = [ln for ln in proc.stdout.strip().splitlines()
+                 if ln.startswith("{")]
+        if lines:
+            out = json.loads(lines[-1])
+            if proc.returncode != 0:
+                out["mesh_child_exit_code"] = proc.returncode
+                out["mesh_child_stderr_tail"] = \
+                    proc.stderr.strip()[-200:]
+            return out
+        return {"mesh_error":
+                f"child rc={proc.returncode}: "
+                f"{proc.stderr.strip()[-300:]}"}
     except Exception as exc:
         return {"mesh_error": f"{type(exc).__name__}: {exc}"}
 
@@ -1330,6 +1464,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
         # IS the served ceiling here)
         buckets = (64, 256, 1024, 2048)
         srv = RuntimeServer(store, ServerArgs(
+            initial_prewarm=False,   # plan.prewarm(buckets) below
             batch_window_s=0.002, max_batch=2048, pipeline=pipeline,
             # colocated chips overlap trips for real — let the deep
             # pipeline actually pipeline (hold_at=pipeline); behind
@@ -1572,19 +1707,23 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             # the host.
             report_fields: dict = {}
             try:
-                rsz = 64
+                # ≥1024 records per RPC (ROADMAP item 1 first slice /
+                # ISSUE 6 satellite): the report batcher coalesces
+                # records across RPCs into bucket-sized packed device
+                # trips either way, but fat RPCs stop paying the
+                # ~0.4ms python-grpc cost 16× per bucket — at 64
+                # records/RPC the wire front, not the device lowering,
+                # capped records/s
+                rsz = 1024 if on_tpu else 256
                 rpayloads = perf.make_report_payloads(
                     workloads.make_request_dicts(512),
                     records_per_request=rsz)
-                # records coalesce ACROSS RPCs (RuntimeServer.report
-                # rides the report batcher since r5): depth-64 clients
-                # put 4096 records in flight so the 2048-row bucket
-                # fills even with half the depth riding the in-flight
-                # trip (measured fill ~1700 rows/batch at this depth)
+                # depth-8 clients put 8192 records in flight so the
+                # 2048-row bucket fills several trips deep
                 rrep = perf.run_load(
                     f"127.0.0.1:{port}", rpayloads,
-                    n_record=300 if on_tpu else 20,
-                    n_procs=1, concurrency=64 if on_tpu else 4,
+                    n_record=48 if on_tpu else 8,
+                    n_procs=1, concurrency=8 if on_tpu else 4,
                     warmup_s=2.0 if on_tpu else 1.0,
                     method="/istio.mixer.v1.Mixer/Report",
                     checks_per_payload=rsz)
@@ -1676,6 +1815,7 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
         depth = 4096 if on_tpu else 64
         store = workloads.make_store(n_rules)
         srv = RuntimeServer(store, ServerArgs(
+            initial_prewarm=False,   # plan.prewarm(buckets) below
             batch_window_s=0.002, max_batch=buckets[-1], pipeline=2,
             buckets=buckets,
             default_manifest=workloads.MESH_MANIFEST))

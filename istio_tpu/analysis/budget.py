@@ -48,6 +48,63 @@ def _regex_atoms(ast: Expression, out: list) -> None:
         _regex_atoms(a, out)
 
 
+def _slot_shaped(e: Expression, finder=None) -> bool:
+    """Syntactic mirror of ruleset._slot_ref: a bare attribute or a
+    constant-string-key map index — the shapes that resolve to a
+    slot. The parser builds INDEX as FunctionCall(args=[map, key])
+    with NO target (parser.py), exactly what _slot_ref matches.
+    `finder` applies _slot_ref's type gate on bare attributes —
+    undeclared or STRING_MAP attrs never resolve to a slot there."""
+    if e.var is not None:
+        if finder is None:
+            return True
+        try:
+            from istio_tpu.attribute.types import ValueType
+            vt = finder.get_attribute(e.var.name)
+            return vt is not None and vt != ValueType.STRING_MAP
+        except Exception:
+            return False
+    f = e.fn
+    return (f is not None and f.name == "INDEX" and len(f.args) == 2
+            and f.args[0].var is not None
+            and f.args[1].const_ is not None
+            and isinstance(f.args[1].const_.value, str))
+
+
+def _const_shaped(e: Expression) -> bool:
+    """Mirror of the compiler's _const_id eligibility: a literal
+    constant, or a foldable ip()/timestamp() over one. An ExternError
+    during folding routes the atom to the general path there, so it is
+    NOT const-shaped here either."""
+    if e.const_ is not None:
+        return True
+    try:
+        from istio_tpu.compiler.ruleset import _fold_time_const
+        return _fold_time_const(e) is not None
+    except Exception:
+        return False
+
+
+def _eq_shaped(e: Expression, finder) -> bool:
+    """Layout-free mirror of the compiler's tier-1 EQ classification
+    (compile_ruleset's fused gather-compare eligibility): a bare BOOL
+    attribute, or EQ/NEQ between a slot-shaped ref and a constant
+    (incl. folded ip()/timestamp() constants, per _const_id)."""
+    if e.var is not None:
+        try:
+            from istio_tpu.attribute.types import ValueType
+            return finder.get_attribute(e.var.name) == ValueType.BOOL
+        except Exception:
+            return False
+    f = e.fn
+    if f is None or f.name not in ("EQ", "NEQ") or len(f.args) != 2:
+        return False
+    for x, y in ((f.args[0], f.args[1]), (f.args[1], f.args[0])):
+        if _slot_shaped(x, finder) and _const_shaped(y):
+            return True
+    return False
+
+
 def check_budgets(rules: Sequence[tuple[str, str, Expression]],
                   finder: AttributeDescriptorFinder,
                   dnf_cap: int = DEFAULT_DNF_CAP) -> list[Finding]:
@@ -97,9 +154,16 @@ def check_budgets(rules: Sequence[tuple[str, str, Expression]],
                          f"to the latency-bound gather scan")))
 
     # --- DNF conjunction growth + padded index-tensor footprint ---
+    # Mirrors compile_ruleset's fused/legacy conjunction split: all-EQ
+    # conjunctions compile to the eqc_* gather-compare tensors (two
+    # int32 + two bool lanes ≈ 2.5 int32 entries per padded literal,
+    # padded to the FUSED l_max), the rest to lit_idx rows (one int32
+    # per literal at the LEGACY l_max) — one global l_max over both
+    # blocks would over-gate mixed snapshots and under-count the eqc
+    # tensors entirely.
     table = _AtomTable()
-    n_conjs = 0
-    l_max = 1
+    n_fused = n_legacy = 0
+    l_max_f = l_max_l = 1
     k_max = 1
     for name, _ns, ast in rules:
         try:
@@ -118,16 +182,25 @@ def check_budgets(rules: Sequence[tuple[str, str, Expression]],
             table.revert(mark)
             continue
         conjs = m | n
-        n_conjs += len(conjs)
-        l_max = max(l_max, max((len(c) for c in conjs), default=1))
+        for conj in conjs:
+            if all(_eq_shaped(table.asts[aidx], finder)
+                   for aidx, _kind in conj):
+                n_fused += 1
+                l_max_f = max(l_max_f, max(len(conj), 1))
+            else:
+                n_legacy += 1
+                l_max_l = max(l_max_l, max(len(conj), 1))
         k_max = max(k_max, max(len(m), len(n)))
     n_rows = max(len(rules), 1)
-    tile_entries = n_conjs * l_max + 2 * n_rows * k_max
+    tile_entries = (n_fused * l_max_f * 5 + 1) // 2 \
+        + n_legacy * l_max_l + 2 * n_rows * k_max
     if tile_entries > TILE_ENTRY_BUDGET:
         findings.append(Finding(
             code=TILE_BUDGET, severity=Severity.ERROR,
-            message=(f"predicted index tensors need {tile_entries} "
-                     f"int32 entries ({n_conjs} conjs × {l_max} "
-                     f"literals + {n_rows} rules × {k_max} conjs), "
-                     f"past the {TILE_ENTRY_BUDGET} device budget")))
+            message=(f"predicted index tensors need ~{tile_entries} "
+                     f"int32-equivalent entries ({n_fused} fused "
+                     f"conjs × {l_max_f} eqc lanes + {n_legacy} "
+                     f"legacy conjs × {l_max_l} literals + {n_rows} "
+                     f"rules × {k_max} conjs), past the "
+                     f"{TILE_ENTRY_BUDGET} device budget")))
     return findings

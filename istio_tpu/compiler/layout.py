@@ -167,6 +167,18 @@ class InternTable:
         }
         self._values: list[Any] = [None, False, True]
         self._lock = threading.Lock()
+        # longest byte-plane CONSTANT any compile using this table has
+        # materialized (tensor_expr._compile_bytes). The latency-tier
+        # gate (fused.str_tiers) must not narrow batches below it: a
+        # constant row sliced to the tier loses real tail bytes, which
+        # flips suffix-window verdicts. Grow-only like the table, so
+        # conservative across config swaps on a shared table.
+        self.max_byte_const_len = 0
+
+    def note_byte_const(self, n: int) -> None:
+        with self._lock:
+            if n > self.max_byte_const_len:
+                self.max_byte_const_len = n
 
     def intern(self, value: Any) -> int:
         key = _normalize(value)

@@ -278,6 +278,11 @@ class RuleSetProgram:
     atom_asts: list[Any] = dataclasses.field(default_factory=list)
     atom_tier: dict[int, str] = dataclasses.field(default_factory=dict)
     per_rule_dnf: list[Any] = dataclasses.field(default_factory=list)
+    # ---- compiled-shape geometry (atom tier counts, conjunction split,
+    #      padded index widths) — the roofline accounting layer
+    #      (compiler/roofline.py) derives per-step bytes/op counts from
+    #      THESE shapes, never from hand constants
+    geometry: dict = dataclasses.field(default_factory=dict)
 
     @property
     def n_rules(self) -> int:
@@ -596,16 +601,6 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     dfa_atom_idx = [a for g in dfa_groups.values() for a in g["atoms"]]
 
     n_atoms = len(atoms.asts)
-    order = eq_atom_idx + ss_atom_idx + dfa_atom_idx + gen_atom_idx
-    n_live = max(len(order), 1)   # width of the m/n literal blocks
-    # inverse permutation: position of atom i in the concatenated output
-    pos_of = np.full(max(n_atoms, 1), 0, dtype=np.int32)
-    for pos, aidx in enumerate(order):
-        pos_of[aidx] = pos
-
-    eq_cols_a = np.asarray(eq_cols, np.int32)
-    eq_cids_a = np.asarray(eq_cids, np.int32)
-    eq_neg_a = np.asarray(eq_neg, bool)
     ss_a_a = np.asarray(ss_a, np.int32)
     ss_b_a = np.asarray(ss_b, np.int32)
     ss_neg_a = np.asarray(ss_neg, bool)
@@ -636,10 +631,81 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     n_rules = len(rules)
     # rule-axis padding for even mp sharding (see docstring)
     n_rows = max(-(-max(n_rules, 1) // rule_pad) * rule_pad, 1)
-    l_max = max((len(c) for c in conj_list), default=1) or 1
+
+    # ---- fused gather–compare fast path ----
+    # Conjunctions whose EVERY literal is a tier-1 EQ/NEQ(slot, const)
+    # atom skip the two-stage evaluation (atom planes → literal
+    # gather): their sat column gathers the slot ids/present bits
+    # DIRECTLY and compares against the interned constants in the same
+    # pass — one fused gather-compare over the slot tensor instead of
+    # materializing the m/n literal planes and re-gathering them.
+    # Literal truth for an EQ atom: m = cmp∧present, n = ¬cmp∧present,
+    # so a (atom, kind) literal is ((ids==cid) ^ neg ^ (kind=='n')) ∧
+    # present, and padding lanes read True (AND identity). EQ atoms
+    # dominate real istio configs, so most snapshots evaluate entirely
+    # here and the legacy literal-gather stage compiles away.
+    # Conjunction columns permute fused-first; the rule-stage index
+    # matrices are remapped through the permutation.
+    eq_info = {aidx: (eq_cols[i], eq_cids[i], eq_neg[i])
+               for i, aidx in enumerate(eq_atom_idx)}
+    fused_j = [j for j, conj in enumerate(conj_list)
+               if all(aidx in eq_info for aidx, _ in conj)]
+    fused_set = set(fused_j)
+    legacy_j = [j for j in range(n_conjs) if j not in fused_set]
+    n_fused = len(fused_j)
+    n_legacy = n_conjs - n_fused
+    new_of_old = np.zeros(max(n_conjs, 1), np.int32)
+    for newj, oldj in enumerate(fused_j + legacy_j):
+        new_of_old[oldj] = newj
+    conj_list = [conj_list[j] for j in fused_j + legacy_j]
+    rule_m_cols = [[int(new_of_old[j]) for j in cols]
+                   for cols in rule_m_cols]
+    rule_n_cols = [[int(new_of_old[j]) for j in cols]
+                   for cols in rule_n_cols]
+    # the legacy block only exists for conjunctions it still owns (or
+    # as the placeholder column of an empty ruleset)
+    use_legacy = n_legacy > 0 or n_fused == 0
+
+    l_max_f = max((len(conj_list[j]) for j in range(n_fused)),
+                  default=1) or 1
+    l_max = max((len(conj_list[j]) for j in range(n_fused, n_conjs)),
+                default=1) or 1
     k_max = max((max(len(m), len(n)) for m, n in
                  ((rule_m_cols[r], rule_n_cols[r]) for r in range(n_rules))),
                 default=1) or 1
+
+    eqc_col = np.zeros((max(n_fused, 1), l_max_f), np.int32)
+    eqc_cid = np.zeros((max(n_fused, 1), l_max_f), np.int32)
+    eqc_xor = np.zeros((max(n_fused, 1), l_max_f), bool)
+    eqc_pad = np.ones((max(n_fused, 1), l_max_f), bool)
+    for j in range(n_fused):
+        for s, (aidx, kind) in enumerate(sorted(conj_list[j])):
+            col, cid, neg = eq_info[aidx]
+            eqc_col[j, s] = col
+            eqc_cid[j, s] = cid
+            eqc_xor[j, s] = bool(neg) ^ (kind == "n")
+            eqc_pad[j, s] = False
+
+    # The legacy m/n planes carry ONLY the EQ atoms some legacy
+    # conjunction still references — an EQ atom every referencing
+    # conjunction of which went fused would be gathered/compared into
+    # lanes no lit_idx row ever reads (XLA cannot DCE them: lit_idx is
+    # a traced param, not a constant). ss/dfa/gen atoms are legacy by
+    # construction (any conjunction holding one is non-fusable).
+    legacy_atom_set = {aidx for conj in conj_list[n_fused:]
+                       for aidx, _ in conj}
+    eq_keep = [i for i, aidx in enumerate(eq_atom_idx)
+               if aidx in legacy_atom_set]
+    eq_live_idx = [eq_atom_idx[i] for i in eq_keep]
+    order = eq_live_idx + ss_atom_idx + dfa_atom_idx + gen_atom_idx
+    n_live = max(len(order), 1)   # width of the m/n literal blocks
+    # inverse permutation: position of atom i in the concatenated output
+    pos_of = np.full(max(n_atoms, 1), 0, dtype=np.int32)
+    for pos, aidx in enumerate(order):
+        pos_of[aidx] = pos
+    eq_cols_a = np.asarray([eq_cols[i] for i in eq_keep], np.int32)
+    eq_cids_a = np.asarray([eq_cids[i] for i in eq_keep], np.int32)
+    eq_neg_a = np.asarray([eq_neg[i] for i in eq_keep], bool)
 
     # Sparse (gather) formulation. Conjunctions average only a few
     # literals and rules a few conjunctions, so dense [2A, n_conj] /
@@ -651,10 +717,13 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     LIT_TRUE = 2 * n_live
     CONJ_FALSE = max(n_conjs, 1)   # sat has max(n_conjs,1) real columns
     CONJ_TRUE = CONJ_FALSE + 1     # pad rows: definitely-not-matched
-    lit_idx = np.full((max(n_conjs, 1), l_max), LIT_TRUE, np.int32)
-    for j, conj in enumerate(conj_list):
+    # legacy literal gather rows: only the conjunctions the fused
+    # gather-compare path above did NOT absorb (an all-EQ snapshot
+    # compiles no literal gather at all)
+    lit_idx = np.full((max(n_legacy, 1), l_max), LIT_TRUE, np.int32)
+    for jj, conj in enumerate(conj_list[n_fused:]):
         for s, (aidx, kind) in enumerate(sorted(conj)):
-            lit_idx[j, s] = pos_of[aidx] + (0 if kind == "m" else n_live)
+            lit_idx[jj, s] = pos_of[aidx] + (0 if kind == "m" else n_live)
     conj_m_idx = np.full((n_rows, k_max), CONJ_FALSE, np.int32)
     conj_n_idx = np.full((n_rows, k_max), CONJ_FALSE, np.int32)
     # padding rows read not_matched=True (never "err"): their N gather
@@ -671,42 +740,62 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     # serialized program must stay small for remote compilation).
     params = {"lit_idx": jnp.asarray(lit_idx),
               "conj_m_idx": jnp.asarray(conj_m_idx),
-              "conj_n_idx": jnp.asarray(conj_n_idx)}
+              "conj_n_idx": jnp.asarray(conj_n_idx),
+              "eqc_col": jnp.asarray(eqc_col),
+              "eqc_cid": jnp.asarray(eqc_cid),
+              "eqc_xor": jnp.asarray(eqc_xor),
+              "eqc_pad": jnp.asarray(eqc_pad)}
 
     def run(params: Mapping[str, Any],
             batch: AttributeBatch) -> tuple[Any, Any, Any]:
         b = batch.ids.shape[0]
-        parts_m, parts_n = [], []
-        if eq_cols_a.size:
-            ids = batch.ids[:, eq_cols_a]
-            pres = batch.present[:, eq_cols_a]
-            cmp = (ids == eq_cids_a[None, :]) ^ eq_neg_a[None, :]
-            parts_m.append(cmp & pres)
-            parts_n.append(~cmp & pres)
-        if ss_a_a.size:
-            pres = batch.present[:, ss_a_a] & batch.present[:, ss_b_a]
-            cmp = (batch.ids[:, ss_a_a] == batch.ids[:, ss_b_a]) ^ ss_neg_a[None, :]
-            parts_m.append(cmp & pres)
-            parts_n.append(~cmp & pres)
-        for gfn in dfa_group_fns:
-            gval, gee = gfn(batch)
-            parts_m.append(gval)               # already masked by ~ee
-            parts_n.append(~gval & ~gee)
-        for fn in gen_fns:
-            t = fn(batch)
-            ee = t.err | ~t.ok
-            parts_m.append((t.val & ~ee)[:, None])
-            parts_n.append((~t.val & ~ee)[:, None])
-        if parts_m:
-            m_all = jnp.concatenate(parts_m, axis=1)
-            n_all = jnp.concatenate(parts_n, axis=1)
-        else:
-            m_all = jnp.zeros((b, 1), bool)
-            n_all = jnp.zeros((b, 1), bool)
-        # lit[:, LIT_TRUE] is the AND-identity sentinel
-        lit = jnp.concatenate(
-            [m_all, n_all, jnp.ones((b, 1), bool)], axis=1)
-        sat = jnp.all(lit[:, params["lit_idx"]], axis=2)     # [B, n_conjs]
+        sat_parts = []
+        if n_fused:
+            # fused gather-compare: one pass over the slot tensor
+            # computes every all-EQ conjunction's sat bit — no literal
+            # planes, no second gather
+            iv = batch.ids[:, params["eqc_col"]]        # [B, F, Lf]
+            pv = batch.present[:, params["eqc_col"]]
+            hit = ((iv == params["eqc_cid"][None]) ^
+                   params["eqc_xor"][None]) & pv
+            sat_parts.append(jnp.all(hit | params["eqc_pad"][None],
+                                     axis=2))
+        if use_legacy:
+            parts_m, parts_n = [], []
+            if eq_cols_a.size:
+                ids = batch.ids[:, eq_cols_a]
+                pres = batch.present[:, eq_cols_a]
+                cmp = (ids == eq_cids_a[None, :]) ^ eq_neg_a[None, :]
+                parts_m.append(cmp & pres)
+                parts_n.append(~cmp & pres)
+            if ss_a_a.size:
+                pres = batch.present[:, ss_a_a] & batch.present[:, ss_b_a]
+                cmp = (batch.ids[:, ss_a_a] == batch.ids[:, ss_b_a]) \
+                    ^ ss_neg_a[None, :]
+                parts_m.append(cmp & pres)
+                parts_n.append(~cmp & pres)
+            for gfn in dfa_group_fns:
+                gval, gee = gfn(batch)
+                parts_m.append(gval)           # already masked by ~ee
+                parts_n.append(~gval & ~gee)
+            for fn in gen_fns:
+                t = fn(batch)
+                ee = t.err | ~t.ok
+                parts_m.append((t.val & ~ee)[:, None])
+                parts_n.append((~t.val & ~ee)[:, None])
+            if parts_m:
+                m_all = jnp.concatenate(parts_m, axis=1)
+                n_all = jnp.concatenate(parts_n, axis=1)
+            else:
+                m_all = jnp.zeros((b, 1), bool)
+                n_all = jnp.zeros((b, 1), bool)
+            # lit[:, LIT_TRUE] is the AND-identity sentinel
+            lit = jnp.concatenate(
+                [m_all, n_all, jnp.ones((b, 1), bool)], axis=1)
+            sat_parts.append(
+                jnp.all(lit[:, params["lit_idx"]], axis=2))
+        sat = sat_parts[0] if len(sat_parts) == 1 \
+            else jnp.concatenate(sat_parts, axis=1)   # [B, n_conjs]
         # sat[:, CONJ_FALSE] is the OR-identity sentinel;
         # sat[:, CONJ_TRUE] the always-true column rule-axis padding
         # points its N gather at
@@ -752,6 +841,27 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     atom_tier.update({aidx: "dfa-pack" for aidx in dfa_atom_idx})
     atom_tier.update({aidx: "tensor" for aidx in gen_atom_idx})
 
+    geometry = {
+        # EQ atoms the LEGACY stage materializes planes for (fused-only
+        # EQ atoms are excluded above) — the roofline model sizes the
+        # legacy stage from this; the total is n_eq_atoms_total
+        "n_eq_atoms": len(eq_keep),
+        "n_eq_atoms_total": len(eq_atom_idx),
+        "n_ss_atoms": len(ss_atom_idx),
+        "n_dfa_atoms": len(dfa_atom_idx),
+        "n_gen_atoms": len(gen_atom_idx),
+        "n_dfa_groups": len(dfa_group_fns),
+        "n_live": n_live,
+        "n_conjs": n_conjs,
+        "n_fused_conjs": n_fused,
+        "n_legacy_conjs": n_legacy,
+        "use_legacy": use_legacy,
+        "l_max_fused": int(eqc_col.shape[1]) if n_fused else 0,
+        "l_max_legacy": int(lit_idx.shape[1]) if use_legacy else 0,
+        "k_max": k_max,
+        "n_rows": n_rows,
+    }
+
     return RuleSetProgram(
         rules=list(rules), layout=layout, interner=interner,
         fn=jax.jit(run) if jit else run, params=params,
@@ -760,7 +870,7 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
         attr_mask=attr_mask, attr_names=attr_names,
         rule_ns=rule_ns, ns_ids=ns_ids,
         atom_asts=list(atoms.asts), atom_tier=atom_tier,
-        per_rule_dnf=list(per_rule))
+        per_rule_dnf=list(per_rule), geometry=geometry)
 
 
 def _collect_attr_names(e: Expression, finder: AttributeDescriptorFinder,

@@ -668,11 +668,23 @@ def _compile_bytes(e: Expression, ctx: _Ctx) -> ByteFn:
         if raw:
             row[:len(raw)] = np.frombuffer(raw, dtype=np.uint8)
         n = len(raw)
+        # the latency-tier gate (fused.str_tiers) reads this back: a
+        # batch plane must never narrow below the longest constant —
+        # slicing a constant row loses REAL tail bytes (a >tier
+        # constant subject of endsWith would silently flip verdicts;
+        # the runtime str_lens check cannot see compile-time rows)
+        ctx.interner.note_byte_const(n)
 
         def fn(batch: AttributeBatch) -> BVal:
             b = batch.ids.shape[0]
-            return BVal(jnp.broadcast_to(jnp.asarray(row),
-                                         (b, lay.max_str_len)),
+            # constant rows follow the BATCH plane's width, which a
+            # narrowed latency-tier batch (fused.narrow_batch) slices
+            # below max_str_len. Sound because str_tiers gates every
+            # tier to >= the longest compiled constant (note_byte_const
+            # above): row[:w] only ever drops zero padding, and `n`
+            # keeps the TRUE length for the tiebreaks.
+            w = batch.str_bytes.shape[2]
+            return BVal(jnp.broadcast_to(jnp.asarray(row[:w]), (b, w)),
                         jnp.full(b, n, jnp.int32),
                         jnp.ones(b, bool), jnp.zeros(b, bool))
         return fn

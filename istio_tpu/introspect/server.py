@@ -133,6 +133,7 @@ class IntrospectServer:
         "/debug/analysis": "_h_analysis",
         "/debug/rulestats": "_h_rulestats",
         "/debug/canary": "_h_canary",
+        "/debug/roofline": "_h_roofline",
     }
 
     @staticmethod
@@ -285,6 +286,89 @@ class IntrospectServer:
             rb = self.runtime._report_batcher
             if rb is not None:
                 payload["report"] = rb.stats()
+        self._send_json(req, payload)
+
+    def _h_roofline(self, req: BaseHTTPRequestHandler) -> None:
+        """Roofline accounting for the LIVE snapshot's fused step
+        (compiler/roofline.py): per serving bucket, bytes/op counts
+        derived from the compiled shapes — and, when the stage
+        decomposition has observations, the live device_step median
+        judged against the platform roof (achieved GB/s / TOPS,
+        fraction_of_roof, binding resource). ?batch=N models one
+        extra shape."""
+        import jax
+
+        from istio_tpu.compiler import roofline
+        from istio_tpu.runtime import monitor
+
+        platform = jax.devices()[0].platform
+        payload: dict[str, Any] = {
+            "platform": platform,
+            "peaks": roofline.peaks_for(platform),
+        }
+        d = self.runtime.controller.dispatcher \
+            if self.runtime is not None else None
+        if d is None or d.fused is None:
+            payload["note"] = "no fused plan (generic path serving)"
+            self._send_json(req, payload)
+            return
+        plan = d.fused
+        buckets = list(d.buckets) or [self.runtime.args.max_batch]
+        # the live p50 is judged against the largest SERVING bucket —
+        # a ?batch=N model is what-if only (no served batch ever ran
+        # at a non-bucket shape, so judging the p50 against it would
+        # be nonsense)
+        judged = max(buckets) if buckets else None
+        try:
+            extra = int(self._query(req).get("batch", 0))
+        except ValueError:
+            extra = 0
+        if extra > 0:
+            buckets = sorted(set(buckets) | {extra})
+        dev = monitor.latency_snapshot()["stages"].get(
+            "device_step", {})
+        step_ms = dev.get("p50_ms")
+        payload["device_step_p50_ms"] = step_ms
+        payload["str_tiers"] = list(plan.str_tiers)
+        # byte-plane width the served batches ACTUALLY ran (latency-
+        # tier narrowing): judging the live p50 against the worst-case
+        # max_str_len model when every batch was tier-narrowed inflates
+        # achieved GB/s / fraction_of_roof for the byte-dominated
+        # components. Use the dominant served width; fall back to the
+        # full plane when nothing has been counted yet.
+        tier_counts = dict(plan._tier_served)
+        payload["tier_served_batches"] = {
+            str(w): n for w, n in sorted(tier_counts.items())}
+        live_width = max(tier_counts, key=tier_counts.get) \
+            if tier_counts else None
+        per: dict[str, Any] = {}
+        # the device_step histogram aggregates EVERY served batch
+        # shape, so judging each bucket's (very different) byte model
+        # against the one p50 would mislabel all but the shape that
+        # dominates the window — attach the live judgment only to the
+        # largest serving bucket (what sustained load pads to)
+        if step_ms:
+            payload["vs_live_note"] = (
+                "device_step_p50_ms aggregates all served batch "
+                f"shapes; vs_live_device_step is attached to bucket "
+                f"{judged} only (the shape sustained load pads to), "
+                f"modeled at the dominant served byte-plane width "
+                f"{live_width} — per-bucket walls need a shape-keyed "
+                "histogram")
+        for b in buckets:
+            model = roofline.model_check_step(plan.engine, b,
+                                              plan=plan)
+            entry = model.asdict()
+            if step_ms and b == judged:
+                live_model = model if live_width is None else \
+                    roofline.model_check_step(plan.engine, b,
+                                              plan=plan,
+                                              str_len=live_width)
+                entry["vs_live_device_step"] = live_model.report(
+                    step_ms / 1e3)
+                entry["vs_live_str_len"] = live_width
+            per[str(b)] = entry
+        payload["buckets"] = per
         self._send_json(req, payload)
 
     def _h_cache(self, req: BaseHTTPRequestHandler) -> None:

@@ -303,8 +303,13 @@ class PolicyEngine:
         self._has_quota = n_quotas > 0
 
         ruleset_run = self.ruleset.fn   # fn(ruleset_params, batch)
-        attr_mask = jnp.asarray(
-            self.ruleset.attr_mask.astype(np.int8))
+        # referenced-attr literal mask rides as BIT LANES (pack_bits)
+        # and unpacks to int8 on device once per step — the [R, C]
+        # int8 mask at 50k rules was MBs of resident weight for one
+        # bit of information per cell
+        from istio_tpu.ops.bytes_ops import pack_bits
+        n_attr_cols = int(self.ruleset.attr_mask.shape[1])
+        attr_mask_bits = jnp.asarray(pack_bits(self.ruleset.attr_mask))
         rule_ns = jnp.asarray(self.ruleset.rule_ns)
         default_ns = self.ruleset.ns_ids[""]
         deny_mask_j = jnp.asarray(deny_mask)
@@ -568,6 +573,8 @@ class PolicyEngine:
                         granted.astype(jnp.int32).reshape(-1))
                 quota_counts = quota_counts + add.reshape(quota_counts.shape)
 
+            attr_mask = bytes_ops.unpack_bits(
+                attr_mask_bits, n_attr_cols).astype(jnp.int8)
             referenced = lax.dot_general(
                 ns_ok.astype(jnp.int8), attr_mask, dims,
                 preferred_element_type=jnp.int32) > 0
@@ -585,6 +592,59 @@ class PolicyEngine:
                                          err_rule_mask_j[None, :]))
                                        .astype(jnp.int32)))
             return verdict, quota_counts
+
+        # ---- compiled-shape geometry for the roofline accounting
+        # layer (compiler/roofline.py): every entry derives from the
+        # ACTUAL device tensors built above, never hand constants
+        def _banks_geom() -> list:
+            out = []
+            for bank in rx_banks:
+                g = {"m_bytes": int(bank["M"].nbytes)
+                     + int(bank["M_def"].nbytes),
+                     "n_lists": int(bank["M"].shape[1])}
+                if bank["packed"] is not None:
+                    p = bank["packed"]
+                    g.update(kind="dense", s_tot=int(p["n_states"]),
+                             n_cls=int(p["n_classes"]),
+                             step_bytes=int(p["step_bits"].nbytes),
+                             n_pats=int(p["accept"].shape[1]))
+                elif bank["packed_blk"] is not None:
+                    p = bank["packed_blk"]
+                    g.update(kind="blocked",
+                             s_max=int(p["n_states_max"]),
+                             n_cls=int(p["n_classes"]),
+                             step_bytes=int(p["step_bits"].nbytes),
+                             n_pats=int(p["n_pats"]))
+                else:
+                    g.update(kind="gather",
+                             step_bytes=int(bank["trans"].nbytes),
+                             n_pats=int(bank["trans"].shape[0]),
+                             s_max=int(bank["trans"].shape[1]))
+                out.append(g)
+            return out
+
+        self.geometry = {
+            "n_rows": R,
+            "n_deny": len(deny),
+            "deny_bytes": int(deny_mask_j.nbytes + deny_status_j.nbytes
+                              + deny_dur_j.nbytes + deny_uses_j.nbytes),
+            "n_lists": n_lists,
+            "list_max_entries": int(list_ids.shape[1]),
+            "list_table_bytes": int(list_ids_j.nbytes)
+            if has_lists else 0,
+            "rx_banks": _banks_geom(),
+            "cidr_entries": 0 if cidr_bank is None else
+            int(cidr_bank["prefix"].shape[0]
+                * cidr_bank["prefix"].shape[1]),
+            "cidr_bytes": 0 if cidr_bank is None else
+            int(cidr_bank["prefix"].nbytes + cidr_bank["mask"].nbytes),
+            "n_rbac": n_rbac,
+            "rbac_k_allow": k_allow,
+            "n_quotas": n_quotas,
+            "quota_buckets": int(n_buckets),
+            "attr_mask_bits_bytes": int(attr_mask_bits.nbytes),
+            "n_attr_cols": n_attr_cols,
+        }
 
         self.raw_step = step   # unjitted: for entry()/sharded wrappers
         self.params = self.ruleset.params

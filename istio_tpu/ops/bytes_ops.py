@@ -152,6 +152,37 @@ def lex_cmp(a_data: jnp.ndarray, a_lens: jnp.ndarray,
     return jnp.where(has, byte_cmp, len_cmp)
 
 
+def pack_bits(a: np.ndarray) -> np.ndarray:
+    """Host-side bit packing of a bool/0-1 array along its LAST axis →
+    uint32 lanes, little-endian bit order within each 32-bit word,
+    width ceil(n/32). THE storage format for every bit-packed bank /
+    mask weight (one-hot DFA step matrices in regex_dfa; attr/instance
+    literal masks in the engine + packer): a one-hot transition bank
+    stored as f32 was 32× the HBM-resident bytes of its information
+    content. `unpack_bits` below is the on-device inverse."""
+    a = np.ascontiguousarray(np.asarray(a) != 0)
+    n = a.shape[-1]
+    w = max((n + 31) // 32, 0)
+    padded = np.zeros(a.shape[:-1] + (w * 32,), bool)
+    padded[..., :n] = a
+    packed8 = np.ascontiguousarray(
+        np.packbits(padded, axis=-1, bitorder="little"))
+    return packed8.view(np.uint32)
+
+
+def unpack_bits(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """On-device inverse of pack_bits: uint32 bit lanes
+    [..., W] → bool [..., n] (little-endian within each word). The
+    unpack is elementwise VPU work that runs ONCE per kernel
+    invocation; the packed lanes are what lives in HBM (and what the
+    compiled program carries), so a bank's resident weight is 1/32 of
+    its f32 one-hot formulation."""
+    bits = (packed[..., None] >> jnp.arange(32, dtype=jnp.uint32)) \
+        & jnp.uint32(1)
+    flat = bits.reshape(packed.shape[:-1] + (-1,))
+    return flat[..., :n] != 0
+
+
 def dfa_match(data: jnp.ndarray, lens: jnp.ndarray,
               transitions: jnp.ndarray, accept: jnp.ndarray) -> jnp.ndarray:
     """Run one dense DFA over every row: state := T[state, byte] for the
@@ -246,7 +277,9 @@ def dfa_match_many_onehot(data: jnp.ndarray, lens: jnp.ndarray,
     """
     b, l = data.shape
     s_tot, n_cls = packed["n_states"], packed["n_classes"]
-    step_m = jnp.asarray(packed["step"], jnp.bfloat16)
+    # bit-packed bank → bf16 once per invocation (unpack-on-device)
+    step_m = unpack_bits(jnp.asarray(packed["step_bits"]),
+                         s_tot).astype(jnp.bfloat16)
     cls_m = jnp.asarray(packed["cls"], jnp.bfloat16)
     accept = jnp.asarray(packed["accept"], jnp.bfloat16)
     starts = packed["starts"]
@@ -294,7 +327,9 @@ def dfa_match_many_onehot_blocked(data: jnp.ndarray, lens: jnp.ndarray,
     b, l = data.shape
     s_max, n_cls = packed["n_states_max"], packed["n_classes"]
     n = packed["n_pats"]
-    step_m = jnp.asarray(packed["step"], jnp.bfloat16)   # [N, s·C, s]
+    # bit-packed blocks → bf16 once per invocation [N, s·C, s]
+    step_m = unpack_bits(jnp.asarray(packed["step_bits"]),
+                         s_max).astype(jnp.bfloat16)
     cls_m = jnp.asarray(packed["cls"], jnp.bfloat16)     # [256, C]
     accept = jnp.asarray(packed["accept"], jnp.bfloat16)  # [N, s]
 

@@ -26,6 +26,11 @@ import dataclasses
 
 import numpy as np
 
+# the bit-lane codec lives with its on-device inverse (unpack_bits);
+# re-exported here because the packed one-hot step banks below are its
+# heaviest producer
+from istio_tpu.ops.bytes_ops import pack_bits
+
 ALPHABET = 256
 
 
@@ -509,22 +514,25 @@ def pack_dfas_onehot(dfas: list[DFA],
     """Pack several DFAs for the MXU (one-hot matmul) device kernel
     (bytes_ops.dfa_match_many_onehot).
 
-    Returns {"step": [S·C, S] bf16-safe one-hot transition matrix
-    (row s·C+c → one-hot of next state), "cls": [256, C] one-hot
-    byte→class matrix, "starts": [N] int32 global start states,
-    "accept": [S, N] pattern acceptance matrix}. The step matrix is
-    O(S²·C) memory — size-gate via pack_dfas_classes first."""
+    Returns {"step_bits": [S·C, ceil(S/32)] BIT-PACKED one-hot
+    transition matrix (row s·C+c → one-hot of next state; pack_bits
+    lanes, unpacked to bf16 on device once per kernel invocation —
+    bytes_ops.unpack_bits), "cls": [256, C] one-hot byte→class matrix,
+    "starts": [N] int32 global start states, "accept": [S, N] pattern
+    acceptance matrix}. The step matrix is O(S²·C) one-hot entries —
+    bit lanes keep the resident bank at 1/32 of the f32 formulation's
+    bytes; size-gate via pack_dfas_classes first."""
     k = classes if classes is not None else pack_dfas_classes(dfas)
     s_tot, n_cls = k["n_states"], k["n_classes"]
     gt, class_of, rep = k["gt"], k["class_of"], k["rep"]
-    step = np.zeros((s_tot * n_cls, s_tot), np.float32)
+    step = np.zeros((s_tot * n_cls, s_tot), bool)
     rows = (np.arange(s_tot)[:, None] * n_cls
             + np.arange(n_cls)[None, :]).reshape(-1)
     cols = gt[:, rep].reshape(-1)          # [S, C] next states
-    step[rows, cols] = 1.0
+    step[rows, cols] = True
     cls = np.zeros((ALPHABET, n_cls), np.float32)
     cls[np.arange(ALPHABET), class_of] = 1.0
-    return {"step": step, "cls": cls,
+    return {"step_bits": pack_bits(step), "cls": cls,
             "starts": k["starts"], "accept": k["accept"],
             "n_states": s_tot, "n_classes": n_cls}
 
@@ -541,29 +549,31 @@ def pack_dfas_onehot_blocked(dfas: list[DFA],
     never cross patterns, so the dense matrix was block-diagonal
     anyway — this stores only the blocks.
 
-    Returns {"step": [N, s_max·C, s_max], "cls": [256, C],
-    "accept": [N, s_max] (acceptance of pattern i's own states),
-    "n_states_max", "n_classes", "n_pats"}; pattern i starts in its
-    local state 0 (compile_regex numbers the start state 0)."""
+    Returns {"step_bits": [N, s_max·C, ceil(s_max/32)] bit-packed
+    blocks (pack_bits lanes, device-unpacked once per invocation),
+    "cls": [256, C], "accept": [N, s_max] (acceptance of pattern i's
+    own states), "n_states_max", "n_classes", "n_pats"}; pattern i
+    starts in its local state 0 (compile_regex numbers the start
+    state 0)."""
     k = classes if classes is not None else pack_dfas_classes(dfas)
     n = len(dfas)
     n_cls = int(k["n_classes"])
     class_of, rep = k["class_of"], k["rep"]
     s_max = max(d.n_states for d in dfas)
-    step = np.zeros((n, s_max * n_cls, s_max), np.float32)
+    step = np.zeros((n, s_max * n_cls, s_max), bool)
     accept = np.zeros((n, s_max), np.float32)
     for i, d in enumerate(dfas):
         s_i = d.n_states
         rows = (np.arange(s_i)[:, None] * n_cls
                 + np.arange(n_cls)[None, :]).reshape(-1)
         cols = d.transitions[:, rep].reshape(-1)
-        step[i, rows, cols] = 1.0
+        step[i, rows, cols] = True
         accept[i, :s_i] = d.accept
         # padding states self-loop dead (all-zero rows: a one-hot that
         # reaches them vanishes — they are unreachable from state 0)
     cls = np.zeros((ALPHABET, n_cls), np.float32)
     cls[np.arange(ALPHABET), class_of] = 1.0
-    return {"step": step, "cls": cls, "accept": accept,
+    return {"step_bits": pack_bits(step), "cls": cls, "accept": accept,
             "n_states_max": s_max, "n_classes": n_cls, "n_pats": n}
 
 

@@ -35,8 +35,11 @@ def default_buckets(max_batch: int) -> tuple[int, ...]:
     """Few, coarse bucket shapes: every bucket is one jit trace the
     server must pay (seconds on TPU), so a small fixed set beats
     power-of-two granularity — padding a 3-request batch to 256 rows
-    costs microseconds of MXU time, a 12th trace costs seconds."""
-    out = sorted({min(256, max_batch), max_batch})
+    costs microseconds of MXU time, a 12th trace costs seconds.
+    Includes the 64-wide LATENCY TIER (profiled r4: B=64 lands under
+    the 1 ms budget at 10k rules where B=256 does not) so light-load
+    batches compile to a tight shape instead of padding to 256."""
+    out = sorted({min(64, max_batch), min(256, max_batch), max_batch})
     return tuple(out)
 
 

@@ -36,7 +36,9 @@ class Controller:
                  mesh=None,
                  rule_telemetry: bool = True,
                  canary=None,
-                 on_canary_reject: Callable[..., None] | None = None):
+                 on_canary_reject: Callable[..., None] | None = None,
+                 initial_prewarm: bool = True,
+                 prewarm_hook: Callable[..., None] | None = None):
         self.store = store
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
@@ -54,6 +56,19 @@ class Controller:
         self.last_canary_rejection = None
         self.mesh = mesh    # jax.sharding.Mesh for multi-chip serving
         self.prewarm_buckets = tuple(prewarm_buckets)
+        # False skips the BACKGROUND first-build prewarm (callers that
+        # warm explicitly, e.g. bench rigs — the duplicate compiles
+        # contend for the core and a thread still compiling at process
+        # exit aborts the interpreter); config-SWAP prewarms are
+        # synchronous and unaffected
+        self.initial_prewarm = initial_prewarm
+        # called with the candidate plan next to plan.prewarm (config
+        # SWAPS only, pre-swap, rebuild thread): the owner warms extra
+        # per-plan programs (e.g. the in-step quota step) while the
+        # old dispatcher keeps serving
+        self.prewarm_hook = prewarm_hook
+        self._prewarm_stop = False
+        self._prewarm_thread: threading.Thread | None = None
         self._builder = SnapshotBuilder(default_manifest,
                                         InternTable(), max_str_len,
                                         lower_rbac=fused)
@@ -112,15 +127,29 @@ class Controller:
                     # (SURVEY hard-part #5): a config change must never
                     # surface trace time in-band
                     plan.prewarm(self.prewarm_buckets)
-                else:
+                    if self.prewarm_hook is not None:
+                        # extra shapes the OWNER serves through this
+                        # plan (RuntimeServer: the merged check+quota
+                        # in-step program) — warmed here, BEFORE the
+                        # swap, for the same reason; a post-publish
+                        # warm would leave a window where the first
+                        # quota batch traces in-band
+                        try:
+                            self.prewarm_hook(plan)
+                        except Exception:
+                            log.exception("prewarm hook failed")
+                elif self.initial_prewarm:
                     # first build: serve immediately, warm in the
                     # background — blocking startup for minutes of
                     # per-bucket device compiles helps nobody, but
                     # without ANY warm the first requests serialize
-                    # behind those same compiles
-                    threading.Thread(
-                        target=plan.prewarm, args=(self.prewarm_buckets,),
-                        daemon=True, name="prewarm-initial").start()
+                    # behind those same compiles. The thread polls the
+                    # controller's stop flag between shapes so close()
+                    # never leaves it compiling into teardown.
+                    self._prewarm_thread = threading.Thread(
+                        target=self._guarded_prewarm, args=(plan,),
+                        daemon=True, name="prewarm-initial")
+                    self._prewarm_thread.start()
         # config canary: replay recorded live traffic through the
         # candidate BEFORE any publish side effect (the handler table
         # and quota pools below mutate shared state toward the new
@@ -183,10 +212,27 @@ class Controller:
             self.on_publish(dispatcher)
         return dispatcher
 
+    def _guarded_prewarm(self, plan) -> None:
+        try:
+            plan.prewarm(self.prewarm_buckets,
+                         should_stop=lambda: self._prewarm_stop)
+        except Exception:
+            log.exception("initial prewarm failed")
+
     def close(self) -> None:
         with self._lock:
             if self._timer is not None:
                 self._timer.cancel()
+        # stop + reap the initial prewarm: a daemon thread still inside
+        # an XLA compile at interpreter exit aborts the process
+        # ("terminate called without an active exception"). The join is
+        # UNTIMED on purpose: the flag is polled between shapes, so the
+        # thread exits after at most the in-flight compile — a timed
+        # join that expires mid-compile re-opens the teardown abort.
+        self._prewarm_stop = True
+        t = self._prewarm_thread
+        if t is not None and t.is_alive():
+            t.join()
         self._handler_table.close()
         if self._quota_table is not None:
             self._quota_table.close()

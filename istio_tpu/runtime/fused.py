@@ -41,6 +41,37 @@ log = scope("runtime.fused")
 
 _FUSABLE_LIST_TYPES = ("STRINGS", "REGEX", "IP_ADDRESSES")
 
+# latency-tier byte-plane width: batches whose every string fits this
+# many bytes serve through a str_bytes plane sliced to it (see
+# FusedPlan.narrow_batch) — the worst-case max_str_len plane is paid
+# only by batches that actually carry long strings
+STR_TIER_MIN = 32
+
+
+def str_tiers(layout, interner=None) -> tuple[int, ...]:
+    """Byte-plane length tiers for a snapshot: (STR_TIER_MIN, L) when
+    the layout carries real byte slots wider than the small tier, else
+    the single full width. Each tier is one extra jit trace per bucket
+    (prewarmed like buckets are), bought back on every easy batch: the
+    H2D bytes and every full-width byte op (prefix/suffix/exact
+    compares, lex_cmp) shrink L/STR_TIER_MIN-fold.
+
+    `interner`: the snapshot's InternTable, consulted AFTER every
+    program compiled (its max_byte_const_len is grow-only). A tier
+    below the longest compiled byte CONSTANT is unsound — narrowing
+    slices constant rows, and a constant longer than the tier loses
+    real tail bytes (e.g. the subject of `"long...".endsWith(attr)`),
+    flipping verdicts the runtime str_lens check cannot catch — so the
+    small tier only exists when every constant fits it."""
+    L = layout.max_str_len
+    min_safe = STR_TIER_MIN
+    if interner is not None:
+        min_safe = max(min_safe,
+                       int(getattr(interner, "max_byte_const_len", 0)))
+    if layout.n_byte_slots and L > min_safe:
+        return (min_safe, L)
+    return (L,)
+
 
 def pack_bool_rows(flags, n_words: int):
     """[B, n_words*32] bool → int32 word rows [n_words, B]: THE wire
@@ -140,10 +171,46 @@ class FusedPlan:
     telemetry: Any = None
     _report_packer: Any = None
     _instep_packer: Any = None
+    # byte-plane length tiers (str_tiers(layout)): serving batches whose
+    # strings all fit the small tier compile/serve at the sliced shape
+    str_tiers: tuple = ()
+    # observed-check tier usage: byte-plane width actually served →
+    # batch count (GIL-atomic int bumps; /debug/roofline judges the
+    # live device_step p50 against the dominant width, not the
+    # worst-case max_str_len plane)
+    _tier_served: dict = dataclasses.field(default_factory=dict)
+    # completed prewarm_instep (buckets, counts-shape) combinations
+    _instep_warmed: set = dataclasses.field(default_factory=set)
 
     @property
     def n_ref_words(self) -> int:
         return (len(self.item_names) + 31) // 32
+
+    def narrow_batch(self, batch):
+        """Latency-tier bucket specialization (byte-plane axis): when
+        every string in the batch fits the small tier, slice str_bytes
+        to it so the engine step + packer run (and prewarm) a tighter
+        XLA shape instead of riding the max_str_len worst case.
+        Verdict-identical by construction: sliced lanes are zero
+        padding past every row's length, and the truncation contract
+        compares str_lens against layout.max_str_len — which narrowing
+        never changes (a row truncated at ingest has len == max_str_len
+        and keeps the full-width shape). Host-side numpy only."""
+        tiers = self.str_tiers
+        if len(tiers) < 2 or not isinstance(batch.str_bytes, np.ndarray) \
+                or not isinstance(batch.str_lens, np.ndarray):
+            return batch
+        t = tiers[0]
+        if batch.str_bytes.shape[2] <= t:
+            return batch
+        if not batch.str_lens.size:
+            return batch
+        m = int(batch.str_lens.max())   # hotpath: sync-ok (host numpy)
+        if m > t:
+            return batch
+        return dataclasses.replace(
+            batch,
+            str_bytes=np.ascontiguousarray(batch.str_bytes[:, :, :t]))
 
     @property
     def n_overlay_words(self) -> int:
@@ -171,9 +238,12 @@ class FusedPlan:
 
         from istio_tpu.runtime import monitor
 
+        batch = self.narrow_batch(batch)   # latency-tier byte plane
         if self._packer is None:
             self._packer = jax.jit(self._base_packer())
         if observe:
+            w = int(batch.str_bytes.shape[2])
+            self._tier_served[w] = self._tier_served.get(w, 0) + 1
             # fault-injection seam at the device boundary (chaos suite
             # + scripts/chaos_smoke.py): an injected exception here
             # unwinds exactly like a real device-step failure. Gated
@@ -214,12 +284,17 @@ class FusedPlan:
         packed_report (which appends report-field planes)."""
         import jax.numpy as jnp
         from jax import lax
+
+        from istio_tpu.ops.bytes_ops import pack_bits, unpack_bits
         rs = self.engine.ruleset
         cols = jnp.asarray(self.overlay_cols, jnp.int32)
         rule_ns = jnp.asarray(rs.rule_ns)
         default_ns = rs.ns_ids[""]
-        inst_mask_j = jnp.asarray(self.inst_mask)
-        pred_map_j = jnp.asarray(self.pred_map_mask)
+        # instance/predicate-map literal masks ride bit-packed and
+        # unpack to int8 on device per step (pack_bits discipline —
+        # one bit of information per cell, 1/8 the resident int8 bytes)
+        inst_bits = jnp.asarray(pack_bits(self.inst_mask))
+        pred_map_bits = jnp.asarray(pack_bits(self.pred_map_mask))
         n_items = len(self.item_names)
         n_words = self.n_ref_words
         n_cols = rs.layout.n_columns
@@ -247,6 +322,8 @@ class FusedPlan:
                 items = items.at[:, :n_cols].set(
                     verdict.referenced[:, :n_cols])
                 if n_maps_used:
+                    pred_map_j = unpack_bits(
+                        pred_map_bits, n_maps_used).astype(jnp.int8)
                     pred_maps = lax.dot_general(
                         ns_ok.astype(jnp.int8), pred_map_j, dims,
                         preferred_element_type=jnp.int32) > 0
@@ -254,6 +331,8 @@ class FusedPlan:
                         :, n_cols:n_cols + n_maps_used].set(
                             items[:, n_cols:n_cols + n_maps_used]
                             | pred_maps)
+                inst_mask_j = unpack_bits(
+                    inst_bits, n_items).astype(jnp.int8)
                 inst = lax.dot_general(
                     active.astype(jnp.int8), inst_mask_j, dims,
                     preferred_element_type=jnp.int32) > 0
@@ -288,6 +367,7 @@ class FusedPlan:
             return self.packed_check(batch, ns_ids, observe=False)
         import jax
 
+        batch = self.narrow_batch(batch)   # latency-tier byte plane
         if self._report_packer is None:
             import jax.numpy as jnp
             pack = self._base_packer()
@@ -321,6 +401,8 @@ class FusedPlan:
         transport (the bench's no-quota windows measure ~2x the mixed
         rate for exactly this reason).
 
+        Narrowed to the batch's byte tier like packed_check.
+
         `q` carries the staged per-row alloc arrays from
         device_quota.InlineQuotaSession (buckets/amounts/be/mx/active/
         ticks/lasts/rolling, plus rule_idx — the ruleset row whose
@@ -330,6 +412,10 @@ class FusedPlan:
         packed's last TWO rows are granted and gate once pulled."""
         import jax
 
+        batch = self.narrow_batch(batch)   # latency-tier byte plane
+        if n_real is None or n_real > 0:   # prewarm dummies pass 0
+            w = int(batch.str_bytes.shape[2])
+            self._tier_served[w] = self._tier_served.get(w, 0) + 1
         if self._instep_packer is None:
             import jax.numpy as jnp
             from istio_tpu.models.quota_alloc import \
@@ -425,75 +511,104 @@ class FusedPlan:
         out["ns_pred_cache_entries"] = len(self._ns_pred_cache)
         return out
 
-    def prewarm(self, buckets) -> None:
+    def prewarm(self, buckets, should_stop=None) -> None:
         """Trace/compile the engine step for every serving batch shape.
 
         Called by the controller BEFORE the atomic dispatcher swap
         (SURVEY hard-part #5; resolver refcount-swap semantics,
         mixer/pkg/runtime/resolver.go:240-247): the old snapshot keeps
         serving while the new one's jit cache fills, so no request pays
-        multi-second trace time in-band after a config change."""
+        multi-second trace time in-band after a config change.
+
+        `should_stop`: zero-arg callable polled between shapes — the
+        controller's BACKGROUND initial prewarm passes its shutdown
+        flag so a closing server never leaves a daemon thread compiling
+        into interpreter teardown (C++ abort on exit)."""
+        for b in sorted(set(buckets)):
+            # one serving entry per (bucket, byte tier): dummy batches
+            # with zero lens narrow to the small tier, full-length
+            # lens hold the worst-case width — together they warm
+            # every shape narrow_batch can route a served batch to
+            for batch in self._prewarm_batches(b):
+                if should_stop is not None and should_stop():
+                    return
+                # warm the SERVING entry (engine step + packer), not
+                # just the engine — the packer gather is its own XLA
+                # program
+                self.packed_check(batch, np.zeros(b, np.int32),
+                                  observe=False)
+                if self.report_lowering is not None and \
+                        self.report_rules:
+                    # the report path's packer (check rows + field
+                    # planes) is a separate XLA program per shape
+                    self.packed_report(batch, np.zeros(b, np.int32))
+
+    def _prewarm_batches(self, b: int) -> list:
+        """Dummy AttributeBatches covering every byte-plane tier for
+        bucket size `b`. The dummy batch MUST flatten to the same
+        pytree treedef as served batches (hash_ids included) — a
+        treedef mismatch compiles a cache entry serving never hits,
+        silently un-doing the prewarm."""
         from istio_tpu.compiler.layout import AttributeBatch
 
         lay = self.engine.ruleset.layout
-        for b in sorted(set(buckets)):
-            # the dummy batch MUST flatten to the same pytree treedef
-            # as served batches (hash_ids included) — a treedef
-            # mismatch compiles a cache entry serving never hits,
-            # silently un-doing the prewarm
-            batch = AttributeBatch(
+        tiers = self.str_tiers or (lay.max_str_len,)
+        out = []
+        for tier in sorted(set(tiers)):
+            # lens pinned AT the tier so narrow_batch routes the dummy
+            # to exactly this tier's compiled shape (0 → small tier;
+            # max_str_len → the full-width worst case)
+            out.append(AttributeBatch(
                 ids=np.zeros((b, lay.n_columns), np.int32),
                 present=np.zeros((b, lay.n_columns), bool),
                 map_present=np.zeros((b, max(lay.n_maps, 1)), bool),
                 str_bytes=np.zeros((b, max(lay.n_byte_slots, 1),
                                     lay.max_str_len), np.uint8),
-                str_lens=np.zeros((b, max(lay.n_byte_slots, 1)),
-                                  np.int32),
-                hash_ids=np.zeros((b, lay.n_columns), np.int32))
-            # warm the SERVING entry (engine step + packer), not just
-            # the engine — the packer gather is its own XLA program
-            self.packed_check(batch, np.zeros(b, np.int32),
-                              observe=False)
-            if self.report_lowering is not None and self.report_rules:
-                # the report path's packer (check rows + field planes)
-                # is a separate XLA program per bucket shape
-                self.packed_report(batch, np.zeros(b, np.int32))
+                str_lens=np.full((b, max(lay.n_byte_slots, 1)),
+                                 0 if tier == min(tiers) else tier,
+                                 np.int32),
+                hash_ids=np.zeros((b, lay.n_columns), np.int32)))
+        return out
 
-    def prewarm_instep(self, buckets, counts) -> None:
+    def prewarm_instep(self, buckets, counts, should_stop=None) -> None:
         """Compile the in-step quota program for every serving bucket
         (ServerArgs.quota_in_step fronts call this before taking
         traffic — a first-quota-batch compile mid-serve stalls every
-        row behind it). `counts` only supplies the counter SHAPE; the
-        dummy trips never touch the pool's live buffer."""
-        from istio_tpu.compiler.layout import AttributeBatch
+        row behind it; RuntimeServer wires it on every publish).
+        `counts` only supplies the counter SHAPE; the dummy trips
+        never touch the pool's live buffer. `should_stop` is polled
+        between shapes like prewarm's — a closing server must be able
+        to stop a background warm before interpreter teardown.
 
+        Completed (buckets, counts-shape) combinations are memoized:
+        the post-publish backstop re-invokes this after the pre-swap
+        hook already warmed, and re-executing every bucket × tier
+        dummy trip would contend with live traffic for the device."""
         import jax.numpy as jnp
 
-        lay = self.engine.ruleset.layout
+        key = (tuple(sorted(set(buckets))), tuple(counts.shape))
+        if key in self._instep_warmed:
+            return
         zero_counts = jnp.zeros_like(counts)
         for b in sorted(set(buckets)):
-            batch = AttributeBatch(
-                ids=np.zeros((b, lay.n_columns), np.int32),
-                present=np.zeros((b, lay.n_columns), bool),
-                map_present=np.zeros((b, max(lay.n_maps, 1)), bool),
-                str_bytes=np.zeros((b, max(lay.n_byte_slots, 1),
-                                    lay.max_str_len), np.uint8),
-                str_lens=np.zeros((b, max(lay.n_byte_slots, 1)),
-                                  np.int32),
-                hash_ids=np.zeros((b, lay.n_columns), np.int32))
-            q = {"buckets": np.zeros(b, np.int32),
-                 "amounts": np.zeros(b, np.int32),
-                 "be": np.zeros(b, bool),
-                 "mx": np.zeros(b, np.int32),
-                 "active": np.zeros(b, bool),
-                 "ticks": np.zeros(b, np.int32),
-                 "lasts": np.zeros(b, np.int32),
-                 "rolling": np.zeros(b, bool),
-                 "rule_idx": np.full(b, -1, np.int32)}
-            packed, _cnt = self.packed_check_instep(
-                batch, np.zeros(b, np.int32), q, zero_counts,
-                n_real=0)   # dummy rows must not feed rule telemetry
-            np.asarray(packed)   # force compile + execute
+            for batch in self._prewarm_batches(b):
+                if should_stop is not None and should_stop():
+                    return
+                q = {"buckets": np.zeros(b, np.int32),
+                     "amounts": np.zeros(b, np.int32),
+                     "be": np.zeros(b, bool),
+                     "mx": np.zeros(b, np.int32),
+                     "active": np.zeros(b, bool),
+                     "ticks": np.zeros(b, np.int32),
+                     "lasts": np.zeros(b, np.int32),
+                     "rolling": np.zeros(b, bool),
+                     "rule_idx": np.full(b, -1, np.int32)}
+                packed, _cnt = self.packed_check_instep(
+                    batch, np.zeros(b, np.int32), q, zero_counts,
+                    n_real=0)   # dummies must not feed rule telemetry
+                np.asarray(packed)   # force compile + execute
+        # only a COMPLETED warm counts (not stopped)
+        self._instep_warmed.add(key)
 
     def message_for(self, rule_idx: int, status: int) -> str:
         """Best-effort status message for a device-produced denial."""
@@ -730,6 +845,10 @@ def build_fused_plan(snapshot: Snapshot,
                           "without per-rule accumulators")
     return FusedPlan(engine=engine, native=native,
                      telemetry=telemetry,
+                     # AFTER every compile above (engine, report
+                     # lowering): the interner's constant-length max
+                     # is grow-only and now complete for this snapshot
+                     str_tiers=str_tiers(layout, rs.interner),
                      host_actions=host_actions,
                      host_rule_idx=np.asarray(sorted(host_actions),
                                               np.int64),

@@ -53,6 +53,10 @@ class ServerArgs:
     # serving batch shapes (None → batcher.default_buckets(max_batch));
     # each is one jit trace, pre-warmed before config swaps
     buckets: tuple[int, ...] | None = None
+    # False skips the background FIRST-build prewarm (bench rigs and
+    # tests that call plan.prewarm explicitly — the duplicate compile
+    # contends for the core); swap-time prewarm stays synchronous
+    initial_prewarm: bool = True
     max_str_len: int | None = None
     preprocess: bool = True
     # serve checks through the fused device engine (runtime/fused.py);
@@ -169,7 +173,9 @@ class RuntimeServer:
             mesh=mesh,
             rule_telemetry=self.args.rule_telemetry,
             canary=self.canary,
-            on_publish=self._on_config_publish)
+            on_publish=self._on_config_publish,
+            initial_prewarm=self.args.initial_prewarm,
+            prewarm_hook=self._prewarm_instep_for)
         self._rulestats_drainer = RuleStatsDrainer(
             self.rulestats, self.args.rulestats_drain_s) \
             if (self.args.rule_telemetry and self.args.fused
@@ -222,6 +228,19 @@ class RuntimeServer:
             # decomposition / live p99 window
             observe_latency=False) \
             if self.args.report_batching else None
+        # initial publish ran before this hook's dependencies existed;
+        # warm the in-step quota program in the background like the
+        # controller's own initial prewarm (swaps re-warm in-line via
+        # _on_config_publish). close() flips the stop flag so a still-
+        # running background warm exits between shapes instead of
+        # compiling into interpreter teardown.
+        self._instep_prewarm_stop = False
+        try:
+            self.prewarm_instep(background=True)
+        except Exception:
+            import logging
+            logging.getLogger("istio_tpu.runtime.server").exception(
+                "initial in-step quota prewarm failed")
 
     # -- API surface (grpcServer.go Check/Report semantics) --
     # Preprocessing (the APA phase) happens exactly ONCE per request, in
@@ -239,6 +258,79 @@ class RuntimeServer:
             import logging
             logging.getLogger("istio_tpu.runtime.server").exception(
                 "rulestats attach failed")
+        # in-step quota prewarm backstop (ADVICE r5: fused.
+        # prewarm_instep was defined but never called, so the first
+        # quota-carrying batch paid its XLA trace in-band). The main
+        # warm runs PRE-SWAP via the controller's prewarm_hook
+        # (_prewarm_instep_for); this post-publish pass uses the
+        # precise instep_quota_target eligibility and catches a pool
+        # whose counts shape changed with the new config — already-
+        # compiled shapes just re-execute cheap dummy trips. The
+        # initial publish fires before self.controller exists and is
+        # covered by prewarm_instep() at the end of __init__.
+        try:
+            if getattr(self, "controller", None) is not None:
+                self.prewarm_instep()
+        except Exception:
+            import logging
+            logging.getLogger("istio_tpu.runtime.server").exception(
+                "in-step quota prewarm failed")
+
+    def _prewarm_instep_for(self, plan) -> None:
+        """Controller prewarm_hook: compile the CANDIDATE plan's
+        merged check+quota program BEFORE the dispatcher swap (old
+        plan keeps serving), so no quota batch in the swap window
+        traces in-band. Uses the live pool's counter shape — pools
+        persist across swaps (quota state continuity); if the new
+        config changes the shape, the post-publish backstop
+        (_on_config_publish → prewarm_instep) compiles the real one."""
+        if not self.args.quota_in_step or plan is None \
+                or not plan.quota_actions:
+            return
+        pools = getattr(self.controller, "device_quotas", None) \
+            if getattr(self, "controller", None) is not None else None
+        if not pools or len(set(map(id, pools.values()))) != 1:
+            return
+        pool = next(iter(pools.values()))
+        plan.prewarm_instep(
+            self.controller.prewarm_buckets, pool.counts,
+            should_stop=lambda: getattr(
+                self, "_instep_prewarm_stop", False))
+
+    def prewarm_instep(self, background: bool = False) -> None:
+        """Compile the merged check+quota-alloc program for every
+        serving bucket (and byte tier) BEFORE traffic selects it —
+        only when the in-step quota path is actually configured and
+        the live snapshot is in-step eligible. No-op otherwise."""
+        if not self.args.quota_in_step:
+            return
+        d = self.controller.dispatcher
+        plan = d.fused
+        target = self.instep_quota_target()
+        if plan is None or target is None:
+            return
+        pool, _ = target
+        buckets = self.controller.prewarm_buckets
+
+        def warm() -> None:
+            try:
+                plan.prewarm_instep(
+                    buckets, pool.counts,
+                    should_stop=lambda: self._instep_prewarm_stop)
+            except Exception:
+                import logging
+                logging.getLogger(
+                    "istio_tpu.runtime.server").exception(
+                    "in-step quota prewarm failed")
+
+        if background:
+            import threading
+            t = threading.Thread(target=warm, daemon=True,
+                                 name="prewarm-instep")
+            self._instep_prewarm_thread = t
+            t.start()
+        else:
+            warm()
 
     def preprocess(self, bag: Bag) -> Bag:
         d = self.controller.dispatcher
@@ -585,6 +677,15 @@ class RuntimeServer:
         return responses, results
 
     def close(self) -> None:
+        # a still-running initial in-step prewarm must not race
+        # interpreter/pool teardown (its dummy trips touch jax state):
+        # flip the stop flag (polled between shapes), then reap.
+        # Untimed join — the thread exits after at most the in-flight
+        # compile; expiring mid-compile would abort teardown anyway.
+        self._instep_prewarm_stop = True
+        t = getattr(self, "_instep_prewarm_thread", None)
+        if t is not None and t.is_alive():
+            t.join()
         self.batcher.close()
         if self._report_batcher is not None:
             self._report_batcher.close()

@@ -61,7 +61,7 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
     }),
     "istio_tpu/runtime/fused.py": frozenset({
         "FusedPlan.packed_check", "FusedPlan.packed_report",
-        "FusedPlan.packed_check_instep",
+        "FusedPlan.packed_check_instep", "FusedPlan.narrow_batch",
     }),
     # rule-telemetry fold + drain (PR 4): observe/add_host/sample run
     # inside the batch step; drain's device→host pull is THE designated

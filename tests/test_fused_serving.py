@@ -560,12 +560,17 @@ def test_snapshot_swap_under_load():
             t.start()
         _time.sleep(0.3)
         baseline_n = len(latencies)
-        # config change → debounce → rebuild + prewarm → atomic swap
+        # config change → debounce → rebuild + prewarm → atomic swap.
+        # The pre-swap warm covers every bucket × byte tier plus the
+        # in-step quota program (latency-tier specialization), so on a
+        # loaded CPU host the swap can take well over 30s — the budget
+        # here only bounds "eventually", the latency asserts below are
+        # what this test exists for.
         store.set(("rule", "istio-system", "swap-deny"), {
             "match": 'request.path.startsWith("/swapped")',
             "actions": [{"handler": "denyall.istio-system",
                          "instances": ["nothing.istio-system"]}]})
-        deadline = _time.time() + 30
+        deadline = _time.time() + 120
         while _time.time() < deadline:
             r = srv.check(bag_from_mapping(
                 {"request.path": "/swapped/x"}))
