@@ -84,6 +84,8 @@ def _load_lib() -> ctypes.CDLL:
                                    ctypes.POINTER(ctypes.c_int64)]
     lib.h2srv_stop.restype = None
     lib.h2srv_stop.argtypes = [ctypes.c_void_p]
+    lib.h2srv_quiesce.restype = None
+    lib.h2srv_quiesce.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -129,10 +131,28 @@ class NativeMixerServer(MixerGrpcServer):
         return self.port
 
     def stop(self, grace: float = 1.0) -> None:
-        # order matters: pumps must be out of h2srv_take before the
-        # server object is torn down
+        """Ordered graceful stop (the native leg of the lifecycle
+        plane): quiesce intake → drain in-flight rows → join pumps →
+        tear down the wire. Every submitted row resolves — to its real
+        verdict during the drain window, or to a typed UNAVAILABLE
+        rejection past it — never a silent drop."""
         if self._h is None:
             return
+        import time as _time
+
+        # 1. stop intake: new wire requests answer UNAVAILABLE in C++;
+        #    already-queued rows dispatch to the pumps immediately
+        #    (no min_fill hold during a drain)
+        self._lib.h2srv_quiesce(self._h)
+        # 2. drain: wait for queued + dispatched + deferred-quota rows
+        #    to complete (in_flight counts enqueue → completion-write)
+        deadline = _time.monotonic() + grace
+        while _time.monotonic() < deadline:
+            if self.counters().get("in_flight", 0) <= 0:
+                break
+            _time.sleep(0.01)
+        # 3. pumps must be out of h2srv_take before the handle is torn
+        #    down
         self._stop_flag.set()
         for t in self._pumps:
             t.join(timeout=grace + 30)
@@ -141,10 +161,14 @@ class NativeMixerServer(MixerGrpcServer):
             # a pump is wedged mid-batch (device stall): freeing the
             # handle under it would turn a stall into a segfault —
             # leak the C++ server instead (it stays valid for the
-            # straggler's h2srv_take/complete calls)
+            # straggler's h2srv_take/complete calls, and h2srv_stop's
+            # own abi-call guard would leak it anyway)
             log.error("native server handle leaked: pump stuck "
                       "past %.0fs grace", grace + 30)
             return
+        # 4. teardown: rows the drain deadline abandoned get typed
+        #    rejections framed + flushed by the IO thread's shutdown
+        #    drain; double-stop is a C++-side no-op
         with self._comp_lock:
             self._lib.h2srv_stop(self._h)
             self._h = None

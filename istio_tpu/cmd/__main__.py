@@ -680,6 +680,37 @@ def cmd_node_agent(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_brkcol(args: argparse.Namespace) -> int:
+    """brkcol (broker/cmd/brkcol): broker-config collector — read the
+    service-class / service-plan kinds out of a config store, assemble
+    the OSB catalog exactly as a serving brks would (controller.go:48
+    via BrokerConfigStore.catalog), and print it. The offline
+    collection/inspection half of the broker pair: run it against the
+    store a broker will mount to see the catalog it would serve."""
+    from istio_tpu.broker.model import BrokerConfigStore
+    from istio_tpu.runtime import FsStore
+
+    store = FsStore(args.config_store)
+    bcs = BrokerConfigStore(store)
+    classes = bcs.service_classes()
+    plans = bcs.service_plans()
+    catalog = bcs.catalog().to_wire()
+    if args.json:
+        print(json.dumps({"service_classes": sorted(classes),
+                          "service_plans": sorted(plans),
+                          "catalog": catalog}, indent=1))
+    else:
+        print(f"brkcol: {len(classes)} service-class(es), "
+              f"{len(plans)} service-plan(s), "
+              f"{len(catalog['services'])} catalog service(s)")
+        for key in sorted(classes):
+            print(f"  class {key}")
+        for key, plan in sorted(plans.items()):
+            svcs = ",".join(plan.get("services") or ())
+            print(f"  plan  {key} -> [{svcs}]")
+    return 0
+
+
 def cmd_brks(args: argparse.Namespace) -> int:
     """brks (broker/cmd/brks)."""
     import yaml
@@ -915,6 +946,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--port", type=int, default=8090)
     s.add_argument("--catalog", default="")
     s.set_defaults(fn=cmd_brks)
+
+    s = sub.add_parser("brkcol",
+                       help="broker-config collector: assemble + "
+                            "print the OSB catalog a broker would "
+                            "serve from this config store")
+    s.add_argument("--config-store", required=True,
+                   help="directory of YAML config documents "
+                        "(service-class / service-plan kinds)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    s.set_defaults(fn=cmd_brkcol)
     return p
 
 

@@ -13,7 +13,8 @@ tests run against (k8s.io/client-go/testing fixtures).
 from istio_tpu.kube.fake import AdmissionDenied, FakeKubeCluster, WatchEvent
 from istio_tpu.kube.crd import CrdStore, KubeConfigStore, ISTIO_CRD_KINDS
 from istio_tpu.kube.registry import KubeServiceRegistry
-from istio_tpu.kube.ingress import IngressController
+from istio_tpu.kube.ingress import (IngressController,
+                                    IngressStatusSyncer)
 from istio_tpu.kube.admission import (register_analysis_admission,
                                       register_istio_admission)
 
@@ -21,6 +22,7 @@ __all__ = [
     "AdmissionDenied", "FakeKubeCluster", "WatchEvent",
     "CrdStore", "KubeConfigStore", "ISTIO_CRD_KINDS",
     "KubeServiceRegistry", "IngressController",
+    "IngressStatusSyncer",
     "register_istio_admission", "register_analysis_admission",
 ]
 

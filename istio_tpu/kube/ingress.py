@@ -1,20 +1,26 @@
 """Ingress controller — k8s Ingress → istio ingress-rule configs.
 
-Reference: pilot/pkg/config/kube/ingress/{controller,conversion}.go —
-watch Ingress resources, decompose each (host, path, backend) tuple
-into one `ingress-rule` config named `<ingress>-<i>-<j>`, and keep the
-target config store in sync (status writing is the only part omitted:
-there is no cloud LB to report).
+Reference: pilot/pkg/config/kube/ingress/{controller,conversion,
+status}.go — watch Ingress resources, decompose each (host, path,
+backend) tuple into one `ingress-rule` config named `<ingress>-<i>-<j>`,
+keep the target config store in sync, and write the ingress gateway's
+load-balancer address back into each watched resource's
+status.loadBalancer (IngressStatusSyncer — kubectl and cloud
+controllers read it to learn where traffic actually lands).
 
 The emitted rules land in a pilot ConfigStore; the envoy config
 generator's ingress route builder consumes them (pilot/routes.py).
 """
 from __future__ import annotations
 
+import ipaddress
+import logging
 from typing import Any, Mapping
 
 from istio_tpu.kube.fake import FakeKubeCluster, WatchEvent
 from istio_tpu.pilot.model import Config, ConfigMeta, ConfigStore
+
+log = logging.getLogger("istio_tpu.kube.ingress")
 
 
 def _backend_service(backend: Mapping[str, Any], namespace: str,
@@ -93,3 +99,58 @@ class IngressController:
                 out.append(rule(i, j, host, str(p.get("path", "") or ""),
                                 p.get("backend") or {}))
         return out
+
+
+class IngressStatusSyncer:
+    """status.go analog — the part this module used to declare
+    omitted: write the ingress gateway's external address into
+    status.loadBalancer.ingress of every watched Ingress resource the
+    mesh class owns. An IP address writes the `ip` field, anything
+    else `hostname` (status.go's shape). Idempotent by comparison: a
+    resource whose status already matches is left untouched — which
+    is also what terminates the watch → update → watch loop this
+    syncer rides (updates re-notify watchers, including itself)."""
+
+    def __init__(self, cluster: FakeKubeCluster, address: str,
+                 ingress_class: str = "istio"):
+        self.cluster = cluster
+        self.address = str(address)
+        self.ingress_class = ingress_class
+        cluster.watch("Ingress", self._on_event)
+
+    def _desired(self) -> list[dict]:
+        try:
+            ipaddress.ip_address(self.address)
+            key = "ip"
+        except ValueError:
+            key = "hostname"
+        return [{key: self.address}]
+
+    def _should_process(self, obj: Mapping[str, Any]) -> bool:
+        annotations = (obj.get("metadata") or {}) \
+            .get("annotations") or {}
+        cls = annotations.get("kubernetes.io/ingress.class")
+        return cls is None or cls == self.ingress_class
+
+    def _on_event(self, ev: WatchEvent) -> None:
+        if ev.type == "DELETED" or not self._should_process(ev.obj):
+            return
+        current = (((ev.obj.get("status") or {})
+                    .get("loadBalancer") or {}).get("ingress")) or []
+        desired = self._desired()
+        if current == desired:
+            return
+        updated = dict(ev.obj)
+        # merge, never replace: sibling status fields another
+        # controller wrote (conditions etc.) must survive the patch
+        # (status.go touches only the loadBalancer field)
+        status = dict(updated.get("status") or {})
+        lb = dict(status.get("loadBalancer") or {})
+        lb["ingress"] = desired
+        status["loadBalancer"] = lb
+        updated["status"] = status
+        try:
+            self.cluster.update(updated)
+        except Exception:   # conflict/raced delete: next event retries
+            log.exception("ingress status write failed for %s/%s",
+                          ev.namespace, ev.name)

@@ -43,6 +43,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
@@ -50,6 +51,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 #include "h2_frame.h"
@@ -425,6 +427,14 @@ struct Server {
   int wake_fd = -1;
   std::thread io;
   std::atomic<bool> stopping{false};
+  // intake stopped (h2srv_quiesce): new wire requests answer
+  // UNAVAILABLE immediately, already-queued rows dispatch to pumps
+  // without holding for min_fill/window — the graceful-drain phase
+  std::atomic<bool> draining{false};
+  // threads currently inside an ABI call on this handle (take/
+  // complete/counters/port): stop waits for this to reach zero before
+  // freeing the server, so a straggling pump can never use-after-free
+  std::atomic<int> abi_calls{0};
 
   int32_t max_batch = 1024;
   int32_t min_fill = 256;
@@ -451,6 +461,53 @@ struct Server {
   std::unordered_map<uint32_t, Conn*> conns;   // by gen
   uint32_t next_gen = 1;
 };
+
+// ------------------------- lifecycle registry -----------------------
+// Live-handle set: h2srv_stop erases first (double-stop on the same
+// handle becomes a no-op instead of a use-after-free), ABI entry
+// points check membership before touching the pointer, and an atexit
+// sweep quiesces anything python never stopped so process teardown is
+// orderly (no IO thread mid-poll while the runtime unloads). Leaky
+// singletons: static-destruction order must never free these while a
+// straggler thread is still checking in.
+
+std::mutex& reg_mu() {
+  static std::mutex* m = new std::mutex();
+  return *m;
+}
+
+std::unordered_set<Server*>& live_servers() {
+  static std::unordered_set<Server*>* s = new std::unordered_set<Server*>();
+  return *s;
+}
+
+// RAII abi-call token; acquire() under reg_mu so a stop that already
+// erased the handle is seen (the caller then backs off, never touching
+// freed memory)
+bool abi_enter(Server* srv) {
+  std::lock_guard<std::mutex> lk(reg_mu());
+  if (!live_servers().count(srv)) return false;
+  srv->abi_calls.fetch_add(1, std::memory_order_acq_rel);
+  return true;
+}
+
+void abi_exit(Server* srv) {
+  srv->abi_calls.fetch_sub(1, std::memory_order_acq_rel);
+}
+
+void stop_server(Server* srv, bool at_exit);
+int64_t take_impl(Server* srv, int32_t timeout_ms, uint8_t* buf,
+                  int64_t cap);
+
+void stop_all_at_exit() {
+  std::vector<Server*> all;
+  {
+    std::lock_guard<std::mutex> lk(reg_mu());
+    for (Server* s : live_servers()) all.push_back(s);
+    live_servers().clear();
+  }
+  for (Server* s : all) stop_server(s, /*at_exit=*/true);
+}
 
 void conn_error(Server* srv, Conn* c, uint32_t code) {
   if (!c->goaway_sent) {
@@ -592,6 +649,14 @@ void enqueue_request(Server* srv, Conn* c, uint32_t stream_id,
   st->dispatched = true;
   st->body.clear();
   st->body.shrink_to_fit();
+
+  if (srv->draining.load(std::memory_order_relaxed)) {
+    // intake stopped (graceful drain): a TYPED rejection, never a
+    // silent connection drop — the client sees UNAVAILABLE and can
+    // retry against a peer
+    write_response(srv, c, stream_id, 14, "server draining");
+    return;
+  }
 
   if (srv->echo) {   // wire-ceiling mode: respond in C++, no engine
     srv->counters[0]++;
@@ -929,7 +994,49 @@ void io_loop(Server* srv) {
       }
     }
   }
-  // shutdown: close everything
+  // shutdown: answer everything already completed (including the
+  // typed rejections stop_server queued for rows no pump will take),
+  // then best-effort flush outbound bytes so clients SEE their
+  // responses before the close — a silently dropped in-flight request
+  // is the failure mode this drain exists to prevent
+  {
+    std::deque<Completion> done;
+    {
+      std::lock_guard<std::mutex> lk(srv->cmu);
+      done.swap(srv->completions);
+    }
+    for (auto& comp : done) {
+      uint32_t gen = static_cast<uint32_t>(comp.tag >> 32);
+      uint32_t sid = static_cast<uint32_t>(comp.tag & 0xffffffffu);
+      auto it = srv->conns.find(gen);
+      srv->counters[4]--;
+      if (it != srv->conns.end())
+        write_response(srv, it->second, sid, comp.grpc_status,
+                       comp.msg);
+    }
+  }
+  // bounded flush (~200ms): a client that starves its flow-control
+  // windows must not hold the stop hostage
+  int64_t flush_deadline = mono_ns() + 200 * 1000000LL;
+  bool pending = true;
+  while (pending && mono_ns() < flush_deadline) {
+    pending = false;
+    for (auto& kv : srv->conns) {
+      Conn* c = kv.second;
+      if (c->out.empty()) continue;
+      ssize_t n = write(c->fd, c->out.data(), c->out.size());
+      if (n > 0) {
+        srv->counters[9] += n;
+        c->out.erase(0, static_cast<size_t>(n));
+      } else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        c->out.clear();
+        continue;
+      }
+      if (!c->out.empty()) pending = true;
+    }
+    if (pending)
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+  }
   std::vector<Conn*> all;
   for (auto& kv : srv->conns) all.push_back(kv.second);
   for (Conn* c : all) close_conn(srv, c);
@@ -940,6 +1047,55 @@ void put_u32(std::string* s, uint32_t v) {
 }
 void put_u64(std::string* s, uint64_t v) {
   s->append(reinterpret_cast<char*>(&v), 8);
+}
+
+// Ordered teardown (the graceful-lifecycle plane's native leg):
+//   1. stop intake + mark stopping (pumps in take return -1, the IO
+//      loop exits its poll cycle);
+//   2. convert rows no pump will ever take into typed UNAVAILABLE
+//      completions (drained + flushed by the IO thread's shutdown
+//      path — zero silently dropped in-flight requests);
+//   3. join the IO thread;
+//   4. wait for every in-flight ABI caller to leave before freeing —
+//      a pump wedged inside take gets the handle LEAKED, never freed
+//      under it (a stall must stay a stall, not become a segfault).
+// Callers must have erased the handle from live_servers() first (the
+// double-stop guard), so no NEW abi_enter can succeed while we wait.
+void stop_server(Server* srv, bool at_exit) {
+  srv->draining.store(true);
+  srv->stopping.store(true);
+  srv->cv.notify_all();
+  {
+    std::lock_guard<std::mutex> lk(srv->mu);
+    std::lock_guard<std::mutex> lk2(srv->cmu);
+    while (!srv->queue.empty()) {
+      Completion comp;
+      comp.tag = srv->queue.front().tag;
+      comp.grpc_status = 14;
+      comp.msg = "server shutting down";
+      srv->completions.push_back(std::move(comp));
+      srv->queue.pop_front();
+    }
+  }
+  uint64_t one = 1;
+  ssize_t ignored = write(srv->wake_fd, &one, 8);
+  (void)ignored;
+  if (srv->io.joinable()) srv->io.join();
+  for (int i = 0; i < 5000; i++) {   // ~5s bound
+    if (srv->abi_calls.load(std::memory_order_acquire) == 0) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
+  if (srv->abi_calls.load(std::memory_order_acquire) > 0) {
+    // a straggler is still inside take/complete: leak the server (fds
+    // included — closing them could hand recycled fd numbers to its
+    // next syscall) rather than free memory under a live thread
+    return;
+  }
+  close(srv->listen_fd);
+  close(srv->wake_fd);
+  if (!at_exit) delete srv;
+  // at exit: frozen interpreter threads may still hold the pointer —
+  // the process is dying, the leak is free, the UAF would not be
 }
 
 }  // namespace
@@ -985,10 +1141,42 @@ void* h2srv_start(int32_t port, int32_t max_batch, int32_t min_fill,
   srv->port = ntohs(addr.sin_port);
   srv->wake_fd = eventfd(0, EFD_NONBLOCK);
   srv->io = std::thread(io_loop, srv);
+  {
+    std::lock_guard<std::mutex> lk(reg_mu());
+    live_servers().insert(srv);
+    static bool atexit_registered = false;
+    if (!atexit_registered) {
+      atexit_registered = true;
+      std::atexit(stop_all_at_exit);
+    }
+  }
   return srv;
 }
 
-int32_t h2srv_port(void* h) { return static_cast<Server*>(h)->port; }
+int32_t h2srv_port(void* h) {
+  Server* srv = static_cast<Server*>(h);
+  if (!abi_enter(srv)) return 0;
+  int32_t p = srv->port;
+  abi_exit(srv);
+  return p;
+}
+
+// Graceful-drain entry (ordered shutdown step 1, callable long before
+// h2srv_stop): stop intake — new wire requests answer UNAVAILABLE
+// immediately, queued rows dispatch to pumps without holding for
+// min_fill/window. Connections stay open and in-flight rows complete
+// normally; the caller polls counters()[in_flight] down to zero, THEN
+// stops pumps and calls h2srv_stop.
+void h2srv_quiesce(void* h) {
+  Server* srv = static_cast<Server*>(h);
+  if (!abi_enter(srv)) return;
+  srv->draining.store(true);
+  srv->cv.notify_all();
+  uint64_t one = 1;
+  ssize_t ignored = write(srv->wake_fd, &one, 8);
+  (void)ignored;
+  abi_exit(srv);
+}
 
 // Blocking batch take (pump side). Adaptive policy (the saturation-
 // batcher fix the python batcher's fixed window lacked): dispatch when
@@ -1001,6 +1189,18 @@ int32_t h2srv_port(void* h) { return static_cast<Server*>(h)->port; }
 int64_t h2srv_take(void* h, int32_t timeout_ms, uint8_t* buf,
                    int64_t cap) {
   Server* srv = static_cast<Server*>(h);
+  if (!abi_enter(srv)) return -1;   // already stopped: shutdown signal
+  int64_t rc = take_impl(srv, timeout_ms, buf, cap);
+  abi_exit(srv);
+  return rc;
+}
+
+}  // extern "C"
+
+namespace {
+
+int64_t take_impl(Server* srv, int32_t timeout_ms, uint8_t* buf,
+                  int64_t cap) {
   std::unique_lock<std::mutex> lk(srv->mu);
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(timeout_ms);
@@ -1014,7 +1214,10 @@ int64_t h2srv_take(void* h, int32_t timeout_ms, uint8_t* buf,
       int64_t waited_us = (mono_ns() - srv->first_enq_ns) / 1000;
       if (static_cast<int32_t>(srv->queue.size()) >= srv->min_fill ||
           srv->idle_pumps == srv->n_pumps ||
-          waited_us >= srv->window_us) {
+          waited_us >= srv->window_us ||
+          srv->draining.load(std::memory_order_relaxed)) {
+        // draining: already-queued rows dispatch IMMEDIATELY — a
+        // shutdown must never hold submitted work for min_fill
         break;   // this pump takes the batch
       }
       // wait out the window (bounded; re-checked on every enqueue)
@@ -1081,13 +1284,21 @@ int64_t h2srv_take(void* h, int32_t timeout_ms, uint8_t* buf,
   return static_cast<int64_t>(out.size());
 }
 
+}  // namespace
+
+extern "C" {
+
 // Completion blob: u32 n, then per item u64 tag, i32 grpc_status,
 // u32 len, bytes (resp proto when status 0, else grpc-message text).
 void h2srv_complete(void* h, const uint8_t* blob, int64_t len) {
   Server* srv = static_cast<Server*>(h);
+  if (!abi_enter(srv)) return;   // stopped under a deferred completion
   const uint8_t* p = blob;
   const uint8_t* end = blob + len;
-  if (end - p < 4) return;
+  if (end - p < 4) {
+    abi_exit(srv);
+    return;
+  }
   uint32_t n;
   memcpy(&n, p, 4);
   p += 4;
@@ -1113,27 +1324,35 @@ void h2srv_complete(void* h, const uint8_t* blob, int64_t len) {
   uint64_t one = 1;
   ssize_t ignored = write(srv->wake_fd, &one, 8);
   (void)ignored;
+  abi_exit(srv);
 }
 
 void h2srv_counters(void* h, int64_t* out, int64_t* hist) {
   Server* srv = static_cast<Server*>(h);
+  if (!abi_enter(srv)) {
+    memset(out, 0, 10 * sizeof(int64_t));
+    memset(hist, 0, 16 * sizeof(int64_t));
+    return;
+  }
   for (int i = 0; i < 10; i++)
     out[i] = srv->counters[i].load(std::memory_order_relaxed);
-  std::lock_guard<std::mutex> lk(srv->mu);
-  memcpy(hist, srv->hist, sizeof(srv->hist));
+  {
+    std::lock_guard<std::mutex> lk(srv->mu);
+    memcpy(hist, srv->hist, sizeof(srv->hist));
+  }
+  abi_exit(srv);
 }
 
 void h2srv_stop(void* h) {
   Server* srv = static_cast<Server*>(h);
-  srv->stopping.store(true);
-  srv->cv.notify_all();
-  uint64_t one = 1;
-  ssize_t ignored = write(srv->wake_fd, &one, 8);
-  (void)ignored;
-  if (srv->io.joinable()) srv->io.join();
-  close(srv->listen_fd);
-  close(srv->wake_fd);
-  delete srv;
+  {
+    // double-stop guard: only the caller that actually erases the
+    // live entry tears the server down; any later stop (or a stop
+    // racing the atexit sweep) is a no-op instead of a use-after-free
+    std::lock_guard<std::mutex> lk(reg_mu());
+    if (!live_servers().erase(srv)) return;
+  }
+  stop_server(srv, /*at_exit=*/false);
 }
 
 }  // extern "C"

@@ -168,6 +168,9 @@ class CheckBatcher:
                                         daemon=True,
                                         name="check-batcher")
         self._closed = False
+        # admission stopped (graceful shutdown step 1): new submits
+        # resolve typed UNAVAILABLE; queued/in-flight work drains
+        self._draining = False
         # watchdog: set to the fatal exception if the flusher thread
         # ever dies — submit() then fails fast (an orphaned Future
         # would block its caller forever) and /healthz goes unhealthy
@@ -196,6 +199,13 @@ class CheckBatcher:
         coalescer (which shares this class) never pollutes the CHECK
         resilience counters."""
         observe = self._observe_latency
+        if self._draining:
+            # ordered shutdown: admission is OFF — a typed rejection
+            # the fronts map to UNAVAILABLE (clients retry a peer),
+            # while already-admitted work keeps draining below
+            if observe:
+                monitor.CHECK_SHED.labels(reason="draining").inc()
+            return UnavailableError("server shutting down")
         if self._dead is not None or \
                 (not self._closed and not self._thread.is_alive()):
             if observe:
@@ -561,11 +571,37 @@ class CheckBatcher:
             "max_batch": self.max_batch,
             "buckets": list(self.buckets),
             "closed": self._closed,
+            "draining": self._draining,
             "max_queue": self.max_queue,
             "brownout": self.brownout,
             "healthy": healthy,
             "health_error": health_err,
         }
+
+    def quiesce(self) -> None:
+        """Graceful-shutdown step 1: stop admission. Every submit from
+        here on resolves a typed UNAVAILABLE immediately; queued and
+        in-flight batches are unaffected (drain() waits them out)."""
+        self._draining = True
+
+    def drain(self, deadline: float | None = 5.0) -> bool:
+        """Block until the queue is empty and no batch is in flight
+        (bounded by `deadline` seconds; None = wait forever). Returns
+        True when fully drained — False means the deadline expired
+        with work still pending (close() then resolves the leftovers,
+        never abandons them)."""
+        end = None if deadline is None \
+            else time.perf_counter() + deadline
+        while True:
+            if self._dead is not None:
+                return False   # watchdog already resolved the queue
+            with self._queue.mutex:
+                empty = not self._queue.queue
+            if empty and self._inflight_n == 0:
+                return True
+            if end is not None and time.perf_counter() >= end:
+                return False
+            time.sleep(0.005)
 
     def close(self) -> None:
         if not self._closed:

@@ -24,6 +24,25 @@ from istio_tpu.runtime.store import Event, Store
 log = logging.getLogger("istio_tpu.runtime.controller")
 
 
+def _serving_backoff() -> None:
+    """Yield between prewarm shape compiles so the warm never starves
+    serving: the jaxpr trace is pure python holding the GIL for
+    seconds at a time on large rulesets, and on a loaded single core
+    the stream threads would otherwise stall behind it. Always yields
+    a scheduling quantum; backs off harder while the live p99 gauge is
+    over the SLO target (the serving-latency backoff — prewarm is the
+    lowest-priority work in the process by construction)."""
+    import time
+
+    time.sleep(0.005)
+    try:
+        monitor.refresh_latency_gauges()
+        if monitor.CHECK_P99_MS.value() > monitor.CHECK_P99_TARGET_MS:
+            time.sleep(0.1)
+    except Exception:   # a gauge refresh must never break a rebuild
+        pass
+
+
 class Controller:
     def __init__(self, store: Store,
                  default_manifest: Mapping[str, ValueType] | None = None,
@@ -69,6 +88,13 @@ class Controller:
         self.prewarm_hook = prewarm_hook
         self._prewarm_stop = False
         self._prewarm_thread: threading.Thread | None = None
+        # post-swap background warm (the shapes live traffic was NOT
+        # serving pre-swap): stoppable per swap — a superseding swap
+        # or close() flips the event and the thread exits between
+        # shapes; batches racing onto a not-yet-warm shape serve
+        # through the host oracle (Dispatcher._check_fused bridge)
+        self._swap_warm_thread: threading.Thread | None = None
+        self._swap_warm_stop: threading.Event | None = None
         self._builder = SnapshotBuilder(default_manifest,
                                         InternTable(), max_str_len,
                                         lower_rbac=fused)
@@ -117,16 +143,37 @@ class Controller:
         for err in snapshot.errors:
             log.warning("config: %s", err)
         plan = None
+        swap_rest: list = []
         if self.fused_enabled:
             from istio_tpu.runtime.fused import build_fused_plan
             plan = build_fused_plan(snapshot, mesh=self.mesh,
                                     rule_telemetry=self.rule_telemetry)
             if plan is not None and self.prewarm_buckets:
                 if self._dispatcher is not None:
-                    # shadow-compile the serving shapes before the swap
-                    # (SURVEY hard-part #5): a config change must never
-                    # surface trace time in-band
-                    plan.prewarm(self.prewarm_buckets)
+                    # shadow-compile BEFORE the swap (SURVEY hard-part
+                    # #5: a config change must never surface trace
+                    # time in-band) — but only the shapes live traffic
+                    # is actually SERVING (the old plan's observed
+                    # (bucket, byte-tier) set), so swap latency scales
+                    # with the served working set, not the full
+                    # bucket × tier product. The remainder compiles
+                    # post-swap in a background thread (below); a
+                    # batch racing onto a not-yet-warm shape serves
+                    # through the host oracle instead of tracing
+                    # in-band. Between shapes the warm YIELDS to
+                    # serving (_serving_backoff) — on a loaded single
+                    # core the pure-python jaxpr trace would otherwise
+                    # starve the stream threads of the GIL.
+                    old_plan = self._dispatcher.fused
+                    pairs = plan.all_warm_shapes(self.prewarm_buckets)
+                    first = plan.map_served_shapes(
+                        self.prewarm_buckets,
+                        old_plan.served_shapes()
+                        if old_plan is not None else set())
+                    swap_rest = [p for p in pairs
+                                 if p not in set(first)]
+                    plan.begin_warm()
+                    plan.warm_shapes(first, backoff=_serving_backoff)
                     if self.prewarm_hook is not None:
                         # extra shapes the OWNER serves through this
                         # plan (RuntimeServer: the merged check+quota
@@ -184,6 +231,15 @@ class Controller:
         # a successful publish supersedes any earlier veto: introspect
         # must not report a stale rejection against the live config
         self.last_canary_rejection = None
+        if plan is not None and plan._warm_pending:
+            # the pre-swap phase warmed only the live-served shapes;
+            # finish the rest in the background (oracle-bridged until
+            # each shape lands), or end the warm outright when the
+            # served set already covered everything
+            if swap_rest:
+                self._start_swap_warm(plan, swap_rest)
+            else:
+                plan.end_warm()
         if self.canary is not None:
             # post-swap hook: re-baselines the recorder when the
             # published candidate was divergent (gate.on_published)
@@ -219,6 +275,39 @@ class Controller:
         except Exception:
             log.exception("initial prewarm failed")
 
+    def _start_swap_warm(self, plan, pairs: list) -> None:
+        """Post-swap background warm of the (bucket, tier) shapes the
+        pre-swap phase skipped. Serialized behind any previous swap's
+        still-running warm (one compile stream — concurrent traces
+        would contend for the core the serving threads need), stopped
+        by a superseding swap or close(), and always end_warm()ed so
+        the oracle bridge disengages."""
+        prev_stop = self._swap_warm_stop
+        if prev_stop is not None:
+            prev_stop.set()   # superseded candidate: stop its warm
+        prev_thread = self._swap_warm_thread
+        stop = threading.Event()
+        self._swap_warm_stop = stop
+
+        def run() -> None:
+            try:
+                if prev_thread is not None and prev_thread.is_alive():
+                    prev_thread.join()
+                plan.warm_shapes(
+                    pairs,
+                    should_stop=lambda: (stop.is_set()
+                                         or self._prewarm_stop),
+                    backoff=_serving_backoff)
+            except Exception:
+                log.exception("post-swap background warm failed")
+            finally:
+                plan.end_warm()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="prewarm-swap")
+        self._swap_warm_thread = t
+        t.start()
+
     def close(self) -> None:
         with self._lock:
             if self._timer is not None:
@@ -231,6 +320,15 @@ class Controller:
         # join that expires mid-compile re-opens the teardown abort.
         self._prewarm_stop = True
         t = self._prewarm_thread
+        if t is not None and t.is_alive():
+            t.join()
+        # same discipline for the post-swap background warm: flag is
+        # polled between shapes, join is untimed (expiring mid-compile
+        # re-opens the teardown abort)
+        ev = self._swap_warm_stop
+        if ev is not None:
+            ev.set()
+        t = self._swap_warm_thread
         if t is not None and t.is_alive():
             t.join()
         self._handler_table.close()

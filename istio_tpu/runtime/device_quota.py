@@ -117,8 +117,9 @@ class DeviceQuotaPool:
         # from stage to DISPATCH (the successor buffer is swapped in
         # as a device future — trips chain on-device, so two pumps'
         # trips overlap on the transport while the data dependency
-        # resolves in XLA). Lock order: never take self._lock then
-        # _counts_lock — the worker releases _lock before allocating.
+        # resolves in XLA). Lock order: ALWAYS _counts_lock then
+        # self._lock (inline_begin and the worker's _flush both) —
+        # taking self._lock first would deadlock against them.
         self._counts_lock = threading.Lock()
         # in-step commit ordering: bookkeeping (dedup-cache writes,
         # pending-dedup replays) must apply in DISPATCH order even
@@ -130,6 +131,12 @@ class DeviceQuotaPool:
         # a same-id row staged meanwhile must NOT re-consume — it
         # resolves from the cache at its own (later) commit turn
         self._dedup_pending: dict[str, int] = {}
+        # dedup ids whose consuming session committed GATE-OFF (rule
+        # inactive → granted freely, nothing consumed, nothing in
+        # _dedup — consumed outcomes only): id → expiry. A pending
+        # replay that finds its id here replays grant-freely instead
+        # of failing "quota trip failed" (ADVICE r5 parity gap).
+        self._dedup_free: dict[str, float] = {}
         # last known-good counter handle (restore target when a
         # dispatched trip's pull fails)
         self._counts_good = self.counts
@@ -172,6 +179,15 @@ class DeviceQuotaPool:
                     fut.set(QuotaResult(granted_amount=hit[0],
                                         valid_duration_s=lim["duration"],
                                         status_code=status))
+                    return fut
+                free_exp = self._dedup_free.get(args.dedup_id)
+                if free_exp is not None and free_exp > now:
+                    # first transmission committed GATE-OFF (granted
+                    # freely, nothing consumed): dedup-id semantics
+                    # replay that outcome on EVERY path — consuming
+                    # fresh here would double-book the retransmission
+                    fut.set(QuotaResult(
+                        granted_amount=args.quota_amount))
                     return fut
             if self._closed:   # post-swap drain raced the caller
                 fut.set(QuotaResult(
@@ -242,6 +258,13 @@ class DeviceQuotaPool:
                                 granted_amount=hit[0],
                                 valid_duration_s=lim["duration"],
                                 status_code=status)
+                            continue
+                        free_exp = self._dedup_free.get(did)
+                        if free_exp is not None and free_exp > now:
+                            # gate-off outcome replay (see alloc)
+                            sess.early[slot] = QuotaResult(
+                                granted_amount=int(
+                                    args.quota_amount))
                             continue
                         if did in first_of:
                             sess.replay_of[slot] = (first_of[did],
@@ -364,6 +387,8 @@ class DeviceQuotaPool:
         first_of: dict[str, int] = {}
         replay_items: list[tuple[Any, int]] = []   # (item, kept index)
         cache_replays: list = []   # (item, cached granted)
+        free_replays: list = []    # gate-off outcome: grant freely
+        deferred: list = []   # dedup id held by an uncommitted session
         kept: list = []
         with self._lock:
             for item in batch:
@@ -377,6 +402,22 @@ class DeviceQuotaPool:
                     if hit is not None and hit[1] > now:
                         cache_replays.append((item, hit[0]))
                         continue
+                    free_exp = self._dedup_free.get(dedup_id)
+                    if free_exp is not None and free_exp > now:
+                        # gate-off outcome: replay grant-freely (the
+                        # deferred-past-a-gate-off-commit case lands
+                        # here on its re-flush)
+                        free_replays.append(item)
+                        continue
+                    if dedup_id in self._dedup_pending:
+                        # consumed by a dispatched-but-uncommitted
+                        # in-step session: memquota's mutex would
+                        # serialize and REPLAY — defer this item past
+                        # the session's commit (re-queued below; the
+                        # next flush resolves it from the cache, or
+                        # consumes fresh if the session aborted)
+                        deferred.append(item)
+                        continue
                     if dedup_id in first_of:
                         replay_items.append((item, first_of[dedup_id]))
                         continue
@@ -387,8 +428,11 @@ class DeviceQuotaPool:
             fut.set(QuotaResult(granted_amount=g,
                                 valid_duration_s=duration,
                                 status_code=status))
+        for (_, amount, *_rest, fut) in free_replays:
+            fut.set(QuotaResult(granted_amount=amount))
         batch = kept
         if not batch:
+            self._requeue_deferred(deferred)
             return
         n = len(batch)
         # pad to one of TWO fixed shapes: every distinct shape is its
@@ -406,43 +450,59 @@ class DeviceQuotaPool:
         ticks = np.zeros(pn, np.int32)
         lasts = np.zeros(pn, np.int32)
         rolling = np.zeros(pn, bool)
-        roll_updates: list[tuple[int, int]] = []   # (bucket, abs tick)
-        for i, (b_, a_, e_, m_, *_rest) in enumerate(batch):
-            buckets[i], amounts[i], be[i], mx[i] = b_, a_, e_, m_
-            active[i] = True
-            tl = self._tick_len[b_]
-            if tl > 0:
-                # absolute tick boundary = host adapter's _Window
-                # (floor(now / tick_len)); device gets REBASED int32s
-                abs_tick = int(now / tl)
-                base = int(self._tick_base[b_])
-                ticks[i] = abs_tick - base
-                lasts[i] = int(self._last_tick[b_]) - base
-                rolling[i] = True
-                roll_updates.append((b_, abs_tick))
-        # sequential-within-batch semantics only matter when a bucket
-        # repeats — rare at 100k-key scale. Contended batches where
-        # every amount is 1 (the dominant rate-limit shape) take the
-        # parallel rank kernel; other contended batches the segmented
-        # prefix-sum kernel (deterministic ao-before-be amount-
-        # ascending intra-window order — quota_alloc.step_seg). The
-        # O(B) scan is a test/bench parity oracle only: NO
-        # serving-reachable input selects it.
-        if len(np.unique(buckets[:n])) < n:
-            alloc = self._alloc_unit \
-                if bool((amounts[:n] == 1).all()) else self._alloc_seg
-        else:
-            alloc = self._alloc_fast
+        # The tick/last staging and the roll application MUST happen
+        # under _lock INSIDE the _counts_lock critical section, ordered
+        # exactly like InlineQuotaSession.stage (ADVICE r5): _last_tick
+        # is shared with in-step sessions, and a flush that read it
+        # outside the locks could stage a stale `last` (the device
+        # kernel then re-rolls slots holding fresh consumption — an
+        # over-grant) or regress it after a session's optimistic
+        # advance (under-grant). _counts_lock serializes this trip
+        # against session dispatch; _lock orders the host bookkeeping.
+        # The update is OPTIMISTIC like stage()'s: the dispatched
+        # program rolls every active row's bucket unconditionally, so
+        # host _last_tick and the device slots agree for whatever trip
+        # chains next, on either path.
         with self._counts_lock:
+            with self._lock:
+                for i, (b_, a_, e_, m_, *_rest) in enumerate(batch):
+                    buckets[i], amounts[i], be[i], mx[i] = \
+                        b_, a_, e_, m_
+                    active[i] = True
+                    tl = self._tick_len[b_]
+                    if tl > 0:
+                        # absolute tick boundary = host adapter's
+                        # _Window (floor(now / tick_len)); device gets
+                        # REBASED int32s
+                        abs_tick = int(now / tl)
+                        base = int(self._tick_base[b_])
+                        ticks[i] = abs_tick - base
+                        lasts[i] = int(self._last_tick[b_]) - base
+                        rolling[i] = True
+                        self._last_tick[b_] = abs_tick
+            # sequential-within-batch semantics only matter when a
+            # bucket repeats — rare at 100k-key scale. Contended
+            # batches where every amount is 1 (the dominant rate-limit
+            # shape) take the parallel rank kernel; other contended
+            # batches the segmented prefix-sum kernel (deterministic
+            # ao-before-be amount-ascending intra-window order —
+            # quota_alloc.step_seg). The O(B) scan is a test/bench
+            # parity oracle only: NO serving-reachable input selects
+            # it.
+            if len(np.unique(buckets[:n])) < n:
+                all_unit = bool((amounts[:n] == 1).all())   # hotpath: sync-ok (host numpy)
+                alloc = self._alloc_unit if all_unit \
+                    else self._alloc_seg
+            else:
+                alloc = self._alloc_fast
             granted, self.counts = alloc(
                 self.counts, jnp.asarray(buckets),
                 jnp.asarray(amounts), jnp.asarray(be),
                 jnp.asarray(mx), jnp.asarray(active),
                 jnp.asarray(ticks), jnp.asarray(lasts),
                 jnp.asarray(rolling))
-            granted = np.asarray(granted)
-        for b_, abs_tick in roll_updates:
-            self._last_tick[b_] = abs_tick
+            # the worker's designated pull — hotpath: sync-ok
+            granted = np.asarray(granted)   # hotpath: sync-ok
         with self._lock:
             for i, (_, amount, _, _, duration, dedup_id, fut) \
                     in enumerate(batch):
@@ -461,12 +521,35 @@ class DeviceQuotaPool:
             fut.set(QuotaResult(granted_amount=g,
                                 valid_duration_s=duration,
                                 status_code=status))
+        self._requeue_deferred(deferred)
+
+    def _requeue_deferred(self, deferred: list) -> None:
+        """Items whose dedup id was held by a dispatched-but-
+        uncommitted in-step session: re-queue for the next flush (the
+        session commits in its dispatch-order turn — typically within
+        one device trip — after which the cache replays the outcome,
+        or a fresh consume runs if the session aborted). A closing
+        pool resolves them immediately instead of spinning."""
+        if not deferred:
+            return
+        with self._lock:
+            if not self._closed:
+                self._pending.extend(deferred)
+                self._wake.notify()
+                return
+        for *_x, fut in deferred:
+            fut.set(QuotaResult(granted_amount=0, status_code=14,
+                                status_message="quota pool closed"))
 
     def _gc_dedup(self, now: float) -> None:
         if len(self._dedup) > 10_000:
             for k in [k for k, (_, exp) in self._dedup.items()
                       if exp <= now]:
                 del self._dedup[k]
+        if len(self._dedup_free) > 10_000:
+            for k in [k for k, exp in self._dedup_free.items()
+                      if exp <= now]:
+                del self._dedup_free[k]
 
 
 class QuotaFuture:
@@ -619,7 +702,15 @@ class InlineQuotaSession:
                     if not gate[slot]:
                         # no active quota rule for this request: grant
                         # the requested amount freely, consuming
-                        # nothing (dispatcher.quota tail)
+                        # nothing (dispatcher.quota tail). The outcome
+                        # is recorded in _dedup_free (NOT the consumed-
+                        # outcome cache) so a same-id row that raced
+                        # this session into pending_replay resolves
+                        # grant-freely too, like a serialized memquota
+                        # would
+                        if did:
+                            p._dedup_free[did] = self.now + max(
+                                duration, p.min_dedup_s)
                         out[slot] = QuotaResult(granted_amount=amount)
                         continue
                     g = int(granted[slot])
@@ -635,6 +726,7 @@ class InlineQuotaSession:
                 for slot, (did, duration, amount) in \
                         self.pending_replay.items():
                     hit = p._dedup.get(did)
+                    free_exp = p._dedup_free.get(did)
                     if hit is not None and hit[1] > self.now:
                         status = 0 if hit[0] > 0 or amount == 0 \
                             else RESOURCE_EXHAUSTED
@@ -642,6 +734,12 @@ class InlineQuotaSession:
                             granted_amount=hit[0],
                             valid_duration_s=duration,
                             status_code=status)
+                    elif free_exp is not None and free_exp > self.now:
+                        # consuming session committed GATE-OFF: the
+                        # serialized outcome is grant-freely (this
+                        # row's own requested amount, nothing
+                        # consumed) — never "quota trip failed"
+                        out[slot] = QuotaResult(granted_amount=amount)
                     else:
                         # the consuming session aborted (device
                         # failure): no outcome to replay

@@ -351,6 +351,7 @@ class Dispatcher:
         from istio_tpu.runtime.batcher import trim_pads
         n_real = len(trim_pads(bags))
         observe = self.observe
+        bridged = False
         with (monitor.resolve_timer() if observe
               else contextlib.nullcontext()):
             if pre_tensorized is not None:
@@ -364,37 +365,58 @@ class Dispatcher:
                 if observe:
                     monitor.observe_stage("tensorize",
                                           time.perf_counter() - t_tz)
-            # ONE device→host pull for the whole verdict: each extra
-            # pull costs a full RTT (~120ms behind the axon tunnel),
-            # and plane-by-plane conversion was 6 RTTs per batch
-            with tr.span("serve.device"):
-                if instep is not None:
-                    t_d = time.perf_counter()
-                    q_arrays, counts, on_dispatch, on_pull = instep
-                    packed_dev, new_counts = plan.packed_check_instep(
-                        batch, ns_ids, q_arrays, counts,
-                        n_real=n_real)
-                    # the program is IN FLIGHT: on_dispatch swaps the
-                    # pool onto the device-future counters and drops
-                    # the token, so the next trip chains on-device
-                    # while this one's pull is still outstanding
-                    on_dispatch(new_counts)
-                    t_pull = time.perf_counter()
-                    monitor.observe_stage("h2d", t_pull - t_d)
-                    packed = np.asarray(packed_dev)   # the pull — hotpath: sync-ok
-                    monitor.observe_stage(
-                        "device_step", time.perf_counter() - t_pull)
-                    # granted/gate are the LAST two rows; everything
-                    # the overlay decode reads sits before them
-                    on_pull(packed[-2], packed[-1] != 0)
-                else:
-                    packed = plan.packed_check(batch, ns_ids,
-                                               observe=observe,
-                                               n_real=n_real)
-            status = packed[0]
-            dur = packed[1].view(np.float32)
-            uses = packed[2]
-            deny_rule = packed[3]
+            # swap-warm oracle bridge: while a background warm is
+            # still compiling this shape's program (a config swap
+            # deferred the shapes live traffic was NOT serving), the
+            # batch serves through the CPU oracle — the new snapshot's
+            # semantics apply immediately and no request pays the
+            # in-band XLA trace. Serving path only (shadow replay
+            # keeps the device surface; the in-step quota path has no
+            # oracle equivalent and compiles through). Bridged
+            # responses carry no device activity bits, so a quota
+            # riding one falls back to the host adapter path.
+            if observe and instep is None \
+                    and plan.swap_warm_pending(batch):
+                bridged = True
+            else:
+                # ONE device→host pull for the whole verdict: each
+                # extra pull costs a full RTT (~120ms behind the axon
+                # tunnel), and plane-by-plane conversion was 6 RTTs
+                # per batch
+                with tr.span("serve.device"):
+                    if instep is not None:
+                        t_d = time.perf_counter()
+                        q_arrays, counts, on_dispatch, on_pull = instep
+                        packed_dev, new_counts = \
+                            plan.packed_check_instep(
+                                batch, ns_ids, q_arrays, counts,
+                                n_real=n_real)
+                        # the program is IN FLIGHT: on_dispatch swaps
+                        # the pool onto the device-future counters and
+                        # drops the token, so the next trip chains
+                        # on-device while this one's pull is still
+                        # outstanding
+                        on_dispatch(new_counts)
+                        t_pull = time.perf_counter()
+                        monitor.observe_stage("h2d", t_pull - t_d)
+                        packed = np.asarray(packed_dev)   # the pull — hotpath: sync-ok
+                        monitor.observe_stage(
+                            "device_step",
+                            time.perf_counter() - t_pull)
+                        # granted/gate are the LAST two rows;
+                        # everything the overlay decode reads sits
+                        # before them
+                        on_pull(packed[-2], packed[-1] != 0)
+                    else:
+                        packed = plan.packed_check(batch, ns_ids,
+                                                   observe=observe,
+                                                   n_real=n_real)
+                status = packed[0]
+                dur = packed[1].view(np.float32)
+                uses = packed[2]
+                deny_rule = packed[3]
+        if bridged:
+            return self.check_host_oracle(bags)
         t_overlay = time.perf_counter()
         rs = snap.ruleset
 

@@ -179,6 +179,19 @@ class FusedPlan:
     # live device_step p50 against the dominant width, not the
     # worst-case max_str_len plane)
     _tier_served: dict = dataclasses.field(default_factory=dict)
+    # observed-check (bucket rows, byte width) → batch count: the
+    # shapes live traffic actually serves. A config swap warms THESE
+    # synchronously pre-swap (swap latency scales with what traffic
+    # uses, not the full bucket × tier product) and defers the rest to
+    # a post-swap background warm.
+    _shape_served: dict = dataclasses.field(default_factory=dict)
+    # (bucket rows, byte width) pairs whose serving programs are
+    # compiled (prewarm dummies and organic trips both register)
+    _warmed_shapes: set = dataclasses.field(default_factory=set)
+    # a background warm is still filling _warmed_shapes: batches at
+    # missing shapes bridge to the host oracle (Dispatcher._check_fused)
+    # instead of tracing in-band
+    _warm_pending: bool = False
     # completed prewarm_instep (buckets, counts-shape) combinations
     _instep_warmed: set = dataclasses.field(default_factory=set)
 
@@ -196,21 +209,13 @@ class FusedPlan:
         compares str_lens against layout.max_str_len — which narrowing
         never changes (a row truncated at ingest has len == max_str_len
         and keeps the full-width shape). Host-side numpy only."""
-        tiers = self.str_tiers
-        if len(tiers) < 2 or not isinstance(batch.str_bytes, np.ndarray) \
-                or not isinstance(batch.str_lens, np.ndarray):
-            return batch
-        t = tiers[0]
-        if batch.str_bytes.shape[2] <= t:
-            return batch
-        if not batch.str_lens.size:
-            return batch
-        m = int(batch.str_lens.max())   # hotpath: sync-ok (host numpy)
-        if m > t:
+        w = self._serve_width(batch)   # single home of tier routing
+        if not isinstance(batch.str_bytes, np.ndarray) \
+                or w >= int(batch.str_bytes.shape[2]):
             return batch
         return dataclasses.replace(
             batch,
-            str_bytes=np.ascontiguousarray(batch.str_bytes[:, :, :t]))
+            str_bytes=np.ascontiguousarray(batch.str_bytes[:, :, :w]))
 
     @property
     def n_overlay_words(self) -> int:
@@ -244,6 +249,8 @@ class FusedPlan:
         if observe:
             w = int(batch.str_bytes.shape[2])
             self._tier_served[w] = self._tier_served.get(w, 0) + 1
+            key = (int(batch.ids.shape[0]), w)
+            self._shape_served[key] = self._shape_served.get(key, 0) + 1
             # fault-injection seam at the device boundary (chaos suite
             # + scripts/chaos_smoke.py): an injected exception here
             # unwinds exactly like a real device-step failure. Gated
@@ -273,6 +280,10 @@ class FusedPlan:
         t1 = time.perf_counter()
         # the single host<->device sync — hotpath: sync-ok
         out = np.asarray(dev)              # hotpath: sync-ok
+        # this (bucket, width) shape's programs are compiled now —
+        # the swap-warm oracle bridge stops routing it away
+        self._warmed_shapes.add((int(batch.ids.shape[0]),
+                                 int(batch.str_bytes.shape[2])))
         if observe:
             monitor.observe_stage("h2d", t1 - t0)
             monitor.observe_stage("device_step",
@@ -350,24 +361,44 @@ class FusedPlan:
 
         return pack
 
-    def packed_report(self, batch, ns_ids) -> np.ndarray:
+    def packed_report(self, batch, ns_ids,
+                      observe: bool = True) -> np.ndarray:
         """packed_check's rows PLUS the report instance-field planes in
         the SAME single device pull (VERDICT r4 item 3 — one RTT per
         report batch, never one per plane): after the overlay words
         come F int32 value rows (intern ids; 0/1 for BOOL fields) and
         ceil(F/32) bitpacked field-valid words, F =
         report_lowering.n_fields. Falls back to packed_check when no
-        instance lowered."""
+        instance lowered. `observe=False` for prewarm dummy trips —
+        they must not feed the served-shape set below."""
         if self.report_lowering is None or \
                 self.report_lowering.n_fields == 0:
             # zero field programs (e.g. reportnothing-only): the check
             # rows alone serve; ReportFieldCtx slices empty planes.
             # observe=False: this is REPORT traffic — it must not feed
-            # the Check() stage decomposition
+            # the Check() stage decomposition. Narrow ONCE and pass
+            # the narrowed batch down (packed_check's own narrow then
+            # early-returns — no second byte-plane copy).
+            batch = self.narrow_batch(batch)
+            if observe:
+                key = (int(batch.ids.shape[0]),
+                       int(batch.str_bytes.shape[2]))
+                self._shape_served[key] = \
+                    self._shape_served.get(key, 0) + 1
             return self.packed_check(batch, ns_ids, observe=False)
         import jax
 
         batch = self.narrow_batch(batch)   # latency-tier byte plane
+        # report traffic feeds the served-shape set too: the pre-swap
+        # warm must cover the shapes the report coalescer serves (its
+        # packer compiles per shape like the check packer's), or the
+        # first post-swap report trip pays an in-band trace — there is
+        # no oracle bridge on the report path
+        if observe:
+            key = (int(batch.ids.shape[0]),
+                   int(batch.str_bytes.shape[2]))
+            self._shape_served[key] = \
+                self._shape_served.get(key, 0) + 1
         if self._report_packer is None:
             import jax.numpy as jnp
             pack = self._base_packer()
@@ -416,6 +447,8 @@ class FusedPlan:
         if n_real is None or n_real > 0:   # prewarm dummies pass 0
             w = int(batch.str_bytes.shape[2])
             self._tier_served[w] = self._tier_served.get(w, 0) + 1
+            key = (int(batch.ids.shape[0]), w)
+            self._shape_served[key] = self._shape_served.get(key, 0) + 1
         if self._instep_packer is None:
             import jax.numpy as jnp
             from istio_tpu.models.quota_alloc import \
@@ -471,12 +504,15 @@ class FusedPlan:
         # DEVICE handles, not host arrays: the caller swaps the pool
         # onto new_counts at dispatch (the next trip chains on-device)
         # and pulls `packed` with the counter token already released
-        return self._instep_packer(
+        out = self._instep_packer(
             verdict,
             ns_arr,
             counts,
             q["buckets"], q["amounts"], q["be"], q["mx"], q["active"],
             q["ticks"], q["lasts"], q["rolling"], q["rule_idx"])
+        self._warmed_shapes.add((int(batch.ids.shape[0]),
+                                 int(batch.str_bytes.shape[2])))
+        return out
 
     def pred_attrs_for_ns(self, ns_id: int) -> frozenset:
         """Union of predicate attr uses over rules visible to ns_id —
@@ -511,7 +547,7 @@ class FusedPlan:
         out["ns_pred_cache_entries"] = len(self._ns_pred_cache)
         return out
 
-    def prewarm(self, buckets, should_stop=None) -> None:
+    def prewarm(self, buckets, should_stop=None, backoff=None) -> None:
         """Trace/compile the engine step for every serving batch shape.
 
         Called by the controller BEFORE the atomic dispatcher swap
@@ -523,52 +559,131 @@ class FusedPlan:
         `should_stop`: zero-arg callable polled between shapes — the
         controller's BACKGROUND initial prewarm passes its shutdown
         flag so a closing server never leaves a daemon thread compiling
-        into interpreter teardown (C++ abort on exit)."""
-        for b in sorted(set(buckets)):
-            # one serving entry per (bucket, byte tier): dummy batches
-            # with zero lens narrow to the small tier, full-length
-            # lens hold the worst-case width — together they warm
-            # every shape narrow_batch can route a served batch to
-            for batch in self._prewarm_batches(b):
-                if should_stop is not None and should_stop():
-                    return
-                # warm the SERVING entry (engine step + packer), not
-                # just the engine — the packer gather is its own XLA
-                # program
-                self.packed_check(batch, np.zeros(b, np.int32),
-                                  observe=False)
-                if self.report_lowering is not None and \
-                        self.report_rules:
-                    # the report path's packer (check rows + field
-                    # planes) is a separate XLA program per shape
-                    self.packed_report(batch, np.zeros(b, np.int32))
+        into interpreter teardown (C++ abort on exit). `backoff`: see
+        warm_shapes."""
+        self.warm_shapes(self.all_warm_shapes(buckets),
+                         should_stop=should_stop, backoff=backoff)
 
-    def _prewarm_batches(self, b: int) -> list:
-        """Dummy AttributeBatches covering every byte-plane tier for
-        bucket size `b`. The dummy batch MUST flatten to the same
-        pytree treedef as served batches (hash_ids included) — a
-        treedef mismatch compiles a cache entry serving never hits,
-        silently un-doing the prewarm."""
+    def all_warm_shapes(self, buckets) -> list:
+        """Every (bucket rows, byte tier) pair narrow_batch can route
+        a served batch to — the full shape product prewarm compiles."""
+        lay = self.engine.ruleset.layout
+        tiers = sorted(set(self.str_tiers or (lay.max_str_len,)))
+        return [(b, t) for b in sorted(set(buckets)) for t in tiers]
+
+    def served_shapes(self) -> set:
+        """(bucket rows, byte width) pairs live traffic has actually
+        served through this plan — the pre-swap warm priority set."""
+        return set(self._shape_served)
+
+    def map_served_shapes(self, buckets, served) -> list:
+        """Old plan's observed (bucket, width) pairs mapped onto THIS
+        plan's warmable (bucket, tier) pairs (width → smallest tier
+        that holds it). Empty/unmappable `served` returns the full
+        product — the conservative first-swap behavior."""
+        pairs = self.all_warm_shapes(buckets)
+        if not served:
+            return pairs
+        tiers = sorted({t for _, t in pairs})
+        bset = {b for b, _ in pairs}
+        out: list = []
+        for b, w in sorted(served):
+            if b not in bset:
+                continue
+            t = next((t for t in tiers if t >= w), tiers[-1])
+            if (b, t) not in out:
+                out.append((b, t))
+        return out or pairs
+
+    def warm_shapes(self, pairs, should_stop=None,
+                    backoff=None) -> None:
+        """Compile the SERVING entry (engine step + packer — the
+        packer gather is its own XLA program — plus the report packer
+        when report instances lowered) for each (bucket, byte-tier)
+        pair. `backoff` is called between shapes: the config-swap path
+        passes a serving-latency yield (controller._serving_backoff)
+        so a loaded single core keeps serving while this thread traces
+        jaxprs — the warm yields to traffic, never the reverse."""
+        for b, tier in pairs:
+            if should_stop is not None and should_stop():
+                return
+            batch = self._dummy_batch(b, tier)
+            self.packed_check(batch, np.zeros(b, np.int32),
+                              observe=False)
+            if self.report_lowering is not None and \
+                    self.report_rules:
+                self.packed_report(batch, np.zeros(b, np.int32),
+                                   observe=False)
+            if backoff is not None:
+                backoff()
+
+    def begin_warm(self) -> None:
+        """A warm phase is running (or queued) for this plan: serving
+        batches at not-yet-compiled shapes bridge to the host oracle
+        (Dispatcher._check_fused) instead of tracing in-band. Pair
+        with end_warm() in a finally — a plan left warm-pending would
+        oracle-serve its missing shapes forever."""
+        self._warm_pending = True
+
+    def end_warm(self) -> None:
+        self._warm_pending = False
+
+    def swap_warm_pending(self, batch) -> bool:
+        """True while a warm is still filling this batch's (bucket,
+        byte-tier) program slot — the dispatcher then serves the batch
+        through the CPU oracle: the new snapshot's semantics apply
+        immediately and no request pays the in-band XLA trace."""
+        if not self._warm_pending:
+            return False
+        b = int(batch.ids.shape[0])
+        return (b, self._serve_width(batch)) not in self._warmed_shapes
+
+    def _serve_width(self, batch) -> int:
+        """The byte-plane width this batch serves at — THE tier-routing
+        decision (narrow_batch slices to it, swap_warm_pending keys on
+        it; one implementation so the two can never drift). Host numpy
+        only."""
+        w = int(batch.str_bytes.shape[2])
+        tiers = self.str_tiers
+        if len(tiers) < 2 or not isinstance(batch.str_bytes, np.ndarray) \
+                or not isinstance(batch.str_lens, np.ndarray):
+            return w
+        t = tiers[0]
+        if w <= t or not batch.str_lens.size:
+            return w
+        m = int(batch.str_lens.max())   # hotpath: sync-ok (host numpy)
+        return t if m <= t else w
+
+    def _dummy_batch(self, b: int, tier: int):
+        """Dummy AttributeBatch routed to exactly one byte-plane tier
+        of bucket size `b`. The dummy MUST flatten to the same pytree
+        treedef as served batches (hash_ids included) — a treedef
+        mismatch compiles a cache entry serving never hits, silently
+        un-doing the prewarm."""
         from istio_tpu.compiler.layout import AttributeBatch
 
         lay = self.engine.ruleset.layout
-        tiers = self.str_tiers or (lay.max_str_len,)
-        out = []
-        for tier in sorted(set(tiers)):
-            # lens pinned AT the tier so narrow_batch routes the dummy
-            # to exactly this tier's compiled shape (0 → small tier;
-            # max_str_len → the full-width worst case)
-            out.append(AttributeBatch(
-                ids=np.zeros((b, lay.n_columns), np.int32),
-                present=np.zeros((b, lay.n_columns), bool),
-                map_present=np.zeros((b, max(lay.n_maps, 1)), bool),
-                str_bytes=np.zeros((b, max(lay.n_byte_slots, 1),
-                                    lay.max_str_len), np.uint8),
-                str_lens=np.full((b, max(lay.n_byte_slots, 1)),
-                                 0 if tier == min(tiers) else tier,
-                                 np.int32),
-                hash_ids=np.zeros((b, lay.n_columns), np.int32)))
-        return out
+        tiers = sorted(set(self.str_tiers or (lay.max_str_len,)))
+        # lens pinned AT the tier so narrow_batch routes the dummy to
+        # exactly this tier's compiled shape (0 → small tier;
+        # max_str_len → the full-width worst case)
+        lens = 0 if tier == min(tiers) else tier
+        return AttributeBatch(
+            ids=np.zeros((b, lay.n_columns), np.int32),
+            present=np.zeros((b, lay.n_columns), bool),
+            map_present=np.zeros((b, max(lay.n_maps, 1)), bool),
+            str_bytes=np.zeros((b, max(lay.n_byte_slots, 1),
+                                lay.max_str_len), np.uint8),
+            str_lens=np.full((b, max(lay.n_byte_slots, 1)),
+                             lens, np.int32),
+            hash_ids=np.zeros((b, lay.n_columns), np.int32))
+
+    def _prewarm_batches(self, b: int) -> list:
+        """Dummy AttributeBatches covering every byte-plane tier for
+        bucket size `b` (prewarm_instep's shape walk)."""
+        lay = self.engine.ruleset.layout
+        tiers = sorted(set(self.str_tiers or (lay.max_str_len,)))
+        return [self._dummy_batch(b, tier) for tier in tiers]
 
     def prewarm_instep(self, buckets, counts, should_stop=None) -> None:
         """Compile the in-step quota program for every serving bucket

@@ -65,7 +65,8 @@ CHECK_RESPONSES = prometheus_client.Counter(
 # batch); label series are pre-touched below so every reason exposes
 # at zero from the first scrape (a dashboard must distinguish "never
 # shed" from "counter missing").
-CHECK_SHED_REASONS = ("queue_full", "brownout", "batcher_dead")
+CHECK_SHED_REASONS = ("queue_full", "brownout", "batcher_dead",
+                      "draining")
 CHECK_FALLBACK_REASONS = ("breaker_open", "device_error", "fail_open")
 CHECK_SHED = prometheus_client.Counter(
     "mixer_check_shed_total",

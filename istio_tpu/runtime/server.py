@@ -676,7 +676,28 @@ class RuntimeServer:
         results.update(early)
         return responses, results
 
-    def close(self) -> None:
+    def shutdown(self, deadline: float | None = 5.0) -> None:
+        """Ordered graceful shutdown — the lifecycle plane's runtime
+        leg (COMPONENTS.md "Lifecycle & shutdown"; ordering: admission
+        → pump → device → flush → join):
+
+          1. stop admission — new checks/reports resolve a typed
+             UNAVAILABLE immediately (never a hang, never a drop);
+          2. drain the batchers — queued and in-flight batches run to
+             completion, bounded by `deadline` seconds (leftovers past
+             it still resolve: CheckBatcher.close flushes, the typed
+             rejection path covers the rest);
+          3. stop the batchers and flush the telemetry plane (final
+             rulestats drain; the canary recorder ring is sampling
+             state rebuilt from live traffic — dropped by design);
+          4. close the controller — reaps prewarm threads, closes
+             handlers, and closes the device quota pools (each pool's
+             worker flushes pending allocations before exiting).
+
+        Idempotent; close() is shutdown() with the default grace."""
+        if getattr(self, "_shutdown_done", False):
+            return
+        self._shutdown_done = True
         # a still-running initial in-step prewarm must not race
         # interpreter/pool teardown (its dummy trips touch jax state):
         # flip the stop flag (polled between shapes), then reap.
@@ -686,6 +707,12 @@ class RuntimeServer:
         t = getattr(self, "_instep_prewarm_thread", None)
         if t is not None and t.is_alive():
             t.join()
+        self.batcher.quiesce()
+        if self._report_batcher is not None:
+            self._report_batcher.quiesce()
+        self.batcher.drain(deadline)
+        if self._report_batcher is not None:
+            self._report_batcher.drain(deadline)
         self.batcher.close()
         if self._report_batcher is not None:
             self._report_batcher.close()
@@ -696,3 +723,6 @@ class RuntimeServer:
             except Exception:
                 pass
         self.controller.close()
+
+    def close(self) -> None:
+        self.shutdown()

@@ -62,6 +62,18 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
     "istio_tpu/runtime/fused.py": frozenset({
         "FusedPlan.packed_check", "FusedPlan.packed_report",
         "FusedPlan.packed_check_instep", "FusedPlan.narrow_batch",
+        # swap-warm oracle bridge (PR 7): consulted on every served
+        # batch by Dispatcher._check_fused — host-numpy tier routing
+        # only, same pragma discipline as narrow_batch
+        "FusedPlan.swap_warm_pending", "FusedPlan._serve_width",
+    }),
+    # quota-plane flush (PR 7): the classic worker's device trip now
+    # builds its tick/last staging under _lock INSIDE the _counts_lock
+    # critical section (ordered with in-step session dispatch); its
+    # designated pull and host-numpy kernel selection carry the only
+    # sync-ok pragmas in the file
+    "istio_tpu/runtime/device_quota.py": frozenset({
+        "DeviceQuotaPool._flush",
     }),
     # rule-telemetry fold + drain (PR 4): observe/add_host/sample run
     # inside the batch step; drain's device→host pull is THE designated

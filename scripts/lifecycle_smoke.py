@@ -1,0 +1,375 @@
+"""Lifecycle smoke: the CI gate that the serving stack is RESTARTABLE.
+
+PR 7's hard constraint — the stack must survive restart storms, SIGTERM
+mid-traffic and config swaps under load with ZERO aborts/core dumps and
+ZERO silently dropped in-flight requests. Three phases, each failing
+(nonzero exit) unless the lifecycle plane degrades exactly as designed:
+
+  (a) RESTART STORM — N× native C++ front start/stop cycles over one
+      RuntimeServer, live gRPC traffic on a sampling of cycles, a
+      DELIBERATE double-stop every cycle (the C++ live-handle registry
+      must make it a no-op, never a use-after-free), and per-cycle wire
+      accounting: in_flight must drain to zero and every decoded
+      request must have a response written (no silent drops).
+  (b) SIGTERM UNDER LIVE TRAFFIC — a child process serves the native
+      front while this process drives closed-loop checks; SIGTERM
+      mid-traffic runs the ordered shutdown (h2srv_quiesce → drain →
+      pump join → h2srv_stop → RuntimeServer.shutdown) and the child
+      must exit 0 — a negative returncode means SIGABRT/SIGSEGV, the
+      crash-on-teardown class this PR exists to kill. The child's
+      final counters must show in_flight == 0.
+  (c) SWAP STORM — rapid config churn under concurrent check streams:
+      serving never pauses (every check answers or raises a typed
+      rejection), no exception escapes, and the LAST config wins. The
+      served-shape pre-swap warm + background warm + host-oracle
+      bridge (runtime/controller.py, Dispatcher._check_fused) make the
+      storm cheap by construction.
+
+Runnable anywhere under JAX_PLATFORMS=cpu; tier-1 invokes main()
+in-process (tests/test_lifecycle_smoke.py, the chaos_smoke pattern).
+
+Usage: JAX_PLATFORMS=cpu python scripts/lifecycle_smoke.py
+           [--cycles N] [--swaps N] [--traffic-s S]
+       (internal: --sigterm-child runs the phase-b server process)
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+OK, PERMISSION_DENIED, UNAVAILABLE = 0, 7, 14
+
+
+def _smoke_store():
+    """Tiny deterministic config: one deny rule + one allow path —
+    cheap to compile (restart cycles must be wire-dominated, not
+    XLA-dominated) but still exercising the fused device path."""
+    from istio_tpu.runtime import MemStore
+
+    s = MemStore()
+    s.set(("handler", "istio-system", "denyall"), {
+        "adapter": "denier", "params": {"status_code": 7}})
+    s.set(("instance", "istio-system", "nothing"), {
+        "template": "checknothing", "params": {}})
+    s.set(("rule", "istio-system", "denyadmin"), {
+        "match": 'request.path.startsWith("/admin")',
+        "actions": [{"handler": "denyall", "instances": ["nothing"]}]})
+    return s
+
+
+def _runtime():
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+
+    srv = RuntimeServer(_smoke_store(), ServerArgs(
+        batch_window_s=0.0005, max_batch=8, buckets=(8,),
+        initial_prewarm=False, rulestats_drain_s=0))
+    # compile the serving shape BEFORE the storm: the cycles measure
+    # lifecycle hygiene, not first-compile latency
+    srv.check(bag_from_mapping({"request.path": "/warm"}))
+    return srv
+
+
+def _grpc_available() -> bool:
+    try:
+        import grpc  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------- (a) restarts
+
+def restart_storm(failures: list, cycles: int) -> None:
+    from istio_tpu.api.native_server import NativeMixerServer
+
+    srv = _runtime()
+    use_grpc = _grpc_available()
+    try:
+        for cycle in range(cycles):
+            native = NativeMixerServer(srv, min_fill=1, window_us=200,
+                                       pumps=2)
+            port = native.start()
+            if use_grpc and cycle % 10 == 0:
+                from istio_tpu.api.client import MixerClient
+                cli = MixerClient(f"127.0.0.1:{port}",
+                                  enable_check_cache=False)
+                try:
+                    r1 = cli.check({"request.path": "/admin/x"})
+                    r2 = cli.check({"request.path": "/ok"})
+                    if r1.precondition.status.code != PERMISSION_DENIED \
+                            or r2.precondition.status.code != OK:
+                        failures.append(
+                            f"cycle {cycle}: wrong verdicts "
+                            f"({r1.precondition.status.code}, "
+                            f"{r2.precondition.status.code})")
+                finally:
+                    cli.close()
+            native.stop(grace=5.0)
+            c = native.counters()
+            if c.get("in_flight", 0) != 0:
+                failures.append(
+                    f"cycle {cycle}: {c['in_flight']} requests "
+                    f"enqueued but never answered (silent drop)")
+            if c.get("responses_sent", 0) < c.get("requests_decoded", 0):
+                failures.append(
+                    f"cycle {cycle}: decoded "
+                    f"{c['requests_decoded']} > sent "
+                    f"{c['responses_sent']} (silent drop)")
+            # double-stop: the C++ registry guard must no-op this
+            # (before PR 7 it was a use-after-free → abort)
+            native.stop()
+    finally:
+        srv.close()
+
+
+# -------------------------------------------------------- (b) SIGTERM
+
+def sigterm_child() -> int:
+    """The phase-b server process: serve the native front until
+    SIGTERM, then run the ordered graceful shutdown and report the
+    final wire accounting on stdout."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from istio_tpu.api.native_server import NativeMixerServer
+
+    srv = _runtime()
+    native = NativeMixerServer(srv, min_fill=1, window_us=300, pumps=2)
+    port = native.start()
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: done.set())
+    print(f"PORT {port}", flush=True)
+    done.wait()
+    # ordered shutdown UNDER live traffic: quiesce intake (typed
+    # UNAVAILABLE for new wire requests), drain in-flight rows, join
+    # pumps, tear down the wire, then drain the runtime itself
+    native.stop(grace=5.0)
+    counters = native.counters()
+    srv.shutdown(deadline=5.0)
+    print("COUNTERS " + json.dumps(counters), flush=True)
+    if counters.get("in_flight", 0) != 0:
+        return 3   # enqueued rows vanished: silent drop
+    return 0
+
+
+def sigterm_under_load(failures: list, traffic_s: float) -> None:
+    if not _grpc_available():
+        print("lifecycle_smoke: grpc unavailable — SIGTERM phase "
+              "runs without client traffic")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--sigterm-child"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    port = None
+    lines: list = []
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if line.startswith("PORT "):
+                port = int(line.split()[1])
+                break
+        if port is None:
+            failures.append("sigterm child never reported a port")
+            proc.kill()
+            return
+        # drain the rest of the child's stdout on a thread so the
+        # pipe never fills and blocks the child's shutdown prints
+        reader = threading.Thread(
+            target=lambda: lines.extend(proc.stdout),
+            daemon=True)
+        reader.start()
+
+        served = [0]
+        rejected = [0]
+        client_bugs: list = []
+        stop = threading.Event()
+
+        def drive(tid: int) -> None:
+            if not _grpc_available():
+                return
+            import grpc
+            from istio_tpu.api.client import MixerClient
+            cli = MixerClient(f"127.0.0.1:{port}",
+                              enable_check_cache=False)
+            i = 0
+            try:
+                while not stop.is_set():
+                    try:
+                        r = cli.check(
+                            {"request.path": f"/t{tid}/{i}"})
+                        if r.precondition.status.code in (
+                                OK, UNAVAILABLE):
+                            served[0] += 1
+                        else:
+                            client_bugs.append(
+                                r.precondition.status.code)
+                    except grpc.RpcError:
+                        # typed rejection / connection close during
+                        # the drain — the client SAW an outcome,
+                        # nothing hung and nothing silently vanished
+                        rejected[0] += 1
+                        if stop.is_set():
+                            break
+                    i += 1
+            finally:
+                cli.close()
+
+        threads = [threading.Thread(target=drive, args=(t,),
+                                    daemon=True) for t in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(traffic_s)
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=90)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            failures.append("sigterm child hung past 90s — graceful "
+                            "shutdown wedged")
+            rc = None
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+            if t.is_alive():
+                failures.append("client thread hung across the "
+                                "shutdown (a request never resolved)")
+        if rc is not None and rc != 0:
+            kind = "killed by signal (abort/core dump)" if rc < 0 \
+                else "nonzero exit"
+            failures.append(
+                f"sigterm child rc={rc} ({kind}); output tail: "
+                f"{''.join(lines)[-2000:]}")
+        if _grpc_available() and served[0] == 0:
+            failures.append("no request served before SIGTERM — the "
+                            "under-load premise never held")
+        for line in lines:
+            if line.startswith("COUNTERS "):
+                c = json.loads(line[len("COUNTERS "):])
+                if c.get("in_flight", 0) != 0:
+                    failures.append(
+                        f"child wire counters leak in_flight="
+                        f"{c['in_flight']} (silent drops)")
+                break
+        else:
+            if rc == 0:
+                failures.append("child exited 0 but never printed "
+                                "its final counters")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+# ----------------------------------------------------- (c) swap storm
+
+def swap_storm(failures: list, swaps: int) -> None:
+    from istio_tpu.attribute.bag import bag_from_mapping
+
+    srv = _runtime()
+    store = srv.controller.store
+    errors: list = []
+    answered = [0]
+    stop = threading.Event()
+
+    def stream(tid: int) -> None:
+        i = 0
+        while not stop.is_set():
+            try:
+                r = srv.check(bag_from_mapping(
+                    {"request.path": f"/s{tid}/{i}"}))
+                if r.status_code not in (OK, PERMISSION_DENIED):
+                    errors.append(("status", r.status_code))
+                answered[0] += 1
+            except Exception as exc:   # typed rejections only
+                from istio_tpu.runtime.resilience import CheckRejected
+                if not isinstance(exc, CheckRejected):
+                    errors.append(("raise", repr(exc)))
+            i += 1
+
+    threads = [threading.Thread(target=stream, args=(t,), daemon=True)
+               for t in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        for i in range(swaps):
+            store.set(("rule", "istio-system", f"storm{i}"), {
+                "match": f'request.path.startsWith("/storm{i}/")',
+                "actions": [{"handler": "denyall",
+                             "instances": ["nothing"]}]})
+            time.sleep(0.05)
+        # the storm's LAST rule must take effect (every intermediate
+        # swap may be debounce-coalesced — only the final config is
+        # contractual)
+        probe = bag_from_mapping(
+            {"request.path": f"/storm{swaps - 1}/x"})
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if srv.check(probe).status_code == PERMISSION_DENIED:
+                break
+            time.sleep(0.05)
+        else:
+            failures.append("swap storm: final config never took "
+                            "effect within 60s")
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+            if t.is_alive():
+                failures.append("swap storm: stream thread hung")
+        if errors:
+            failures.append(f"swap storm: {len(errors)} bad outcomes, "
+                            f"first: {errors[0]}")
+        if not answered[0]:
+            failures.append("swap storm: nothing served during churn")
+    finally:
+        stop.set()
+        srv.close()
+
+
+def main(cycles: int = 50, swaps: int = 6,
+         traffic_s: float = 1.0) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    failures: list = []
+
+    t0 = time.time()
+    restart_storm(failures, cycles)
+    t1 = time.time()
+    print(f"lifecycle_smoke: restart storm ({cycles} cycles) "
+          f"{t1 - t0:.1f}s, {len(failures)} failure(s)")
+    sigterm_under_load(failures, traffic_s)
+    t2 = time.time()
+    print(f"lifecycle_smoke: sigterm-under-load {t2 - t1:.1f}s, "
+          f"{len(failures)} cumulative failure(s)")
+    swap_storm(failures, swaps)
+    print(f"lifecycle_smoke: swap storm ({swaps} swaps) "
+          f"{time.time() - t2:.1f}s, {len(failures)} cumulative "
+          f"failure(s)")
+
+    for f in failures:
+        print(f"lifecycle_smoke FAIL: {f}")
+    if not failures:
+        print("lifecycle_smoke: OK (zero aborts, zero dropped "
+              "in-flight requests)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cycles", type=int, default=50)
+    ap.add_argument("--swaps", type=int, default=6)
+    ap.add_argument("--traffic-s", type=float, default=1.0)
+    ap.add_argument("--sigterm-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.sigterm_child:
+        sys.exit(sigterm_child())
+    sys.exit(main(cycles=args.cycles, swaps=args.swaps,
+                  traffic_s=args.traffic_s))

@@ -256,6 +256,72 @@ def test_ingress_controller_emits_rules():
     assert store.list("ingress-rule") == []
 
 
+def test_ingress_status_syncer_writes_lb_status():
+    """status.go analog: the syncer writes the gateway address into
+    status.loadBalancer.ingress of watched Ingress resources (IP →
+    `ip`, name → `hostname`), skips foreign classes, is idempotent
+    (the self-triggered MODIFIED event terminates), and re-syncs a
+    resource whose status was wiped by an update."""
+    from istio_tpu.kube import IngressStatusSyncer
+
+    cluster = FakeKubeCluster()
+    # pre-existing ingress: the watch replay must sync it too
+    cluster.create({
+        "kind": "Ingress",
+        "metadata": {"name": "pre", "namespace": "default"},
+        "spec": {"backend": {"serviceName": "a", "servicePort": 80}}})
+    IngressStatusSyncer(cluster, "203.0.113.7")
+    got = cluster.get("Ingress", "default", "pre")
+    assert got["status"]["loadBalancer"]["ingress"] == \
+        [{"ip": "203.0.113.7"}]
+
+    cluster.create({
+        "kind": "Ingress",
+        "metadata": {"name": "gw", "namespace": "default",
+                     "annotations": {
+                         "kubernetes.io/ingress.class": "istio"}},
+        "spec": {"backend": {"serviceName": "b", "servicePort": 80}}})
+    got = cluster.get("Ingress", "default", "gw")
+    assert got["status"]["loadBalancer"]["ingress"] == \
+        [{"ip": "203.0.113.7"}]
+    rv_after_sync = got["metadata"]["resourceVersion"]
+
+    # foreign class: never touched
+    cluster.create({
+        "kind": "Ingress",
+        "metadata": {"name": "other", "namespace": "default",
+                     "annotations": {
+                         "kubernetes.io/ingress.class": "nginx"}},
+        "spec": {"backend": {"serviceName": "x", "servicePort": 80}}})
+    assert "status" not in cluster.get("Ingress", "default", "other")
+
+    # idempotence: a status-only touch must not loop resourceVersions
+    assert cluster.get("Ingress", "default", "gw")["metadata"][
+        "resourceVersion"] == rv_after_sync
+
+    # a spec update that drops status gets re-synced by the syncer
+    cluster.update({
+        "kind": "Ingress",
+        "metadata": {"name": "gw", "namespace": "default",
+                     "annotations": {
+                         "kubernetes.io/ingress.class": "istio"}},
+        "spec": {"backend": {"serviceName": "c", "servicePort": 81}}})
+    got = cluster.get("Ingress", "default", "gw")
+    assert got["status"]["loadBalancer"]["ingress"] == \
+        [{"ip": "203.0.113.7"}]
+
+    # hostname addresses write the hostname field (status.go shape)
+    cluster2 = FakeKubeCluster()
+    IngressStatusSyncer(cluster2, "gw.example.com")
+    cluster2.create({
+        "kind": "Ingress",
+        "metadata": {"name": "h", "namespace": "default"},
+        "spec": {"backend": {"serviceName": "y", "servicePort": 80}}})
+    assert cluster2.get("Ingress", "default", "h")["status"][
+        "loadBalancer"]["ingress"] == \
+        [{"hostname": "gw.example.com"}]
+
+
 # ---------------------------------------------------------------------------
 # SA → workload-cert secrets
 # ---------------------------------------------------------------------------

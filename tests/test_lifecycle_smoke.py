@@ -1,0 +1,27 @@
+"""Tier-1 hook for scripts/lifecycle_smoke.py: the CI gate that the
+stack is restartable — 50× native start/stop cycles (with deliberate
+double-stops) leak nothing and drop nothing, SIGTERM under live
+traffic runs the ordered graceful shutdown and exits 0 (a negative
+returncode would mean the abort-on-teardown class PR 7 removed), and
+a config swap storm never pauses serving. Runs main() in-process (the
+chaos_smoke pattern — the SIGTERM phase spawns its one subprocess
+internally); the script stays runnable standalone under
+JAX_PLATFORMS=cpu."""
+import importlib.util
+import os
+import sys
+
+
+def test_lifecycle_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "lifecycle_smoke.py")
+    spec = importlib.util.spec_from_file_location("lifecycle_smoke",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(cycles=50, swaps=4, traffic_s=0.6)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0

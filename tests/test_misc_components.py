@@ -121,9 +121,44 @@ def test_cli_parser_covers_all_tools():
     for argv in (["mixc", "check"],
                  ["istioctl", "get", "route-rule"],
                  ["mixs"], ["pilot-discovery"], ["brks"],
+                 ["brkcol", "--config-store", "/tmp/x"],
                  ["node-agent", "--identity", "spiffe://c/ns/a/sa/b"]):
         args = parser.parse_args(argv)
         assert callable(args.fn)
+
+
+def test_brkcol_collects_catalog(tmp_path, capsys):
+    """brkcol (broker/cmd/brkcol): the collector assembles the same
+    OSB catalog a serving brks would from the store's service-class /
+    service-plan kinds — smoke the CLI end to end over an FsStore."""
+    import json as _json
+
+    from istio_tpu.cmd.__main__ import main
+    docs = [
+        {"kind": "service-class",
+         "metadata": {"name": "db", "namespace": "default"},
+         "spec": {"deployment": {"instance": "postgres"},
+                  "entry": {"name": "db", "id": "svc-1",
+                            "description": "managed db"}}},
+        {"kind": "service-plan",
+         "metadata": {"name": "small", "namespace": "default"},
+         "spec": {"plan": {"name": "small", "id": "plan-1",
+                           "description": "1 cpu"},
+                  "services": ["db"]}},
+    ]
+    (tmp_path / "broker.yaml").write_text(
+        yaml.safe_dump_all(docs))
+    assert main(["brkcol", "--config-store", str(tmp_path),
+                 "--json"]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["service_classes"] == ["default/db"]
+    assert out["service_plans"] == ["default/small"]
+    [svc] = out["catalog"]["services"]
+    assert svc["id"] == "svc-1"
+    assert [p["id"] for p in svc.get("plans", [])] == ["plan-1"]
+    # human-readable mode exits 0 too
+    assert main(["brkcol", "--config-store", str(tmp_path)]) == 0
+    assert "1 catalog service(s)" in capsys.readouterr().out
 
 
 def test_istioctl_create_get_delete(tmp_path):

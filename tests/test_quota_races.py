@@ -1,0 +1,270 @@
+"""Quota-plane race regressions (PR 7 / ADVICE r5).
+
+The device quota pool serves TWO concurrent mutation paths over one
+counter buffer: the classic worker's `_flush` (gRPC Quota RPCs,
+multi-quota rows, mixed fronts) and in-step sessions riding check
+trips (`InlineQuotaSession`). The advisor's round-5 findings named
+three gaps this file pins forever:
+
+  * `_flush` built its tick/last arrays and applied roll updates
+    OUTSIDE the locks — racing a session's optimistic `_last_tick`
+    advance could stage a stale `last` (device re-rolls slots holding
+    fresh consumption → over-grant) or regress it (under-grant). The
+    fix orders the host bookkeeping under _lock inside the
+    _counts_lock critical section on BOTH paths; the round-phased
+    test here asserts window totals match a serialized memquota
+    oracle EXACTLY while the two paths race on one bucket across
+    window-tick boundaries.
+  * `_flush` never consulted `_dedup_pending`: a retransmission
+    routed classic while an in-step session was dispatched-but-
+    uncommitted re-consumed instead of replaying (memquota's mutex
+    would serialize). Now it defers and replays.
+  * a pending replay whose consuming session committed GATE-OFF
+    (grant-freely, nothing cached) resolved status-14 "quota trip
+    failed"; now `_dedup_free` records the outcome and the replay
+    grants freely.
+"""
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from istio_tpu.adapters.memquota import MemQuotaHandler
+from istio_tpu.adapters.sdk import Env, QuotaArgs
+from istio_tpu.runtime.device_quota import DeviceQuotaPool
+
+OK, RESOURCE_EXHAUSTED = 0, 8
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _inst(dims):
+    return {"name": "rq", "dimensions": dims}
+
+
+def _pool(clock, max_amount=10, duration=0.0):
+    return DeviceQuotaPool(
+        {"rq": {"name": "rq", "max_amount": max_amount,
+                "valid_duration_s": duration}},
+        n_buckets=32, clock=clock, batch_window_s=0.0005,
+        max_batch=64)
+
+
+def _run_instep_session(pool, rows):
+    """Emulate one check trip's in-step quota leg exactly as the
+    merged device program does (fused.packed_check_instep): roll every
+    staged row's bucket, allocate with the contended-mixed seg kernel,
+    swap the pool onto the successor counters at dispatch, commit in
+    turn order. All staged rows gate ON (the emulated check matched)."""
+    sess = pool.inline_begin(len(rows), rows, pool._clock())
+    assert sess is not None
+    granted, new_counts = pool._alloc_seg(
+        sess.prev_counts, jnp.asarray(sess.buckets),
+        jnp.asarray(sess.amounts), jnp.asarray(sess.be),
+        jnp.asarray(sess.mx), jnp.asarray(sess.active),
+        jnp.asarray(sess.ticks), jnp.asarray(sess.lasts),
+        jnp.asarray(sess.rolling))
+    sess.dispatched(new_counts)
+    out = sess.commit(np.asarray(granted),
+                      sess.active.astype(bool))
+    out.update(sess.early)
+    return out
+
+
+def test_classic_flush_vs_instep_matches_serialized_oracle():
+    """Classic `_flush` bursts RACING in-step sessions on the SAME
+    rolling-window bucket, round-phased across window-tick boundaries:
+    every round's granted total must equal the serialized memquota
+    oracle exactly. Unit amounts make round totals order-independent,
+    so the assertion is exact under ANY thread interleaving — an
+    over-grant (stale `last` re-rolled fresh consumption) or
+    under-grant (regressed `_last_tick`) shows up as a hard
+    inequality."""
+    clock = Clock()
+    pool = _pool(clock, max_amount=30, duration=10.0)
+    oracle = MemQuotaHandler(
+        {"quotas": [{"name": "rq", "max_amount": 30,
+                     "valid_duration_s": 10.0}]},
+        Env("test"), clock=clock)
+    dims = {"user": "hot"}
+    try:
+        for rnd in range(8):
+            futs: list = []
+            inres: list = []
+
+            def classic():
+                for _ in range(6):
+                    futs.append(pool.alloc(
+                        "rq", _inst(dims),
+                        QuotaArgs(quota_amount=1, best_effort=True)))
+
+            def instep():
+                for _ in range(2):
+                    rows = [(s, "rq", _inst(dims),
+                             QuotaArgs(quota_amount=1,
+                                       best_effort=True))
+                            for s in range(3)]
+                    inres.append(_run_instep_session(pool, rows))
+
+            threads = [threading.Thread(target=classic),
+                       threading.Thread(target=instep)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+                assert not t.is_alive(), "quota path wedged"
+            got = sum(f.result(timeout=30).granted_amount
+                      for f in futs)
+            got += sum(r.granted_amount
+                       for out in inres for r in out.values())
+            want = sum(
+                oracle.handle_quota(
+                    "quota", _inst(dims),
+                    QuotaArgs(quota_amount=1,
+                              best_effort=True)).granted_amount
+                for _ in range(12))
+            assert got == want, (rnd, got, want)
+            # quarter-window per round: ticks advance DURING the run,
+            # crossing the window boundary — the regime where stale
+            # tick staging over/under-grants
+            clock.t += 2.5
+    finally:
+        pool.close()
+
+
+def test_classic_flush_defers_dedup_held_by_uncommitted_session():
+    """A retransmission on the CLASSIC path while an in-step session
+    holds its dedup id dispatched-but-uncommitted must DEFER past the
+    session's commit and REPLAY the cached outcome — memquota's mutex
+    serializes those; re-consuming would double-book the window."""
+    clock = Clock()
+    pool = _pool(clock, max_amount=10, duration=0.0)
+    dims = {"user": "alice"}
+    try:
+        rows = [(0, "rq", _inst(dims),
+                 QuotaArgs(quota_amount=5, best_effort=True,
+                           dedup_id="dd"))]
+        sess = pool.inline_begin(1, rows, clock())
+        assert sess is not None
+        granted, new_counts = pool._alloc_seg(
+            sess.prev_counts, jnp.asarray(sess.buckets),
+            jnp.asarray(sess.amounts), jnp.asarray(sess.be),
+            jnp.asarray(sess.mx), jnp.asarray(sess.active),
+            jnp.asarray(sess.ticks), jnp.asarray(sess.lasts),
+            jnp.asarray(sess.rolling))
+        sess.dispatched(new_counts)
+        # dispatched, NOT committed: the classic retransmission lands
+        # in _flush, which must defer it (not consume a second 5)
+        fut = pool.alloc("rq", _inst(dims),
+                         QuotaArgs(quota_amount=5, best_effort=True,
+                                   dedup_id="dd"))
+        time.sleep(0.05)   # worker flushed, deferred, re-queued
+        assert not fut.done(), \
+            "classic flush resolved a dedup id still held by an " \
+            "uncommitted in-step session"
+        out = sess.commit(np.asarray(granted), np.array([True]))
+        assert out[0].granted_amount == 5
+        got = fut.result(timeout=10)
+        assert got.granted_amount == 5       # replayed
+        assert got.status_code == OK
+        # single consumption: 5 of 10 left proves the retransmission
+        # never re-consumed
+        fresh = pool.alloc(
+            "rq", _inst(dims),
+            QuotaArgs(quota_amount=10, best_effort=True)).result(10)
+        assert fresh.granted_amount == 5
+    finally:
+        pool.close()
+
+
+def test_gate_off_commit_replays_grant_freely_to_pending_rows():
+    """A pending replay whose consuming session committed GATE-OFF
+    (quota rule inactive → grant freely, nothing consumed, nothing in
+    the consumed-outcome cache) must resolve grant-freely with its
+    OWN requested amount — the serialized outcome — not status-14
+    'quota trip failed' (ADVICE r5 low)."""
+    clock = Clock()
+    pool = _pool(clock, max_amount=10, duration=0.0)
+    dims = {"user": "bob"}
+    try:
+        s1 = pool.inline_begin(
+            1, [(0, "rq", _inst(dims),
+                 QuotaArgs(quota_amount=7, best_effort=True,
+                           dedup_id="g1"))], clock())
+        assert s1 is not None
+        # gate-off trips consume nothing: the counter handle is
+        # unchanged by the zeroed-amount alloc
+        s1.dispatched(s1.prev_counts)
+        s2 = pool.inline_begin(
+            1, [(0, "rq", _inst(dims),
+                 QuotaArgs(quota_amount=4, best_effort=True,
+                           dedup_id="g1"))], clock())
+        assert s2 is not None
+        assert 0 in s2.pending_replay   # id held by s1, uncommitted
+        s2.dispatched(s2.prev_counts)
+        out1 = s1.commit(np.zeros(1, np.int32),
+                         np.array([False]))   # gate OFF
+        assert out1[0].granted_amount == 7
+        assert out1[0].status_code == OK
+        out2 = s2.commit(np.zeros(1, np.int32), np.zeros(1, bool))
+        assert out2[0].status_code == OK, \
+            f"pending replay degraded to {out2[0].status_message!r}"
+        assert out2[0].granted_amount == 4   # ITS amount, freely
+        # the CLASSIC path replays the gate-off outcome too (dedup-id
+        # semantics are path-independent): granted freely, unconsumed
+        classic = pool.alloc(
+            "rq", _inst(dims),
+            QuotaArgs(quota_amount=3, best_effort=True,
+                      dedup_id="g1")).result(10)
+        assert (classic.granted_amount, classic.status_code) == (3, OK)
+        # none of the three consumed: the full window is intact
+        fresh = pool.alloc(
+            "rq", _inst(dims),
+            QuotaArgs(quota_amount=10, best_effort=True)).result(10)
+        assert fresh.granted_amount == 10
+    finally:
+        pool.close()
+
+
+def test_consuming_commit_still_replays_to_pending_rows():
+    """The consumed-outcome half of the same race (coverage pin): a
+    pending replay whose consuming session committed GATE-ON replays
+    the cached grant, and the window shows exactly one consumption."""
+    clock = Clock()
+    pool = _pool(clock, max_amount=10, duration=0.0)
+    dims = {"user": "eve"}
+    try:
+        s1 = pool.inline_begin(
+            1, [(0, "rq", _inst(dims),
+                 QuotaArgs(quota_amount=6, best_effort=True,
+                           dedup_id="c1"))], clock())
+        granted, new_counts = pool._alloc_seg(
+            s1.prev_counts, jnp.asarray(s1.buckets),
+            jnp.asarray(s1.amounts), jnp.asarray(s1.be),
+            jnp.asarray(s1.mx), jnp.asarray(s1.active),
+            jnp.asarray(s1.ticks), jnp.asarray(s1.lasts),
+            jnp.asarray(s1.rolling))
+        s1.dispatched(new_counts)
+        s2 = pool.inline_begin(
+            1, [(0, "rq", _inst(dims),
+                 QuotaArgs(quota_amount=6, best_effort=True,
+                           dedup_id="c1"))], clock())
+        assert 0 in s2.pending_replay
+        s2.dispatched(pool.counts)
+        out1 = s1.commit(np.asarray(granted), np.array([True]))
+        assert out1[0].granted_amount == 6
+        out2 = s2.commit(np.zeros(1, np.int32), np.zeros(1, bool))
+        assert (out2[0].granted_amount, out2[0].status_code) == (6, OK)
+        fresh = pool.alloc(
+            "rq", _inst(dims),
+            QuotaArgs(quota_amount=10, best_effort=True)).result(10)
+        assert fresh.granted_amount == 4     # 10 - one consumption
+    finally:
+        pool.close()
