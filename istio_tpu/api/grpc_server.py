@@ -403,9 +403,29 @@ class MixerGrpcServer:
 
     def _report(self, request: "pb.ReportRequest",
                 context) -> "pb.ReportResponse":
-        bags = self._decode_report(request)
-        if bags:
-            self.runtime.report(bags)
+        # ROOT span at RPC decode (the report analog of rpc.check):
+        # the coalescer's serve.batch span parents under it via the
+        # thread-local stack; the client's W3C traceparent (metadata)
+        # becomes the root's parent when sent
+        from istio_tpu.utils import tracing
+        monitor.REPORT_REQUESTS.inc()
+        with tracing.get_tracer().span(
+                "rpc.report", parent=self._traceparent_from(context),
+                records=len(request.attributes)) as root:
+            t0 = time.perf_counter()
+            bags = self._decode_report(request)
+            monitor.observe_report_stage("wire_decode",
+                                         time.perf_counter() - t0)
+            try:
+                if bags:
+                    self.runtime.report(bags)
+            except CheckRejected as exc:
+                # typed admission rejection (bounded report queue,
+                # draining): the honest wire code, never INTERNAL
+                self._tag_status(root, exc.grpc_code)
+                context.abort(_reject_status(exc), str(exc))
+            self._tag_status(root, 0)
+        monitor.REPORT_RESPONSES.inc()
         return pb.ReportResponse()
 
 
@@ -539,27 +559,55 @@ class MixerAioGrpcServer(MixerGrpcServer):
     async def _areport(self, request: "pb.ReportRequest",
                        context) -> "pb.ReportResponse":
         import asyncio
+
+        from istio_tpu.utils import tracing
         loop = asyncio.get_running_loop()
-        # decode + preprocess are synchronous host work — off the
-        # loop; the WAIT for the coalesced batches holds no thread
-        # (futures bridge back via wrap_future, like _acheck), so
-        # in-flight Reports are bounded by the batcher, not a pool
-        bags = await loop.run_in_executor(None, self._decode_report,
-                                          request)
-        if bags:
-            futs = await loop.run_in_executor(
-                None, self.runtime.submit_report, bags)
-            if futs:
-                # shield: a client cancel must never poison shared
-                # batch-mates; gather-with-exceptions retrieves every
-                # future before the first error re-raises
-                results = await asyncio.shield(asyncio.gather(
-                    *[asyncio.wrap_future(f) for f in futs],
-                    return_exceptions=True))
-                first = next((r for r in results
-                              if isinstance(r, BaseException)), None)
-                if first is not None:
-                    raise first
+        monitor.REPORT_REQUESTS.inc()
+        # rpc.report root: built inline (not via the thread-local
+        # `with` — handler awaits hop threads); wire_decode is timed
+        # in the executor wrapper so the stage covers the real work
+        root = tracing.get_tracer().span(
+            "rpc.report", parent=self._traceparent_from(context),
+            records=len(request.attributes), transport="grpc-aio")
+
+        def _decode():
+            t0 = time.perf_counter()
+            bags = self._decode_report(request)
+            monitor.observe_report_stage("wire_decode",
+                                         time.perf_counter() - t0)
+            return bags
+
+        with root as span:
+            # decode + preprocess are synchronous host work — off the
+            # loop; the WAIT for the coalesced batches holds no thread
+            # (futures bridge back via wrap_future, like _acheck), so
+            # in-flight Reports are bounded by the batcher, not a pool
+            bags = await loop.run_in_executor(None, _decode)
+            if bags:
+                futs = await loop.run_in_executor(
+                    None, self.runtime.submit_report, bags)
+                if futs:
+                    # shield: a client cancel must never poison shared
+                    # batch-mates; gather-with-exceptions retrieves
+                    # every future before the first error re-raises
+                    results = await asyncio.shield(asyncio.gather(
+                        *[asyncio.wrap_future(f) for f in futs],
+                        return_exceptions=True))
+                    first = next((r for r in results
+                                  if isinstance(r, BaseException)),
+                                 None)
+                    if first is not None:
+                        if isinstance(first, CheckRejected):
+                            # typed shed (bounded report queue,
+                            # draining) → honest wire status; aio
+                            # abort is a coroutine and must run ON
+                            # the loop
+                            self._tag_status(span, first.grpc_code)
+                            await context.abort(_reject_status(first),
+                                                str(first))
+                        raise first
+            self._tag_status(span, 0)
+        monitor.REPORT_RESPONSES.inc()
         return pb.ReportResponse()
 
     def _run(self) -> None:

@@ -333,14 +333,92 @@ class NativeMixerServer(MixerGrpcServer):
                 self._run_checks(checks, completions, deferred,
                                  span=span)
 
+        if reports:
+            # rpc.report root at the wire (same role as rpc.check
+            # above): parents under the first report row's W3C
+            # traceparent when one was sent
+            parent = next(
+                (p for p in (tracing.parent_from_traceparent(it[6])
+                             for it in reports if it[6])
+                 if p is not None), None)
+            with tracing.get_tracer().span(
+                    "rpc.report", parent=parent, transport="native",
+                    rpcs=len(reports)) as span:
+                self._run_reports(reports, completions, span=span)
+
+    def _run_reports(self, reports: list, completions: list,
+                     span: dict | None = None) -> None:
+        """ACK-AFTER-ENQUEUE report serving (the ingestion plane's
+        native leg): each RPC's records are decoded, admitted into the
+        bounded cross-RPC record coalescer, and the RPC is answered
+        the moment its records are ACCEPTED — the pump thread never
+        waits out a device trip, so Report rows sharing a take batch
+        with Check rows add only decode+enqueue time in front of them.
+
+        Admission overflow answers a typed RESOURCE_EXHAUSTED (and a
+        draining coalescer UNAVAILABLE) instead of buffering without
+        bound behind an already-acked wire; admitted records are
+        conservation-accounted by submit_report (every one ends
+        exported or typed-rejected — never silently dropped)."""
+        from istio_tpu.runtime.resilience import CheckRejected
+
+        import time as _time
+
+        n_records = 0
+        first_bad = 0
         for tag, _, payload, _, _, _, _ in reports:
+            monitor.REPORT_REQUESTS.inc()
             try:
+                t0 = _time.perf_counter()
                 req = pb.ReportRequest.FromString(payload)
-                self._report(req, None)
-                completions.append((tag, 0, b""))
+                bags = self._decode_report(req)
+                monitor.observe_report_stage(
+                    "wire_decode", _time.perf_counter() - t0)
+            except Exception as exc:
+                completions.append(
+                    (tag, 13, f"report decode failed: {exc}".encode()))
+                first_bad = first_bad or 13
+                continue
+            n_records += len(bags)
+            try:
+                futs = self.runtime.submit_report(bags)
+            except CheckRejected as exc:   # inline path's typed shed
+                completions.append((tag, exc.grpc_code,
+                                    str(exc).encode()))
+                first_bad = first_bad or exc.grpc_code
+                continue
             except Exception as exc:
                 completions.append(
                     (tag, 13, f"report failed: {exc}".encode()))
+                first_bad = first_bad or 13
+                continue
+            # ack-after-enqueue: only ALREADY-REJECTED futures (typed
+            # admission sheds resolve synchronously inside submit)
+            # turn the ack into an error — everything admitted will
+            # export or typed-reject on its own, counted either way
+            err = None
+            for f in futs:
+                if f.done():
+                    try:
+                        err = f.exception()
+                    except BaseException as cancel:
+                        # a cancelled admission future did NOT export
+                        # its record (the ledger counted it rejected)
+                        # — the ack must say so, never OK
+                        err = cancel
+                    if err is not None:
+                        break
+            if err is not None:
+                code = getattr(err, "grpc_code", 13)
+                completions.append((tag, code, str(err).encode()))
+                first_bad = first_bad or code
+            else:
+                completions.append((tag, 0, b""))
+                monitor.REPORT_RESPONSES.inc()
+        if span is not None:
+            span["tags"]["records"] = n_records
+            span["tags"]["status"] = "ok" if first_bad == 0 \
+                else str(first_bad)
 
     def _run_checks(self, checks: list, completions: list,
                     deferred: set, span: dict | None = None) -> None:

@@ -36,6 +36,7 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         # admission control)
         default_check_deadline_ms=args.default_check_deadline_ms,
         check_queue_cap=args.check_queue_cap,
+        report_queue_cap=args.report_queue_cap,
         brownout=args.brownout,
         check_fail_policy=args.check_fail_policy,
         breaker_failures=args.breaker_failures,
@@ -758,6 +759,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="check batcher queue cap: submits past it "
                         "shed RESOURCE_EXHAUSTED (default "
                         "8*max-batch; 0 = unbounded)")
+    s.add_argument("--report-queue-cap", type=int, default=None,
+                   help="report record coalescer admission cap: the "
+                        "ack-after-enqueue contract's bound — records "
+                        "past it shed typed RESOURCE_EXHAUSTED "
+                        "(default 16*max-batch; 0 = unbounded)")
     s.add_argument("--brownout", action="store_true",
                    help="shed the newest check requests while the "
                         "live p99 gauge is over the SLO target and "

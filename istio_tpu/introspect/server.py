@@ -134,6 +134,7 @@ class IntrospectServer:
         "/debug/rulestats": "_h_rulestats",
         "/debug/canary": "_h_canary",
         "/debug/roofline": "_h_roofline",
+        "/debug/report": "_h_report",
     }
 
     @staticmethod
@@ -369,6 +370,58 @@ class IntrospectServer:
                 entry["vs_live_str_len"] = live_width
             per[str(b)] = entry
         payload["buckets"] = per
+        self._send_json(req, payload)
+
+    def _h_report(self, req: BaseHTTPRequestHandler) -> None:
+        """Telemetry ingestion plane view (the report analog of
+        /debug/queues + /debug/resilience in one page): live six-stage
+        pipeline p50/p95/p99 (wire_decode → coalesce_wait → tensorize
+        → device_field_eval → intern_decode → adapter_dispatch),
+        record-conservation state (accepted == exported + rejected;
+        in_flight is the transient difference), coalescer occupancy,
+        per-template record totals, per-exporter delivery/drop/lag
+        stats, and the most recent typed-drop reasons. Serves
+        zero-shaped before the first record — an idle plane must be
+        distinguishable from a missing one."""
+        from istio_tpu.runtime import monitor
+
+        payload: dict[str, Any] = {
+            **monitor.report_latency_snapshot(),
+            **monitor.report_counters(),
+        }
+        if self.runtime is not None:
+            rb = self.runtime._report_batcher
+            payload["coalescer"] = rb.stats() if rb is not None \
+                else {"inline": True,
+                      "note": "report_batching=False — records "
+                              "dispatch inline, no coalescer"}
+            args = self.runtime.args
+            payload["policy"] = {
+                "report_batching": args.report_batching,
+                # the coalescer's OWN normalized cap (None =
+                # unbounded, no coalescer = no cap) — never re-derive
+                # the default here and risk disagreeing with the
+                # coalescer block above
+                "report_queue_cap": rb.max_queue
+                if rb is not None else None,
+                "max_batch": args.max_batch,
+                "buckets": list(getattr(
+                    self.runtime.controller.dispatcher, "buckets",
+                    ())),
+            }
+            d = self.runtime.controller.dispatcher
+            if d.fused is not None:
+                rl = d.fused.report_lowering
+                payload["lowering"] = {
+                    "report_rules": len(d.fused.report_rules),
+                    "device_instances":
+                        len(rl.specs) if rl is not None else 0,
+                    "host_instances":
+                        len(rl.host_instances) if rl is not None
+                        else None,
+                    "field_programs":
+                        rl.n_fields if rl is not None else 0,
+                }
         self._send_json(req, payload)
 
     def _h_cache(self, req: BaseHTTPRequestHandler) -> None:

@@ -56,7 +56,7 @@ int main(int argc, char** argv) {
   if (argc < 6) {
     fprintf(stderr,
             "usage: h2load <port> <payload_file> <n_record> <depth> "
-            "<warmup_s>\n");
+            "<warmup_s> [:path]\n");
     return 2;
   }
   int port = atoi(argv[1]);
@@ -64,6 +64,10 @@ int main(int argc, char** argv) {
   long n_record = atol(argv[3]);
   int depth = atoi(argv[4]);
   double warmup_s = atof(argv[5]);
+  // optional gRPC method path (default Check): the Report bench
+  // drives /istio.mixer.v1.Mixer/Report with ReportRequest payloads
+  std::string method_path = argc > 6 ? argv[6]
+                                     : "/istio.mixer.v1.Mixer/Check";
 
   // load payloads (u32 len prefix each)
   std::vector<std::string> payloads;
@@ -109,7 +113,7 @@ int main(int argc, char** argv) {
   std::string hdr;
   lit_header(&hdr, ":method", "POST");
   lit_header(&hdr, ":scheme", "http");
-  lit_header(&hdr, ":path", "/istio.mixer.v1.Mixer/Check");
+  lit_header(&hdr, ":path", method_path);
   lit_header(&hdr, ":authority", "localhost");
   lit_header(&hdr, "content-type", "application/grpc");
   lit_header(&hdr, "te", "trailers");

@@ -99,7 +99,8 @@ class CheckBatcher:
                  pad_batches: bool = True,
                  observe_latency: bool = True,
                  max_queue: int | None = None,
-                 brownout: bool = False):
+                 brownout: bool = False,
+                 stage_observer: Callable[[float], None] | None = None):
         self.run_batch = run_batch
         # bounded admission (DAGOR-style front-door shedding): a submit
         # that would push the queue past max_queue resolves
@@ -120,6 +121,10 @@ class CheckBatcher:
         # batches must not feed the Check() stage decomposition or the
         # live p99 window
         self._observe_latency = observe_latency
+        # queue-wait observer for coalescers with their OWN stage
+        # decomposition (the report batcher feeds coalesce_wait into
+        # the report pipeline histograms instead of the Check stages)
+        self._stage_observer = stage_observer
         # False for hooks whose downstream re-pads anyway (the report
         # batcher: dispatcher._report_active_fused pads per chunk) —
         # skips allocate-then-trim churn on every light-load batch
@@ -482,6 +487,8 @@ class CheckBatcher:
             if self._observe_latency:
                 monitor.observe_stage("queue_wait",
                                       max(waits, default=0.0))
+            elif self._stage_observer is not None:
+                self._stage_observer(max(waits, default=0.0))
             # parent under the OLDEST request's rpc root span — the
             # request whose queue-wait the batch's wait tag reports
             parent = next((t for t in

@@ -715,6 +715,7 @@ class Dispatcher:
 
     def report(self, bags: Sequence[Bag]) -> None:
         from istio_tpu.runtime.batcher import trim_pads
+        from istio_tpu.runtime.config import _qualify
 
         # defensive vs padded callers (BatchCheck-style fronts hand
         # bucket-shaped batches): padding rows carry no caller and
@@ -734,6 +735,14 @@ class Dispatcher:
             actives, _ = self._resolve(bags)
         rl = self.fused.report_lowering if self.fused is not None \
             else None
+        observe = self.observe
+        # adapter_dispatch accumulates ONLY handle_report wall time
+        # (the documented stage semantics): host instance builds for
+        # unlowerable instances run in this loop too and must not be
+        # blamed on exporters — that ambiguity is what the
+        # per-exporter accounting exists to remove
+        adapter_s = 0.0
+        tmpl_records: dict[str, int] = {}
         for b, (bag, rule_idxs) in enumerate(zip(bags, actives)):
             for ridx in rule_idxs:
                 for hc, template, inst_names in self.snapshot.actions_for(
@@ -761,12 +770,37 @@ class Dispatcher:
                             monitor.DISPATCH_ERRORS.inc()
                             log.warning("instance %s: %s", iname, exc)
                     if instances:
+                        t_h = time.perf_counter()
+                        failed = False
                         with monitor.dispatch_timer():
                             try:
                                 handler.handle_report(template, instances)
                             except Exception:
+                                failed = True
                                 monitor.DISPATCH_ERRORS.inc()
                                 log.exception("adapter report failed")
+                        adapter_s += time.perf_counter() - t_h
+                        if observe:
+                            # per-exporter delivery/drop/lag gauges
+                            # (adapter-export backpressure accounting
+                            # — a slow or throwing exporter must be
+                            # attributable from /debug/report)
+                            monitor.note_adapter_export(
+                                _qualify(hc.name, hc.namespace),
+                                template, len(instances),
+                                time.perf_counter() - t_h,
+                                error=failed)
+                            if not failed:
+                                tmpl_records[template] = \
+                                    tmpl_records.get(template, 0) + \
+                                    len(instances)
+        if observe:
+            if adapter_s > 0 or tmpl_records:
+                monitor.observe_report_stage("adapter_dispatch",
+                                             adapter_s)
+            for template, n in tmpl_records.items():
+                monitor.REPORT_TEMPLATE_RECORDS.inc(n,
+                                                    template=template)
 
     def _report_active_fused(self, bags: Sequence[Bag]
                              ) -> tuple[list[list[int]], Any]:
@@ -800,16 +834,28 @@ class Dispatcher:
         rcols = None
         cap = self.buckets[-1] if self.buckets else len(bags) or 1
         out: list[list[int]] = []
+        observe = self.observe
         for lo in range(0, len(bags), cap):
             chunk = bags[lo:lo + cap]
             padded = pad_to_bucket(chunk, self.buckets) \
                 if self.buckets else chunk
             with monitor.resolve_timer():
+                t_tz = time.perf_counter()
                 batch, ns_ids = self._tensorize_for_device(padded)
+                t_dev = time.perf_counter()
                 packed = plan.packed_report(batch, ns_ids) \
                     if rl is not None \
                     else plan.packed_check(batch, ns_ids,
                                            observe=False)
+                t_done = time.perf_counter()
+                if observe:
+                    # report-pipeline stages, per chunk (the report
+                    # analog of tensorize/h2d+device_step — the
+                    # packed_report call carries dispatch AND pull)
+                    monitor.observe_report_stage("tensorize",
+                                                 t_dev - t_tz)
+                    monitor.observe_report_stage("device_field_eval",
+                                                 t_done - t_dev)
             active_sub, col_pos = self._overlay_active(
                 packed, chunk,
                 np.asarray(ns_ids)[:len(chunk)])  # hotpath: sync-ok (host ids)
@@ -817,6 +863,7 @@ class Dispatcher:
                 rcols = [(ridx, col_pos[ridx])
                          for ridx in sorted(plan.report_rules)
                          if ridx in col_pos]
+            t_dec = time.perf_counter()
             if fctx is not None:
                 # skip the unique-id decode for chunks with no active
                 # report rule anywhere — their planes are never read
@@ -824,6 +871,10 @@ class Dispatcher:
                     active_sub[:, [p for _, p in rcols]].any())
                 fctx.add_chunk(packed, base, len(chunk), batch,
                                decode=any_active)
+                if observe:
+                    monitor.observe_report_stage(
+                        "intern_decode",
+                        time.perf_counter() - t_dec)
             out.extend(
                 [ridx for ridx, pos in rcols if active_sub[b, pos]]
                 for b in range(len(chunk)))

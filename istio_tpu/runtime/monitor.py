@@ -12,7 +12,9 @@ against the 1ms target. The introspect server
 (istio_tpu/introspect/) merges both into one /metrics exposition."""
 from __future__ import annotations
 
+import collections
 import contextlib
+import threading
 import time
 
 import prometheus_client
@@ -292,6 +294,264 @@ def serving_counters() -> dict:
         "report_batches_formed": int(
             REPORT_BATCH_SIZE._buckets and sum(
                 int(b.get()) for b in REPORT_BATCH_SIZE._buckets)),
+    }
+
+
+# -- telemetry ingestion plane (the REPORT half of Mixer's API) -------
+#
+# Stage semantics, mirroring the six-stage Check() decomposition above
+# (one observation per unit of pipeline work; counts differ by design —
+# wire_decode is per-RPC, coalesce_wait/tensorize/device_field_eval/
+# intern_decode per coalesced batch/chunk, adapter_dispatch per
+# dispatched batch):
+#   wire_decode       — ReportRequest parse + per-record delta decode
+#                       into bags (front side, per RPC)
+#   coalesce_wait     — oldest record's enqueue -> batch start in the
+#                       cross-RPC record coalescer (the report batcher)
+#   tensorize         — record bags -> AttributeBatch (+ns ids)
+#   device_field_eval — the packed_report device trip (rule resolve +
+#                       every instance-field expression for every
+#                       record in one pull)
+#   intern_decode     — pulled id planes -> Python values (one
+#                       unique-id pass per chunk) + seal
+#   adapter_dispatch  — host adapter fan-out (handle_report calls)
+REPORT_STAGES = ("wire_decode", "coalesce_wait", "tensorize",
+                 "device_field_eval", "intern_decode",
+                 "adapter_dispatch")
+
+REPORT_STAGE_SECONDS = hostmetrics.default_registry.histogram(
+    "mixer_report_stage_seconds",
+    "per-unit report ingestion stage latency (label: stage; see "
+    "runtime/monitor.py REPORT_STAGES for unit semantics)")
+
+# Record conservation (the ingestion plane's correctness invariant):
+# every record entering the plane ends in EXACTLY one of exported /
+# rejected, so accepted == exported + rejected holds at quiescence and
+# in_flight = accepted - exported - rejected is never negative.
+# Unlabeled counters expose at zero from the first scrape; the labeled
+# rejection family pre-touches its reasons below.
+REPORT_REJECT_REASONS = ("queue_full", "unavailable", "deadline",
+                         "error")
+REPORT_REQUESTS = prometheus_client.Counter(
+    "mixer_grpc_report_requests", "Report RPCs decoded (all fronts)",
+    registry=REGISTRY)
+REPORT_RESPONSES = prometheus_client.Counter(
+    "mixer_grpc_report_responses",
+    "Report responses sent (all fronts)", registry=REGISTRY)
+REPORT_RECORDS_ACCEPTED = prometheus_client.Counter(
+    "mixer_report_records_accepted_total",
+    "report records entering the ingestion plane (pre-admission; "
+    "conservation: accepted == exported + rejected at quiescence)",
+    registry=REGISTRY)
+REPORT_RECORDS_EXPORTED = prometheus_client.Counter(
+    "mixer_report_records_exported_total",
+    "report records whose batch completed adapter dispatch",
+    registry=REGISTRY)
+REPORT_RECORDS_REJECTED = prometheus_client.Counter(
+    "mixer_report_records_rejected_total",
+    "report records resolved with a typed rejection, by reason "
+    "(queue_full=RESOURCE_EXHAUSTED shed, unavailable=draining/dead "
+    "coalescer, deadline, error=batch failure)", ["reason"],
+    registry=REGISTRY)
+for _r in REPORT_REJECT_REASONS:
+    REPORT_RECORDS_REJECTED.labels(reason=_r)
+
+# per-template record counts (label appears on first dispatch; the
+# family itself zero-exposes via the homegrown registry's counter)
+REPORT_TEMPLATE_RECORDS = hostmetrics.default_registry.counter(
+    "mixer_report_template_records_total",
+    "report instances dispatched to adapters, by template")
+REPORT_TEMPLATE_RECORDS.inc(0)   # zero-series before the first record
+
+# adapter-export accounting, by exporter (qualified handler name):
+# records delivered, drops (handler exceptions — safeDispatch absorbs
+# them, this is their only trace besides the log), last dispatch wall
+# seconds. Queue depth for the plane is the coalescer's (the export
+# fan-out runs inside the report batch; /debug/report joins both).
+REPORT_EXPORTER_RECORDS = hostmetrics.default_registry.counter(
+    "mixer_report_exporter_records_total",
+    "report instances delivered per exporter (qualified handler name)")
+REPORT_EXPORTER_DROPS = hostmetrics.default_registry.counter(
+    "mixer_report_exporter_drops_total",
+    "report dispatches dropped by adapter exceptions, per exporter")
+REPORT_EXPORTER_LAG_MS = hostmetrics.default_registry.gauge(
+    "mixer_report_exporter_last_dispatch_ms",
+    "wall milliseconds of the exporter's most recent handle_report")
+REPORT_EXPORTER_RECORDS.inc(0)
+REPORT_EXPORTER_DROPS.inc(0)
+REPORT_EXPORTER_LAG_MS.set(0.0)
+
+# recent drop reasons (bounded; /debug/report's "what got rejected
+# lately" pane — a typed shed the client saw must be explainable from
+# the server side without log spelunking)
+_REPORT_DROPS: collections.deque = collections.deque(maxlen=32)
+_REPORT_DROPS_LOCK = threading.Lock()
+
+# per-exporter point-in-time stats for /debug/report (the counter
+# families above are the scrape surface; this dict carries the
+# JSON-able view: wall stamps don't belong in counters)
+_EXPORTER_STATS: dict = {}
+
+
+def observe_report_stage(stage: str, seconds: float) -> None:
+    REPORT_STAGE_SECONDS.observe(seconds, stage=stage)
+
+
+def report_accepted(n: int = 1) -> None:
+    REPORT_RECORDS_ACCEPTED.inc(n)
+
+
+def report_exported(n: int = 1) -> None:
+    REPORT_RECORDS_EXPORTED.inc(n)
+
+
+def report_rejected(n: int, reason: str, detail: str = "") -> None:
+    if reason not in REPORT_REJECT_REASONS:
+        reason = "error"
+    REPORT_RECORDS_REJECTED.labels(reason=reason).inc(n)
+    with _REPORT_DROPS_LOCK:
+        _REPORT_DROPS.append({
+            "wall": time.time(), "reason": reason,
+            "records": int(n), "detail": detail[:200]})
+
+
+def report_record_done(fut) -> None:
+    """Single accounting home for coalesced report records: attached
+    as a done-callback to every future the report coalescer returns,
+    so every accepted record is counted exported or typed-rejected
+    EXACTLY once — the conservation invariant is enforced where
+    futures resolve, not re-derived per code path."""
+    from istio_tpu.runtime import resilience
+
+    try:
+        exc = fut.exception()
+    except BaseException as cancel:   # cancelled futures carry no exc
+        report_rejected(1, "error",
+                        f"cancelled: {type(cancel).__name__}")
+        return
+    if exc is None:
+        report_exported(1)
+    elif isinstance(exc, resilience.ResourceExhaustedError):
+        report_rejected(1, "queue_full", str(exc))
+    elif isinstance(exc, resilience.DeadlineExceededError):
+        report_rejected(1, "deadline", str(exc))
+    elif isinstance(exc, resilience.UnavailableError):
+        report_rejected(1, "unavailable", str(exc))
+    else:
+        report_rejected(1, "error",
+                        f"{type(exc).__name__}: {exc}")
+
+
+def note_adapter_export(exporter: str, template: str, n_records: int,
+                        seconds: float, error: bool = False) -> None:
+    """One adapter handle_report outcome (dispatcher.report)."""
+    if error:
+        REPORT_EXPORTER_DROPS.inc(1, exporter=exporter)
+    else:
+        REPORT_EXPORTER_RECORDS.inc(n_records, exporter=exporter)
+    REPORT_EXPORTER_LAG_MS.set(seconds * 1e3, exporter=exporter)
+    with _REPORT_DROPS_LOCK:
+        st = _EXPORTER_STATS.setdefault(exporter, {
+            "records": 0, "drops": 0, "last_dispatch_ms": 0.0,
+            "last_wall": 0.0, "templates": {}})
+        if error:
+            st["drops"] += 1
+        else:
+            st["records"] += n_records
+            st["templates"][template] = \
+                st["templates"].get(template, 0) + n_records
+        st["last_dispatch_ms"] = round(seconds * 1e3, 3)
+        st["last_wall"] = time.time()
+
+
+def report_conservation(since: dict | None = None) -> dict:
+    """The invariant, readable: accepted == exported + rejected at
+    quiescence; in_flight is the (transient) difference. `exact` is
+    True only when the plane is fully drained — the form the smoke
+    gate and shutdown assertions check. `since`: a previous
+    report_conservation() reading — the counters are process-lifetime
+    cumulative, so per-scenario checks (bench phases, tests sharing a
+    process) must delta against their own baseline."""
+    accepted = int(REPORT_RECORDS_ACCEPTED._value.get())
+    exported = int(REPORT_RECORDS_EXPORTED._value.get())
+    rejected = {r: int(REPORT_RECORDS_REJECTED.labels(
+        reason=r)._value.get()) for r in REPORT_REJECT_REASONS}
+    if since is not None:
+        accepted -= since.get("accepted", 0)
+        exported -= since.get("exported", 0)
+        base_rej = since.get("rejected", {})
+        rejected = {r: v - base_rej.get(r, 0)
+                    for r, v in rejected.items()}
+    rej_total = sum(rejected.values())
+    return {
+        "accepted": accepted,
+        "exported": exported,
+        "rejected": rejected,
+        "rejected_total": rej_total,
+        "in_flight": accepted - exported - rej_total,
+        "exact": accepted == exported + rej_total,
+    }
+
+
+def report_stage_baseline() -> dict:
+    """Subtraction token for report_latency_snapshot(since=...) — same
+    delta-window discipline as stage_baseline()."""
+    return {stage: REPORT_STAGE_SECONDS.state(stage=stage)
+            for stage in REPORT_STAGES}
+
+
+def report_latency_snapshot(since: dict | None = None) -> dict:
+    """Six-stage report pipeline decomposition (p50/p95/p99 per stage)
+    as one JSON-able dict — what /debug/report serves and bench.py
+    scrapes into the BENCH artifact per served scenario."""
+    from istio_tpu.utils.metrics import quantile_from_counts
+
+    empty = ([], 0.0, 0)
+    stages: dict[str, dict] = {}
+    h = REPORT_STAGE_SECONDS
+    for stage in REPORT_STAGES:
+        counts, total, n = h.state(stage=stage)
+        if since is not None:
+            counts, total, n = _delta((counts, total, n),
+                                      since.get(stage, empty))
+        if not n:
+            continue
+        stages[stage] = {
+            "count": n,
+            "sum_ms": round(total * 1e3, 3),
+            "p50_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.5) * 1e3, 3),
+            "p95_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.95) * 1e3, 3),
+            "p99_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.99) * 1e3, 3),
+        }
+    return {"stages": stages}
+
+
+def report_counters() -> dict:
+    """Ingestion-plane snapshot for /debug/report and bench artifacts:
+    conservation + per-template record counts + per-exporter stats +
+    recent drop reasons. Always JSON-able; zero-shaped before the
+    first record (the view must serve on an idle server)."""
+    with _REPORT_DROPS_LOCK:
+        drops = list(_REPORT_DROPS)
+        exporters = {k: {**v, "templates": dict(v["templates"])}
+                     for k, v in _EXPORTER_STATS.items()}
+    templates = {}
+    with REPORT_TEMPLATE_RECORDS._lock:   # snapshot vs live inc()s
+        tmpl_values = dict(REPORT_TEMPLATE_RECORDS._values)
+    for labels, v in tmpl_values.items():
+        name = dict(labels).get("template")
+        if name:
+            templates[name] = int(v)
+    return {
+        "rpcs_decoded": int(REPORT_REQUESTS._value.get()),
+        "responses_sent": int(REPORT_RESPONSES._value.get()),
+        "conservation": report_conservation(),
+        "templates": templates,
+        "exporters": exporters,
+        "recent_drops": drops,
     }
 
 

@@ -43,6 +43,12 @@ class ServerArgs:
     # trips (see RuntimeServer.report); False dispatches each call's
     # records as their own batch
     report_batching: bool = True
+    # record coalescer admission bound: submits past it shed typed
+    # RESOURCE_EXHAUSTED (the ack-after-enqueue contract's overflow
+    # leg — the native front acks a Report once its records are
+    # ADMITTED, so admission must be bounded or memory isn't).
+    # None → 16×max_batch; 0 → unbounded.
+    report_queue_cap: int | None = None
     # allocate quota IN the check trip (FusedPlan.packed_check_instep)
     # instead of a separate pool-flush trip serialized behind it —
     # gated: only the native front's pump consumes it, and only for
@@ -213,6 +219,7 @@ class RuntimeServer:
         # so report trips are separately counted and the two queues
         # can't starve each other's windows.
         from istio_tpu.runtime import monitor as _monitor
+        rcap = self.args.report_queue_cap
         self._report_batcher = CheckBatcher(
             self._run_report_batch,
             window_s=self.args.batch_window_s,
@@ -225,8 +232,16 @@ class RuntimeServer:
             # allocate padding here just to trim it
             pad_batches=False,
             # report records must not feed the CHECK latency
-            # decomposition / live p99 window
-            observe_latency=False) \
+            # decomposition / live p99 window — they feed the report
+            # pipeline's own coalesce_wait stage instead
+            observe_latency=False,
+            stage_observer=lambda w: _monitor.observe_report_stage(
+                "coalesce_wait", w),
+            # bounded admission: the ack-after-enqueue contract needs
+            # a typed RESOURCE_EXHAUSTED at overflow, never unbounded
+            # memory behind an already-acked wire
+            max_queue=16 * self.args.max_batch if rcap is None
+            else rcap) \
             if self.args.report_batching else None
         # initial publish ran before this hook's dependencies existed;
         # warm the in-step quota program in the background like the
@@ -435,13 +450,58 @@ class RuntimeServer:
         Report RPCs form one bucket-sized packed pull instead of N
         separate trips — on a trip-serialized transport
         records/s = trips/s × batch size. The aio front awaits the
-        futures so an in-flight Report holds no thread."""
+        futures so an in-flight Report holds no thread; the native
+        front acks after ENQUEUE (inspecting only already-rejected
+        futures) so its pump never waits out a device trip.
+
+        Record conservation: every record is counted ACCEPTED here and
+        counted exported or typed-rejected exactly once when its
+        future resolves (monitor.report_record_done) — the batcher's
+        lifecycle guarantees (watchdog, drain-on-close, typed
+        admission sheds) mean no future is ever abandoned, so
+        accepted == exported + rejected holds at quiescence."""
+        from istio_tpu.runtime import monitor as _monitor
+
         bags = [self.preprocess(b) for b in bags]
         rb = self._report_batcher
         if rb is None:
-            self.controller.dispatcher.report(bags)
+            # inline dispatch (report_batching=False): same
+            # conservation accounting, no coalescer
+            _monitor.report_accepted(len(bags))
+            try:
+                self.controller.dispatcher.report(bags)
+            except Exception as exc:
+                _monitor.report_rejected(
+                    len(bags), "error",
+                    f"{type(exc).__name__}: {exc}")
+                raise
+            _monitor.report_exported(len(bags))
             return []
-        return [rb.submit(b) for b in bags]
+        from concurrent.futures import Future
+
+        from istio_tpu.runtime.resilience import (CheckRejected,
+                                                  UnavailableError)
+        futs = []
+        for b in bags:
+            _monitor.report_accepted(1)
+            try:
+                fut = rb.submit(b)
+            except Exception as exc:
+                # a CLOSED coalescer (post-shutdown submit) raises —
+                # convert to a typed-rejected future so the record
+                # stays on the conservation ledger (an accepted count
+                # with no resolving future would leak in_flight
+                # forever) and fronts answer UNAVAILABLE, not a stack
+                # trace
+                fut = Future()
+                fut.set_exception(
+                    exc if isinstance(exc, CheckRejected) else
+                    UnavailableError(
+                        f"report coalescer closed: "
+                        f"{type(exc).__name__}: {exc}"))
+            fut.add_done_callback(_monitor.report_record_done)
+            futs.append(fut)
+        return futs
 
     def report(self, bags: Sequence[Bag]) -> None:
         """Blocking report: returns after EVERY record's batch
@@ -716,6 +776,22 @@ class RuntimeServer:
         self.batcher.close()
         if self._report_batcher is not None:
             self._report_batcher.close()
+            # record conservation at quiescence (the ingestion plane's
+            # invariant): every record this process ever accepted must
+            # by now be exported or typed-rejected — close() resolves
+            # every leftover future. Non-zero in_flight here is a
+            # silently-dropped record: log it loudly (counters are
+            # process-global, so another still-serving RuntimeServer
+            # in this process can legitimately hold records — only a
+            # negative/positive residue with no other server is a bug;
+            # the smoke gate asserts the exact form per scenario).
+            from istio_tpu.runtime import monitor as _monitor
+            cons = _monitor.report_conservation()
+            if not cons["exact"]:
+                import logging
+                logging.getLogger("istio_tpu.runtime.server").warning(
+                    "report record conservation residue at shutdown: "
+                    "%s", cons)
         if self._rulestats_drainer is not None:
             self._rulestats_drainer.close()
             try:   # flush whatever the last interval left on device

@@ -101,12 +101,14 @@ def make_report_payloads(dicts: Sequence[Mapping[str, Any]],
 
 def run_h2load(port: int, payloads: Sequence[bytes], n_record: int,
                depth: int, warmup_s: float,
-               timeout_s: float = 300.0) -> dict:
+               timeout_s: float = 300.0,
+               method: str = "/istio.mixer.v1.Mixer/Check") -> dict:
     """Drive the native front-end (native/httpd.cpp) with the C++
     closed-loop client (native/h2load.cpp) — the wire-speed
     counterpart of run_load for servers whose transport is not bounded
     by the python grpc stack. Payloads are serialized CheckRequests
-    (make_check_payloads); returns h2load's JSON report dict."""
+    (make_check_payloads) or, with method=.../Report, ReportRequests
+    (make_report_payloads); returns h2load's JSON report dict."""
     import json
     import struct
     import subprocess
@@ -121,7 +123,7 @@ def run_h2load(port: int, payloads: Sequence[bytes], n_record: int,
     try:
         out = subprocess.run(
             [ensure_h2load_built(), str(port), path, str(n_record),
-             str(depth), str(warmup_s)],
+             str(depth), str(warmup_s), method],
             capture_output=True, text=True, timeout=timeout_s)
         if out.returncode != 0:
             raise PerfError(f"h2load rc={out.returncode}: "

@@ -101,6 +101,13 @@ def make_engine(n_rules: int = 1024,
                         quotas=quotas, jit=jit)
 
 
+def _overlay_list_provider() -> list[str]:
+    """Provider seam for the overlay workload's refreshed list (the
+    reference's URL-fetch role; module-level named function so stores
+    built in child processes resolve it by reference)."""
+    return [f"ns{j}" for j in range(0, 23, 2)]
+
+
 def make_store(n_rules: int, n_services: int | None = None,
                with_regex: bool = True,
                host_overlay_every: int | None = None,
@@ -111,10 +118,17 @@ def make_store(n_rules: int, n_services: int | None = None,
     make_engine()'s fused-action mix. Rules live in their own
     namespaces (namespace targeting identical to make_rules).
 
-    `host_overlay_every`: every Nth rule additionally carries a
-    REGEX-entry list action the device cannot absorb — the
-    host-overlay-heavy shape (VERDICT r2 weak #4) whose per-request
-    python cost the overlay bench measures.
+    `host_overlay_every`: every Nth rule additionally carries work the
+    device GENUINELY cannot absorb — the host-overlay-heavy shape
+    (VERDICT r2 weak #4) whose per-request python cost the overlay
+    bench measures. r4's device lowering learned REGEX-entry lists and
+    silently emptied the old overlay workload (`overlay_rules: 0`);
+    the three shapes now cycle through the reference's genuinely
+    host-bound list semantics (mixer/adapter/list/list.go:115-247):
+    case-insensitive membership, provider-refreshed entries (the TTL
+    refresh loop — entries change between requests, so no compiled
+    bank can be current), and a dynamic `match(x, attr)` predicate
+    whose pattern is an attribute (no constant DFA exists).
 
     `seed` forwards to make_rules (explicit, reproducible constant
     variation; None = legacy fixed constants). Action wiring stays
@@ -162,11 +176,36 @@ def make_store(n_rules: int, n_services: int | None = None,
         "match": "",
         "actions": [{"handler": "prom", "instances": ["reqcount"]}]})
     if host_overlay_every:
-        # REGEX entry type keeps list.go's host semantics — the fused
-        # plan must overlay these rules per request (runtime/fused.py)
-        s.set(("handler", "istio-system", "rxpath"), {
+        # shape 1: CASE_INSENSITIVE_STRINGS membership — list.go's
+        # ToLower path; the device's one-hot banks are case-exact, so
+        # the fused plan must overlay these rules per request
+        # (runtime/fused._split_list_instances keeps them host-side)
+        s.set(("handler", "istio-system", "cilist"), {
             "adapter": "list",
-            "params": {"overrides": ["^/api/v[0-3]/"],
+            "params": {"overrides": [f"NS{j}" for j in range(0, 23, 2)],
+                       "entry_type": "CASE_INSENSITIVE_STRINGS",
+                       "blacklist": False}})
+        # shape 2: provider-refreshed entries (the reference's URL-
+        # fetch + TTL refresh loop, list.go:115-247) — entries can
+        # change between requests, so membership stays a host call
+        s.set(("handler", "istio-system", "provlist"), {
+            "adapter": "list",
+            "params": {"overrides": [],
+                       "provider": _overlay_list_provider,
+                       "refresh_interval_s": 3600.0,
+                       "blacklist": False}})
+        s.set(("instance", "istio-system", "nsinst"), {
+            "template": "listentry",
+            "params": {"value": "source.namespace"}})
+        # shape 3: REGEX entries OUTSIDE the DFA-compilable subset
+        # (a backreference — the dynamic per-entry match semantics
+        # list.go applies that no compiled bank can express); the
+        # plain dynamic match(x, attr) predicate form now lowers on
+        # device (tensor_expr._compile_dyn_byte_pred), so this is the
+        # remaining genuinely-dynamic pattern shape
+        s.set(("handler", "istio-system", "dynpat"), {
+            "adapter": "list",
+            "params": {"overrides": [r"^/api/(v[0-9])/\1/"],
                        "entry_type": "REGEX", "blacklist": True}})
         s.set(("instance", "istio-system", "pathinst"), {
             "template": "listentry",
@@ -181,8 +220,16 @@ def make_store(n_rules: int, n_services: int | None = None,
             actions.append({"handler": "nswhitelist.istio-system",
                             "instances": ["srcns.istio-system"]})
         if host_overlay_every and i % host_overlay_every == 2:
-            actions.append({"handler": "rxpath.istio-system",
-                            "instances": ["pathinst.istio-system"]})
+            k = (i // host_overlay_every) % 3
+            if k == 0:
+                actions.append({"handler": "cilist.istio-system",
+                                "instances": ["nsinst.istio-system"]})
+            elif k == 1:
+                actions.append({"handler": "provlist.istio-system",
+                                "instances": ["nsinst.istio-system"]})
+            else:
+                actions.append({"handler": "dynpat.istio-system",
+                                "instances": ["pathinst.istio-system"]})
         if not actions:   # every rule carries at least a no-op check
             actions.append({"handler": "denyall.istio-system",
                             "instances": []})

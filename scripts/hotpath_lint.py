@@ -57,6 +57,11 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
         "Dispatcher._ns_ids_from_batch",
         "Dispatcher._request_ns_ids",
         "Dispatcher._report_active_fused",
+        # the report coalescer's dispatch leg (the telemetry
+        # ingestion plane): runs on the report batcher's worker —
+        # adapter fan-out and stage accounting only; the designated
+        # device pulls live in _report_active_fused above
+        "Dispatcher.report",
         "Dispatcher._apply_device_status", "Dispatcher._combine",
     }),
     "istio_tpu/runtime/fused.py": frozenset({
@@ -66,6 +71,14 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
         # batch by Dispatcher._check_fused — host-numpy tier routing
         # only, same pragma discipline as narrow_batch
         "FusedPlan.swap_warm_pending", "FusedPlan._serve_width",
+    }),
+    # report ingestion entries (the telemetry ingestion plane):
+    # submit_report runs on pump/front threads (ack-after-enqueue —
+    # the admission path must never sync or block), and
+    # _run_report_batch is the coalescer worker's dispatch hook
+    "istio_tpu/runtime/server.py": frozenset({
+        "RuntimeServer.submit_report",
+        "RuntimeServer._run_report_batch",
     }),
     # quota-plane flush (PR 7): the classic worker's device trip now
     # builds its tick/last staging under _lock INSIDE the _counts_lock

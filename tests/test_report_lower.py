@@ -230,3 +230,93 @@ def test_absent_value_aborts_instance_like_host():
         lg_c = [i for i in cap.flat()
                 if i["name"] == "lg.istio-system"]
         assert len(lg_c) == 6, fused
+
+
+def _zero_map_store() -> MemStore:
+    """A metric with a ZERO-ENTRY dimensions map and a logentry with
+    an empty variables map: the host build materializes the empty
+    container ({}), and the device path must too (InstanceSpec
+    containers are created before fields for exactly this case)."""
+    s = MemStore()
+    s.set(("handler", "istio-system", "sink"), {
+        "adapter": "noop", "params": {}})
+    s.set(("instance", "istio-system", "zm"), {
+        "template": "metric",
+        "params": {"value": "response.size", "dimensions": {}}})
+    s.set(("instance", "istio-system", "zl"), {
+        "template": "logentry",
+        "params": {"severity": '"info"', "variables": {}}})
+    s.set(("rule", "istio-system", "tally"), {
+        "match": "",
+        "actions": [{"handler": "sink", "instances": ["zm", "zl"]}]})
+    return s
+
+
+def test_zero_entry_map_containers_parity():
+    """Zero-entry map containers appear as {} on BOTH paths — a
+    device-built instance omitting the empty map would diverge from
+    every adapter that reads instance['dimensions'] unconditionally."""
+    flats = {}
+    for fused in (True, False):
+        srv = RuntimeServer(_zero_map_store(),
+                            ServerArgs(fused=fused, max_batch=4,
+                                       buckets=(4,)))
+        try:
+            d = srv.controller.dispatcher
+            if fused:
+                rl = d.fused.report_lowering
+                assert rl is not None and "zm.istio-system" in rl.specs
+            cap = CaptureHandler()
+            d.handlers["sink.istio-system"] = cap
+            d.report([bag_from_mapping(
+                {"destination.service": "a.default.svc",
+                 "response.size": 7})])
+            flats[fused] = cap.flat()
+        finally:
+            srv.close()
+    assert flats[True] == flats[False]
+    zm = next(i for i in flats[True] if i["name"] == "zm.istio-system")
+    assert zm["dimensions"] == {}
+    zl = next(i for i in flats[True] if i["name"] == "zl.istio-system")
+    assert zl["variables"] == {}
+
+
+def test_seeded_instance_parity_property():
+    """Property-style sweep: seeded request mixes through the mixed
+    lowerable/unlowerable config must produce adapter instances
+    IDENTICAL (==, covering types and nesting) to the InstanceBuilder
+    host oracle — the satellite's fused-vs-host report parity gate."""
+    from istio_tpu.testing import workloads
+
+    t0 = datetime.datetime(2018, 3, 1, 12, 0, 0,
+                           tzinfo=datetime.timezone.utc)
+    for seed in (5, 11):
+        dicts = workloads.make_request_dicts(12, seed=seed)
+        for j, d in enumerate(dicts):
+            # the report attrs the _store() instances read; every 3rd
+            # row keeps response.size ABSENT (the metric value expr
+            # errors → EvalError row-abort parity is exercised)
+            if j % 3:
+                d["response.size"] = 100 + j
+            d["response.code"] = 200 if j % 2 else 404
+            d["request.time"] = t0
+            d["response.duration"] = datetime.timedelta(
+                milliseconds=j)
+            d["request.headers"] = {"path": f"/p{j}",
+                                    **({"host": f"h{j}.com"}
+                                       if j % 2 else {})}
+        bags = [bag_from_mapping(d) for d in dicts]
+        flats = {}
+        for fused in (True, False):
+            srv = RuntimeServer(_store(),
+                                ServerArgs(fused=fused, max_batch=8,
+                                           buckets=(8,)))
+            try:
+                d = srv.controller.dispatcher
+                cap = CaptureHandler()
+                d.handlers["sink.istio-system"] = cap
+                d.report(bags)
+                flats[fused] = cap.flat()
+            finally:
+                srv.close()
+        assert flats[True] == flats[False], f"seed {seed}"

@@ -1,0 +1,33 @@
+"""Tier-1 hook for scripts/report_smoke.py: the CI gate that the
+telemetry ingestion plane stays a measurement — Report served
+end-to-end over real HTTP (native wire when the toolchain builds,
+python gRPC otherwise) conserves records EXACTLY (accepted ==
+adapter-exported + typed-rejected), all six pipeline stage histograms
+record observations, /debug/report serves and agrees with the live
+counters, and a bounded coalescer under overflow sheds typed
+RESOURCE_EXHAUSTED at the wire without dropping a record silently.
+Runs main() in-process (the introspect_smoke pattern: a subprocess
+would pay a second jax import for no extra coverage; the script stays
+runnable standalone under JAX_PLATFORMS=cpu)."""
+import importlib.util
+import os
+import sys
+
+
+def _load():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "report_smoke.py")
+    spec = importlib.util.spec_from_file_location("report_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_report_smoke_main():
+    mod = _load()
+    try:
+        rc = mod.main(n_rules=10, n_rpcs=3, records_per_rpc=6)
+    finally:
+        sys.modules.pop("report_smoke", None)
+    assert rc == 0
