@@ -135,6 +135,7 @@ class IntrospectServer:
         "/debug/canary": "_h_canary",
         "/debug/roofline": "_h_roofline",
         "/debug/report": "_h_report",
+        "/debug/shards": "_h_shards",
     }
 
     @staticmethod
@@ -448,6 +449,69 @@ class IntrospectServer:
             payload["native_ref_cache"] = len(self.native._ref_cache)
         self._send_json(req, payload)
 
+    def _h_shards(self, req: BaseHTTPRequestHandler) -> None:
+        """Sharded serving plane view (istio_tpu/sharding): the last
+        shard-plan decision + balance, per-bank rule counts / resident
+        bank bytes / rows routed, per-replica lane queue depth and
+        batch-latency percentiles, and the router stage decomposition
+        (shard_dispatch / bank_check / fold). Zero-shaped before the
+        first routed batch per the promtext doctrine; {"enabled":
+        false} on a monolithic server."""
+        from istio_tpu.runtime import monitor
+
+        payload: dict[str, Any] = {"enabled": False}
+        rt = self.runtime
+        state = getattr(rt, "_sharded", None) if rt is not None \
+            else None
+        rr = getattr(rt, "_replica_router", None) if rt is not None \
+            else None
+        if state is None or rr is None:
+            self._send_json(req, payload)
+            return
+        plan = state["plan"]
+        payload = {
+            "enabled": True,
+            "mode": state.get("mode"),
+            "fallback_reason": state.get("fallback_reason") or None,
+            "revision": state.get("revision"),
+            "last_decision": {
+                **plan.to_json(),
+                "build_wall_ms": round(
+                    state.get("build_wall_s", 0.0) * 1e3, 3),
+                "built_wall": state.get("built_wall"),
+            },
+            "banks": [b.stats() for b in state.get("banks", ())],
+            "replicas": [],
+            "stages": monitor.shard_latency_snapshot()["stages"],
+        }
+        rep_lat = monitor.replica_snapshot()
+        routers = {r.replica: r for r in rr.routers}
+        for i, lane in enumerate(rr.lanes):
+            st = lane.stats()
+            entry = {
+                "replica": i,
+                "queue_depth": st["depth"],
+                "oldest_wait_ms": st["oldest_wait_ms"],
+                "in_flight": st["in_flight"],
+                "healthy": st["healthy"],
+                # zero-shaped latency block before the first batch
+                "batch_latency": rep_lat.get(str(i), {
+                    "batches": 0, "sum_ms": 0.0, "p50_ms": 0.0,
+                    "p95_ms": 0.0, "p99_ms": 0.0}),
+            }
+            r = routers.get(i)
+            if r is not None:
+                entry["router"] = r.stats()
+            payload["replicas"].append(entry)
+        # cross-lane routing aggregate (rows per shard / occupancy /
+        # misroutes): ReplicaRouter.routing_stats is the single home
+        # shared with the fleet bench and the shard smoke
+        routing = rr.routing_stats()
+        payload["rows_per_shard"] = routing["rows_per_shard"]
+        payload["occupancy"] = routing["occupancy"]
+        payload["misrouted"] = routing["misrouted"]
+        self._send_json(req, payload)
+
     def _h_resilience(self, req: BaseHTTPRequestHandler) -> None:
         """Overload-resilience view: breaker state machine, shed /
         expired / fallback counters, admission-control config and the
@@ -462,6 +526,22 @@ class IntrospectServer:
             res = getattr(self.runtime, "resilience", None)
             if res is not None:
                 payload.update(res.snapshot())
+            # sharded serving bypasses the monolithic checker: the
+            # page must say so and show the PER-BANK breakers that
+            # actually see traffic (detail in /debug/shards)
+            state = getattr(self.runtime, "_sharded", None)
+            if state is not None:
+                payload["sharded"] = {
+                    "note": "sharded serving: check traffic rides "
+                            "per-bank resilience (one breaker + "
+                            "oracle fallback per bank); the "
+                            "monolithic breaker above sees no "
+                            "check batches",
+                    "bank_breakers": {
+                        str(b.shard_id): b.checker.breaker.snapshot()
+                        for b in state.get("banks", ())
+                        if b.checker is not None},
+                }
             args = self.runtime.args
             payload["policy"] = {
                 "default_check_deadline_ms":

@@ -104,6 +104,12 @@ class Snapshot:
     n_config_rules: int = 0
     rbac_groups: dict[tuple[str, str], RbacGroup] = \
         dataclasses.field(default_factory=dict)
+    # the exact compile_ruleset kwargs this snapshot's ruleset was
+    # built with (extra derived/byte/extern sources, max_str_len,
+    # rule_pad) — the sharding plane recompiles rule SUBSETS
+    # (istio_tpu/sharding/banks.py) and must reproduce the layout
+    # inputs, or a bank would miss a column its instances read
+    compile_kwargs: dict = dataclasses.field(default_factory=dict)
 
     def rule_index(self, name: str, namespace: str) -> int:
         for i, r in enumerate(self.rules):
@@ -418,7 +424,8 @@ class SnapshotBuilder:
                                               self.interner),
                         roles=roles, bindings=bindings, errors=errors,
                         n_config_rules=n_config_rules,
-                        rbac_groups=rbac_groups)
+                        rbac_groups=rbac_groups,
+                        compile_kwargs=dict(kwargs))
 
     @staticmethod
     def _lower_rbac_groups(rules, handlers, instances,

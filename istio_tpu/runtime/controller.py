@@ -57,7 +57,8 @@ class Controller:
                  canary=None,
                  on_canary_reject: Callable[..., None] | None = None,
                  initial_prewarm: bool = True,
-                 prewarm_hook: Callable[..., None] | None = None):
+                 prewarm_hook: Callable[..., None] | None = None,
+                 warm_parent_plans: bool = True):
         self.store = store
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
@@ -81,6 +82,14 @@ class Controller:
         # exit aborts the interpreter); config-SWAP prewarms are
         # synchronous and unaffected
         self.initial_prewarm = initial_prewarm
+        # False when a sharded serving plane owns the check path
+        # (istio_tpu/sharding): the parent monolithic plan is then a
+        # metadata/oracle surface only — warming its bucket × tier
+        # device programs would compile XLA programs serving never
+        # runs (at 100k+ rules, the compile the sharding plane exists
+        # to avoid). The RuntimeServer warms the shard BANKS instead,
+        # inside its own publish hook.
+        self.warm_parent_plans = warm_parent_plans
         # called with the candidate plan next to plan.prewarm (config
         # SWAPS only, pre-swap, rebuild thread): the owner warms extra
         # per-plan programs (e.g. the in-step quota step) while the
@@ -148,7 +157,8 @@ class Controller:
             from istio_tpu.runtime.fused import build_fused_plan
             plan = build_fused_plan(snapshot, mesh=self.mesh,
                                     rule_telemetry=self.rule_telemetry)
-            if plan is not None and self.prewarm_buckets:
+            if plan is not None and self.prewarm_buckets \
+                    and self.warm_parent_plans:
                 if self._dispatcher is not None:
                     # shadow-compile BEFORE the swap (SURVEY hard-part
                     # #5: a config change must never surface trace

@@ -555,6 +555,105 @@ def report_counters() -> dict:
     }
 
 
+# -- sharded serving plane (istio_tpu/sharding) ----------------------
+#
+# Stage semantics (one observation per unit of router work;
+# bank_check is per (batch, bank) so a batch spanning B banks
+# contributes B observations — the device-trip fan-out IS the cost
+# being attributed):
+#   shard_dispatch — namespace extraction + row bucketing, per batch
+#   bank_check     — one bank's full fused check on its sub-batch
+#                    (tensorize → device trip → overlay, the existing
+#                    CHECK stages decompose it further)
+#   fold           — response scatter back into row order + bank-local
+#                    → global deny-index remap, per batch
+SHARD_STAGES = ("shard_dispatch", "bank_check", "fold")
+
+SHARD_STAGE_SECONDS = hostmetrics.default_registry.histogram(
+    "mixer_shard_stage_seconds",
+    "per-batch sharded-serving stage latency (label: stage; see "
+    "runtime/monitor.py SHARD_STAGES for unit semantics)")
+REPLICA_BATCH_SECONDS = hostmetrics.default_registry.histogram(
+    "mixer_replica_batch_seconds",
+    "per-replica served batch wall seconds (label: replica)")
+REPLICA_ROWS = hostmetrics.default_registry.counter(
+    "mixer_replica_rows_total",
+    "check rows served per replica lane (label: replica)")
+REPLICA_ROWS.inc(0)   # zero-series before the first routed batch
+
+
+def observe_shard_stage(stage: str, seconds: float) -> None:
+    SHARD_STAGE_SECONDS.observe(seconds, stage=stage)
+
+
+def observe_replica_batch(replica: int, seconds: float,
+                          rows: int) -> None:
+    REPLICA_BATCH_SECONDS.observe(seconds, replica=str(replica))
+    REPLICA_ROWS.inc(rows, replica=str(replica))
+
+
+def shard_stage_baseline() -> dict:
+    """Subtraction token for shard_latency_snapshot(since=...) — the
+    same delta-window discipline as stage_baseline() (the fleet bench
+    reads per-scenario stage attribution, not process-lifetime)."""
+    return {stage: SHARD_STAGE_SECONDS.state(stage=stage)
+            for stage in SHARD_STAGES}
+
+
+def shard_latency_snapshot(since: dict | None = None) -> dict:
+    """Sharded-path stage decomposition (count/sum/p50/p99 per stage)
+    as one JSON-able dict — /debug/shards' `stages` pane and the fleet
+    bench's per-stage attribution."""
+    from istio_tpu.utils.metrics import quantile_from_counts
+
+    empty = ([], 0.0, 0)
+    stages: dict[str, dict] = {}
+    h = SHARD_STAGE_SECONDS
+    for stage in SHARD_STAGES:
+        counts, total, n = h.state(stage=stage)
+        if since is not None:
+            counts, total, n = _delta((counts, total, n),
+                                      since.get(stage, empty))
+        if not n:
+            continue
+        stages[stage] = {
+            "count": n,
+            "sum_ms": round(total * 1e3, 3),
+            "p50_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.5) * 1e3, 3),
+            "p99_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.99) * 1e3, 3),
+        }
+    return {"stages": stages}
+
+
+def replica_snapshot() -> dict:
+    """Per-replica batch latency + row counts for /debug/shards —
+    zero-shaped ({} lanes) before the first routed batch."""
+    from istio_tpu.utils.metrics import quantile_from_counts
+
+    out: dict[str, dict] = {}
+    h = REPLICA_BATCH_SECONDS
+    for lab in h.label_sets():
+        rep = lab.get("replica")
+        if rep is None:
+            continue
+        counts, total, n = h.state(replica=rep)
+        if not n:
+            continue
+        out[rep] = {
+            "batches": n,
+            "sum_ms": round(total * 1e3, 3),
+            "p50_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.5) * 1e3, 3),
+            "p95_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.95) * 1e3, 3),
+            "p99_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.99) * 1e3, 3),
+        }
+    return out
+
+
 @contextlib.contextmanager
 def resolve_timer():
     RESOLVE_COUNT.inc()

@@ -337,6 +337,12 @@ class RuleStatsAggregator:
         # swapped-out plans still being swept: (plan, their names,
         # drop-after timestamp) — see attach()
         self._retired: list[tuple] = []
+        # sharded serving lanes (istio_tpu/sharding): bank dispatchers'
+        # plans swept on every drain alongside the main plan — their
+        # per-rule counts merge into the same name-keyed cumulative
+        # stats (bank rule names ARE the global qualified names). See
+        # attach_lanes(). Entries: (plan, names, slot_names).
+        self._lanes: list[tuple] = []
 
     # -- wiring --
 
@@ -385,6 +391,50 @@ class RuleStatsAggregator:
                     name, {"hits": 0, "denies": 0, "errors": 0,
                            "ns": {}})
 
+    def attach_lanes(self, dispatchers) -> None:
+        """Bind the sharded plane's bank dispatchers as additional
+        drain sources (config swaps call this right after the lane
+        publish). The PREVIOUS lane set is retired for continued
+        sweeping exactly like attach()'s old plan — a batch in flight
+        on an old bank can fold after the rebind, and a swap must
+        never drop counts. The main attached plan is skipped if it
+        also appears as a lane (replica-only mode's lane 0 rides the
+        published dispatcher)."""
+        lanes: list[tuple] = []
+        seen: set[int] = set()
+        with self._lock:
+            main = self._plan
+        for d in dispatchers:
+            plan = getattr(d, "fused", None)
+            if plan is None or plan is main or id(plan) in seen:
+                continue
+            if getattr(plan, "telemetry", None) is None:
+                continue
+            seen.add(id(plan))
+            snap = d.snapshot
+            qn = getattr(snap, "qualified_rule_names", None)
+            names = list(qn()) if qn is not None else []
+            rs = snap.ruleset
+            by_id = {v: k for k, v in rs.ns_ids.items()}
+            n_slots = len(rs.ns_ids) + 1
+            slot_names = [by_id.get(i, f"ns#{i}") or "(default)"
+                          for i in range(n_slots - 1)] + ["(unknown)"]
+            lanes.append((plan, names, slot_names))
+        with self._lock:
+            for _plan, names, _slots in lanes:
+                for name in names:
+                    self._cum.setdefault(
+                        name, {"hits": 0, "denies": 0, "errors": 0,
+                               "ns": {}})
+            old = self._lanes
+            self._lanes = lanes
+            deadline = time.time() + self.RETIRE_SWEEP_S
+            live = {id(p) for p, _, _ in lanes}
+            for plan, names, slots in old:
+                if id(plan) not in live:
+                    self._retired.append((plan, names, slots,
+                                          deadline))
+
     def add_exporter(self, handler, template: str = "metric") -> None:
         """Register an adapter handler (prometheus/statsd/stdio/...)
         to receive Report-style metric instances on every drain."""
@@ -417,6 +467,7 @@ class RuleStatsAggregator:
             now = time.time()
             retired = list(self._retired)
             self._retired = [r for r in self._retired if r[3] > now]
+            lanes = list(self._lanes)
         instances: list[dict] = []
         for rplan, rnames, rslots, _deadline in retired:
             rtele = getattr(rplan, "telemetry", None)
@@ -438,7 +489,20 @@ class RuleStatsAggregator:
                 self.last_generation = d["generation"]
                 self.drains += 1
                 self.last_drain_wall_s = d["wall_s"]
-        if d is None and not retired:
+        # sharded serving lanes: every bank's accumulators drain into
+        # the same name-keyed stats (bank names are global qualified
+        # names, so counts from different banks never collide — each
+        # rule lives in exactly one bank per generation, global rules
+        # in every bank but each request served by exactly one)
+        for lplan, lnames, lslots in lanes:
+            ltele = getattr(lplan, "telemetry", None)
+            if ltele is None:
+                continue
+            try:
+                instances += self._fold(ltele.drain(), lnames, lslots)
+            except Exception:
+                log.exception("lane-plan drain failed")
+        if d is None and not retired and not lanes:
             return None
         with self._lock:
             exporters = list(self._exporters)

@@ -1,0 +1,192 @@
+"""Shard banks — each shard compiled into a full serving stack.
+
+A bank is NOT a thinner code path: it is a sub-Snapshot (the shard's
+rules + every replicated global rule, sharing the parent's finder,
+handlers, instances and InternTable) compiled through the SAME
+pipeline the monolithic path uses — compile_ruleset for the predicate
+program, build_fused_plan for the device engine (deny/list fusion,
+host-overlay map, per-rule telemetry, canary recorder tap), a real
+Dispatcher on top. Everything the serving plane learned in PRs 1-8
+(stage decomposition, referenced-attribute bitmaps, oracle fallback,
+quota activity bits) works per bank for free, and the oracle-parity
+story reduces to the per-bank conformance the compiler tests already
+pin.
+
+Host-overlay rules are pinned to their home shard by construction
+(assignment is by namespace; a rule's host actions and host-fallback
+oracle program recompile inside its own bank). Quota rules route
+correctly across banks because quota STATE never lives in a bank:
+device quota pools are controller-owned, keyed by handler name, and
+the bank's check response carries (bank dispatcher, bank-local active
+quota rules) as its quota_context — exactly the contract
+RuntimeServer.quota_fused already honors, so a global quota rule
+replicated into every bank still allocates once per request from the
+one shared pool. The in-step quota merge is the one quota shape that
+CANNOT cross banks (one merged device program per pool) — the server
+refuses it under sharding (instep_quota_target → None).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+import numpy as np
+
+from istio_tpu.compiler.layout import Tensorizer
+from istio_tpu.compiler.ruleset import compile_ruleset
+from istio_tpu.runtime.config import Snapshot
+from istio_tpu.runtime.dispatcher import Dispatcher
+from istio_tpu.sharding.planner import ShardPlan
+from istio_tpu.utils.log import scope
+
+log = scope("sharding.banks")
+
+
+class ShardingUnsupported(RuntimeError):
+    """The snapshot cannot shard (e.g. device-lowered rbac pseudo-rule
+    rows reference absolute ruleset positions) — the server falls back
+    to monolithic serving and says why."""
+
+
+@dataclasses.dataclass
+class ShardBank:
+    """One compiled shard: sub-snapshot + dispatcher + index map."""
+    shard_id: int
+    snapshot: Snapshot
+    dispatcher: Dispatcher
+    # bank-local rule index → parent (global) config rule index; the
+    # router's fold remaps deny attribution through this
+    local_to_global: np.ndarray
+    predicted_cost: float = 0.0
+    # per-bank ResilientChecker (runtime/resilience.py): each bank is
+    # its own device lease, so it carries its OWN circuit breaker +
+    # CPU-oracle fallback over the bank's rules — a flapping bank
+    # degrades to its oracle without touching its siblings. Wired by
+    # RuntimeServer._rebuild_sharded (it owns the ResilienceConfig);
+    # None = raw dispatcher.check (tests driving banks directly).
+    checker: Any = None
+
+    def check(self, bags) -> list:
+        """The router's per-bank entry: resilient when wired."""
+        if self.checker is not None:
+            return list(self.checker.run_batch(bags))
+        return self.dispatcher.check(bags)
+
+    @property
+    def n_rules(self) -> int:
+        return len(self.snapshot.rules)
+
+    def bank_bytes(self) -> int:
+        """Resident device bytes of the bank's compiled programs
+        (ruleset index tensors + engine adapter banks) — the
+        /debug/shards `bank_bytes` column."""
+        total = 0
+        plan = self.dispatcher.fused
+        params: Mapping[str, Any] = plan.engine.params \
+            if plan is not None else self.snapshot.ruleset.params
+        for v in params.values():
+            total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+
+    def stats(self) -> dict:
+        out = {
+            "shard": self.shard_id,
+            "rules": self.n_rules,
+            "host_overlay_rules":
+                len(self.dispatcher.fused.host_rule_idx)
+                if self.dispatcher.fused is not None else 0,
+            "bank_bytes": self.bank_bytes(),
+            "predicted_cost": round(self.predicted_cost, 1),
+        }
+        if self.checker is not None:
+            out["breaker"] = self.checker.breaker.state
+        return out
+
+
+def shard_snapshot(parent: Snapshot, plan: ShardPlan,
+                   k: int) -> tuple[Snapshot, np.ndarray]:
+    """Compile shard k's sub-Snapshot → (snapshot, local_to_global).
+
+    Shares the parent's finder/handlers/instances and — critically —
+    its InternTable, so every bank agrees on constant ids and a bag
+    tensorizes identically no matter which bank serves it. The rule
+    list keeps ascending global order, so lowest-rule-index-wins
+    status combining inside a bank equals the monolithic order
+    restricted to the request's visible set."""
+    if parent.n_config_rules != len(parent.ruleset.rules):
+        raise ShardingUnsupported(
+            "snapshot carries synthesized pseudo-rule rows (device-"
+            "lowered rbac) that reference absolute ruleset positions; "
+            "sharding such a snapshot would renumber them — serve it "
+            "monolithically")
+    idxs = plan.shard_rules[k]
+    preds = [parent.ruleset.rules[i] for i in idxs]
+    rules = [parent.rules[i] for i in idxs]
+    interner = parent.ruleset.interner
+    ruleset = compile_ruleset(preds, parent.finder, interner=interner,
+                              **parent.compile_kwargs)
+    sub = Snapshot(
+        revision=parent.revision, finder=parent.finder,
+        handlers=parent.handlers, instances=parent.instances,
+        instance_templates=parent.instance_templates,
+        rules=rules, ruleset=ruleset,
+        tensorizer=Tensorizer(ruleset.layout, interner),
+        roles=[], bindings=[], errors=[],
+        n_config_rules=len(rules), rbac_groups={},
+        compile_kwargs=dict(parent.compile_kwargs))
+    return sub, np.asarray(idxs, np.int64)
+
+
+def build_shard_banks(parent: Snapshot,
+                      handlers: Mapping[str, Any],
+                      plan: ShardPlan, *,
+                      identity_attr: str,
+                      buckets: Sequence[int] = (),
+                      rule_telemetry: bool = True,
+                      recorder: Any = None) -> list[ShardBank]:
+    """Compile every shard of `plan` into a ShardBank. Raises
+    ShardingUnsupported when the snapshot cannot shard; individual
+    bad rules never fail a bank (compile_ruleset demotes them to the
+    bank's host-fallback oracle, same as monolithic)."""
+    from istio_tpu.runtime.fused import build_fused_plan
+
+    banks: list[ShardBank] = []
+    for k in range(plan.n_shards):
+        sub, l2g = shard_snapshot(parent, plan, k)
+        fused = build_fused_plan(sub, rule_telemetry=rule_telemetry)
+        disp = Dispatcher(sub, handlers, identity_attr,
+                          fused=fused, buckets=tuple(buckets),
+                          recorder=recorder)
+        cost = float(plan.shard_cost[k]) if plan.shard_cost else 0.0
+        banks.append(ShardBank(shard_id=k, snapshot=sub,
+                               dispatcher=disp, local_to_global=l2g,
+                               predicted_cost=cost))
+    log.info("built %d shard banks (%s rules/bank, %d global rules "
+             "replicated)", len(banks),
+             "/".join(str(b.n_rules) for b in banks),
+             len(plan.global_rules))
+    return banks
+
+
+def full_bank(parent: Snapshot, handlers: Mapping[str, Any],
+              shard_id: int, *, identity_attr: str,
+              buckets: Sequence[int] = (),
+              rule_telemetry: bool = True,
+              recorder: Any = None,
+              dispatcher: Dispatcher | None = None) -> ShardBank:
+    """A bank over the WHOLE snapshot — the replica-only mode's lane
+    executor (each replica owns its own FusedPlan over the full rule
+    set). `dispatcher` reuses an existing one (lane 0 rides the
+    controller's published dispatcher; other lanes compile their own
+    plan so each owns its device lease)."""
+    from istio_tpu.runtime.fused import build_fused_plan
+
+    if dispatcher is None:
+        fused = build_fused_plan(parent,
+                                 rule_telemetry=rule_telemetry)
+        dispatcher = Dispatcher(parent, handlers, identity_attr,
+                                fused=fused, buckets=tuple(buckets),
+                                recorder=recorder)
+    return ShardBank(
+        shard_id=shard_id, snapshot=parent, dispatcher=dispatcher,
+        local_to_global=np.arange(len(parent.rules), dtype=np.int64))

@@ -104,6 +104,15 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
     "istio_tpu/canary/recorder.py": frozenset({
         "TrafficRecorder.tap",
     }),
+    # sharded serving plane (ISSUE 10): the shard router runs on every
+    # lane's step worker (check = route + per-bank fused check + fold)
+    # and the lane selector on every front thread's submit — host
+    # string/dict work only; the banks' device pulls live behind
+    # dispatcher.py's and fused.py's existing pragmas
+    "istio_tpu/sharding/router.py": frozenset({
+        "ShardRouter.check", "ReplicaRouter.submit",
+        "ReplicaRouter.lane_of",
+    }),
 }
 
 _SYNC_ATTRS = ("item", "block_until_ready")

@@ -1,0 +1,223 @@
+"""Shard smoke: compile a seeded ≥100k-rule fleet snapshot into K
+namespace shards, serve Zipf-skewed traffic through the replica-
+parallel router over a REAL front (python gRPC), and FAIL (nonzero
+exit) unless
+
+  1. the sharded path's verdicts are EXACTLY what the compiler's
+     SnapshotOracle derives (istio_tpu/sharding/parity.py:
+     per-visible-rule OracleProgram evaluation + the shared
+     fused_check_status decision derivation) — status codes over the
+     wire, status + GLOBAL deny-rule attribution in-process (the fold
+     must remap bank-local deny indices);
+  2. zero rows are dropped or misrouted: every sent request is
+     answered, router misroute counters are zero, and per-bank routed
+     rows sum to exactly the rows served;
+  3. the plan is sane: every config rule lives in exactly one bank
+     (global rules replicated into all K), and LPT balance holds
+     under the documented namespace skew;
+  4. /debug/shards agrees with the routers (occupancy, bank rule
+     counts, stage decomposition non-empty after traffic).
+
+The monolithic device program is never warmed or executed — the whole
+point of the plane is that a 100k-rule snapshot serves WITHOUT its
+monolithic XLA compile. Rule telemetry is off here (a 100k-row ×
+500-namespace accumulator plane is its own scale project; the
+sharding telemetry fan is covered at unit scale in
+tests/test_sharding.py).
+
+Runnable under JAX_PLATFORMS=cpu; tier-1 invokes main() in-process
+(tests/test_shard_smoke.py) at the full 100k-rule scale.
+
+Usage: JAX_PLATFORMS=cpu python scripts/shard_smoke.py \
+           [--rules N] [--namespaces N] [--shards K] [--replicas N] \
+           [--checks N] [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(n_rules: int = 100_000, n_namespaces: int = 512,
+         shards: int = 8, replicas: int = 2, n_checks: int = 48,
+         seed: int = 7) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import time
+
+    from istio_tpu.api.client import MixerClient
+    from istio_tpu.api.grpc_server import MixerGrpcServer
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.sharding import oracle_check_statuses
+    from istio_tpu.testing import workloads
+    from istio_tpu.utils import tracing
+
+    failures: list[str] = []
+    t0 = time.perf_counter()
+    store = workloads.make_fleet_store(n_rules, n_namespaces, seed)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=16, buckets=(16,),
+        shards=shards, replicas=replicas,
+        rule_telemetry=False, initial_prewarm=False,
+        default_manifest=workloads.MESH_MANIFEST))
+    build_s = time.perf_counter() - t0
+    intro = IntrospectServer(runtime=srv)
+    g = MixerGrpcServer(runtime=srv)
+    client = None
+    try:
+        state = srv._sharded
+        plan = state["plan"]
+        banks = state["banks"]
+        snap = srv.controller.dispatcher.snapshot
+        n_cfg = len(snap.rules)
+
+        # -- plan sanity: exact coverage + replication accounting ----
+        if state["mode"] != "sharded":
+            failures.append(f"expected sharded mode, got "
+                            f"{state['mode']} "
+                            f"({state['fallback_reason']})")
+        if len(banks) != shards:
+            failures.append(f"{len(banks)} banks != {shards} shards")
+        n_global = len(plan.global_rules)
+        covered = sum(len(r) for r in plan.shard_rules)
+        want = n_cfg + (shards - 1) * n_global
+        if covered != want:
+            failures.append(
+                f"plan covers {covered} rule slots, expected {want} "
+                f"({n_cfg} rules + {shards - 1}x{n_global} replicated "
+                f"globals) — a rule is dropped or double-assigned")
+        seen: set[int] = set()
+        for rs_ in plan.shard_rules:
+            seen.update(rs_)
+        if len(seen) != n_cfg:
+            failures.append(f"plan reaches {len(seen)} distinct rules "
+                            f"of {n_cfg}")
+        bal = plan.balance()
+        if bal["max_over_mean_cost"] > 2.0:
+            failures.append(f"shard balance {bal['max_over_mean_cost']}"
+                            f"x max/mean — LPT packing regressed "
+                            f"(per-shard costs {bal['cost_per_shard']})")
+
+        # -- serve through the real front ----------------------------
+        intro_port = intro.start()
+        grpc_port = g.start()
+        client = MixerClient(f"127.0.0.1:{grpc_port}",
+                             enable_check_cache=False)
+        dicts = workloads.make_fleet_traffic(
+            n_checks, n_rules, n_namespaces, seed)
+        wire_codes = []
+        for d in dicts:
+            resp = client.check(d)
+            wire_codes.append(int(resp.precondition.status.code))
+        if len(wire_codes) != len(dicts):
+            failures.append(f"dropped rows at the wire: "
+                            f"{len(wire_codes)}/{len(dicts)} answered")
+
+        # -- in-process pass (deny_rule fold remap is judged here) ---
+        bags = [bag_from_mapping(d) for d in dicts]
+        local = srv.check_many(bags)
+
+        # -- EXACT SnapshotOracle parity -----------------------------
+        t_or = time.perf_counter()
+        plan_fused = srv.controller.dispatcher.fused
+        expected = oracle_check_statuses(snap, plan_fused, bags)
+        oracle_s = time.perf_counter() - t_or
+        n_deny = 0
+        for i, (want_r, got, code) in enumerate(
+                zip(expected, local, wire_codes)):
+            if got.status_code != want_r["status"]:
+                failures.append(
+                    f"row {i}: sharded status {got.status_code} != "
+                    f"oracle {want_r['status']}")
+            if code != want_r["status"]:
+                failures.append(
+                    f"row {i}: wire status {code} != oracle "
+                    f"{want_r['status']}")
+            if got.deny_rule != want_r["deny_rule"]:
+                failures.append(
+                    f"row {i}: folded deny_rule {got.deny_rule} != "
+                    f"oracle global index {want_r['deny_rule']}")
+            if want_r["status"] != 0:
+                n_deny += 1
+            if len(failures) > 16:
+                break
+        if not n_deny:
+            failures.append("oracle saw zero denies — the fleet "
+                            "traffic no longer exercises deny rules")
+
+        # -- zero dropped / misrouted rows ---------------------------
+        routing = srv.batcher.routing_stats()
+        mis = routing["misrouted"]
+        if mis:
+            failures.append(f"{mis} misrouted rows")
+        routed = routing["rows_total"]
+        served = len(wire_codes) + len(bags)
+        if routed != served:
+            failures.append(f"router row conservation: routed "
+                            f"{routed} != served {served}")
+
+        # -- /debug/shards agreement ---------------------------------
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{intro_port}/debug/shards",
+                timeout=30) as r:
+            view = json.loads(r.read().decode())
+        if not view.get("enabled"):
+            failures.append("/debug/shards reports disabled on a "
+                            "sharded server")
+        if view.get("misrouted") != 0:
+            failures.append(f"/debug/shards misrouted = "
+                            f"{view.get('misrouted')}")
+        vrows = sum(view.get("rows_per_shard", {}).values())
+        if vrows != routed:
+            failures.append(f"/debug/shards rows {vrows} != router "
+                            f"rows {routed}")
+        vbanks = {b["shard"]: b["rules"] for b in view.get("banks", ())}
+        for k in range(shards):
+            if vbanks.get(k) != len(plan.shard_rules[k]):
+                failures.append(
+                    f"/debug/shards bank {k} rules {vbanks.get(k)} != "
+                    f"plan {len(plan.shard_rules[k])}")
+        stages = view.get("stages", {})
+        for stage in ("shard_dispatch", "bank_check", "fold"):
+            if not stages.get(stage, {}).get("count"):
+                failures.append(f"shard stage {stage!r} has no "
+                                f"observations after traffic")
+    finally:
+        if client is not None:
+            client.close()
+        g.stop()
+        intro.close()
+        srv.close()
+        tracing.shutdown()
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"shard smoke ok: {n_rules} rules / {n_namespaces} ns "
+              f"-> {shards} shards x {replicas} replicas "
+              f"(build {build_s:.1f}s), {len(wire_codes)} wire + "
+              f"{len(bags)} local checks, EXACT oracle parity "
+              f"({n_deny} denies, recount {oracle_s:.1f}s), "
+              f"0 dropped/misrouted, balance "
+              f"{bal['max_over_mean_cost']}x")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=100_000)
+    ap.add_argument("--namespaces", type=int, default=512)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--checks", type=int, default=48)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    sys.exit(main(args.rules, args.namespaces, args.shards,
+                  args.replicas, args.checks, args.seed))

@@ -1,0 +1,481 @@
+"""Sharded serving plane (istio_tpu/sharding) — planner properties,
+exact sharded-vs-monolithic parity, host-overlay pinning, config-swap
+continuity, quota routing across shard boundaries, replica routing,
+and the telemetry fan. The 100k-rule scale gate lives in
+tests/test_shard_smoke.py; these pin the SEMANTICS at unit scale."""
+import numpy as np
+import pytest
+
+from istio_tpu.adapters.sdk import QuotaArgs
+from istio_tpu.attribute.bag import bag_from_mapping
+from istio_tpu.runtime import RuntimeServer, ServerArgs
+from istio_tpu.sharding import (ShardPlan, plan_shards,
+                                predict_rule_costs)
+from istio_tpu.sharding.planner import HOST_FALLBACK_COST
+from istio_tpu.testing import workloads
+
+
+# ---------------------------------------------------------------- plan
+
+def _fleet_preds(n=2000, ns=64, seed=3):
+    return workloads.make_fleet_rules(n, ns, seed=seed)
+
+
+def test_plan_covers_every_rule_exactly_once():
+    preds = _fleet_preds()
+    plan = plan_shards(preds, workloads.MESH_FINDER, 4)
+    seen = {}
+    for k, idxs in enumerate(plan.shard_rules):
+        assert idxs == sorted(idxs)          # global order per bank
+        for i in idxs:
+            seen.setdefault(i, []).append(k)
+    assert sorted(seen) == list(range(len(preds)))
+    for i, shards in seen.items():
+        ns = preds[i].namespace
+        if ns:
+            # namespace-scoped rule: exactly its namespace's one bank
+            assert shards == [plan.ns_to_shard[ns]], (i, ns)
+        else:
+            # global rule: replicated into every bank
+            assert shards == list(range(plan.n_shards))
+
+
+def test_plan_balances_skewed_namespaces():
+    preds = _fleet_preds()
+    plan = plan_shards(preds, workloads.MESH_FINDER, 4)
+    bal = plan.balance()
+    # the fleet namespace sizes are Zipf-skewed by design; LPT packing
+    # must still land within a modest envelope of perfect balance
+    assert bal["max_over_mean_cost"] <= 1.5, bal
+    assert bal["min_over_mean_cost"] >= 0.5, bal
+    # a naive round-robin over namespaces does measurably worse on
+    # cost spread than LPT, or the planner is not earning its keep
+    costs = np.asarray(predict_rule_costs(preds,
+                                          workloads.MESH_FINDER))
+    ns_names = sorted({p.namespace for p in preds if p.namespace})
+    rr_cost = np.zeros(4)
+    for j, ns in enumerate(ns_names):
+        rr_cost[j % 4] += sum(
+            costs[i] for i, p in enumerate(preds)
+            if p.namespace == ns)
+    assert max(plan.shard_cost) <= rr_cost.max() + 1e-9
+
+
+def test_plan_deterministic_and_stable_hash_routing():
+    preds = _fleet_preds(400, 16, seed=9)
+    a = plan_shards(preds, workloads.MESH_FINDER, 3)
+    b = plan_shards(preds, workloads.MESH_FINDER, 3)
+    assert a.ns_to_shard == b.ns_to_shard
+    assert a.shard_rules == b.shard_rules
+    # unknown namespaces route stably (crc32, not PYTHONHASHSEED)
+    assert a.shard_of("never-seen-ns") == b.shard_of("never-seen-ns")
+    assert 0 <= a.shard_of("never-seen-ns") < 3
+    # known namespaces route to their assigned bank
+    for ns, k in a.ns_to_shard.items():
+        assert a.shard_of(ns) == k
+
+
+def test_cost_model_prices_host_fallback():
+    from istio_tpu.compiler.ruleset import Rule
+    preds = [
+        Rule(name="eq", match='request.method == "GET"',
+             namespace="a"),
+        # dynamic pattern argument: no constant DFA, host fallback
+        Rule(name="dyn",
+             match='"x".matches(request.path) || '
+                   'match(request.path, request.host)',
+             namespace="a"),
+    ]
+    costs = predict_rule_costs(preds, workloads.MESH_FINDER)
+    assert costs[0] > 0
+    assert costs[0] < HOST_FALLBACK_COST
+
+
+def test_costs_from_ruleset_matches_standalone_model():
+    """The publish path prices rules from the retained compiled
+    decomposition (costs_from_ruleset — no second parse/DNF pass at
+    100k rules); it must agree exactly with the standalone
+    predict_rule_costs model, or swap-time plans drift from the
+    tested balance properties."""
+    from istio_tpu.compiler.ruleset import compile_ruleset
+    from istio_tpu.sharding.planner import costs_from_ruleset
+
+    preds = _fleet_preds(600, 24, seed=12)
+    rs = compile_ruleset(preds, workloads.MESH_FINDER, jit=False)
+    a = predict_rule_costs(preds, workloads.MESH_FINDER)
+    b = costs_from_ruleset(rs, workloads.MESH_FINDER)
+    assert np.allclose(a, b[:len(preds)])
+
+
+# ------------------------------------------------- serving parity
+
+N_RULES = 240
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """plain (monolithic) vs sharded+replicated servers over the SAME
+    config — make_store's full action mix incl. host-overlay list
+    shapes (case-insensitive / provider-refreshed / dynamic-regex)
+    and host-fallback predicates, so parity covers the overlay path."""
+    kw = dict(batch_window_s=0.001, buckets=(16, 64), max_batch=64,
+              default_manifest=workloads.MESH_MANIFEST)
+    plain = RuntimeServer(
+        workloads.make_store(N_RULES, host_overlay_every=10, seed=5),
+        ServerArgs(**kw))
+    sharded = RuntimeServer(
+        workloads.make_store(N_RULES, host_overlay_every=10, seed=5),
+        ServerArgs(shards=3, replicas=2, **kw))
+    yield plain, sharded
+    plain.close()
+    sharded.close()
+
+
+def _mixed_bags(n=64, seed=6):
+    return [bag_from_mapping(d)
+            for d in workloads.make_request_dicts(n, seed=seed)]
+
+
+def test_sharded_matches_monolithic_exactly(pair):
+    plain, sharded = pair
+    assert sharded._sharded["mode"] == "sharded"
+    bags = _mixed_bags()
+    rp = plain.check_many(bags)
+    rs = sharded.check_many(bags)
+    for i, (a, b) in enumerate(zip(rp, rs)):
+        assert a.status_code == b.status_code, f"row {i}"
+        assert a.status_message == b.status_message, f"row {i}"
+        assert a.valid_duration_s == pytest.approx(
+            b.valid_duration_s), f"row {i}"
+        assert a.valid_use_count == b.valid_use_count, f"row {i}"
+        assert a.referenced == b.referenced, f"row {i}"
+        # deny attribution folds back to GLOBAL rule indices
+        assert a.deny_rule == b.deny_rule, f"row {i}"
+
+
+def test_sharded_through_replica_front(pair):
+    plain, sharded = pair
+    bags = _mixed_bags(48, seed=8)
+    want = plain.check_many(bags)
+    futs = [sharded.batcher.submit(b) for b in bags]
+    got = [f.result() for f in futs]
+    for i, (a, b) in enumerate(zip(want, got)):
+        assert a.status_code == b.status_code, f"row {i}"
+        assert a.referenced == b.referenced, f"row {i}"
+    # zero misroutes, exact row conservation across lanes
+    routed = sum(n for r in sharded.batcher.routers
+                 for n in r.rows_routed.values())
+    assert routed >= len(bags)
+    assert sum(r.misrouted for r in sharded.batcher.routers) == 0
+
+
+def test_host_overlay_rules_pinned_to_home_shard(pair):
+    _, sharded = pair
+    state = sharded._sharded
+    plan: ShardPlan = state["plan"]
+    snap = sharded.controller.dispatcher.snapshot
+    pinned = 0
+    for bank in state["banks"]:
+        fused = bank.dispatcher.fused
+        for local in fused.host_actions:
+            gidx = int(bank.local_to_global[local])
+            ns = snap.ruleset.rules[gidx].namespace
+            # a host-overlay rule compiles into exactly its
+            # namespace's bank (global rules are replicated, so only
+            # namespace-scoped ones pin)
+            if ns:
+                assert plan.ns_to_shard[ns] == bank.shard_id
+                pinned += 1
+    assert pinned > 0, "workload lost its host-overlay rules"
+
+
+def test_unknown_namespace_serves_global_rules_only(pair):
+    plain, sharded = pair
+    bag = bag_from_mapping({
+        "destination.service": "svc0.nowhere-ns.svc.cluster.local",
+        "source.user": "anon", "request.method": "GET"})
+    a = plain.check_many([bag])[0]
+    b = sharded.check_many([bag])[0]
+    assert a.status_code == b.status_code
+    assert a.referenced == b.referenced
+
+
+def test_sticky_lane_routing(pair):
+    _, sharded = pair
+    rr = sharded.batcher
+    bags = _mixed_bags(32, seed=11)
+    lanes = {}
+    for bag in bags:
+        ns = bag.get("destination.service")[0].split(".")[1]
+        lane = rr.lane_of(bag)
+        assert lanes.setdefault(ns, lane) == lane, \
+            "namespace bounced between lanes"
+    assert len(set(lanes.values())) > 1, \
+        "all namespaces collapsed onto one lane"
+
+
+def test_rulestats_fan_across_banks(pair):
+    """Per-rule telemetry from every bank merges into the one
+    aggregator, name-keyed, matching an oracle recount of hits."""
+    from istio_tpu.sharding import oracle_check_statuses
+
+    plain, sharded = pair
+    sharded.rulestats.drain()
+    base = {k: dict(v) for k, v in
+            sharded.rulestats.counts().items()}
+    bags = _mixed_bags(40, seed=13)
+    sharded.check_many(bags)
+    sharded.rulestats.drain()
+    got = sharded.rulestats.counts()
+    snap = sharded.controller.dispatcher.snapshot
+    expected = oracle_check_statuses(
+        snap, sharded.controller.dispatcher.fused, bags)
+    names = snap.qualified_rule_names()
+    want_hits: dict[str, int] = {}
+    for row in expected:
+        for ridx in row["active"]:
+            want_hits[names[ridx]] = want_hits.get(names[ridx], 0) + 1
+    for name, n in want_hits.items():
+        prev = base.get(name, {}).get("hits", 0)
+        assert got[name]["hits"] - prev == n, name
+
+
+def test_router_chunks_over_bucket_batches():
+    """A lane batch larger than the banks' largest prewarmed bucket
+    must chunk (never run an un-prewarmed shape), and still return
+    every row in order."""
+    srv = RuntimeServer(
+        workloads.make_fleet_store(90, 6, seed=3),
+        ServerArgs(batch_window_s=0.001, buckets=(8,), max_batch=32,
+                   shards=2, replicas=1,
+                   default_manifest=workloads.MESH_MANIFEST))
+    try:
+        bags = [bag_from_mapping(d) for d in
+                workloads.make_fleet_traffic(32, 90, 6, seed=3)]
+        got = srv.check_many(bags)
+        assert len(got) == len(bags)
+        from istio_tpu.sharding import oracle_check_statuses
+        want = oracle_check_statuses(
+            srv.controller.dispatcher.snapshot,
+            srv.controller.dispatcher.fused, bags)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert g.status_code == w["status"], f"row {i}"
+            assert g.deny_rule == w["deny_rule"], f"row {i}"
+    finally:
+        srv.close()
+
+
+def test_bank_device_fault_degrades_to_oracle_not_error():
+    """A transient device-step fault inside a bank must be absorbed by
+    the bank's OWN resilience wrap (retry → breaker → the bank's CPU
+    oracle) — every request still answers CORRECTLY, none surfaces a
+    raw internal error. The monolithic path's contract
+    (tests/test_resilience.py), per bank."""
+    from istio_tpu.runtime.resilience import CHAOS
+    from istio_tpu.sharding import oracle_check_statuses
+
+    srv = RuntimeServer(
+        workloads.make_fleet_store(90, 6, seed=6),
+        ServerArgs(batch_window_s=0.001, buckets=(16,), max_batch=16,
+                   shards=2, replicas=2, device_retry=False,
+                   default_manifest=workloads.MESH_MANIFEST))
+    try:
+        banks = srv._sharded["banks"]
+        assert all(b.checker is not None for b in banks)
+        bags = [bag_from_mapping(d) for d in
+                workloads.make_fleet_traffic(16, 90, 6, seed=6)]
+        srv.check_many(bags)                # warm every bank shape
+        CHAOS.reset()
+        CHAOS.device_failures = 2           # fault the next 2 steps
+        try:
+            futs = [srv.batcher.submit(b) for b in bags]
+            got = [f.result() for f in futs]   # no raised futures
+        finally:
+            injected = CHAOS.injected_device
+            CHAOS.reset()
+        assert injected > 0, "chaos seam never fired in a bank step"
+        want = oracle_check_statuses(
+            srv.controller.dispatcher.snapshot,
+            srv.controller.dispatcher.fused, bags)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert g.status_code == w["status"], f"row {i}"
+    finally:
+        srv.close()
+
+
+def test_config_swap_continuity():
+    """A config swap rebuilds the banks and every lane serves the NEW
+    snapshot — no dropped requests, no stale verdicts."""
+    store = workloads.make_fleet_store(120, 8, seed=2)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.001, buckets=(16,), max_batch=16,
+        shards=2, replicas=2,
+        default_manifest=workloads.MESH_MANIFEST))
+    try:
+        traffic = workloads.make_fleet_traffic(24, 120, 8, seed=2)
+        bags = [bag_from_mapping(d) for d in traffic]
+        before = srv.check_many(bags)
+        assert any(r.status_code == 0 for r in before)
+        rev0 = srv._sharded["revision"]
+        # swap: a fresh GLOBAL deny-everything rule — every request
+        # must now answer non-OK through every bank (a lower-index
+        # rule's own non-OK status may still win the combine, so the
+        # pin is "nothing stays OK", not one specific code)
+        store.set(("rule", "istio-system", "deny-world"), {
+            "match": "",
+            "actions": [{"handler": "denyall",
+                         "instances": ["nothing"]}]})
+        srv.controller.rebuild()
+        assert srv._sharded["revision"] > rev0
+        futs = [srv.batcher.submit(b) for b in bags]
+        after = [f.result() for f in futs]
+        assert all(r.status_code != 0 for r in after), \
+            [r.status_code for r in after[:8]]
+        assert len(after) == len(before)
+        assert sum(r.misrouted for r in srv.batcher.routers) == 0
+    finally:
+        srv.close()
+
+
+def test_global_quota_rule_routes_to_shared_pool():
+    """A default-namespace quota rule replicates into every bank, but
+    allocation happens ONCE per request from the one controller-owned
+    pool — grants match the monolithic server exactly."""
+    kw = dict(batch_window_s=0.001, buckets=(16,), max_batch=16,
+              default_manifest=workloads.MESH_MANIFEST)
+    plain = RuntimeServer(
+        workloads.make_fleet_store(60, 6, seed=4, with_quota=True),
+        ServerArgs(**kw))
+    sharded = RuntimeServer(
+        workloads.make_fleet_store(60, 6, seed=4, with_quota=True),
+        ServerArgs(shards=3, replicas=2, **kw))
+    try:
+        # every bank carries the replicated global quota rule
+        for bank in sharded._sharded["banks"]:
+            assert any(r.name == "quota-rule"
+                       for r in bank.snapshot.rules)
+            assert bank.dispatcher.fused.quota_actions
+        traffic = workloads.make_fleet_traffic(12, 60, 6, seed=4)
+        for d in traffic:
+            bag_p = bag_from_mapping(d)
+            bag_s = bag_from_mapping(d)
+            rp = plain.check_many([bag_p])[0]
+            rs = sharded.check_many([bag_s])[0]
+            args = QuotaArgs(quota_amount=3)
+            qp = plain.quota_fused(bag_p, "rq.istio-system", args, rp)
+            qs = sharded.quota_fused(bag_s, "rq.istio-system", args,
+                                     rs)
+            gp = qp.result() if hasattr(qp, "result") else qp
+            gs = qs.result() if hasattr(qs, "result") else qs
+            assert gp is not None and gs is not None
+            assert gs.granted_amount == gp.granted_amount
+        # the sharded server used ONE pool for all banks
+        pools = {id(p) for p in sharded.controller.device_quotas
+                 .values()}
+        assert len(pools) == 1
+    finally:
+        plain.close()
+        sharded.close()
+
+
+def test_instep_quota_refused_under_sharding():
+    srv = RuntimeServer(
+        workloads.make_fleet_store(30, 4, seed=1, with_quota=True),
+        ServerArgs(batch_window_s=0.001, buckets=(16,), max_batch=16,
+                   shards=2, quota_in_step=True,
+                   default_manifest=workloads.MESH_MANIFEST))
+    try:
+        # the merged check+quota program cannot span banks: sharded
+        # serving must refuse the in-step path (classic defer serves)
+        assert srv.instep_quota_target() is None
+    finally:
+        srv.close()
+
+
+def test_rbac_snapshot_falls_back_to_replica_only():
+    """Device-lowered rbac pseudo-rules reference absolute ruleset
+    rows — such snapshots refuse to shard and serve replica-only,
+    verdict-identical to the monolithic path."""
+    kw = dict(batch_window_s=0.001, buckets=(16,), max_batch=16)
+    plain = RuntimeServer(workloads.make_rbac_store(40), ServerArgs(**kw))
+    sharded = RuntimeServer(workloads.make_rbac_store(40),
+                            ServerArgs(shards=2, replicas=2, **kw))
+    try:
+        st = sharded._sharded
+        assert st["mode"] == "replica-only"
+        assert "pseudo-rule" in st["fallback_reason"]
+        dicts = workloads.make_rbac_request_dicts(24)
+        bags_p = [bag_from_mapping(d) for d in dicts]
+        bags_s = [bag_from_mapping(d) for d in dicts]
+        rp = plain.check_many(bags_p)
+        rs = sharded.check_many(bags_s)
+        for i, (a, b) in enumerate(zip(rp, rs)):
+            assert a.status_code == b.status_code, f"row {i}"
+    finally:
+        plain.close()
+        sharded.close()
+
+
+def test_debug_shards_view_zero_shaped_and_live():
+    import json
+    import urllib.request
+
+    from istio_tpu.introspect import IntrospectServer
+
+    srv = RuntimeServer(
+        workloads.make_fleet_store(40, 4, seed=8),
+        ServerArgs(batch_window_s=0.001, buckets=(16,), max_batch=16,
+                   shards=2, replicas=2,
+                   default_manifest=workloads.MESH_MANIFEST))
+    intro = IntrospectServer(runtime=srv)
+    try:
+        port = intro.start()
+
+        def view():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/shards",
+                    timeout=30) as r:
+                return json.loads(r.read().decode())
+
+        v = view()   # before any traffic: zero-shaped, never an error
+        assert v["enabled"] and v["mode"] == "sharded"
+        assert sum(v["rows_per_shard"].values()) == 0
+        assert v["misrouted"] == 0
+        assert len(v["banks"]) == 2
+        assert all(b["bank_bytes"] > 0 for b in v["banks"])
+        assert len(v["replicas"]) == 2
+        for rep in v["replicas"]:
+            assert rep["batch_latency"]["batches"] >= 0
+        bags = [bag_from_mapping(d) for d in
+                workloads.make_fleet_traffic(16, 40, 4, seed=8)]
+        futs = [srv.batcher.submit(b) for b in bags]
+        [f.result() for f in futs]
+        v = view()
+        assert sum(v["rows_per_shard"].values()) == len(bags)
+        assert v["last_decision"]["balance"]["n_shards"] == 2
+    finally:
+        intro.close()
+        srv.close()
+
+
+def test_monolithic_server_reports_shards_disabled():
+    import json
+    import urllib.request
+
+    from istio_tpu.introspect import IntrospectServer
+
+    srv = RuntimeServer(
+        workloads.make_fleet_store(20, 4, seed=1),
+        ServerArgs(batch_window_s=0.001, buckets=(16,), max_batch=16,
+                   default_manifest=workloads.MESH_MANIFEST))
+    intro = IntrospectServer(runtime=srv)
+    try:
+        port = intro.start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/shards",
+                timeout=30) as r:
+            v = json.loads(r.read().decode())
+        assert v == {"enabled": False}
+    finally:
+        intro.close()
+        srv.close()
