@@ -332,6 +332,7 @@ def main() -> None:
     full_mesh = _full_mesh_bench(on_tpu)
     overlay = _overlay_bench(on_tpu)
     capacity = _capacity_bench(on_tpu)
+    republish = _capacity_republish_bench(on_tpu)
     mesh_scaling = _mesh_scaling_bench(on_tpu)
     fleet = _fleet_bench(on_tpu)
     analysis = _analysis_bench(on_tpu)
@@ -423,6 +424,7 @@ def main() -> None:
     out.update(full_mesh)
     out.update(overlay)
     out.update(capacity)
+    out.update(republish)
     out.update(mesh_scaling)
     out.update(fleet)
     out.update(analysis)
@@ -1212,6 +1214,89 @@ def _capacity_bench(on_tpu: bool) -> dict:
         return out
     except Exception as exc:
         return {"capacity_error": f"{type(exc).__name__}: {exc}"}
+
+
+def _capacity_republish_bench(on_tpu: bool) -> dict:
+    """Delta-publish phase of the capacity story (ISSUE 11): a
+    production mesh republishes config constantly, so the artifact
+    pins what a ONE-NAMESPACE delta costs on a sharded fleet snapshot
+    versus a full rebuild of every bank.
+
+      capacity_republish_full_s    republish wall with delta
+                                   compilation DISABLED — every bank
+                                   recompiles (the pre-delta world)
+      capacity_republish_delta_s   republish wall for a one-namespace
+                                   constant edit with the content-
+                                   addressed bank cache on
+      capacity_banks_reused        banks carried across that delta
+                                   (K-1 expected: only the edited
+                                   namespace's bank recompiles)
+
+    The edit is constant-only (a literal swap inside one rule's
+    match), the dominant real-world churn shape — the compiled
+    programs take their index tensors as traced arguments, so the
+    delta's one recompiled bank also re-uses its XLA artifact via the
+    persistent compilation cache when one is configured."""
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.runtime.store import Event
+    from istio_tpu.testing import workloads
+
+    n_rules = 100_000 if on_tpu else 4_000
+    n_ns = 512 if on_tpu else 64
+    shards = 8 if on_tpu else 4
+    srv = None
+    try:
+        store = workloads.make_fleet_store(n_rules, n_ns, seed=17)
+        t0 = time.perf_counter()
+        srv = RuntimeServer(store, ServerArgs(
+            batch_window_s=0.001, max_batch=16, buckets=(16,),
+            shards=shards, replicas=1, rule_telemetry=False,
+            initial_prewarm=False,
+            default_manifest=workloads.MESH_MANIFEST))
+        build_s = time.perf_counter() - t0
+
+        def edit_one(tag: str) -> None:
+            # constant-only edit of one rule in one namespace; quiet
+            # apply + explicit rebuild = exactly one deterministic
+            # republish per measurement (no debounce-timer race)
+            key = next(k for k in store.list("rule") if k[1] == "ns1")
+            spec = dict(store.get(key))
+            # prefix the first string constant (the service literal) —
+            # applies cleanly no matter how many edits came before
+            spec["match"] = spec["match"].replace('"', f'"{tag}-', 1)
+            store.apply_events([Event(key, spec)], notify=False)
+
+        # full republish: the kill switch makes every bank rebuild
+        srv.args.delta_compile = False
+        edit_one("full")
+        t0 = time.perf_counter()
+        srv.controller.rebuild()
+        full_s = time.perf_counter() - t0
+
+        # delta republish: diff by content hash, rebuild one bank
+        srv.args.delta_compile = True
+        edit_one("delta")
+        t0 = time.perf_counter()
+        srv.controller.rebuild()
+        delta_s = time.perf_counter() - t0
+        st = dict(srv._rebuild_status)
+        return {
+            "capacity_republish_rules": n_rules,
+            "capacity_republish_shards": shards,
+            "capacity_republish_build_s": round(build_s, 2),
+            "capacity_republish_full_s": round(full_s, 3),
+            "capacity_republish_delta_s": round(delta_s, 3),
+            "capacity_banks_reused": st["banks_reused"],
+            "capacity_banks_recompiled": st["banks_recompiled"],
+            "capacity_republish_speedup": round(
+                full_s / delta_s, 2) if delta_s > 0 else None,
+        }
+    except Exception as exc:
+        return {"capacity_republish_error":
+                f"{type(exc).__name__}: {exc}"}
+    finally:
+        if srv is not None:
+            srv.close()
 
 
 def _capacity_parity(engine, ab, ns, status_dev, on_tpu: bool) -> dict:

@@ -48,7 +48,14 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         canary_capacity=args.canary_capacity,
         canary_sample_every=args.canary_sample_every,
         canary_replay_limit=args.canary_replay_limit,
-        canary_waivers=tuple(args.canary_waive or ())))
+        canary_waivers=tuple(args.canary_waive or ()),
+        # sharded serving + delta compilation (istio_tpu/sharding,
+        # compiler/cache.py)
+        shards=args.shards,
+        replicas=args.replicas,
+        jax_compile_cache_dir=args.jax_compile_cache_dir,
+        delta_compile=not args.no_delta_compile,
+        shard_rebalance_budget=args.shard_rebalance_budget))
     server = MixerGrpcServer(runtime, f"{args.address}:{args.port}")
     port = server.start()
     print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
@@ -801,6 +808,31 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--canary-waive", action="append", metavar="RULE",
                    help="qualified rule name (ns/name) whose "
                         "divergences never gate (repeatable)")
+    s.add_argument("--shards", type=int, default=0,
+                   help="partition the snapshot by namespace into "
+                        "this many compiled banks (the sharded "
+                        "serving plane, istio_tpu/sharding); 0 = "
+                        "monolithic")
+    s.add_argument("--replicas", type=int, default=1,
+                   help="replica-parallel serving lanes behind the "
+                        "one front (sticky-by-namespace)")
+    s.add_argument("--jax-compile-cache-dir", default=None,
+                   metavar="DIR",
+                   help="JAX persistent compilation cache directory: "
+                        "restarts and rolling deploys skip warm XLA "
+                        "compiles for unchanged banks "
+                        "(compiler/cache.py). Falls back to the "
+                        "MIXS_JAX_COMPILE_CACHE_DIR env var; unset = "
+                        "jax's own defaulting")
+    s.add_argument("--no-delta-compile", action="store_true",
+                   help="kill switch for delta compilation: every "
+                        "config publish rebuilds every shard bank "
+                        "instead of diffing by content hash")
+    s.add_argument("--shard-rebalance-budget", type=int, default=0,
+                   help="namespaces the delta planner may relocate "
+                        "per republish to chase LPT balance (each "
+                        "move recompiles two banks; 0 = perfect plan "
+                        "stability)")
     s.add_argument("--trace-zipkin-url", default="",
                    help="zipkin v2 collector (POST /api/v2/spans)")
     s.add_argument("--trace-log-spans", action="store_true",

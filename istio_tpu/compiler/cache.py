@@ -1,0 +1,269 @@
+"""Compilation caching — the delta-compilation plane's shared vocabulary.
+
+A production mesh republishes config constantly; the point of this
+module is that NOTHING recompiles unless its inputs changed. Three
+layers, cheapest first:
+
+  1. Content digests (`stable_digest` / `manifest_digest`) — the
+     deterministic hashes the sharding plane keys its content-addressed
+     bank cache on (istio_tpu/sharding/banks.bank_content_key): a bank
+     whose rules, referenced handlers/instances, layout inputs and
+     manifest are byte-identical across generations IS the same
+     compiled artifact and is carried over, prewarmed shapes, breaker
+     state and rulestats bindings included.
+
+  2. DecompCache — per-rule parse + DNF-decomposition memo across
+     snapshot builds. compile_ruleset's host cost is dominated by
+     parsing and decomposing match predicates (measured ~85% of the
+     build at fleet scale); a config delta re-presents almost every
+     rule unchanged, so the builder replays the cached decomposition
+     (atom ASTs re-interned into the new _AtomTable, conjunction sets
+     re-indexed) and pays parse/DNF only for rules it has never seen.
+     Guarded by the manifest digest + dnf_cap: a vocabulary change
+     invalidates everything (eval_type / lowering decisions depend on
+     attribute types).
+
+  3. The JAX persistent compilation cache — XLA artifacts on disk
+     (`jax_compilation_cache_dir`), so process restarts and rolling
+     deploys skip the warm compile for every program whose HLO is
+     unchanged. Our compiled programs take their index tensors as
+     ARGUMENTS, never closure constants (compiler/ruleset.py), so a
+     constant-only rule edit keeps the HLO — and therefore the cache
+     key — bit-identical: only SHAPE changes (new atoms, wider
+     conjunctions, different bank sizes) recompile. Wired through
+     ServerArgs.jax_compile_cache_dir / `mixs --jax-compile-cache-dir`
+     (env fallback MIXS_JAX_COMPILE_CACHE_DIR; JAX's own
+     JAX_COMPILATION_CACHE_DIR works too, jax reads it natively).
+
+Hit/miss accounting rides jax's monitoring events
+('/jax/compilation_cache/cache_hits' / 'cache_misses') — the delta
+smoke gate asserts a warm restart compiles NOTHING for unchanged
+banks, and /debug/shards surfaces the counters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Mapping
+
+# -- content digests ---------------------------------------------------
+
+
+def stable_digest(obj: Any) -> str:
+    """sha256 of the canonical-JSON rendering of `obj` — deterministic
+    across processes and PYTHONHASHSEED (sorted keys, no whitespace,
+    default=str for the odd non-JSON leaf)."""
+    blob = json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def manifest_digest(finder) -> str:
+    """Digest of an AttributeDescriptorFinder's vocabulary — the
+    (name, value type) set every type-check and lowering decision
+    depends on. Two finders with equal digests make identical
+    eval_type / tier-classification decisions for any expression."""
+    items = sorted((n, getattr(finder.get_attribute(n), "name",
+                               str(finder.get_attribute(n))))
+                   for n in finder.names())
+    return stable_digest(items)
+
+
+# -- persistent XLA compilation cache ---------------------------------
+
+ENV_CACHE_DIR = "MIXS_JAX_COMPILE_CACHE_DIR"
+
+
+def resolve_cache_dir(explicit: str | None = None) -> str | None:
+    """Pick the persistent-cache directory: explicit config first
+    (ServerArgs / --jax-compile-cache-dir), then the
+    MIXS_JAX_COMPILE_CACHE_DIR env var. None = leave jax's own
+    defaulting alone (JAX_COMPILATION_CACHE_DIR is read by jax itself
+    at import, so pointing that at a directory also works without us).
+    """
+    if explicit:
+        return explicit
+    env = os.environ.get(ENV_CACHE_DIR, "").strip()
+    return env or None
+
+
+def configure_persistent_cache(cache_dir: str,
+                               min_compile_time_s: float = 0.0) -> str:
+    """Point jax's persistent compilation cache at `cache_dir`
+    (created if missing) and lower the entry thresholds so every
+    serving program is cached — bank programs at small shard sizes
+    compile in well under jax's 1s default threshold, and they are
+    exactly the artifacts a rolling deploy wants to skip. Returns the
+    directory. Safe to call repeatedly (config updates are
+    idempotent)."""
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_time_s))
+    try:
+        # cache entries below 0 bytes never exist; -1 = "cache all"
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+    except Exception:
+        pass   # older jax: size threshold not configurable
+    # jax memoizes its "is the cache used?" decision at the FIRST
+    # compile of the process — a server configured after any earlier
+    # compile (a long-lived test process, a REPL) would silently keep
+    # the cache off forever without this reset
+    reset_backend_cache_state()
+    return cache_dir
+
+
+def reset_backend_cache_state() -> None:
+    """Drop jax's memoized cache-enabled/initialized state so the
+    NEXT compile re-reads the current config. Also the correct thing
+    to call after RESTORING a previous cache config (the smoke gate's
+    finally) — without it the restored setting is never re-checked."""
+    try:
+        from jax._src import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:
+        pass   # fail-soft: at worst the process keeps prior behavior
+
+
+def persistent_cache_entries(cache_dir: str) -> int:
+    """Number of compiled-artifact entries on disk (the `*-cache`
+    files; jax writes a sibling `-atime` touch file per entry)."""
+    try:
+        return sum(1 for f in os.listdir(cache_dir)
+                   if f.endswith("-cache"))
+    except OSError:
+        return 0
+
+
+_EVENTS = {"hits": 0, "misses": 0}
+_EVENTS_LOCK = threading.Lock()
+_EVENTS_INSTALLED = False
+
+
+def _on_event(event: str, **kw) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        with _EVENTS_LOCK:
+            _EVENTS["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        with _EVENTS_LOCK:
+            _EVENTS["misses"] += 1
+
+
+def install_event_counters() -> None:
+    """Register the jax monitoring listener that feeds
+    cache_event_counts(). Idempotent; a jax too old to expose
+    monitoring leaves the counters at zero (fail-soft — accounting
+    must never break serving)."""
+    global _EVENTS_INSTALLED
+    if _EVENTS_INSTALLED:
+        return
+    try:
+        from jax._src import monitoring
+        monitoring.register_event_listener(_on_event)
+        _EVENTS_INSTALLED = True
+    except Exception:
+        pass
+
+
+def cache_event_counts() -> dict:
+    """{"hits": n, "misses": n} persistent-cache lookups since the
+    counters were installed (process-wide; snapshot-and-diff for a
+    phase-scoped view)."""
+    with _EVENTS_LOCK:
+        return dict(_EVENTS)
+
+
+# -- per-rule decomposition cache -------------------------------------
+
+
+@dataclasses.dataclass
+class DecompEntry:
+    """One rule predicate's cached compile front half. `atom_asts`
+    are the decomposition's primitive predicates in entry-local
+    order; `m`/`n` are the monotone DNFs as tuples of
+    ((local_atom_pos, kind), ...) literals. `oracle`/`reason` are set
+    instead when the predicate host-falls-back (DNF blowup /
+    unlowerable shape) — the oracle program is reused too, it is
+    finder-pure and the cache is finder-guarded."""
+    ast: Any
+    atom_asts: tuple = ()
+    m: tuple = ()
+    n: tuple = ()
+    oracle: Any = None
+    reason: str = ""
+    last_gen: int = 0
+
+    @property
+    def is_fallback(self) -> bool:
+        return self.oracle is not None
+
+
+class DecompCache:
+    """Parse + DNF-decomposition memo across compile_ruleset calls.
+
+    Keyed by the rule's raw match string (rules carrying a pre-built
+    AST — rbac pseudo-rules — bypass the cache: they never parse and
+    the sharding plane refuses them anyway). Bound to one
+    (manifest digest, dnf_cap) world via begin(): a changed attribute
+    vocabulary or cap clears everything, because type checking, the
+    decomposition's HostFallback decisions and the cached oracles all
+    depend on it.
+
+    Writers are the controller's serialized rebuild thread (parent
+    snapshot build, then each changed bank's sub-compile — the bank
+    compiles are where the hits pay off twice); a lock keeps the memo
+    safe for any stray concurrent compile anyway. Entries unused for
+    PRUNE_AFTER_GENS begin() cycles are dropped so deleted rules do
+    not accumulate forever."""
+
+    PRUNE_AFTER_GENS = 64
+
+    def __init__(self) -> None:
+        self._entries: dict[str, DecompEntry] = {}
+        self._digest: str | None = None
+        self._gen = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def begin(self, finder, dnf_cap: int) -> None:
+        """Open a compile generation: validate the finder/cap guard
+        (clearing on mismatch) and advance the pruning clock."""
+        digest = manifest_digest(finder) + f":{dnf_cap}"
+        with self._lock:
+            if digest != self._digest:
+                self._entries.clear()
+                self._digest = digest
+            self._gen += 1
+            if self._gen % 16 == 0:
+                floor = self._gen - self.PRUNE_AFTER_GENS
+                stale = [k for k, e in self._entries.items()
+                         if e.last_gen < floor]
+                for k in stale:
+                    del self._entries[k]
+
+    def get(self, key: str) -> DecompEntry | None:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            e.last_gen = self._gen
+            self.hits += 1
+            return e
+
+    def put(self, key: str, entry: DecompEntry) -> None:
+        entry.last_gen = self._gen
+        with self._lock:
+            self._entries[key] = entry
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "hits": self.hits,
+                    "misses": self.misses, "generation": self._gen}

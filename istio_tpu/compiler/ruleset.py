@@ -424,7 +424,8 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
                     extra_derived_keys: Sequence[tuple[str, str]] = (),
                     extra_byte_sources: Sequence[Any] = (),
                     extra_extern_sources: Sequence[tuple[str, str, Any]] = (),
-                    rule_pad: int = 1
+                    rule_pad: int = 1,
+                    decomp_cache=None
                     ) -> RuleSetProgram:
     """Compile a rule snapshot. Never raises for individual bad rules —
     un-lowerable predicates fall back to the oracle; predicates that do
@@ -445,7 +446,18 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     multiple, so the axis can shard evenly over an mp mesh dimension
     (parallel/mesh.py). Pad rows are definitely-not-matched, never
     error, and belong to an unmatchable namespace; `n_rules` still
-    counts real rules only."""
+    counts real rules only.
+
+    `decomp_cache` (compiler/cache.DecompCache) memoizes the parse +
+    DNF decomposition per match string ACROSS compiles: a config delta
+    re-presents almost every predicate unchanged, and parse+decompose
+    dominate the host-side compile at fleet scale. Replay re-interns
+    the cached atom ASTs into this compile's _AtomTable (cross-rule
+    dedup preserved) and skips eval_type — entries only exist for
+    rules that already validated under the same manifest digest (the
+    cache clears itself when the finder or dnf_cap changes)."""
+    from istio_tpu.compiler.cache import DecompEntry
+
     interner = interner or InternTable()
     atoms = _AtomTable()
     per_rule: list[tuple[Dnf, Dnf] | None] = []   # None = host fallback
@@ -453,7 +465,29 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
     fallback_reason: dict[int, str] = {}
     parsed: list[Expression] = []
 
+    if decomp_cache is not None:
+        decomp_cache.begin(finder, dnf_cap)
     for ridx, rule in enumerate(rules):
+        # synthesized pseudo-rules (pre-built ast, e.g. rbac lowering)
+        # bypass the cache: they never parse, and keying them would
+        # need an ast rendering that costs what it saves
+        ckey = rule.match if rule.ast is None else None
+        ent = decomp_cache.get(ckey) \
+            if decomp_cache is not None and ckey is not None else None
+        if ent is not None:
+            parsed.append(ent.ast)
+            if ent.is_fallback:
+                per_rule.append(None)
+                host_fallback[ridx] = ent.oracle
+                fallback_reason[ridx] = ent.reason
+            else:
+                idxs = [atoms.index_of(a) for a in ent.atom_asts]
+                per_rule.append((
+                    {frozenset((idxs[p], k) for p, k in conj)
+                     for conj in ent.m},
+                    {frozenset((idxs[p], k) for p, k in conj)
+                     for conj in ent.n}))
+            continue
         ast = _rule_ast(rule)
         rtype = eval_type(ast, finder, DEFAULT_FUNCS)
         if rtype != V.BOOL:
@@ -464,11 +498,26 @@ def compile_ruleset(rules: Sequence[Rule], finder: AttributeDescriptorFinder,
             mark = atoms.mark()
             mn = _decompose(ast, atoms, dnf_cap)
             per_rule.append(mn)
+            if decomp_cache is not None and ckey is not None:
+                used = sorted({i for conj in (mn[0] | mn[1])
+                               for i, _ in conj})
+                pos = {i: p for p, i in enumerate(used)}
+                decomp_cache.put(ckey, DecompEntry(
+                    ast=ast,
+                    atom_asts=tuple(atoms.asts[i] for i in used),
+                    m=tuple(tuple(sorted((pos[i], k) for i, k in conj))
+                            for conj in mn[0]),
+                    n=tuple(tuple(sorted((pos[i], k) for i, k in conj))
+                            for conj in mn[1])))
         except HostFallback as exc:
             atoms.revert(mark)              # undo partial atom adds
             per_rule.append(None)
-            host_fallback[ridx] = _rule_oracle(rule, finder)
+            oracle = _rule_oracle(rule, finder)
+            host_fallback[ridx] = oracle
             fallback_reason[ridx] = str(exc)
+            if decomp_cache is not None and ckey is not None:
+                decomp_cache.put(ckey, DecompEntry(
+                    ast=ast, oracle=oracle, reason=str(exc)))
 
     # Requirements for every device atom; atoms that cannot lower demote
     # every rule that references them to host fallback.

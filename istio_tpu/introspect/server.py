@@ -480,10 +480,33 @@ class IntrospectServer:
                     state.get("build_wall_s", 0.0) * 1e3, 3),
                 "built_wall": state.get("built_wall"),
             },
+            # delta compilation (compiler/cache.py + the content-
+            # addressed bank cache): which banks the last publish
+            # carried vs recompiled, the cumulative rebuild ledger —
+            # including the LAST REBUILD ERROR and the generation it
+            # struck (a failed rebuild keeps the previous generation
+            # serving; this is where that state is visible) — and the
+            # persistent-cache / decomposition-memo counters
+            "delta": state.get("delta") or {
+                "reused": [], "recompiled": [], "plan_stability": {}},
+            "rebuild": dict(getattr(rt, "_rebuild_status", {})),
             "banks": [b.stats() for b in state.get("banks", ())],
             "replicas": [],
             "stages": monitor.shard_latency_snapshot()["stages"],
         }
+        try:
+            from istio_tpu.compiler import cache as compile_cache
+            cc = {"persistent_cache_dir":
+                  getattr(rt, "_compile_cache_dir", None),
+                  "xla_cache_events":
+                      compile_cache.cache_event_counts()}
+            dc = getattr(rt.controller.dispatcher.snapshot,
+                         "decomp_cache", None)
+            if dc is not None:
+                cc["decomp_cache"] = dc.stats()
+            payload["compile_cache"] = cc
+        except Exception as exc:   # accounting never breaks the view
+            payload["compile_cache"] = f"error: {exc}"
         rep_lat = monitor.replica_snapshot()
         routers = {r.replica: r for r in rr.routers}
         for i, lane in enumerate(rr.lanes):

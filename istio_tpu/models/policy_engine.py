@@ -338,8 +338,68 @@ class PolicyEngine:
             else jnp.asarray(err_rule_mask)
         dims = (((1,), (0,)), ((), ()))
 
+        # Value-carrying bank tensors ride in PARAMS (traced
+        # arguments), never as closure constants: intern ids and
+        # config values (status codes, TTLs, list membership ids,
+        # quota limits, per-rule namespaces) change under config
+        # deltas without changing any shape, and baking them into the
+        # HLO would change the compiled program's identity — defeating
+        # jax's jit cache across swaps and the persistent compilation
+        # cache across restarts (compiler/cache.py: a constant-only
+        # config edit must keep every HLO bit-identical). Only
+        # structure-bearing banks (packed regex DFAs, CIDR tables)
+        # stay closure-bound — editing those changes shapes, which is
+        # a legitimate recompile.
+        pe_params = {
+            "pe_rule_ns": rule_ns,
+            "pe_attr_mask_bits": attr_mask_bits,
+            "pe_deny_mask": deny_mask_j,
+            "pe_deny_status": deny_status_j,
+            "pe_deny_dur": deny_dur_j,
+            "pe_deny_uses": deny_uses_j,
+            "pe_list_ids": list_ids_j,
+            "pe_list_rule": list_rule_j,
+            "pe_list_slot": list_slot_j,
+            "pe_list_black": list_black_j,
+            "pe_list_code": list_code_j,
+            "pe_list_dur": list_dur_j,
+            "pe_list_uses": list_uses_j,
+            "pe_q_rule": q_rule_j,
+            "pe_q_slot": q_slot_j,
+            "pe_q_max": q_max_j,
+            "pe_q_nb": q_nb_j,
+            "pe_rb_rule": rb_rule_j,
+            "pe_rb_dur": rb_dur_j,
+            "pe_rb_guard": rb_guard_j,
+            "pe_rb_allow": rb_allow_j,
+        }
+        if err_rule_mask_j is not None:
+            pe_params["pe_err_rule_mask"] = err_rule_mask_j
+
         def step(params: Any, batch: AttributeBatch, req_ns: Any,
                  quota_counts: Any):
+            rule_ns = params["pe_rule_ns"]
+            attr_mask_bits = params["pe_attr_mask_bits"]
+            deny_mask_j = params["pe_deny_mask"]
+            deny_status_j = params["pe_deny_status"]
+            deny_dur_j = params["pe_deny_dur"]
+            deny_uses_j = params["pe_deny_uses"]
+            list_ids_j = params["pe_list_ids"]
+            list_rule_j = params["pe_list_rule"]
+            list_slot_j = params["pe_list_slot"]
+            list_black_j = params["pe_list_black"]
+            list_code_j = params["pe_list_code"]
+            list_dur_j = params["pe_list_dur"]
+            list_uses_j = params["pe_list_uses"]
+            q_rule_j = params["pe_q_rule"]
+            q_slot_j = params["pe_q_slot"]
+            q_max_j = params["pe_q_max"]
+            q_nb_j = params["pe_q_nb"]
+            rb_rule_j = params["pe_rb_rule"]
+            rb_dur_j = params["pe_rb_dur"]
+            rb_guard_j = params["pe_rb_guard"]
+            rb_allow_j = params["pe_rb_allow"]
+            err_rule_mask_j = params.get("pe_err_rule_mask")
             b = batch.ids.shape[0]
             matched, not_matched, err = ruleset_run(params, batch)
             ns_ok = (rule_ns[None, :] == default_ns) | \
@@ -647,7 +707,11 @@ class PolicyEngine:
         }
 
         self.raw_step = step   # unjitted: for entry()/sharded wrappers
-        self.params = self.ruleset.params
+        # ruleset index tensors + the engine bank tensors above — one
+        # argument pytree every step entry (jit, sharded, bench)
+        # passes through; parallel/mesh.param_shardings replicates
+        # unknown keys, so the pe_* banks need no policy entry there
+        self.params = {**self.ruleset.params, **pe_params}
         # donate the quota buffer only when quota state actually
         # threads through the step: donation invalidates the input
         # buffer, which breaks concurrent (pipelined) batches that all

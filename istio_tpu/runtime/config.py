@@ -110,6 +110,16 @@ class Snapshot:
     # (istio_tpu/sharding/banks.py) and must reproduce the layout
     # inputs, or a bank would miss a column its instances read
     compile_kwargs: dict = dataclasses.field(default_factory=dict)
+    # per-instance content digest (template + raw store params,
+    # compiler/cache.stable_digest): bank_content_key folds in the
+    # digests of every instance a bank's rules reference, so an
+    # instance edit invalidates exactly the banks that serve it
+    instance_digests: dict = dataclasses.field(default_factory=dict)
+    # the builder's cross-build DecompCache (compiler/cache.py) rides
+    # along so shard sub-compiles hit the decomposition memo the
+    # parent build just filled; NEVER part of the snapshot's content
+    # identity (excluded from every digest)
+    decomp_cache: Any = dataclasses.field(default=None, repr=False)
 
     def rule_index(self, name: str, namespace: str) -> int:
         for i, r in enumerate(self.rules):
@@ -178,6 +188,12 @@ class SnapshotBuilder:
         self.interner = interner or InternTable()
         self.max_str_len = max_str_len
         self.config_namespace = config_namespace
+        # per-rule parse/DNF memo shared across every build() AND the
+        # shard-bank sub-compiles (via Snapshot.decomp_cache): config
+        # deltas re-present almost every predicate unchanged, so only
+        # genuinely new match strings pay parse + decomposition
+        from istio_tpu.compiler.cache import DecompCache
+        self.decomp_cache = DecompCache()
         # False for non-fused servers: only the fused engine reads the
         # synthesized pseudo-rule rows — compiling them into a snapshot
         # the generic dispatcher serves would be pure compile/step waste
@@ -215,14 +231,21 @@ class SnapshotBuilder:
             handlers[_qualify(name, ns)] = hc
 
         # 3. instances
+        from istio_tpu.compiler.cache import stable_digest
         instances: dict[str, InstanceBuilder] = {}
         instance_templates: dict[str, str] = {}
+        instance_digests: dict[str, str] = {}
         for (kind, ns, name), spec in store.list(KIND_INSTANCE).items():
             tmpl_name = spec.get("template") or spec.get("compiledTemplate")
             if not tmpl_name:
                 errors.append(f"instance {name}.{ns}: missing template")
                 continue
             qname = _qualify(name, ns)
+            # content identity BEFORE any param mutation below — the
+            # bank cache keys on what the store said, not on builder
+            # internals
+            instance_digests[qname] = stable_digest(
+                [str(tmpl_name), dict(spec.get("params") or {})])
             try:
                 info = template_registry.get(str(tmpl_name))
                 params = dict(spec.get("params") or {})
@@ -373,7 +396,9 @@ class SnapshotBuilder:
 
         try:
             ruleset = compile_ruleset(preds, finder,
-                                      interner=self.interner, **kwargs)
+                                      interner=self.interner,
+                                      decomp_cache=self.decomp_cache,
+                                      **kwargs)
         except Exception as exc:
             # a predicate that doesn't type-check is a config error for
             # that rule; retry with offenders replaced by 'false'
@@ -381,6 +406,7 @@ class SnapshotBuilder:
             for p in preds:
                 try:
                     compile_ruleset([p], finder, interner=self.interner,
+                                    decomp_cache=self.decomp_cache,
                                     **kwargs)
                     safe_preds.append(p)
                 except Exception as e2:
@@ -388,7 +414,9 @@ class SnapshotBuilder:
                     safe_preds.append(RulePred(name=p.name, match="false",
                                                namespace=p.namespace))
             ruleset = compile_ruleset(safe_preds, finder,
-                                      interner=self.interner, **kwargs)
+                                      interner=self.interner,
+                                      decomp_cache=self.decomp_cache,
+                                      **kwargs)
 
         # pseudo-rules are implementation detail, not policy: their
         # predicate attrs must not leak into ReferencedAttributes (the
@@ -425,7 +453,9 @@ class SnapshotBuilder:
                         roles=roles, bindings=bindings, errors=errors,
                         n_config_rules=n_config_rules,
                         rbac_groups=rbac_groups,
-                        compile_kwargs=dict(kwargs))
+                        compile_kwargs=dict(kwargs),
+                        instance_digests=instance_digests,
+                        decomp_cache=self.decomp_cache)
 
     @staticmethod
     def _lower_rbac_groups(rules, handlers, instances,

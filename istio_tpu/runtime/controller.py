@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable, Mapping
 
 from istio_tpu.attribute.types import ValueType
@@ -119,6 +120,11 @@ class Controller:
         self._rebuild_serial = threading.Lock()   # one rebuild at a time
         self._timer: threading.Timer | None = None
         self._dispatcher: Dispatcher | None = None
+        # wall seconds of the last COMPLETED publish, store read →
+        # snapshot compile → swap → on_publish hooks (the sharded bank
+        # rebuild included) — the republish-latency number the delta-
+        # compilation bench and smoke read
+        self.last_publish_wall_s = 0.0
         self.rebuild()                      # initial snapshot
         store.watch(self._on_events)
 
@@ -148,6 +154,7 @@ class Controller:
             return self._rebuild_locked()
 
     def _rebuild_locked(self) -> Dispatcher:
+        t_pub0 = time.perf_counter()
         snapshot = self._builder.build(self.store)
         for err in snapshot.errors:
             log.warning("config: %s", err)
@@ -276,6 +283,7 @@ class Controller:
                  len(snapshot.instances), len(snapshot.errors))
         if self.on_publish is not None:
             self.on_publish(dispatcher)
+        self.last_publish_wall_s = time.perf_counter() - t_pub0
         return dispatcher
 
     def _guarded_prewarm(self, plan) -> None:

@@ -78,6 +78,27 @@ class ServerArgs:
     # tests that call plan.prewarm explicitly — the duplicate compile
     # contends for the core); swap-time prewarm stays synchronous
     initial_prewarm: bool = True
+    # -- delta compilation & bank cache (compiler/cache.py) ------------
+    # True (default): config republishes under sharding diff the
+    # incoming store against the live plan by content hash and rebuild
+    # ONLY the banks whose namespaces (or the replicated global set)
+    # changed — untouched banks carry across generations with their
+    # prewarmed shapes, breaker state and rulestats bindings. False is
+    # the kill switch: every publish rebuilds every bank (and is what
+    # the bench's capacity_republish_full_s measures).
+    delta_compile: bool = True
+    # namespaces the delta planner may RELOCATE per republish to chase
+    # LPT balance — each move recompiles two banks, so this is an
+    # explicit republish-latency vs balance trade (0 = perfect plan
+    # stability, the default; see sharding/planner.plan_shards)
+    shard_rebalance_budget: int = 0
+    # JAX persistent compilation cache directory: restarts and rolling
+    # deploys skip the XLA compile for every program whose HLO is
+    # unchanged (our compiled programs take index tensors as traced
+    # ARGUMENTS, so constant-only config edits keep the HLO
+    # bit-identical). None → the MIXS_JAX_COMPILE_CACHE_DIR env var →
+    # jax's own defaulting (mixs exposes --jax-compile-cache-dir).
+    jax_compile_cache_dir: str | None = None
     max_str_len: int | None = None
     preprocess: bool = True
     # serve checks through the fused device engine (runtime/fused.py);
@@ -147,6 +168,16 @@ class ServerArgs:
 class RuntimeServer:
     def __init__(self, store: Store, args: ServerArgs | None = None):
         self.args = args or ServerArgs()
+        # persistent XLA compilation cache (compiler/cache.py): wire
+        # it BEFORE the first compile so the controller's initial
+        # publish already reads/writes cached artifacts
+        from istio_tpu.compiler import cache as compile_cache
+        cache_dir = compile_cache.resolve_cache_dir(
+            self.args.jax_compile_cache_dir)
+        if cache_dir:
+            compile_cache.configure_persistent_cache(cache_dir)
+            compile_cache.install_event_counters()
+        self._compile_cache_dir = cache_dir
         manifest = self.args.default_manifest
         if manifest is None:
             manifest = GLOBAL_MANIFEST
@@ -204,6 +235,25 @@ class RuntimeServer:
                              "mutually exclusive (banks own their "
                              "device leases)")
         self._sharded: dict | None = None
+        # delta-compilation rebuild ledger — zero-shaped before the
+        # first sharded publish (the promtext doctrine applied to
+        # /debug/shards): per-generation and cumulative reused-vs-
+        # recompiled bank counts, the last rebuild wall, and the last
+        # rebuild ERROR with the generation it struck (satellite fix:
+        # a swallowed bank-build failure must be loudly visible, not
+        # one log line deep in the publish path)
+        self._rebuild_status: dict = {
+            "rebuilds": 0,
+            "banks_reused": 0,
+            "banks_recompiled": 0,
+            "banks_reused_total": 0,
+            "banks_recompiled_total": 0,
+            "last_wall_s": 0.0,
+            "revision": 0,
+            "errors": 0,
+            "last_error": None,
+            "last_error_revision": None,
+        }
         self.controller = Controller(
             store, default_manifest=manifest,
             identity_attr=self.args.identity_attr,
@@ -341,12 +391,21 @@ class RuntimeServer:
         if getattr(self, "_replica_router", None) is not None:
             try:
                 self._rebuild_sharded(dispatcher)
-            except Exception:
+            except Exception as exc:
+                # surfaced, not just logged: /debug/shards renders the
+                # ledger so an on-call sees WHICH generation failed to
+                # build banks and that the previous one keeps serving
+                st = self._rebuild_status
+                st["errors"] += 1
+                st["last_error"] = f"{type(exc).__name__}: {exc}"
+                st["last_error_revision"] = \
+                    dispatcher.snapshot.revision
                 import logging
                 logging.getLogger(
                     "istio_tpu.runtime.server").exception(
-                    "sharded serving rebuild failed; previous "
-                    "generation keeps serving")
+                    "sharded serving rebuild failed for generation "
+                    "%d; previous generation keeps serving",
+                    dispatcher.snapshot.revision)
         # in-step quota prewarm backstop (ADVICE r5: fused.
         # prewarm_instep was defined but never called, so the first
         # quota-carrying batch paid its XLA trace in-band). The main
@@ -368,11 +427,16 @@ class RuntimeServer:
     def _rebuild_sharded(self, dispatcher) -> None:
         """Build the sharded serving generation for a published
         dispatcher and fan it across every surface coherently:
-        compile plan → banks (off-path; the previous generation keeps
-        serving), prewarm each bank's serving shapes, swap all replica
-        lanes with one atomic set_routers, rebind the rulestats
-        aggregator to the bank dispatchers (name-keyed counts merge
-        globally), and record the plan decision for /debug/shards.
+        plan (delta-stable against the live plan) → DIFF by bank
+        content hash → compile only the banks whose namespaces (or
+        the replicated global set) changed, carrying every untouched
+        bank — prewarmed shapes, breaker state, rulestats bindings —
+        across the generation (off-path; the previous generation
+        keeps serving), prewarm the NEW banks' serving shapes, swap
+        all replica lanes with one atomic set_routers, rebind the
+        rulestats aggregator to the bank dispatchers (name-keyed
+        counts merge globally), and record the plan decision + the
+        reused-vs-recompiled ledger for /debug/shards.
         The canary recorder taps the bank dispatchers the same way it
         taps a monolithic one — bank-local rule indices resolve
         through the bank's own qualified_rule_names, which are the
@@ -380,7 +444,9 @@ class RuntimeServer:
         import time as _time
 
         from istio_tpu.sharding import (ReplicaRouter, ShardRouter,
-                                        build_shard_banks)
+                                        bank_content_key,
+                                        compile_shard_bank,
+                                        snapshot_static_digest)
         from istio_tpu.sharding.banks import (ShardingUnsupported,
                                               full_bank)
         from istio_tpu.sharding.planner import (costs_from_ruleset,
@@ -395,6 +461,8 @@ class RuntimeServer:
         t0 = _time.perf_counter()
         n_lanes = router.n_replicas
         reason = ""
+        bank_keys: list[str] = []
+        reused_ids: list[int] = []
         if self.args.shards > 0:
             try:
                 preds = snap.ruleset.rules[:snap.n_config_rules]
@@ -403,15 +471,63 @@ class RuntimeServer:
                 # pass on the rebuild thread
                 costs = costs_from_ruleset(
                     snap.ruleset, snap.finder)[:snap.n_config_rules]
-                plan = plan_shards(preds, snap.finder,
-                                   self.args.shards, costs=costs,
-                                   revision=snap.revision)
-                banks = build_shard_banks(
-                    snap, dispatcher.handlers, plan,
-                    identity_attr=self.args.identity_attr,
+                # the content-addressed bank cache: the previous
+                # generation's banks keyed by their ruleset-
+                # decomposition hash. Delta planning keeps unchanged
+                # namespaces on their current shards, so an unchanged
+                # shard's key matches and its compiled bank carries
+                # over; pop-on-use so two identical shards (possible
+                # when both hold only replicated globals) never share
+                # one bank object.
+                prev = self._sharded if self.args.delta_compile \
+                    else None
+                prev_plan = None
+                cache: dict[str, Any] = {}
+                if prev is not None and prev.get("mode") == "sharded":
+                    prev_plan = prev["plan"]
+                    for b, key in zip(prev["banks"],
+                                      prev.get("bank_keys", ())):
+                        cache.setdefault(key, b)
+                plan = plan_shards(
+                    preds, snap.finder, self.args.shards, costs=costs,
+                    revision=snap.revision, prev=prev_plan,
+                    rebalance_budget=self.args.shard_rebalance_budget)
+                static = snapshot_static_digest(
+                    snap, identity_attr=self.args.identity_attr,
                     buckets=buckets,
-                    rule_telemetry=self.args.rule_telemetry,
-                    recorder=recorder)
+                    rule_telemetry=self.args.rule_telemetry)
+                banks = []
+                for k in range(plan.n_shards):
+                    key = bank_content_key(snap, plan, k, static)
+                    bank_keys.append(key)
+                    carried = cache.pop(key, None)
+                    if carried is not None:
+                        # carry the compiled artifact by SHALLOW COPY:
+                        # the new generation's bank shares the
+                        # dispatcher/snapshot/checker (the expensive,
+                        # content-matched parts) but owns its
+                        # local_to_global — the outgoing generation's
+                        # routers keep the ORIGINAL object, so
+                        # in-flight folds never see the incoming
+                        # generation's rule numbering and a rebuild
+                        # that fails on a later bank leaves serving
+                        # state untouched
+                        banks.append(dataclasses.replace(
+                            carried, shard_id=k,
+                            local_to_global=np.asarray(
+                                plan.shard_rules[k], np.int64),
+                            predicted_cost=float(plan.shard_cost[k])
+                            if plan.shard_cost else 0.0))
+                        reused_ids.append(k)
+                    else:
+                        b = compile_shard_bank(
+                            snap, dispatcher.handlers, plan, k,
+                            identity_attr=self.args.identity_attr,
+                            buckets=buckets,
+                            rule_telemetry=self.args.rule_telemetry,
+                            recorder=recorder)
+                        b.content_key = key
+                        banks.append(b)
                 bank_map = {b.shard_id: b for b in banks}
                 routers = [ShardRouter(bank_map, plan,
                                        self.args.identity_attr,
@@ -470,7 +586,14 @@ class RuntimeServer:
         from istio_tpu.runtime.resilience import (ResilienceConfig,
                                                   ResilientChecker)
         breakers = getattr(self, "_bank_breakers", {})
+        reused_set = set(reused_ids)
         for b in banks:
+            if b.shard_id in reused_set and b.checker is not None:
+                # carried bank: its checker's device/oracle callables
+                # ARE this bank's dispatcher — checker, breaker state
+                # and all, it rides along untouched
+                breakers[b.shard_id] = b.checker.breaker
+                continue
             b.checker = ResilientChecker(
                 device=b.dispatcher.check,
                 oracle=b.dispatcher.check_host_oracle,
@@ -479,21 +602,24 @@ class RuntimeServer:
                     breaker_failures=self.args.breaker_failures,
                     breaker_reset_s=self.args.breaker_reset_s,
                     retry=self.args.device_retry))
-            prev = breakers.get(b.shard_id)
-            if prev is not None:
-                b.checker.breaker = prev
+            prev_brk = breakers.get(b.shard_id)
+            if prev_brk is not None:
+                b.checker.breaker = prev_brk
             else:
                 breakers[b.shard_id] = b.checker.breaker
         self._bank_breakers = breakers
-        # warm each bank's serving shapes BEFORE the lane swap — the
-        # previous generation serves meanwhile, so no request pays a
-        # bank's first XLA trace in-band (the monolithic swap-warm
+        # warm each NEW bank's serving shapes BEFORE the lane swap —
+        # the previous generation serves meanwhile, so no request pays
+        # a bank's first XLA trace in-band (the monolithic swap-warm
         # doctrine, per bank); on swaps the warm yields to live
-        # serving between shapes exactly like the monolithic one
+        # serving between shapes exactly like the monolithic one.
+        # Carried banks keep their already-compiled shape set — NOT
+        # re-warmed, that is the delta-compilation win.
         from istio_tpu.runtime.controller import _serving_backoff
         first_build = self._sharded is None
         distinct = {id(b.dispatcher.fused): b for b in banks
-                    if b.dispatcher.fused is not None}
+                    if b.dispatcher.fused is not None
+                    and b.shard_id not in reused_set}
         for b in distinct.values():
             b.dispatcher.fused.prewarm(
                 buckets,
@@ -510,16 +636,34 @@ class RuntimeServer:
             import logging
             logging.getLogger("istio_tpu.runtime.server").exception(
                 "rulestats lane attach failed")
+        wall = _time.perf_counter() - t0
+        n_recompiled = len(banks) - len(reused_ids)
         self._sharded = {
             "plan": plan,
             "banks": banks,
+            "bank_keys": bank_keys,
             "revision": snap.revision,
             "mode": "sharded" if self.args.shards > 0 and not reason
                     else "replica-only",
             "fallback_reason": reason,
-            "build_wall_s": _time.perf_counter() - t0,
+            "build_wall_s": wall,
             "built_wall": _time.time(),
+            "delta": {
+                "reused": sorted(reused_ids),
+                "recompiled": sorted(
+                    b.shard_id for b in banks
+                    if b.shard_id not in reused_set),
+                "plan_stability": dict(plan.stability),
+            },
         }
+        st = self._rebuild_status
+        st["rebuilds"] += 1
+        st["banks_reused"] = len(reused_ids)
+        st["banks_recompiled"] = n_recompiled
+        st["banks_reused_total"] += len(reused_ids)
+        st["banks_recompiled_total"] += n_recompiled
+        st["last_wall_s"] = round(wall, 4)
+        st["revision"] = snap.revision
 
     def _prewarm_instep_for(self, plan) -> None:
         """Controller prewarm_hook: compile the CANDIDATE plan's

@@ -68,7 +68,12 @@ class Store:
     def delete(self, key: Key) -> None:
         self.apply_events([Event(key, None)])
 
-    def apply_events(self, events: list[Event]) -> None:
+    def apply_events(self, events: list[Event],
+                     notify: bool = True) -> None:
+        """Apply mutations; `notify=False` skips watcher delivery —
+        the deterministic-republish hook benches and smokes use to
+        pair one store edit with ONE explicit controller.rebuild()
+        instead of racing the debounce timer's background rebuild."""
         if self._validator is not None:
             for ev in events:
                 self._validator(ev.key, ev.value)
@@ -78,7 +83,8 @@ class Store:
                     self._data.pop(ev.key, None)
                 else:
                     self._data[ev.key] = dict(ev.value)
-        self._queue.put(list(events))
+        if notify:
+            self._queue.put(list(events))
 
     # -- watch --
     def watch(self, watcher: Watcher) -> None:

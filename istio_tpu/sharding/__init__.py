@@ -32,13 +32,18 @@ Layers (each its own module):
 from istio_tpu.sharding.planner import (ShardPlan, ShardPlanError,
                                         plan_shards, predict_rule_costs)
 from istio_tpu.sharding.banks import (ShardBank, ShardingUnsupported,
-                                      build_shard_banks, shard_snapshot)
+                                      bank_content_key,
+                                      build_shard_banks,
+                                      compile_shard_bank, rebind_bank,
+                                      shard_snapshot,
+                                      snapshot_static_digest)
 from istio_tpu.sharding.router import ReplicaRouter, ShardRouter
 from istio_tpu.sharding.parity import oracle_check_statuses
 
 __all__ = [
     "ShardPlan", "ShardPlanError", "plan_shards", "predict_rule_costs",
-    "ShardBank", "ShardingUnsupported", "build_shard_banks",
-    "shard_snapshot", "ReplicaRouter", "ShardRouter",
-    "oracle_check_statuses",
+    "ShardBank", "ShardingUnsupported", "bank_content_key",
+    "build_shard_banks", "compile_shard_bank", "rebind_bank",
+    "shard_snapshot", "snapshot_static_digest",
+    "ReplicaRouter", "ShardRouter", "oracle_check_statuses",
 ]

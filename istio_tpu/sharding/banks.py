@@ -28,6 +28,8 @@ refuses it under sharding (instep_quota_target → None).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 from typing import Any, Mapping, Sequence
 
 import numpy as np
@@ -65,6 +67,12 @@ class ShardBank:
     # RuntimeServer._rebuild_sharded (it owns the ResilienceConfig);
     # None = raw dispatcher.check (tests driving banks directly).
     checker: Any = None
+    # delta-compilation bookkeeping (bank_content_key / the server's
+    # content-addressed bank cache): the content hash this bank was
+    # compiled for, and the config generation that compiled it — a
+    # carried bank keeps built_revision while serving newer plans
+    content_key: str = ""
+    built_revision: int = 0
 
     def check(self, bags) -> list:
         """The router's per-bank entry: resilient when wired."""
@@ -97,10 +105,94 @@ class ShardBank:
                 if self.dispatcher.fused is not None else 0,
             "bank_bytes": self.bank_bytes(),
             "predicted_cost": round(self.predicted_cost, 1),
+            "built_revision": self.built_revision,
         }
+        if self.content_key:
+            out["content_key"] = self.content_key[:12]
         if self.checker is not None:
             out["breaker"] = self.checker.breaker.state
         return out
+
+
+def snapshot_static_digest(parent: Snapshot, *, identity_attr: str,
+                           buckets: Sequence[int],
+                           rule_telemetry: bool) -> str:
+    """Digest of the COMPILE-ENVIRONMENT inputs every bank of a
+    snapshot shares: the attribute manifest (type decisions), the
+    exact compile_ruleset kwargs (layout columns, byte slots, extern
+    ingest, max_str_len, rule_pad), and the serving knobs baked into
+    a bank's dispatcher at construction. Any change here invalidates
+    EVERY bank — correct, because these are the inputs a compiled
+    program cannot revalidate after the fact."""
+    from istio_tpu.compiler.cache import manifest_digest, stable_digest
+
+    return stable_digest({
+        "manifest": manifest_digest(parent.finder),
+        "compile_kwargs": parent.compile_kwargs,
+        "identity_attr": identity_attr,
+        "buckets": sorted(int(b) for b in buckets),
+        "rule_telemetry": bool(rule_telemetry),
+    })
+
+
+def bank_content_key(parent: Snapshot, plan: ShardPlan, k: int,
+                     static_digest: str) -> str:
+    """Deterministic content hash of shard k's ruleset decomposition —
+    THE key of the content-addressed bank cache. Covers, in bank-local
+    rule order: each rule's name/namespaces/match source and its
+    action wiring, plus the content digests of every handler and
+    instance those actions reference, on top of the shared
+    static digest (manifest + compile_kwargs + serving knobs). Global
+    rules are replicated into every shard's list, so editing one
+    changes every bank's key — the full-rebuild case, by design.
+    Deliberately NOT covering global rule indices: a delta elsewhere
+    in the config renumbers them without changing this bank's
+    compiled artifact (rebind_bank refreshes the index map instead).
+    """
+    h = hashlib.sha256(static_digest.encode("ascii"))
+    ref_handlers: set[str] = set()
+    ref_instances: set[str] = set()
+    for i in plan.shard_rules[k]:
+        rc = parent.rules[i]
+        pred = parent.ruleset.rules[i]
+        h.update(json.dumps(
+            [rc.name, rc.namespace, pred.namespace, rc.match,
+             [[a.handler, list(a.instances)] for a in rc.actions]],
+            sort_keys=True, separators=(",", ":")).encode("utf-8"))
+        for a in rc.actions:
+            ref_handlers.add(a.handler)
+            ref_instances.update(a.instances)
+    for name in sorted(ref_handlers):
+        hc = parent.handlers.get(name)
+        sig = hc.signature if hc is not None else "<missing>"
+        h.update(f"H|{name}|{sig}".encode("utf-8"))
+    for name in sorted(ref_instances):
+        dig = parent.instance_digests.get(name, "<missing>")
+        h.update(f"I|{name}|{dig}".encode("utf-8"))
+    return h.hexdigest()
+
+
+def rebind_bank(bank: ShardBank, plan: ShardPlan, k: int) -> ShardBank:
+    """Carry a content-matched bank into a new generation's plan:
+    the compiled artifact (sub-snapshot, dispatcher, prewarmed
+    shapes, breaker, telemetry accumulators) is byte-equivalent by
+    key, but the PARENT-side bookkeeping is not — global rule indices
+    renumber under deltas elsewhere in the config, so the
+    local→global map is rebuilt from the new plan (the bank's local
+    rule order is ascending global order in both generations, and a
+    matching content key pins the two sequences element-for-element).
+
+    NOTE for banks that are LIVE on a serving generation:
+    `local_to_global` is read by in-flight folds, so the server's
+    rebuild path defers that one assignment until every fallible
+    rebuild step is done (RuntimeServer._rebuild_sharded) — this
+    convenience helper applies everything at once and is meant for
+    banks not currently serving (tests, offline tools)."""
+    bank.shard_id = k
+    bank.local_to_global = np.asarray(plan.shard_rules[k], np.int64)
+    bank.predicted_cost = float(plan.shard_cost[k]) \
+        if plan.shard_cost else 0.0
+    return bank
 
 
 def shard_snapshot(parent: Snapshot, plan: ShardPlan,
@@ -123,7 +215,10 @@ def shard_snapshot(parent: Snapshot, plan: ShardPlan,
     preds = [parent.ruleset.rules[i] for i in idxs]
     rules = [parent.rules[i] for i in idxs]
     interner = parent.ruleset.interner
+    # the parent build just decomposed these exact predicates — the
+    # shared DecompCache makes the sub-compile skip parse+DNF entirely
     ruleset = compile_ruleset(preds, parent.finder, interner=interner,
+                              decomp_cache=parent.decomp_cache,
                               **parent.compile_kwargs)
     sub = Snapshot(
         revision=parent.revision, finder=parent.finder,
@@ -137,6 +232,28 @@ def shard_snapshot(parent: Snapshot, plan: ShardPlan,
     return sub, np.asarray(idxs, np.int64)
 
 
+def compile_shard_bank(parent: Snapshot, handlers: Mapping[str, Any],
+                       plan: ShardPlan, k: int, *,
+                       identity_attr: str,
+                       buckets: Sequence[int] = (),
+                       rule_telemetry: bool = True,
+                       recorder: Any = None) -> ShardBank:
+    """Compile ONE shard of `plan` into a ShardBank — the unit the
+    delta-compilation path pays per CHANGED shard (unchanged shards
+    carry their previous bank via rebind_bank instead)."""
+    from istio_tpu.runtime.fused import build_fused_plan
+
+    sub, l2g = shard_snapshot(parent, plan, k)
+    fused = build_fused_plan(sub, rule_telemetry=rule_telemetry)
+    disp = Dispatcher(sub, handlers, identity_attr,
+                      fused=fused, buckets=tuple(buckets),
+                      recorder=recorder)
+    cost = float(plan.shard_cost[k]) if plan.shard_cost else 0.0
+    return ShardBank(shard_id=k, snapshot=sub, dispatcher=disp,
+                     local_to_global=l2g, predicted_cost=cost,
+                     built_revision=parent.revision)
+
+
 def build_shard_banks(parent: Snapshot,
                       handlers: Mapping[str, Any],
                       plan: ShardPlan, *,
@@ -148,19 +265,12 @@ def build_shard_banks(parent: Snapshot,
     ShardingUnsupported when the snapshot cannot shard; individual
     bad rules never fail a bank (compile_ruleset demotes them to the
     bank's host-fallback oracle, same as monolithic)."""
-    from istio_tpu.runtime.fused import build_fused_plan
-
-    banks: list[ShardBank] = []
-    for k in range(plan.n_shards):
-        sub, l2g = shard_snapshot(parent, plan, k)
-        fused = build_fused_plan(sub, rule_telemetry=rule_telemetry)
-        disp = Dispatcher(sub, handlers, identity_attr,
-                          fused=fused, buckets=tuple(buckets),
-                          recorder=recorder)
-        cost = float(plan.shard_cost[k]) if plan.shard_cost else 0.0
-        banks.append(ShardBank(shard_id=k, snapshot=sub,
-                               dispatcher=disp, local_to_global=l2g,
-                               predicted_cost=cost))
+    banks = [compile_shard_bank(parent, handlers, plan, k,
+                                identity_attr=identity_attr,
+                                buckets=buckets,
+                                rule_telemetry=rule_telemetry,
+                                recorder=recorder)
+             for k in range(plan.n_shards)]
     log.info("built %d shard banks (%s rules/bank, %d global rules "
              "replicated)", len(banks),
              "/".join(str(b.n_rules) for b in banks),
@@ -189,4 +299,5 @@ def full_bank(parent: Snapshot, handlers: Mapping[str, Any],
                                 recorder=recorder)
     return ShardBank(
         shard_id=shard_id, snapshot=parent, dispatcher=dispatcher,
-        local_to_global=np.arange(len(parent.rules), dtype=np.int64))
+        local_to_global=np.arange(len(parent.rules), dtype=np.int64),
+        built_revision=parent.revision)

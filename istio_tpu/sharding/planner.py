@@ -151,6 +151,13 @@ class ShardPlan:
     ns_cost: dict[str, float]
     plan_wall_s: float = 0.0
     revision: int = 0
+    # delta-planning audit trail (plan_shards(prev=...)): namespaces
+    # the bounded rebalance relocated this generation (each one costs
+    # a bank recompile on BOTH its old and new shard — the budget is
+    # the knob that trades balance for republish latency), plus the
+    # kept/new/removed accounting the stability tests pin
+    moved_ns: list = dataclasses.field(default_factory=list)
+    stability: dict = dataclasses.field(default_factory=dict)
 
     def shard_of(self, ns: str) -> int:
         """Bank for a request namespace. Namespaces the plan never saw
@@ -188,6 +195,7 @@ class ShardPlan:
             "revision": self.revision,
             "plan_wall_ms": round(self.plan_wall_s * 1e3, 3),
             "balance": self.balance(),
+            "stability": dict(self.stability) or {"mode": "scratch"},
         }
 
 
@@ -206,13 +214,27 @@ def plan_shards(preds: Sequence, finder: AttributeDescriptorFinder,
                 n_shards: int,
                 costs: np.ndarray | None = None,
                 dnf_cap: int = DEFAULT_DNF_CAP,
-                revision: int = 0) -> ShardPlan:
+                revision: int = 0,
+                prev: ShardPlan | None = None,
+                rebalance_budget: int = 0) -> ShardPlan:
     """Partition compiler Rule preds into an n_shards ShardPlan.
 
-    LPT greedy: namespaces sorted by total predicted cost (descending,
-    name tie-break) land on the currently least-loaded shard; the
-    replicated global-rule cost is charged to every shard up front.
-    Deterministic for a given (preds, n_shards)."""
+    Scratch mode (prev=None): LPT greedy — namespaces sorted by total
+    predicted cost (descending, name tie-break) land on the currently
+    least-loaded shard; the replicated global-rule cost is charged to
+    every shard up front. Deterministic for a given (preds, n_shards).
+
+    Delta mode (prev= a same-width plan): PLAN STABILITY is the
+    contract — every namespace prev knows keeps its shard (its bank's
+    content hash, and therefore the bank cache's carry-over decision,
+    depends on exactly which namespaces share its bank), new
+    namespaces LPT-place onto the least-loaded shard, removed ones
+    simply vanish. An optional LPT rebalance then moves at most
+    `rebalance_budget` namespaces (largest imbalance first, each move
+    strictly reducing the max-shard cost) — every move recompiles two
+    banks, so the budget is an explicit latency/balance trade, default
+    0. Routing of unchanged namespaces is byte-identical to prev by
+    construction (kept assignments + the same crc32 fallback)."""
     if n_shards < 1:
         raise ShardPlanError(f"n_shards must be >= 1, got {n_shards}")
     t0 = time.perf_counter()
@@ -232,11 +254,56 @@ def plan_shards(preds: Sequence, finder: AttributeDescriptorFinder,
 
     shard_cost = [global_cost] * n_shards
     shard_ns: list[list[str]] = [[] for _ in range(n_shards)]
-    order = sorted(by_ns, key=lambda ns: (-ns_cost[ns], ns))
-    for ns in order:
-        k = min(range(n_shards), key=lambda s: (shard_cost[s], s))
-        shard_cost[k] += ns_cost[ns]
-        shard_ns[k].append(ns)
+    moved: list[str] = []
+    stability: dict = {"mode": "scratch"}
+    if prev is not None and prev.n_shards == n_shards \
+            and prev.ns_to_shard:
+        kept = {ns: prev.ns_to_shard[ns] for ns in by_ns
+                if ns in prev.ns_to_shard}
+        fresh = [ns for ns in by_ns if ns not in kept]
+        removed = [ns for ns in prev.ns_to_shard if ns not in by_ns]
+        for ns, k in kept.items():
+            shard_cost[k] += ns_cost[ns]
+            shard_ns[k].append(ns)
+        for ns in sorted(fresh, key=lambda ns: (-ns_cost[ns], ns)):
+            k = min(range(n_shards), key=lambda s: (shard_cost[s], s))
+            shard_cost[k] += ns_cost[ns]
+            shard_ns[k].append(ns)
+        for _ in range(max(int(rebalance_budget), 0)):
+            hi = max(range(n_shards), key=lambda s: (shard_cost[s], -s))
+            lo = min(range(n_shards), key=lambda s: (shard_cost[s], s))
+            gap = shard_cost[hi] - shard_cost[lo]
+            # a move of cost c turns (hi, lo) into (hi-c, lo+c): it
+            # strictly improves the pair's peak iff 0 < c < gap; the
+            # best c is gap/2 (perfectly splitting the imbalance)
+            cands = [ns for ns in shard_ns[hi]
+                     if 0.0 < ns_cost[ns] < gap]
+            if not cands:
+                break
+            ns = min(cands,
+                     key=lambda x: (abs(ns_cost[x] - gap / 2.0), x))
+            shard_ns[hi].remove(ns)
+            shard_ns[lo].append(ns)
+            shard_cost[hi] -= ns_cost[ns]
+            shard_cost[lo] += ns_cost[ns]
+            moved.append(ns)
+        # a relocated FRESH namespace never sat on a shard before —
+        # it costs one new-bank compile either way and must not be
+        # booked as a previously-placed namespace churning off its
+        # shard (only moves of KEPT namespaces cost two recompiles)
+        moved_kept = [ns for ns in moved if ns in kept]
+        stability = {"mode": "delta",
+                     "kept": len(kept) - len(moved_kept),
+                     "new": len(fresh), "removed": len(removed),
+                     "moved": list(moved),
+                     "moved_kept": moved_kept,
+                     "rebalance_budget": int(rebalance_budget)}
+    else:
+        order = sorted(by_ns, key=lambda ns: (-ns_cost[ns], ns))
+        for ns in order:
+            k = min(range(n_shards), key=lambda s: (shard_cost[s], s))
+            shard_cost[k] += ns_cost[ns]
+            shard_ns[k].append(ns)
     ns_to_shard = {ns: k for k, nss in enumerate(shard_ns)
                    for ns in nss}
     shard_rules = []
@@ -250,4 +317,5 @@ def plan_shards(preds: Sequence, finder: AttributeDescriptorFinder,
                      global_rules=sorted(global_rules),
                      shard_cost=shard_cost, ns_cost=ns_cost,
                      plan_wall_s=time.perf_counter() - t0,
-                     revision=revision)
+                     revision=revision,
+                     moved_ns=moved, stability=stability)

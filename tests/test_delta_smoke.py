@@ -1,0 +1,31 @@
+"""Tier-1 hook for scripts/delta_smoke.py: the CI gate that config
+churn is delta-compiled on a sharded snapshot — a one-namespace
+constant edit republishes by rebuilding exactly one bank (the other
+K-1 carried as the same objects under a byte-stable plan), the probe
+flip proves the delta took effect, the sharded path stays EXACTLY
+oracle-parity over the real gRPC front before and after, and a
+simulated restart with the warm persistent XLA cache serves with
+zero cache misses. Runs main() in-process at the issue's platform
+scale (100k rules tpu / 4k cpu — resolved inside main())."""
+import importlib.util
+import os
+import sys
+
+
+def _load():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "delta_smoke.py")
+    spec = importlib.util.spec_from_file_location("delta_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_delta_smoke_main():
+    mod = _load()
+    try:
+        rc = mod.main()
+    finally:
+        sys.modules.pop("delta_smoke", None)
+    assert rc == 0
