@@ -797,25 +797,155 @@ def _overlay_bench(on_tpu: bool) -> dict:
         med, t_min, t_max = _med3(ts)
         cps = batch / med
         baseline = 1e9 / (PER_PREDICATE_NS * n_rules)
-        return {"overlay_rules": n_overlay,
-                # a zero here means the workload regressed back into
-                # the lowerable envelope and the section measures
-                # nothing (the r4 failure mode) — flagged, not silent
-                "overlay_measures_host_actions": bool(n_overlay > 0),
-                "overlay_fused_lists": fused_lists,
-                "overlay_unfused_kinds": unfused,
-                "overlay_checks_per_sec": round(cps, 1),
-                "overlay_checks_per_sec_min": round(batch / t_max, 1),
-                "overlay_checks_per_sec_max": round(batch / t_min, 1),
-                # cross-run spread (max/min wall over the 3 timed
-                # runs): ROADMAP item 4's ≤1.5x done-bar is judged on
-                # this number
-                "overlay_cross_run_spread": round(t_max / t_min, 2)
-                if t_min > 0 else -1.0,
-                "overlay_batch_ms": round(med * 1e3, 1),
-                "overlay_vs_baseline": round(cps / baseline, 2)}
+        out = {"overlay_rules": n_overlay,
+               # a zero here means the workload regressed back into
+               # the lowerable envelope and the section measures
+               # nothing (the r4 failure mode) — flagged, not silent
+               "overlay_measures_host_actions": bool(n_overlay > 0),
+               "overlay_fused_lists": fused_lists,
+               "overlay_unfused_kinds": unfused,
+               "overlay_checks_per_sec": round(cps, 1),
+               "overlay_checks_per_sec_min": round(batch / t_max, 1),
+               "overlay_checks_per_sec_max": round(batch / t_min, 1),
+               # cross-run spread (max/min wall over the 3 timed
+               # runs): ROADMAP item 4's ≤1.5x done-bar is judged on
+               # this number
+               "overlay_cross_run_spread": round(t_max / t_min, 2)
+               if t_min > 0 else -1.0,
+               "overlay_batch_ms": round(med * 1e3, 1),
+               "overlay_vs_baseline": round(cps / baseline, 2)}
+        out.update(_overlay_executor_bench(store, n_rules, batch))
+        out.update(_overlay_opa_bench(on_tpu))
+        return out
     except Exception as exc:
         return {"overlay_error": f"{type(exc).__name__}: {exc}"}
+
+
+def _overlay_executor_bench(store, n_rules: int, batch: int) -> dict:
+    """Throughput vs adapter-executor workers (ISSUE 12 / ROADMAP
+    item 2's done-bar): every request targets an overlay rule so each
+    carries exactly one host list action, and a 2ms per-call adapter
+    latency (ADAPTER_LAT_S, reported as
+    overlay_executor_adapter_latency_ms) is injected at the chaos
+    seam — the stand-in for the external backend RPC (a real list
+    provider / OPA sidecar / quota store hop) whose wall the bulkhead
+    lanes exist to overlap.
+    Keys: overlay_executor_workers, overlay_throughput_vs_workers
+    (checks/s per worker count), overlay_executor_scaling (highest /
+    lowest worker count's throughput — >1 means host-action wall
+    genuinely overlaps), overlay_executor_spread (worst cross-run
+    max/min)."""
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.runtime import monitor as _monitor
+    from istio_tpu.runtime.resilience import CHAOS
+    from istio_tpu.testing import workloads
+
+    # big enough that the injected host-action wall dominates the
+    # ~30ms device+fold floor (128 actions / 3 lanes × 2ms ≈ 85ms at
+    # one worker per lane) — a 0.5ms hop drowned in single-core noise
+    ADAPTER_LAT_S = 0.002
+    handlers = ("cilist.istio-system", "provlist.istio-system",
+                "dynpat.istio-system")
+    n_services = max(n_rules // 2, 1)
+    overlay_rules = list(range(2, n_rules, 10))
+    bags = [bag_from_mapping({
+        "destination.service":
+            f"svc{i % n_services}.ns{i % 23}.svc.cluster.local",
+        "source.namespace": "ns2",
+        "request.method": "GET",
+        "request.path": f"/api/v{i % 3}/items",
+    }) for i in (overlay_rules[j % len(overlay_rules)]
+                 for j in range(batch))]
+    workers = (1, 4)
+    try:
+        vs: dict[str, float] = {}
+        worst_spread = 0.0
+        fired = 0
+        for w in workers:
+            srv = RuntimeServer(store, ServerArgs(
+                batch_window_s=0.001, max_batch=batch,
+                buckets=(batch,), executor_workers=w,
+                default_manifest=workloads.MESH_MANIFEST))
+            try:
+                srv.check_many(bags)   # warm (no injected latency)
+                CHAOS.adapter_latency_s = {
+                    h: ADAPTER_LAT_S for h in handlers}
+                h0 = _monitor.host_action_counters()["submitted"]
+                ts = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    srv.check_many(bags)
+                    ts.append(time.perf_counter() - t0)
+                fired = (_monitor.host_action_counters()["submitted"]
+                         - h0) // 3
+            finally:
+                CHAOS.reset()
+                srv.close()
+            med, t_min, t_max = _med3(ts)
+            vs[str(w)] = round(batch / med, 1)
+            if t_min > 0:
+                worst_spread = max(worst_spread, t_max / t_min)
+        lo, hi = vs[str(workers[0])], vs[str(workers[-1])]
+        return {
+            "overlay_executor_workers": list(workers),
+            "overlay_throughput_vs_workers": vs,
+            "overlay_executor_scaling":
+                round(hi / lo, 2) if lo > 0 else -1.0,
+            "overlay_executor_spread": round(worst_spread, 2),
+            "overlay_executor_actions_per_batch": int(fired),
+            "overlay_executor_adapter_latency_ms":
+                ADAPTER_LAT_S * 1e3,
+        }
+    except Exception as exc:
+        return {"overlay_executor_error":
+                f"{type(exc).__name__}: {exc}"}
+
+
+def _overlay_opa_bench(on_tpu: bool) -> dict:
+    """The rego/OPA engine as a benched overlay scenario: every
+    request fires a real Rego policy evaluation on the executor's opa
+    lane, with an EXACT status parity gate against the generic host
+    oracle path (overlay_opa_parity_ok — the executor changes where
+    adapter work runs, never what it answers)."""
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.testing import workloads
+
+    n_rules = 2000 if on_tpu else 200
+    batch = 512 if on_tpu else 128
+    try:
+        store = workloads.make_opa_store(n_rules)
+        srv = RuntimeServer(store, ServerArgs(
+            batch_window_s=0.001, max_batch=batch, buckets=(batch,),
+            default_manifest=workloads.MESH_MANIFEST))
+        try:
+            bags = [bag_from_mapping(x) for x in
+                    workloads.make_opa_requests(batch, n_rules)]
+            d = srv.controller.dispatcher
+            srv.check_many(bags)   # warm
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = srv.check_many(bags)
+                ts.append(time.perf_counter() - t0)
+            fused = [r.status_code for r in out]
+            oracle = [r.status_code
+                      for r in d.check_host_oracle(bags)]
+        finally:
+            srv.close()
+        med, t_min, t_max = _med3(ts)
+        return {
+            "overlay_opa_rules": n_rules,
+            "overlay_opa_checks_per_sec": round(batch / med, 1),
+            "overlay_opa_batch_ms": round(med * 1e3, 1),
+            "overlay_opa_denies": sum(1 for s in fused if s == 7),
+            "overlay_opa_parity_ok": fused == oracle,
+            "overlay_opa_cross_run_spread":
+                round(t_max / t_min, 2) if t_min > 0 else -1.0,
+        }
+    except Exception as exc:
+        return {"overlay_opa_error": f"{type(exc).__name__}: {exc}"}
 
 
 _MESH_CHILD = r"""

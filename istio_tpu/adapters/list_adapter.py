@@ -44,17 +44,59 @@ class ListHandler(Handler):
             self._provider = lambda: [
                 ln.strip() for ln in open(path, encoding="utf-8")
                 if ln.strip()]
-        self._set_entries(list(config.get("overrides", ())) +
+        self._base_overrides = tuple(config.get("overrides", ()))
+        # refresh bookkeeping (surfaced via refresh_stats() →
+        # /debug/executor): a provider that starts failing keeps the
+        # LAST GOOD list serving — the counters and last-refresh age
+        # are the only signal, so they must exist
+        self.refresh_failures = 0
+        self.last_refresh_wall: float | None = None
+        self.last_refresh_error: str | None = None
+        self._set_entries(list(self._base_overrides) +
                           (self._provider() if self._provider else []))
+        if self._provider is not None:
+            import time
+            self.last_refresh_wall = time.time()
         self.refresh_interval_s = float(
             config.get("refresh_interval_s", 60.0))
 
     def refresh(self) -> None:
         """Re-pull the provider list (the reference's TTL refresh loop
-        body, list.go:115-247; driven by the runtime's timer wheel)."""
-        if self._provider is not None:
-            self._set_entries(list(self.config_overrides) +
-                              self._provider())
+        body, list.go:115-247; driven by the adapter executor's
+        maintenance lane). A failing provider NEVER clobbers the last
+        good list: the pull happens before _set_entries, the failure
+        is recorded (refresh_failures / last_refresh_error) and
+        re-raised so the maintenance runner's counters move."""
+        import time
+        if self._provider is None:
+            return
+        try:
+            entries = self._provider()
+        except Exception as exc:
+            with self._lock:
+                self.refresh_failures += 1
+                self.last_refresh_error = \
+                    f"{type(exc).__name__}: {exc}"
+            raise
+        self._set_entries(list(self._base_overrides) + list(entries))
+        with self._lock:
+            self.last_refresh_wall = time.time()
+            self.last_refresh_error = None
+
+    def refresh_stats(self) -> dict:
+        """Provider freshness for /debug/executor."""
+        import time
+        with self._lock:
+            last = self.last_refresh_wall
+            return {
+                "provider": self._provider is not None,
+                "entries": len(self.config_overrides),
+                "refresh_failures": self.refresh_failures,
+                "last_refresh_age_s":
+                    round(time.time() - last, 3)
+                    if last is not None else None,
+                "last_refresh_error": self.last_refresh_error,
+            }
 
     def _set_entries(self, entries: list[str]) -> None:
         et = self.entry_type

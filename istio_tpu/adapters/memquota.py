@@ -39,6 +39,21 @@ def _key(instance: Mapping[str, Any]) -> str:
     return instance.get("name", "") + "|" + repr(sorted(dims.items()))
 
 
+class QuotaBackend:
+    """The shared mutable half of a memquota handler: cells + dedup
+    cache under one lock. Injected via the `backend` config param
+    (the adapter-executor plane's cross-replica seam — the redis-style
+    shared-quota role: N replicas' handlers allocate against ONE
+    backend, so a dedup_id retried on any replica replays the original
+    grant and the window is enforced globally). Default: each handler
+    builds its own (the reference's per-replica best-effort state)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.cells: dict[str, Any] = {}
+        self.dedup: dict[str, tuple[int, float]] = {}
+
+
 class _Window:
     """Rolling window: counts per tick; expired ticks are reclaimed."""
 
@@ -101,10 +116,14 @@ class MemQuotaHandler(Handler):
     def __init__(self, config: Mapping[str, Any], env: Env,
                  clock=time.monotonic):
         self._clock = clock
-        self._lock = threading.Lock()
+        backend = config.get("backend")
+        if backend is None:
+            backend = QuotaBackend()
+        self._backend = backend
+        self._lock = backend.lock
         self._limits: dict[str, dict] = {}
-        self._cells: dict[str, Any] = {}
-        self._dedup: dict[str, tuple[int, float]] = {}
+        self._cells = backend.cells
+        self._dedup = backend.dedup
         self.min_dedup_s = float(config.get("min_deduplication_duration_s",
                                             1.0))
         for q in config.get("quotas", ()):

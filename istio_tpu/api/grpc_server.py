@@ -114,7 +114,12 @@ class MixerGrpcServer:
                 remaining = context.time_remaining()
             except Exception:   # a front without deadline support
                 remaining = None
-        if remaining is not None:
+        # grpcio reports a deadline-LESS client as a huge
+        # time_remaining (years), not None — treating that as a real
+        # deadline both defeats the server-side default below and
+        # overflows bounded waits downstream (the executor fold's
+        # Event.wait). Anything past a day is "no client deadline".
+        if remaining is not None and remaining < 86_400.0:
             return time.perf_counter() + max(remaining, 0.0)
         d_ms = getattr(self.runtime.args, "default_check_deadline_ms",
                        0.0)
@@ -162,10 +167,12 @@ class MixerGrpcServer:
                 parent=self._traceparent_from(context)) as root:
             try:
                 bag = self._check_bag(request)
+                deadline = self._deadline_from(context)
                 result = self.runtime.check_preprocessed(
-                    bag, deadline=self._deadline_from(context))
+                    bag, deadline=deadline)
                 self._tag_status(root, result.status_code)
-                return self._check_response(request, bag, result)
+                return self._check_response(request, bag, result,
+                                            deadline=deadline)
             except CheckRejected as exc:
                 # abort() raises — the typed rejection becomes the
                 # RPC's status instead of an INTERNAL stack trace
@@ -318,7 +325,8 @@ class MixerGrpcServer:
         return self.runtime.preprocess(bag)
 
     def _check_response(self, request: RawCheckRequest, bag,
-                        result, quotas: list | None = None
+                        result, quotas: list | None = None,
+                        deadline: float | None = None
                         ) -> "pb.CheckResponse":
         resp = pb.CheckResponse()
         resp.precondition.status.code = result.status_code
@@ -338,7 +346,8 @@ class MixerGrpcServer:
         # multiple quotas in one request share a device batch.
         if result.status_code == 0:
             if quotas is None:
-                quotas = self._submit_quotas(request, bag, result)
+                quotas = self._submit_quotas(request, bag, result,
+                                             deadline=deadline)
             for name, qr in quotas:
                 if hasattr(qr, "result"):   # QuotaFuture (sync front)
                     qr = qr.result()
@@ -358,17 +367,19 @@ class MixerGrpcServer:
                          if request.deduplication_id else "")
 
     def _submit_quotas(self, request: RawCheckRequest, bag,
-                       result) -> list:
+                       result, deadline: float | None = None) -> list:
         """→ [(name, QuotaResult | QuotaFuture)] — non-blocking on the
         fused path (pool futures); the dispatcher fallback (generic
-        path / non-device quota handler) resolves inline."""
+        path / non-device quota handler) resolves inline, its host
+        adapter call bounded by the RPC deadline (executor plane)."""
         pending = []
         for name, params in request.quotas.items():
             args = self._quota_args(request, name, params)
             qr = self.runtime.quota_fused(bag, name, args, result)
             if qr is None:   # generic path / non-device handler
                 qr = self.runtime.quota(bag, name, args,
-                                        preprocessed=True)
+                                        preprocessed=True,
+                                        deadline=deadline)
             pending.append((name, qr))
         return pending
 
@@ -529,10 +540,11 @@ class MixerAioGrpcServer(MixerGrpcServer):
                 qr = self.runtime.quota_fused(bag, name, args, result)
                 if qr is None:
                     # dispatcher fallback re-resolves (device RTT) —
-                    # off the loop
+                    # off the loop; host adapter call bounded by the
+                    # RPC deadline (executor plane)
                     qr = loop.run_in_executor(
                         None, self.runtime.quota, bag, name, args,
-                        True)
+                        True, deadline)
                 elif hasattr(qr, "add_done_callback"):
                     af = loop.create_future()
 

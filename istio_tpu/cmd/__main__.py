@@ -41,6 +41,15 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         check_fail_policy=args.check_fail_policy,
         breaker_failures=args.breaker_failures,
         breaker_reset_s=args.breaker_reset_ms / 1e3,
+        # adapter-executor plane (runtime/executor.py): host actions
+        # bulkheaded per handler, deadline-bounded, breaker-guarded
+        host_fail_policy=args.host_fail_policy,
+        executor_workers=args.executor_workers,
+        executor_queue_cap=args.executor_queue_cap,
+        host_action_timeout_ms=args.host_action_timeout_ms,
+        host_executor=not args.no_host_executor,
+        host_breaker_failures=args.host_breaker_failures,
+        host_breaker_reset_s=args.host_breaker_reset_ms / 1e3,
         # config canary (istio_tpu/canary): record live traffic,
         # shadow-replay rebuilt snapshots, veto divergent swaps
         canary=args.canary,
@@ -786,6 +795,36 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--breaker-reset-ms", type=float, default=5000.0,
                    help="how long the breaker stays open before a "
                         "half-open device probe")
+    s.add_argument("--host-fail-policy", default="closed",
+                   choices=("open", "closed"),
+                   help="verdict an unresolvable host adapter action "
+                        "(deadline overrun, bulkhead shed, open "
+                        "lane breaker) contributes: open = OK with a "
+                        "1s/1-use TTL, closed = UNAVAILABLE")
+    s.add_argument("--executor-workers", type=int, default=2,
+                   help="worker threads per handler lane in the "
+                        "adapter executor (the bulkhead's "
+                        "concurrency share)")
+    s.add_argument("--executor-queue-cap", type=int, default=256,
+                   help="pending host actions per handler lane; "
+                        "overflow sheds typed RESOURCE_EXHAUSTED "
+                        "semantics onto the fail policy")
+    s.add_argument("--host-action-timeout-ms", type=float,
+                   default=0.0,
+                   help="extra per-host-action wall bound even when "
+                        "the request carries no deadline (0 = bound "
+                        "by the request deadline only)")
+    s.add_argument("--no-host-executor", action="store_true",
+                   help="run host adapter work inline on the batch "
+                        "worker (the pre-executor loop) instead of "
+                        "the bulkheaded executor plane")
+    s.add_argument("--host-breaker-failures", type=int, default=3,
+                   help="consecutive failed/overrun actions that trip "
+                        "a handler lane's circuit breaker")
+    s.add_argument("--host-breaker-reset-ms", type=float,
+                   default=5000.0,
+                   help="how long an open handler-lane breaker waits "
+                        "before a half-open probe")
     s.add_argument("--canary", default="off",
                    choices=("off", "warn", "gate"),
                    help="config canary: shadow-replay recorded live "

@@ -130,6 +130,7 @@ class IntrospectServer:
         "/debug/cache": "_h_cache",
         "/debug/traces": "_h_traces",
         "/debug/resilience": "_h_resilience",
+        "/debug/executor": "_h_executor",
         "/debug/analysis": "_h_analysis",
         "/debug/rulestats": "_h_rulestats",
         "/debug/canary": "_h_canary",
@@ -533,6 +534,48 @@ class IntrospectServer:
         payload["rows_per_shard"] = routing["rows_per_shard"]
         payload["occupancy"] = routing["occupancy"]
         payload["misrouted"] = routing["misrouted"]
+        self._send_json(req, payload)
+
+    def _h_executor(self, req: BaseHTTPRequestHandler) -> None:
+        """Adapter-executor plane view (runtime/executor.py): per-
+        handler bulkhead lanes (queue depth / in-flight / oldest
+        running / breaker state), the host-action conservation
+        counters (submitted == sum of typed outcomes), the chaos seam
+        state, and the maintenance registry — per-provider refresh
+        totals/failures and last-refresh age (a provider gone stale
+        must be visible here, because the last good list keeps
+        serving silently). Zero-shaped before the first host action;
+        {"enabled": false} when the executor is off."""
+        from istio_tpu.runtime import monitor
+        from istio_tpu.runtime.resilience import CHAOS
+
+        payload: dict[str, Any] = {
+            "enabled": False,
+            "counters": monitor.host_action_counters(),
+        }
+        ex = getattr(self.runtime, "executor", None) \
+            if self.runtime is not None else None
+        if ex is not None:
+            payload = {"enabled": True, **ex.snapshot()}
+        payload["chaos"] = {
+            k: v for k, v in CHAOS.snapshot().items()
+            if k.startswith(("adapter", "injected_adapter"))}
+        # per-handler provider freshness straight from the live
+        # handlers (refresh_stats) — the maintenance registry above
+        # carries the scheduler's view; this is the adapter's own
+        if self.runtime is not None:
+            providers: dict[str, Any] = {}
+            try:
+                d = self.runtime.controller.dispatcher
+                for name, h in d.handlers.items():
+                    stats = getattr(h, "refresh_stats", None)
+                    if callable(stats):
+                        st = stats()
+                        if st.get("provider"):
+                            providers[name] = st
+            except Exception as exc:
+                providers = {"error": str(exc)}
+            payload["providers"] = providers
         self._send_json(req, payload)
 
     def _h_resilience(self, req: BaseHTTPRequestHandler) -> None:

@@ -102,6 +102,11 @@ class CheckBatcher:
                  brownout: bool = False,
                  stage_observer: Callable[[float], None] | None = None):
         self.run_batch = run_batch
+        # deadline propagation (the adapter-executor plane): hooks
+        # that accept it get the batch's min remaining deadline, so
+        # host adapter actions inherit the request budget end to end
+        from istio_tpu.runtime.resilience import _takes_deadline
+        self._run_takes_deadline = _takes_deadline(run_batch)
         # bounded admission (DAGOR-style front-door shedding): a submit
         # that would push the queue past max_queue resolves
         # RESOURCE_EXHAUSTED instead of growing queue_wait without
@@ -500,7 +505,15 @@ class CheckBatcher:
                 queue_wait_ms=round(max(waits, default=0.0) * 1e3, 3))
             try:
                 with span_ctx:
-                    results = self.run_batch(padded)
+                    if self._run_takes_deadline:
+                        # min over the batch's row deadlines: the fold
+                        # must never hold ANY row past its own budget
+                        dmin = None
+                        for _, f in batch:
+                            dmin = self._min_deadline(dmin, (None, f))
+                        results = self.run_batch(padded, deadline=dmin)
+                    else:
+                        results = self.run_batch(padded)
             except Exception as exc:
                 # failed batches are excluded from the stage
                 # decomposition by design — this counter is their only

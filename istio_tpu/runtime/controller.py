@@ -59,8 +59,14 @@ class Controller:
                  on_canary_reject: Callable[..., None] | None = None,
                  initial_prewarm: bool = True,
                  prewarm_hook: Callable[..., None] | None = None,
-                 warm_parent_plans: bool = True):
+                 warm_parent_plans: bool = True,
+                 executor=None):
         self.store = store
+        # AdapterExecutor (runtime/executor.py): handed to every
+        # published Dispatcher so host-overlay adapter work runs
+        # bulkheaded + deadline-bounded; the executor outlives
+        # snapshots (lane breakers persist across swaps)
+        self.executor = executor
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
         self.on_publish = on_publish
@@ -243,7 +249,8 @@ class Controller:
                                 fused=plan,
                                 buckets=self.prewarm_buckets,
                                 recorder=self.canary.recorder
-                                if self.canary is not None else None)
+                                if self.canary is not None else None,
+                                executor=self.executor)
         self._dispatcher = dispatcher      # atomic publish (GIL ref swap)
         # a successful publish supersedes any earlier veto: introspect
         # must not report a stale rejection against the live config

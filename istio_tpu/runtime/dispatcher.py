@@ -95,13 +95,21 @@ class Dispatcher:
                  fused=None,
                  buckets: tuple[int, ...] = (),
                  recorder=None,
-                 observe: bool = True):
+                 observe: bool = True,
+                 executor=None):
         self.snapshot = snapshot
         self.handlers = dict(handlers)
         self.identity_attr = identity_attr
         # FusedPlan (runtime/fused.py) — when present, check() runs the
         # fused device engine and overlays only host-only actions
         self.fused = fused
+        # AdapterExecutor (runtime/executor.py) — when present, the
+        # fused path's host-overlay CHECK actions and quota() adapter
+        # calls run on per-handler bulkhead lanes, deadline-bounded,
+        # instead of inline on this thread. None (the generic path,
+        # shadow replay, direct test construction) keeps the inline
+        # safeDispatch loop — the behavioral oracle.
+        self.executor = executor
         # canary TrafficRecorder (istio_tpu/canary/recorder.py): when
         # present, check batches tap their served decisions into the
         # sampling ring at this boundary — the same verdicts callers
@@ -217,14 +225,21 @@ class Dispatcher:
             fb_cols.append(ridx)
             fb_pos.append(pos)
             vis_errs = 0
-            for b, bag in enumerate(bags):
-                m, _, e = rs.host_eval(ridx, bag)
+            # ONLY ns-visible (bag, rule) pairs are oracle-evaluated:
+            # the ns mask below zeroes invisible bits regardless, so a
+            # slow fallback predicate (attribute pulls, extern calls)
+            # must never run for traffic that can never see its rule —
+            # and the generic path's error accounting is (err & ns_ok),
+            # so skipping keeps RESOLVE_ERRORS oracle-identical (it
+            # over-counted invisible errors before)
+            for b in np.nonzero(ns_ok_sub[:, pos])[0]:
+                m, _, e = rs.host_eval(ridx, bags[b])
                 active_sub[b, pos] = m
-                host_errs += e
-                if e and ns_ok_sub[b, pos]:
-                    vis_errs += 1   # oracle parity: ns-visible errors
+                if e:
+                    vis_errs += 1
             if vis_errs:
                 err_by_rule[ridx] = vis_errs
+                host_errs += vis_errs
         if host_errs and self.observe:
             monitor.RESOLVE_ERRORS.inc(host_errs)
         active_sub &= ns_ok_sub
@@ -300,7 +315,8 @@ class Dispatcher:
     # ------------------------------------------------------------------
 
     def check(self, bags: Sequence[Bag], instep: Any = None,
-              pre_tensorized: Any = None) -> list[CheckResponse]:
+              pre_tensorized: Any = None,
+              deadline: float | None = None) -> list[CheckResponse]:
         """`instep`: optional (q_arrays, counts, on_dispatch, on_pull)
         from an in-step quota session (device_quota.
         InlineQuotaSession) — the quota alloc rides the check
@@ -311,10 +327,14 @@ class Dispatcher:
         pull, before any per-row response python. `pre_tensorized`:
         (batch, ns_ids) computed by the caller (outside the token);
         must correspond to `bags` exactly. Both require the fused
-        path."""
+        path. `deadline`: the batch's min remaining absolute
+        perf_counter instant (threaded from the batcher) — host
+        adapter actions inherit it via the executor plane; None =
+        unbounded (plus any configured per-action timeout)."""
         if self.fused is not None:
             return self._check_fused(bags, instep=instep,
-                                     pre_tensorized=pre_tensorized)
+                                     pre_tensorized=pre_tensorized,
+                                     deadline=deadline)
         actives, visibles = self._resolve(bags, observe=self.observe)
         t_respond = time.perf_counter()
         out = []
@@ -332,7 +352,8 @@ class Dispatcher:
         return out
 
     def _check_fused(self, bags: Sequence[Bag], instep: Any = None,
-                     pre_tensorized: Any = None
+                     pre_tensorized: Any = None,
+                     deadline: float | None = None
                      ) -> list[CheckResponse]:
         """Fused serving path: ONE device step computes rule matching +
         denier/list verdicts + TTLs for the whole batch; the host loop
@@ -456,146 +477,219 @@ class Dispatcher:
         qa_rules = sorted({qa[0] for qa in plan.quota_actions})
         qa_pos = [col_pos[r] for r in qa_rules]
 
-        # Referenced/presence construction deduplicated across the
-        # batch: uniform traffic produces a handful of distinct
-        # (referenced bits, presence bits) signatures, and building
-        # the name tuples + presence dicts per ROW was milliseconds of
-        # python per request — seconds per 2048-batch, single-threaded
-        # in the batcher worker. Shared objects are read-only by
-        # contract (the gRPC layer only serializes them).
-        ref_of = None
-        if n_words:
-            signature = np.concatenate(
-                [ref_bits[:, :len(plan.item_names)],
-                 present_np.astype(np.uint8),
-                 map_present_np.astype(np.uint8),
-                 active_sub.astype(np.uint8)], axis=1)
-            uniq, inverse = np.unique(signature, axis=0,
-                                      return_inverse=True)
-            names = plan.item_names
-            n_items = len(names)
-            shared: list[tuple[tuple, dict]] = []
-            for u in range(uniq.shape[0]):
-                row = uniq[u]
-                referenced = {names[j]
-                              for j in np.nonzero(row[:n_items])[0]}
-                act_row = row[n_items + present_np.shape[1] +
-                              map_present_np.shape[1]:]
-                for ridx, extra in plan.unmapped_instance_attrs.items():
-                    if act_row[col_pos[ridx]]:
-                        referenced |= extra
-                pres_row = row[n_items:n_items + present_np.shape[1]]
-                mp_row = row[n_items + present_np.shape[1]:
-                             n_items + present_np.shape[1] +
-                             map_present_np.shape[1]]
-                presence: dict = {}
-                for item in referenced:
-                    if isinstance(item, tuple):
-                        col = lay.derived_slots.get(item)
-                        if col is not None:
-                            presence[item] = bool(pres_row[col])
-                    else:
-                        col = lay.slots.get(item)
-                        if col is not None:
-                            presence[item] = bool(pres_row[col])
+        # adapter-executor plane (runtime/executor.py): submit every
+        # host action NOW, so adapter calls run on their handler
+        # bulkhead lanes WHILE the fold below decodes the referenced/
+        # presence planes — the response loop then claims results in
+        # rule order, bounded by the request deadline. One list per
+        # row, entries (rule idx, HostAction | final CheckResult) in
+        # exactly the order the inline loop would have executed them,
+        # so lowest-rule-index-wins merging is byte-identical.
+        ex = self.executor
+        host_pending: list[list] | None = None
+        if ex is not None and len(ha):
+            from istio_tpu.runtime.config import _qualify
+            from istio_tpu.runtime.executor import check_fallback
+            host_pending = []
+            for b, bag in enumerate(bags):
+                row: list = []
+                for ridx in ha[active_sub[b, ha_pos]]:
+                    ridx = int(ridx)
+                    for hc, template, inst_names in \
+                            plan.host_actions[ridx]:
+                        handler = self._handler_for(hc)
+                        if handler is None:
+                            continue
+                        hq = _qualify(hc.name, hc.namespace)
+                        for iname in inst_names:
+                            try:
+                                instance = \
+                                    snap.instances[iname].build(bag)
+                            except EvalError as exc:
+                                # instance build stays on this thread
+                                # (_safe_check parity: EvalError →
+                                # INTERNAL, counted as a dispatch
+                                # error)
+                                monitor.DISPATCH_ERRORS.inc()
+                                row.append((ridx, CheckResult(
+                                    status_code=INTERNAL,
+                                    status_message=str(exc))))
+                                continue
+                            row.append((ridx, ex.submit(
+                                hq,
+                                self._bound_check(handler, template,
+                                                  instance),
+                                check_fallback)))
+                host_pending.append(row)
+
+        # Any exception from here to the claims must not leak
+        # submitted-but-unclaimed actions: the conservation ledger
+        # (submitted == resolved) is a smoke/bench gate, and a
+        # ResilientChecker retry of this batch would re-submit
+        # every action while the first generation dangled.
+        try:
+            # Referenced/presence construction deduplicated across the
+            # batch: uniform traffic produces a handful of distinct
+            # (referenced bits, presence bits) signatures, and building
+            # the name tuples + presence dicts per ROW was milliseconds of
+            # python per request — seconds per 2048-batch, single-threaded
+            # in the batcher worker. Shared objects are read-only by
+            # contract (the gRPC layer only serializes them).
+            ref_of = None
+            if n_words:
+                signature = np.concatenate(
+                    [ref_bits[:, :len(plan.item_names)],
+                     present_np.astype(np.uint8),
+                     map_present_np.astype(np.uint8),
+                     active_sub.astype(np.uint8)], axis=1)
+                uniq, inverse = np.unique(signature, axis=0,
+                                          return_inverse=True)
+                names = plan.item_names
+                n_items = len(names)
+                shared: list[tuple[tuple, dict]] = []
+                for u in range(uniq.shape[0]):
+                    row = uniq[u]
+                    referenced = {names[j]
+                                  for j in np.nonzero(row[:n_items])[0]}
+                    act_row = row[n_items + present_np.shape[1] +
+                                  map_present_np.shape[1]:]
+                    for ridx, extra in plan.unmapped_instance_attrs.items():
+                        if act_row[col_pos[ridx]]:
+                            referenced |= extra
+                    pres_row = row[n_items:n_items + present_np.shape[1]]
+                    mp_row = row[n_items + present_np.shape[1]:
+                                 n_items + present_np.shape[1] +
+                                 map_present_np.shape[1]]
+                    presence: dict = {}
+                    for item in referenced:
+                        if isinstance(item, tuple):
+                            col = lay.derived_slots.get(item)
+                            if col is not None:
+                                presence[item] = bool(pres_row[col])
                         else:
-                            mcol = lay.map_slots.get(item)
-                            if mcol is not None:
-                                presence[item] = bool(mp_row[mcol])
-                shared.append((tuple(sorted(referenced, key=str)),
-                               presence))
-            ref_of = [shared[i] for i in inverse]
-        elif plan.unmapped_instance_attrs:
-            # no layout items at all, but some rules still carry
-            # instance attrs — merge them per row from the overlaid
-            # activity bits (presence is unknowable without a layout)
-            ref_of = []
-            for b in range(n_real):
-                referenced: set = set()
-                for ridx, extra in plan.unmapped_instance_attrs.items():
-                    if active_sub[b, col_pos[ridx]]:
-                        referenced |= extra
-                ref_of.append((tuple(sorted(referenced, key=str)), {}))
-        # fold = packed-plane decode (overlay bits, referenced/presence
-        # signature dedup); respond = the per-row CheckResponse loop —
-        # together they are the span the serve.overlay emit reports
-        t_respond = time.perf_counter()
-        if observe:
-            monitor.observe_stage("fold", t_respond - t_overlay)
-        # decision exemplars: denied/errored rows reservoir-sample into
-        # the telemetry plane (host-side, post-fold, from the already-
-        # decoded verdict) with the batch's active span so a
-        # /debug/rulestats entry links to its RingReporter trace; the
-        # canary recorder shares the span so its samples join traces
-        tele = plan.telemetry if observe else None
-        tele_span = tr._current() \
-            if tele is not None or self.recorder is not None else None
-        out = []
-        for b, bag in enumerate(bags):
-            resp = CheckResponse()
-            resp.valid_duration_s = min(resp.valid_duration_s,
-                                        float(dur[b]))
-            resp.valid_use_count = min(resp.valid_use_count,
-                                       int(uses[b]))
-            dev_rule = int(deny_rule[b])
-            dev_applied = False
-            host_active = ha[active_sub[b, ha_pos]] if len(ha) else ()
-            for ridx in host_active:
-                ridx = int(ridx)
-                # ties at ridx == dev_rule follow the rule's config
-                # action order: if its first CHECK action is fused, the
-                # device result applies before the host actions
-                if not dev_applied and (
-                        ridx > dev_rule or
-                        (ridx == dev_rule and
-                         dev_rule in plan.fused_first_rules)):
+                            col = lay.slots.get(item)
+                            if col is not None:
+                                presence[item] = bool(pres_row[col])
+                            else:
+                                mcol = lay.map_slots.get(item)
+                                if mcol is not None:
+                                    presence[item] = bool(mp_row[mcol])
+                    shared.append((tuple(sorted(referenced, key=str)),
+                                   presence))
+                ref_of = [shared[i] for i in inverse]
+            elif plan.unmapped_instance_attrs:
+                # no layout items at all, but some rules still carry
+                # instance attrs — merge them per row from the overlaid
+                # activity bits (presence is unknowable without a layout)
+                ref_of = []
+                for b in range(n_real):
+                    referenced: set = set()
+                    for ridx, extra in plan.unmapped_instance_attrs.items():
+                        if active_sub[b, col_pos[ridx]]:
+                            referenced |= extra
+                    ref_of.append((tuple(sorted(referenced, key=str)), {}))
+            # fold = packed-plane decode (overlay bits, referenced/presence
+            # signature dedup); respond = the per-row CheckResponse loop —
+            # together they are the span the serve.overlay emit reports
+            t_respond = time.perf_counter()
+            if observe:
+                monitor.observe_stage("fold", t_respond - t_overlay)
+            # decision exemplars: denied/errored rows reservoir-sample into
+            # the telemetry plane (host-side, post-fold, from the already-
+            # decoded verdict) with the batch's active span so a
+            # /debug/rulestats entry links to its RingReporter trace; the
+            # canary recorder shares the span so its samples join traces
+            tele = plan.telemetry if observe else None
+            tele_span = tr._current() \
+                if tele is not None or self.recorder is not None else None
+            out = []
+            for b, bag in enumerate(bags):
+                resp = CheckResponse()
+                resp.valid_duration_s = min(resp.valid_duration_s,
+                                            float(dur[b]))
+                resp.valid_use_count = min(resp.valid_use_count,
+                                           int(uses[b]))
+                dev_rule = int(deny_rule[b])
+                dev_applied = False
+                host_active = ha[active_sub[b, ha_pos]] if len(ha) else ()
+                pend = host_pending[b] if host_pending is not None else None
+                pi = 0
+                for ridx in host_active:
+                    ridx = int(ridx)
+                    # ties at ridx == dev_rule follow the rule's config
+                    # action order: if its first CHECK action is fused, the
+                    # device result applies before the host actions
+                    if not dev_applied and (
+                            ridx > dev_rule or
+                            (ridx == dev_rule and
+                             dev_rule in plan.fused_first_rules)):
+                        self._apply_device_status(resp, plan, dev_rule,
+                                                  int(status[b]))
+                        dev_applied = True
+                    if pend is not None:
+                        # executor path: CLAIM this rule's pre-submitted
+                        # results (same order the submit pass appended
+                        # them), each wait bounded by the batch deadline —
+                        # an unresolved action folds as its fail-policy
+                        # verdict, never a held batch
+                        while pi < len(pend) and pend[pi][0] == ridx:
+                            item = pend[pi][1]
+                            pi += 1
+                            result = item if isinstance(item, CheckResult) \
+                                else ex.resolve(item, deadline)
+                            self._combine(resp, result)
+                        continue
+                    for hc, template, inst_names in plan.host_actions[ridx]:
+                        handler = self._handler_for(hc)
+                        if handler is None:
+                            continue
+                        for iname in inst_names:
+                            ib = snap.instances[iname]
+                            result = self._safe_check(handler, template, ib,
+                                                      bag)
+                            self._combine(resp, result)
+                if not dev_applied:
                     self._apply_device_status(resp, plan, dev_rule,
                                               int(status[b]))
-                    dev_applied = True
-                for hc, template, inst_names in plan.host_actions[ridx]:
-                    handler = self._handler_for(hc)
-                    if handler is None:
-                        continue
-                    for iname in inst_names:
-                        ib = snap.instances[iname]
-                        result = self._safe_check(handler, template, ib,
-                                                  bag)
-                        self._combine(resp, result)
-            if not dev_applied:
-                self._apply_device_status(resp, plan, dev_rule,
-                                          int(status[b]))
-            if status[b] != OK:
-                resp.deny_rule = dev_rule
-                if tele is not None:
-                    tele.sample(dev_rule, int(status[b]), bag,
-                                tele_span)
-            # referenced/presence: precomputed per unique signature
-            if ref_of is not None:
-                resp.referenced, resp.referenced_presence = ref_of[b]
-            if qa_rules:
-                resp.active_quota_rules = tuple(
-                    r for r, p in zip(qa_rules, qa_pos)
-                    if active_sub[b, p])
-                resp.quota_context = self
-            else:
-                resp.active_quota_rules = ()
-            out.append(resp)
-        if observe:
-            monitor.observe_stage("respond",
-                                  time.perf_counter() - t_respond)
-            tr.emit("serve.overlay", time.perf_counter() - t_overlay,
-                    batch=len(bags))
-        if self.recorder is not None:
-            # canary tap: bags/out are already padding-trimmed; one
-            # stride check per batch, bounded appends for sampled rows
-            # (istio_tpu/canary/recorder.py — off the device path).
-            # The DEVICE planes are recorded, not the merged response:
-            # the shadow replay compares device-decidable decisions
-            # (host adapters never fire in shadow)
-            self.recorder.tap(bags, out, snap, self.identity_attr,
-                              tele_span,
-                              device=(status, dur, uses, deny_rule))
-        return out
+                if status[b] != OK:
+                    resp.deny_rule = dev_rule
+                    if tele is not None:
+                        tele.sample(dev_rule, int(status[b]), bag,
+                                    tele_span)
+                # referenced/presence: precomputed per unique signature
+                if ref_of is not None:
+                    resp.referenced, resp.referenced_presence = ref_of[b]
+                if qa_rules:
+                    resp.active_quota_rules = tuple(
+                        r for r, p in zip(qa_rules, qa_pos)
+                        if active_sub[b, p])
+                    resp.quota_context = self
+                else:
+                    resp.active_quota_rules = ()
+                out.append(resp)
+            if observe:
+                monitor.observe_stage("respond",
+                                      time.perf_counter() - t_respond)
+                tr.emit("serve.overlay", time.perf_counter() - t_overlay,
+                        batch=len(bags))
+            if self.recorder is not None:
+                # canary tap: bags/out are already padding-trimmed; one
+                # stride check per batch, bounded appends for sampled rows
+                # (istio_tpu/canary/recorder.py — off the device path).
+                # The DEVICE planes are recorded, not the merged response:
+                # the shadow replay compares device-decidable decisions
+                # (host adapters never fire in shadow)
+                self.recorder.tap(bags, out, snap, self.identity_attr,
+                                  tele_span,
+                                  device=(status, dur, uses, deny_rule))
+            return out
+        except BaseException:
+            if host_pending is not None:
+                for _row in host_pending:
+                    for _ridx, _item in _row:
+                        if not isinstance(_item, CheckResult):
+                            ex.abandon(_item)
+            raise
 
     @staticmethod
     def _apply_device_status(resp: CheckResponse, plan, dev_rule: int,
@@ -611,7 +705,8 @@ class Dispatcher:
                                    plan.message_for(dev_rule, dev_status)
                                    ).strip("; ")
 
-    def check_host_oracle(self, bags: Sequence[Bag]
+    def check_host_oracle(self, bags: Sequence[Bag],
+                          deadline: float | None = None
                           ) -> list[CheckResponse]:
         """Graceful-degradation check path: resolve every rule on the
         CPU via the whole-snapshot oracle (compiler/ruleset.py
@@ -681,6 +776,22 @@ class Dispatcher:
                     self._combine(resp, result)
         resp.referenced = tuple(sorted(referenced, key=str))
         return resp
+
+    @staticmethod
+    def _bound_check(handler: Handler, template: str,
+                     instance) -> Any:
+        """Zero-arg adapter call for the executor plane — the worker
+        side of _safe_check's dispatch leg (same counter accounting;
+        exceptions resolve via the executor's retry + safeDispatch
+        INTERNAL path, runtime/executor.py)."""
+        def call():
+            # DISPATCH_ERRORS for a failing action is counted ONCE in
+            # check_fallback's error branch (the resolve-side single
+            # accounting home) — counting per attempt here would
+            # double-bill retried calls relative to the inline path
+            with monitor.dispatch_timer():
+                return handler.handle_check(template, instance)
+        return call
 
     def _safe_check(self, handler: Handler, template: str, ib,
                     bag: Bag) -> CheckResult:
@@ -883,8 +994,12 @@ class Dispatcher:
         return out, fctx
 
     def quota(self, bag: Bag, quota_name: str,
-              args: QuotaArgs) -> QuotaResult:
-        """Dispatches to at most ONE handler (dispatcher.go:242-260)."""
+              args: QuotaArgs,
+              deadline: float | None = None) -> QuotaResult:
+        """Dispatches to at most ONE handler (dispatcher.go:242-260).
+        With an executor attached the adapter call runs on its handler
+        lane (bulkheaded, deadline-bounded — the shared-quota backend
+        may be a genuinely remote side effect); inline otherwise."""
         actives = self._resolve([bag])[0][0]
         for ridx in actives:
             for hc, template, inst_names in self.snapshot.actions_for(
@@ -898,14 +1013,37 @@ class Dispatcher:
                         continue
                     try:
                         instance = self.snapshot.instances[iname].build(bag)
-                        with monitor.dispatch_timer():
-                            return handler.handle_quota(template, instance,
-                                                        args)
                     except EvalError as exc:
                         monitor.DISPATCH_ERRORS.inc()
                         return QuotaResult(granted_amount=0,
                                            status_code=INTERNAL,
                                            status_message=str(exc))
+                    except Exception as exc:
+                        # safeDispatch parity: a malformed attribute
+                        # value must degrade to a typed INTERNAL
+                        # denial, never fail the whole RPC untyped
+                        monitor.DISPATCH_ERRORS.inc()
+                        log.exception("quota instance build failed")
+                        return QuotaResult(granted_amount=0,
+                                           status_code=INTERNAL,
+                                           status_message=str(exc))
+                    ex = self.executor
+                    if ex is not None:
+                        from istio_tpu.runtime.config import _qualify
+                        from istio_tpu.runtime.executor import \
+                            quota_fallback
+                        amount = args.quota_amount
+                        act = ex.submit(
+                            _qualify(hc.name, hc.namespace),
+                            self._bound_quota(handler, template,
+                                              instance, args),
+                            lambda policy, reason, _a=amount:
+                                quota_fallback(policy, reason, _a))
+                        return ex.resolve(act, deadline)
+                    try:
+                        with monitor.dispatch_timer():
+                            return handler.handle_quota(template, instance,
+                                                        args)
                     except Exception as exc:
                         monitor.DISPATCH_ERRORS.inc()
                         log.exception("adapter quota failed")
@@ -914,6 +1052,14 @@ class Dispatcher:
                                            status_message=str(exc))
         # no matching quota rule: grant freely (reference returns empty)
         return QuotaResult(granted_amount=args.quota_amount)
+
+    @staticmethod
+    def _bound_quota(handler: Handler, template: str, instance,
+                     args: QuotaArgs) -> Any:
+        def call():
+            with monitor.dispatch_timer():
+                return handler.handle_quota(template, instance, args)
+        return call
 
     def preprocess(self, bag: Bag) -> Bag:
         """APA phase (dispatcher.go:285): run ATTRIBUTE_GENERATOR

@@ -1,0 +1,643 @@
+"""Adapter executor — host actions off the hot path, bulkheaded,
+deadline-bounded.
+
+The reference Mixer never runs adapter work inline: every aspect
+dispatch goes through a bounded goroutine pool (mixer/pkg/pool,
+SURVEY §2) precisely so one slow backend cannot stall the request
+path. This module is that pool for the TPU build, shaped by the same
+overload-control doctrine as runtime/resilience.py:
+
+  * BULKHEADS — every handler (qualified name) owns its own bounded
+    work queue and worker share. A wedged stackdriver exhausts its own
+    lane's workers and queue; memquota's lane never notices. Overflow
+    sheds typed (the ResourceExhaustedError family), never silently.
+  * DEADLINE-BOUNDED FOLD — a host action inherits the request's
+    remaining check deadline (threaded batcher → dispatcher →
+    resolve()). An action still running at the deadline resolves to
+    the configured --host-fail-policy verdict (open → OK with a
+    1s/1-use TTL so the policy-bypass window closes with the outage;
+    closed → UNAVAILABLE) and is counted `overrun` — the batch folds
+    as soon as device results + resolved-or-defaulted host bits are
+    in, never held by a wedged backend.
+  * PER-HANDLER CIRCUIT BREAKERS — the PR 2 CircuitBreaker, one per
+    lane, persisting across config swaps (handler identity outlives
+    snapshots, like the sharded plane's per-bank breakers). An open
+    breaker short-circuits straight to the fail policy; recovery rides
+    the standard half-open probe.
+  * MAINTENANCE LANE — periodic host work (list-provider refresh,
+    list.go:115-247's TTL loop) runs on a dedicated lane driven by the
+    executor's own scheduler, pinned off the timed request window.
+
+Accounting is conservation-exact at resolve(): every submitted action
+resolves with EXACTLY one outcome in {ok, error, shed, expired,
+overrun, breaker_open} (monitor.host_action_counters asserts
+submitted == resolved). A worker completing an action the fold already
+abandoned records `late_ok`/`late_error` separately — late results
+are accounting, never verdicts.
+
+Plain exceptions keep safeDispatch semantics (dispatcher.go:399): one
+jittered retry, then the action's own INTERNAL result — NOT the fail
+policy — so executor-path verdicts stay oracle-identical to the
+generic host path whenever no breaker is open and no deadline struck.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+from istio_tpu.runtime.resilience import (CHAOS, ChaosHooks,
+                                          CircuitBreaker)
+
+log = logging.getLogger("istio_tpu.runtime.executor")
+
+# the lane every periodic/maintenance job runs on (provider refresh);
+# never used for request-path actions
+MAINTENANCE_LANE = "__maintenance__"
+
+OUTCOMES = ("ok", "error", "shed", "expired", "overrun",
+            "breaker_open")
+
+
+@dataclasses.dataclass
+class ExecutorConfig:
+    """Knobs for the AdapterExecutor (ServerArgs mirrors these; mixs
+    exposes --host-fail-policy / --executor-workers)."""
+    # worker threads per handler lane (the bulkhead's concurrency
+    # share; blocking adapters overlap up to this many calls)
+    workers: int = 2
+    # pending host actions per lane; submits past it shed typed
+    queue_cap: int = 256
+    # what an unresolvable host action contributes to the response:
+    # "open" → OK with a 1s/1-use TTL (Mixer-client fail-open — policy
+    # must not take the mesh down), "closed" → UNAVAILABLE
+    fail_policy: str = "closed"
+    # extra per-action bound applied even when the request carries no
+    # deadline (0 = bound by the request deadline only)
+    action_timeout_s: float = 0.0
+    # per-handler breaker: consecutive failed/overrun actions that
+    # trip it, and how long it stays open before a half-open probe
+    breaker_failures: int = 3
+    breaker_reset_s: float = 5.0
+    # retry a failed adapter call once (jittered) before counting it
+    retry: bool = True
+    retry_backoff_s: float = 0.002
+    retry_jitter_s: float = 0.004
+    # maintenance scheduler poll quantum
+    maintenance_tick_s: float = 0.25
+
+
+class HostAction:
+    """One submitted host adapter call. The WORKER completes it (sets
+    the adapter's real result); the FOLD claims it exactly once via
+    AdapterExecutor.resolve() — whichever side is late, accounting
+    stays single-home: claim carries the conservation outcome, a
+    completion after claim only bumps the late_* counters."""
+
+    __slots__ = ("handler", "fallback", "fn", "_done", "_lock",
+                 "_result", "_worker_outcome", "_claimed", "_wall",
+                 "immediate")
+
+    def __init__(self, handler: str,
+                 fallback: Callable[[str, str], Any],
+                 immediate: str | None = None,
+                 fn: Callable[[], Any] | None = None):
+        self.handler = handler
+        self.fallback = fallback
+        self.fn = fn
+        # set for actions rejected AT submit (breaker_open / shed /
+        # expired): resolve() never waits on these
+        self.immediate = immediate
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._result: Any = None
+        self._worker_outcome: str | None = None
+        self._claimed = False
+        self._wall = 0.0
+
+    def _complete(self, result: Any, outcome: str,
+                  wall: float) -> bool:
+        """Worker side. Returns True when the fold still owns the
+        action (normal completion); False when it was already
+        abandoned (the late_* accounting path)."""
+        with self._lock:
+            self._result = result
+            self._worker_outcome = outcome
+            self._wall = wall
+            fresh = not self._claimed
+        self._done.set()
+        return fresh
+
+    def _claim(self, timeout: float | None
+               ) -> tuple[Any, str, float] | None:
+        """Fold side: wait up to `timeout`, claim the result. None →
+        the action is still running (abandoned; caller applies the
+        fail policy and counts `overrun`)."""
+        self._done.wait(timeout)
+        with self._lock:
+            self._claimed = True
+            if self._worker_outcome is None:
+                return None
+            return self._result, self._worker_outcome, self._wall
+
+
+class HandlerLane:
+    """One handler's bulkhead: bounded queue + dedicated workers + its
+    own circuit breaker. Lanes persist across config swaps (keyed by
+    qualified handler name) so breaker state survives republishes the
+    way the sharded plane's per-bank breakers do."""
+
+    def __init__(self, name: str, config: ExecutorConfig,
+                 chaos: ChaosHooks):
+        self.name = name
+        self.config = config
+        self.chaos = chaos
+        # publish=False: the device breaker gauge belongs to the
+        # device path — per-handler state surfaces via snapshot()
+        self.breaker = CircuitBreaker(config.breaker_failures,
+                                      config.breaker_reset_s,
+                                      publish=False)
+        self._queue: "queue.Queue[HostAction | None]" = \
+            queue.Queue(maxsize=max(int(config.queue_cap), 1))
+        self._lock = threading.Lock()
+        self._in_flight: dict[int, float] = {}   # id(act) → start wall
+        self._closed = False
+        self.outcomes: dict[str, int] = {o: 0 for o in OUTCOMES}
+        self.late = {"ok": 0, "error": 0}
+        self.submitted = 0
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"adapter-{name}-{i}")
+            for i in range(max(int(config.workers), 1))]
+        for t in self._workers:
+            t.start()
+
+    # -- submit side (dispatcher worker threads) ----------------------
+
+    def submit(self, fn: Callable[[], Any],
+               fallback: Callable[[str, str], Any]) -> HostAction:
+        """Queue one adapter call. Never blocks and never raises out:
+        a full queue, a closed lane or an open breaker returns an
+        action pre-resolved to its typed immediate outcome — the fold
+        applies the fail policy and the counters say why."""
+        with self._lock:
+            self.submitted += 1
+        if self._closed:
+            return HostAction(self.name, fallback, immediate="shed")
+        if not self.breaker.allow_device():
+            return HostAction(self.name, fallback,
+                              immediate="breaker_open")
+        act = HostAction(self.name, fallback, fn=fn)
+        try:
+            self._queue.put_nowait(act)
+        except queue.Full:
+            # bulkhead overflow: typed shed, never an unbounded queue
+            # behind a wedged backend. The breaker slot allow_device
+            # may have granted (a half-open probe) must be returned —
+            # the probe never ran.
+            self.breaker.release_probe()
+            return HostAction(self.name, fallback, immediate="shed")
+        return act
+
+    # -- worker side --------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            act = self._queue.get()
+            if act is None:
+                return
+            self._run_one(act, act.fn)
+
+    def _run_one(self, act: HostAction,
+                 fn: Callable[[], Any]) -> None:
+        import random
+
+        from istio_tpu.runtime import monitor
+
+        t0 = time.perf_counter()
+        with self._lock:
+            self._in_flight[id(act)] = t0
+        outcome = "ok"
+        result: Any = None
+        try:
+            try:
+                self.chaos.adapter_call(self.name)
+                result = fn()
+            except Exception as exc:
+                if self.config.retry:
+                    # one jittered retry absorbs transient backend
+                    # faults without involving the breaker
+                    time.sleep(self.config.retry_backoff_s +
+                               random.random() *
+                               self.config.retry_jitter_s)
+                    monitor.note_host_action_retry(self.name)
+                    try:
+                        self.chaos.adapter_call(self.name)
+                        result = fn()
+                    except Exception as exc2:
+                        outcome, result = "error", exc2
+                    else:
+                        self.breaker.record_success()
+                else:
+                    outcome, result = "error", exc
+                if outcome == "error":
+                    self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+        finally:
+            with self._lock:
+                self._in_flight.pop(id(act), None)
+        wall = time.perf_counter() - t0
+        if not act._complete(result, outcome, wall):
+            # the fold already gave up on this action (overrun): the
+            # late completion is accounting only — its result must
+            # never reach a response the policy already answered
+            key = "ok" if outcome == "ok" else "error"
+            with self._lock:
+                self.late[key] += 1
+            monitor.note_host_action_late(self.name, key)
+
+    def note_outcome(self, outcome: str) -> None:
+        with self._lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+
+    # -- introspection / lifecycle ------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            in_flight = dict(self._in_flight)
+            outcomes = dict(self.outcomes)
+            late = dict(self.late)
+            submitted = self.submitted
+        now = time.perf_counter()
+        oldest = max((now - t for t in in_flight.values()),
+                     default=0.0)
+        return {
+            "queue_depth": self._queue.qsize(),
+            "queue_cap": self._queue.maxsize,
+            "workers": len(self._workers),
+            "in_flight": len(in_flight),
+            # a wedged lane shows up here: actions running far past
+            # any sane adapter wall are the smoking gun
+            "oldest_running_s": round(oldest, 3),
+            "submitted": submitted,
+            "outcomes": outcomes,
+            "late": late,
+            "breaker": self.breaker.snapshot(),
+        }
+
+    def close(self, grace_s: float = 1.0) -> None:
+        """Stop the workers. A wedged worker gets its thread LEAKED
+        (daemon), never joined forever — the h2srv doctrine: a stuck
+        backend must not wedge shutdown."""
+        self._closed = True
+        for _ in self._workers:
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                break
+        end = time.perf_counter() + grace_s
+        for t in self._workers:
+            t.join(timeout=max(end - time.perf_counter(), 0.0))
+
+
+class AdapterExecutor:
+    """The adapter-executor plane: per-handler bulkhead lanes, the
+    deadline-bounded resolve/fold contract, and the maintenance
+    scheduler. One instance per RuntimeServer, shared by every
+    dispatcher generation (lanes and breakers outlive config swaps)."""
+
+    def __init__(self, config: ExecutorConfig | None = None,
+                 chaos: ChaosHooks | None = None):
+        self.config = config or ExecutorConfig()
+        if self.config.fail_policy not in ("open", "closed"):
+            raise ValueError(
+                f"host fail_policy must be 'open' or 'closed', got "
+                f"{self.config.fail_policy!r}")
+        self.chaos = chaos if chaos is not None else CHAOS
+        self._lanes: dict[str, HandlerLane] = {}
+        self._lanes_lock = threading.Lock()
+        self._closed = False
+        # maintenance registry: name → {fn, interval_s, next_due, ...}
+        self._refresh: dict[str, dict] = {}
+        self._refresh_lock = threading.Lock()
+        self._maint_stop = threading.Event()
+        self._maint_thread: threading.Thread | None = None
+
+    # -- lanes ---------------------------------------------------------
+
+    def lane(self, handler: str) -> HandlerLane:
+        ln = self._lanes.get(handler)
+        if ln is None:
+            with self._lanes_lock:
+                ln = self._lanes.get(handler)
+                if ln is None:
+                    ln = HandlerLane(handler, self.config, self.chaos)
+                    self._lanes[handler] = ln
+        return ln
+
+    def submit(self, handler: str, fn: Callable[[], Any],
+               fallback: Callable[[str, str], Any]) -> HostAction:
+        """Queue one host adapter call onto the handler's bulkhead.
+        `fn` is the zero-arg adapter call (built by the dispatcher,
+        instance already constructed); `fallback(policy, reason)`
+        builds the fail-policy result for this action's variety
+        (check_fallback / quota_fallback below). Returns immediately —
+        the fold pairs every submit with exactly one resolve()."""
+        from istio_tpu.runtime import monitor
+
+        if self._closed:
+            act = HostAction(handler, fallback, immediate="shed")
+            # closed-executor submits still hit the ledger: lane() on
+            # a closed executor would resurrect worker threads
+            monitor.note_host_action_submitted(handler)
+            return act
+        monitor.note_host_action_submitted(handler)
+        return self.lane(handler).submit(fn, fallback)
+
+    def resolve(self, act: HostAction,
+                deadline: float | None = None) -> Any:
+        """Claim one action's result, bounded by the request's
+        remaining deadline (absolute perf_counter instant) and the
+        configured per-action timeout. THE single accounting home:
+        every submitted action passes through here exactly once and
+        lands on exactly one conservation outcome."""
+        from istio_tpu.runtime import monitor
+
+        lane = self._lanes.get(act.handler)
+        policy = self.config.fail_policy
+        if act.immediate is not None:
+            outcome = act.immediate
+            if lane is not None:
+                lane.note_outcome(outcome)
+            monitor.note_host_action(act.handler, outcome)
+            return act.fallback(policy, outcome)
+        timeout: float | None = None
+        if deadline is not None:
+            timeout = deadline - time.perf_counter()
+            if timeout > 86_400.0:
+                # a deadline days out IS no deadline (and absurd
+                # values would overflow Event.wait's C time type)
+                timeout = None
+        if self.config.action_timeout_s > 0:
+            timeout = self.config.action_timeout_s if timeout is None \
+                else min(timeout, self.config.action_timeout_s)
+        if timeout is not None and timeout <= 0:
+            # the request's deadline is already gone: don't wait at
+            # all — claim whatever finished, else expire the action
+            timeout = 0.0
+        got = act._claim(timeout)
+        if got is None:
+            # still running at the bound: the batch folds with the
+            # policy verdict; the worker's eventual completion counts
+            # late_*. An overrun is a breaker failure — a wedged
+            # backend whose calls never return must still trip open
+            # (record_failure also returns any half-open probe slot).
+            outcome = "overrun" if timeout is None or timeout > 0 \
+                else "expired"
+            if lane is not None:
+                lane.breaker.record_failure()
+                lane.note_outcome(outcome)
+            monitor.note_host_action(act.handler, outcome)
+            return act.fallback(policy, outcome)
+        result, outcome, wall = got
+        if lane is not None:
+            lane.note_outcome(outcome)
+        monitor.note_host_action(act.handler, outcome, wall)
+        if outcome == "error":
+            # safeDispatch parity (dispatcher.go:399): an adapter
+            # exception degrades THIS action to INTERNAL — the same
+            # result the inline path produces, so executor-path
+            # verdicts stay oracle-identical
+            return act.fallback("error", f"{type(result).__name__}: "
+                                         f"{result}")
+        return result
+
+    def abandon(self, act: HostAction) -> None:
+        """Account an action a fold is unwinding past (an exception
+        between submit and claim) — unless the fold already claimed
+        it. Zero wait, result discarded (the batch is failing with its
+        own exception), and deliberately NO breaker involvement: the
+        fold's failure is not the adapter's. Keeps the conservation
+        ledger exact on error paths."""
+        from istio_tpu.runtime import monitor
+
+        with act._lock:
+            if act._claimed:
+                return
+        if act.immediate is not None:
+            outcome = act.immediate
+        else:
+            got = act._claim(0.0)
+            outcome = got[1] if got is not None else "expired"
+        lane = self._lanes.get(act.handler)
+        if lane is not None:
+            lane.note_outcome(outcome)
+        monitor.note_host_action(act.handler, outcome)
+
+    # -- maintenance lane ---------------------------------------------
+
+    def register_refreshables(self,
+                              handlers: Mapping[str, Any]) -> None:
+        """(Re)build the maintenance registry from a published handler
+        map: every handler carrying a callable `refresh` with a
+        positive `refresh_interval_s` and a live provider gets a
+        periodic slot on the maintenance lane. Called from the config
+        publish hook — per-name stats survive republishes (handler
+        identity may change; the NAME is the operator-facing key)."""
+        fresh: dict[str, dict] = {}
+        now = time.monotonic()
+        with self._refresh_lock:
+            for name, h in handlers.items():
+                refresh = getattr(h, "refresh", None)
+                interval = float(getattr(h, "refresh_interval_s", 0.0)
+                                 or 0.0)
+                if not callable(refresh) or interval <= 0:
+                    continue
+                if getattr(h, "_provider", None) is None:
+                    continue   # nothing to re-pull
+                prev = self._refresh.get(name)
+                fresh[name] = {
+                    "fn": refresh,
+                    "interval_s": interval,
+                    "next_due": now + interval,
+                    "total": prev["total"] if prev else 0,
+                    "failures": prev["failures"] if prev else 0,
+                    "last_success_wall":
+                        prev["last_success_wall"] if prev else None,
+                    "last_error": prev["last_error"] if prev else None,
+                    "in_flight": False,
+                }
+            self._refresh = fresh
+        if fresh and self._maint_thread is None and not self._closed:
+            self._maint_thread = threading.Thread(
+                target=self._maintenance_loop, daemon=True,
+                name="adapter-maintenance")
+            self._maint_thread.start()
+
+    def _maintenance_loop(self) -> None:
+        tick = max(self.config.maintenance_tick_s, 0.01)
+        while not self._maint_stop.wait(tick):
+            now = time.monotonic()
+            due: list[tuple[str, dict]] = []
+            with self._refresh_lock:
+                for name, st in self._refresh.items():
+                    if st["next_due"] <= now and not st["in_flight"]:
+                        st["in_flight"] = True
+                        due.append((name, st))
+            for name, st in due:
+                # the refresh runs ON the maintenance lane — a slow
+                # provider occupies the maintenance worker, never a
+                # request lane and never the scheduler thread
+                act = self.lane(MAINTENANCE_LANE).submit(
+                    lambda n=name, s=st: self._run_refresh(n, s),
+                    lambda _p, _r: None)
+                if act.immediate is not None:
+                    # shed at the lane (full queue / closing): the
+                    # refresh never ran — clear in_flight and retry
+                    # next tick, or the provider would stall forever
+                    with self._refresh_lock:
+                        st["in_flight"] = False
+
+    def _run_refresh(self, name: str, st: dict) -> None:
+        from istio_tpu.runtime import monitor
+
+        monitor.LIST_REFRESH_TOTAL.inc()
+        err: str | None = None
+        try:
+            st["fn"]()
+        except Exception as exc:
+            err = f"{type(exc).__name__}: {exc}"
+            monitor.LIST_REFRESH_FAILURES.inc()
+            log.warning("provider refresh %s failed: %s", name, err)
+        with self._refresh_lock:
+            st["total"] += 1
+            if err is None:
+                st["last_success_wall"] = time.time()
+                st["last_error"] = None
+            else:
+                st["failures"] += 1
+                st["last_error"] = err
+            st["next_due"] = time.monotonic() + st["interval_s"]
+            st["in_flight"] = False
+
+    def refresh_now(self, name: str) -> bool:
+        """Synchronous one-shot refresh (tests, /debug triggers);
+        False when the name is not registered."""
+        with self._refresh_lock:
+            st = self._refresh.get(name)
+        if st is None:
+            return False
+        self._run_refresh(name, st)
+        return True
+
+    # -- introspection / lifecycle ------------------------------------
+
+    def snapshot(self) -> dict:
+        """/debug/executor payload: per-lane bulkhead + breaker state,
+        the conservation counter families, policy knobs and the
+        maintenance registry (incl. last-refresh-age per provider)."""
+        from istio_tpu.runtime import monitor
+
+        with self._lanes_lock:
+            lanes = dict(self._lanes)
+        now = time.time()
+        with self._refresh_lock:
+            maint = {}
+            for name, st in self._refresh.items():
+                last = st["last_success_wall"]
+                maint[name] = {
+                    "interval_s": st["interval_s"],
+                    "refresh_total": st["total"],
+                    "refresh_failures": st["failures"],
+                    "last_refresh_age_s":
+                        round(now - last, 3) if last else None,
+                    "last_error": st["last_error"],
+                }
+        return {
+            "policy": {
+                "fail_policy": self.config.fail_policy,
+                "workers_per_handler": self.config.workers,
+                "queue_cap": self.config.queue_cap,
+                "action_timeout_s": self.config.action_timeout_s,
+                "breaker_failures": self.config.breaker_failures,
+                "breaker_reset_s": self.config.breaker_reset_s,
+                "retry": self.config.retry,
+            },
+            "lanes": {name: ln.stats()
+                      for name, ln in sorted(lanes.items())},
+            "counters": monitor.host_action_counters(),
+            "maintenance": maint,
+            "closed": self._closed,
+        }
+
+    def close(self, grace_s: float = 1.0) -> None:
+        """Ordered teardown: scheduler first (no new maintenance
+        work), then every lane. Idempotent; wedged workers are leaked
+        as daemons, never waited on forever."""
+        if self._closed:
+            return
+        self._closed = True
+        self._maint_stop.set()
+        t = self._maint_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=grace_s)
+        with self._lanes_lock:
+            lanes = list(self._lanes.values())
+        for ln in lanes:
+            ln.close(grace_s)
+
+
+# -- fail-policy result factories (the dispatcher's fallbacks) --------
+
+def check_fallback(policy: str, reason: str):
+    """CheckResult for an unresolvable CHECK action. `policy` is
+    "open"/"closed" for deadline/bulkhead/breaker outcomes, or the
+    literal "error" for adapter exceptions (safeDispatch INTERNAL —
+    NOT a policy decision, so it is oracle-identical)."""
+    from istio_tpu.adapters.sdk import CheckResult
+    from istio_tpu.models.policy_engine import INTERNAL, OK
+    from istio_tpu.runtime.resilience import UNAVAILABLE
+
+    if policy == "error":
+        # safeDispatch accounting parity: one dispatch error per
+        # failed action, counted where the verdict is built
+        from istio_tpu.runtime import monitor
+        monitor.DISPATCH_ERRORS.inc()
+        log.warning("adapter check failed: %s", reason)
+        return CheckResult(status_code=INTERNAL,
+                           status_message=f"adapter panic: {reason}")
+    if policy == "open":
+        # fail-open answers OK but with a 1s/1-use TTL: sidecars
+        # re-check promptly instead of caching the blanket allow for a
+        # normal success's 5s/10k uses (resilience.py's posture)
+        return CheckResult(status_code=OK, valid_duration_s=1.0,
+                           valid_use_count=1)
+    return CheckResult(
+        status_code=UNAVAILABLE,
+        status_message=f"host adapter unavailable ({reason})")
+
+
+def quota_fallback(policy: str, reason: str, amount: int):
+    """QuotaResult for an unresolvable QUOTA action: fail-open grants
+    the requested amount (quota outage must not starve the mesh),
+    fail-closed grants nothing with UNAVAILABLE; adapter exceptions
+    keep dispatcher.quota's INTERNAL shape."""
+    from istio_tpu.adapters.sdk import QuotaResult
+    from istio_tpu.models.policy_engine import INTERNAL
+    from istio_tpu.runtime.resilience import UNAVAILABLE
+
+    if policy == "error":
+        from istio_tpu.runtime import monitor
+        monitor.DISPATCH_ERRORS.inc()
+        log.warning("adapter quota failed: %s", reason)
+        return QuotaResult(granted_amount=0, status_code=INTERNAL,
+                           status_message=reason)
+    if policy == "open":
+        return QuotaResult(granted_amount=amount, valid_duration_s=1.0)
+    return QuotaResult(
+        granted_amount=0, status_code=UNAVAILABLE,
+        status_message=f"quota adapter unavailable ({reason})")

@@ -133,6 +133,129 @@ def resilience_counters() -> dict:
     }
 
 
+# -- adapter-executor plane (runtime/executor.py) --------------------
+#
+# Conservation invariant (the report plane's doctrine applied to host
+# actions): every host adapter call SUBMITTED to the executor resolves
+# with EXACTLY one outcome — ok (adapter result used), error (adapter
+# exception → safeDispatch INTERNAL), shed (bulkhead queue full /
+# closed lane), expired (request deadline gone before the wait),
+# overrun (still running at the deadline → fail-policy verdict),
+# breaker_open (lane breaker short-circuit) — so
+# submitted == sum(outcomes) holds at quiescence. A worker finishing
+# an action the fold already abandoned counts late_{ok,error}
+# SEPARATELY: late results are accounting, never verdicts.
+HOST_ACTION_OUTCOMES = ("ok", "error", "shed", "expired", "overrun",
+                        "breaker_open")
+HOST_ACTIONS_SUBMITTED = hostmetrics.default_registry.counter(
+    "mixer_host_actions_submitted_total",
+    "host adapter calls submitted to the executor plane, by handler")
+HOST_ACTIONS = hostmetrics.default_registry.counter(
+    "mixer_host_actions_total",
+    "host adapter calls resolved, by handler and outcome (see "
+    "runtime/monitor.py HOST_ACTION_OUTCOMES)")
+HOST_ACTION_SECONDS = hostmetrics.default_registry.histogram(
+    "mixer_host_action_seconds",
+    "wall seconds of completed host adapter calls, by handler")
+HOST_ACTION_LATE = hostmetrics.default_registry.counter(
+    "mixer_host_action_late_total",
+    "host adapter calls completing AFTER their fold abandoned them "
+    "(outcome already counted overrun/expired), by handler and result")
+HOST_ACTION_RETRIES = hostmetrics.default_registry.counter(
+    "mixer_host_action_retries_total",
+    "host adapter calls retried after a transient exception")
+HOST_ACTIONS_SUBMITTED.inc(0)   # zero-series before the first action
+HOST_ACTIONS.inc(0)
+HOST_ACTION_LATE.inc(0)
+HOST_ACTION_RETRIES.inc(0)
+
+# provider refresh (the executor's maintenance lane driving
+# list_adapter's TTL loop): attempts vs failures + per-provider age
+# in /debug/executor — a stale list must be visible, not silent
+LIST_REFRESH_TOTAL = prometheus_client.Counter(
+    "mixer_list_provider_refresh_total",
+    "list provider refresh attempts (maintenance lane)",
+    registry=REGISTRY)
+LIST_REFRESH_FAILURES = prometheus_client.Counter(
+    "mixer_list_provider_refresh_failures",
+    "list provider refresh attempts that failed (the last good list "
+    "keeps serving)", registry=REGISTRY)
+
+
+def note_host_action_submitted(handler: str) -> None:
+    HOST_ACTIONS_SUBMITTED.inc(1, handler=handler)
+
+
+def note_host_action(handler: str, outcome: str,
+                     seconds: float | None = None) -> None:
+    """One resolved host action (runtime/executor.AdapterExecutor.
+    resolve — the single accounting home)."""
+    HOST_ACTIONS.inc(1, handler=handler, outcome=outcome)
+    if seconds is not None:
+        HOST_ACTION_SECONDS.observe(seconds, handler=handler)
+
+
+def note_host_action_late(handler: str, result: str) -> None:
+    HOST_ACTION_LATE.inc(1, handler=handler, result=result)
+
+
+def note_host_action_retry(handler: str) -> None:
+    HOST_ACTION_RETRIES.inc(1, handler=handler)
+
+
+def host_action_counters() -> dict:
+    """Executor-plane counter snapshot as one JSON-able dict — read by
+    /debug/executor, the executor smoke and bench.py. `exact` is the
+    conservation check (True whenever nothing is in flight)."""
+    by_handler: dict[str, dict] = {}
+    submitted_total = 0
+    with HOST_ACTIONS_SUBMITTED._lock:
+        sub = dict(HOST_ACTIONS_SUBMITTED._values)
+    for labels, v in sub.items():
+        h = dict(labels).get("handler")
+        if h is None:
+            continue
+        by_handler.setdefault(h, {"submitted": 0, "outcomes": {}})
+        by_handler[h]["submitted"] += int(v)
+        submitted_total += int(v)
+    resolved_total = 0
+    outcome_totals = {o: 0 for o in HOST_ACTION_OUTCOMES}
+    with HOST_ACTIONS._lock:
+        res = dict(HOST_ACTIONS._values)
+    for labels, v in res.items():
+        lab = dict(labels)
+        h, o = lab.get("handler"), lab.get("outcome")
+        if h is None or o is None:
+            continue
+        by_handler.setdefault(h, {"submitted": 0, "outcomes": {}})
+        by_handler[h]["outcomes"][o] = \
+            by_handler[h]["outcomes"].get(o, 0) + int(v)
+        outcome_totals[o] = outcome_totals.get(o, 0) + int(v)
+        resolved_total += int(v)
+    late = {"ok": 0, "error": 0}
+    with HOST_ACTION_LATE._lock:
+        for labels, v in dict(HOST_ACTION_LATE._values).items():
+            r = dict(labels).get("result")
+            if r in late:
+                late[r] += int(v)
+    with HOST_ACTION_RETRIES._lock:
+        retries = sum(int(v) for labels, v in
+                      dict(HOST_ACTION_RETRIES._values).items()
+                      if dict(labels).get("handler") is not None)
+    return {
+        "submitted": submitted_total,
+        "resolved": resolved_total,
+        "in_flight": submitted_total - resolved_total,
+        "outcomes": outcome_totals,
+        "late": late,
+        "retries": retries,
+        "by_handler": by_handler,
+        "exact": submitted_total == resolved_total,
+        "refresh_total": int(LIST_REFRESH_TOTAL._value.get()),
+        "refresh_failures": int(LIST_REFRESH_FAILURES._value.get()),
+    }
+
+
 # -- end-to-end Check() latency decomposition ------------------------
 #
 # Stage semantics (one observation per BATCH per stage; e2e is one

@@ -98,9 +98,15 @@ class CircuitBreaker:
 
     CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
 
-    def __init__(self, failures: int = 3, reset_s: float = 5.0):
+    def __init__(self, failures: int = 3, reset_s: float = 5.0,
+                 publish: bool = True):
         self.failure_threshold = max(int(failures), 1)
         self.reset_s = reset_s
+        # False for NON-device breakers (the adapter executor's
+        # per-handler lanes): they must not clobber the device
+        # breaker's mixer_check_breaker_state gauge — their state
+        # surfaces via their owner's snapshot (/debug/executor)
+        self._publish_gauge = publish
         self._lock = threading.Lock()
         self._state = self.CLOSED
         self._consecutive = 0
@@ -109,6 +115,8 @@ class CircuitBreaker:
         self._publish()
 
     def _publish(self) -> None:
+        if not self._publish_gauge:
+            return
         from istio_tpu.runtime import monitor
         monitor.BREAKER_STATE.set(
             {self.CLOSED: 0, self.HALF_OPEN: 1, self.OPEN: 2}[self._state])
@@ -116,10 +124,11 @@ class CircuitBreaker:
     def _transition(self, to: str) -> None:
         if to == self._state:
             return
-        from istio_tpu.runtime import monitor
-        log.warning("device circuit breaker: %s -> %s", self._state, to)
+        log.warning("circuit breaker: %s -> %s", self._state, to)
         self._state = to
-        monitor.BREAKER_TRANSITIONS.labels(to=to).inc()
+        if self._publish_gauge:
+            from istio_tpu.runtime import monitor
+            monitor.BREAKER_TRANSITIONS.labels(to=to).inc()
         self._publish()
 
     @property
@@ -211,6 +220,55 @@ class ChaosHooks:
             self.oracle_failures = 0
             self.injected_device = 0
             self.injected_oracle = 0
+            # -- adapter-boundary seams (the executor plane's chaos
+            #    levers, keyed by qualified handler name) -------------
+            # sleep added to every call on this handler's lane
+            self.adapter_latency_s: dict[str, float] = {}
+            # fail the next N calls on this handler's lane
+            self.adapter_failures: dict[str, int] = {}
+            # wedge: calls on this handler BLOCK until the event sets
+            # (unwedge_adapter / reset releases them) — the bulkhead
+            # and overrun paths' primary lever
+            wedged = getattr(self, "_adapter_wedged", None)
+            if wedged:
+                for ev in wedged.values():
+                    ev.set()   # release stuck workers before dropping
+            self._adapter_wedged: dict[str, threading.Event] = {}
+            self.injected_adapter = 0
+
+    def wedge_adapter(self, handler: str) -> None:
+        """Every subsequent call on `handler`'s lane blocks until
+        unwedge_adapter(handler) or reset()."""
+        with self._lock:
+            self._adapter_wedged.setdefault(handler, threading.Event())
+
+    def unwedge_adapter(self, handler: str) -> None:
+        with self._lock:
+            ev = self._adapter_wedged.pop(handler, None)
+        if ev is not None:
+            ev.set()
+
+    def adapter_call(self, handler: str) -> None:
+        """Called by the executor's lane worker immediately before a
+        real adapter call — the adapter-boundary seam (latency, wedge,
+        injected errors per handler). Inert fields cost two dict
+        lookups per call."""
+        ev = self._adapter_wedged.get(handler)
+        if ev is not None:
+            ev.wait()
+        lat = self.adapter_latency_s.get(handler, 0.0)
+        if lat:
+            time.sleep(lat)
+        if self.adapter_failures.get(handler, 0) <= 0:
+            return
+        with self._lock:
+            n = self.adapter_failures.get(handler, 0)
+            if n <= 0:
+                return
+            self.adapter_failures[handler] = n - 1
+            self.injected_adapter += 1
+        raise RuntimeError(
+            f"chaos: injected adapter failure ({handler})")
 
     def device_step(self) -> None:
         """Called immediately before a real check device step."""
@@ -246,11 +304,31 @@ class ChaosHooks:
             "device_latency_s": self.device_latency_s,
             "injected_device": self.injected_device,
             "injected_oracle": self.injected_oracle,
+            "adapter_wedged": sorted(self._adapter_wedged),
+            "adapter_latency_s": dict(self.adapter_latency_s),
+            "adapter_failures_pending": dict(self.adapter_failures),
+            "injected_adapter": self.injected_adapter,
         }
 
 
 # process-wide chaos seam: tests/scripts arm it, serving code probes it
 CHAOS = ChaosHooks()
+
+
+def _takes_deadline(fn: Callable) -> bool:
+    """Does `fn` accept a `deadline` keyword? Decided once at wiring
+    time (never per batch); unintrospectable callables answer False
+    and are called (bags)-shaped."""
+    import inspect
+
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    if "deadline" in params:
+        return True
+    return any(p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in params.values())
 
 
 class ResilientChecker:
@@ -266,6 +344,12 @@ class ResilientChecker:
                  chaos: ChaosHooks | None = None):
         self.device = device
         self.oracle = oracle
+        # deadline propagation (the adapter-executor plane): callables
+        # that accept it get the batch's min remaining deadline so
+        # host actions inherit the request budget; plain (bags)-shaped
+        # callables (tests, legacy hooks) keep working
+        self._device_takes_deadline = _takes_deadline(device)
+        self._oracle_takes_deadline = _takes_deadline(oracle)
         self.config = config or ResilienceConfig()
         self.chaos = chaos if chaos is not None else CHAOS
         self.breaker = CircuitBreaker(self.config.breaker_failures,
@@ -275,11 +359,19 @@ class ResilientChecker:
         from istio_tpu.runtime.batcher import trim_pads
         return len(trim_pads(list(bags)))
 
-    def run_batch(self, bags: Sequence[Any]) -> Sequence[Any]:
+    def _device_call(self, bags: Sequence[Any],
+                     deadline: float | None) -> Sequence[Any]:
+        if self._device_takes_deadline:
+            return self.device(bags, deadline=deadline)
+        return self.device(bags)
+
+    def run_batch(self, bags: Sequence[Any],
+                  deadline: float | None = None) -> Sequence[Any]:
         from istio_tpu.runtime import monitor
 
         if not self.breaker.allow_device():
-            return self._fallback(bags, "breaker_open")
+            return self._fallback(bags, "breaker_open",
+                                  deadline=deadline)
         # every exit below must leave the breaker with a verdict
         # (success/failure) — or release the probe slot: an unwound
         # half-open probe with no verdict would wedge the breaker in
@@ -287,7 +379,7 @@ class ResilientChecker:
         recorded = False
         try:
             try:
-                out = self.device(bags)
+                out = self._device_call(bags, deadline)
             except CheckRejected:
                 raise           # typed rejections are answers, not faults
             except Exception as exc:
@@ -301,7 +393,7 @@ class ResilientChecker:
                                self.config.retry_jitter_s)
                     monitor.CHECK_DEVICE_RETRIES.inc()
                     try:
-                        out = self.device(bags)
+                        out = self._device_call(bags, deadline)
                     except CheckRejected:
                         raise
                     except Exception as exc2:
@@ -315,7 +407,8 @@ class ResilientChecker:
                 log.warning("device check batch failed (%s: %s); "
                             "serving via the CPU oracle path",
                             type(first).__name__, first)
-                return self._fallback(bags, "device_error")
+                return self._fallback(bags, "device_error",
+                                      deadline=deadline)
             self.breaker.record_success()
             recorded = True
             return out
@@ -323,13 +416,19 @@ class ResilientChecker:
             if not recorded:
                 self.breaker.release_probe()
 
-    def _fallback(self, bags: Sequence[Any], reason: str) -> Sequence[Any]:
+    def _fallback(self, bags: Sequence[Any], reason: str,
+                  deadline: float | None = None) -> Sequence[Any]:
         from istio_tpu.runtime import monitor
 
         n = self._n_real(bags)
         try:
             self.chaos.oracle_step()
-            out = self.oracle(bags)
+            # the degraded path keeps the request's deadline when the
+            # oracle callable takes one (check_host_oracle does) — a
+            # wedged adapter must stay bounded even while the device
+            # breaker routes batches host-side
+            out = self.oracle(bags, deadline=deadline) \
+                if self._oracle_takes_deadline else self.oracle(bags)
         except Exception as exc:
             if self.config.fail_policy == "open":
                 # Mixer-client fail-open: policy outage must not take

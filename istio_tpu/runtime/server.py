@@ -133,6 +133,28 @@ class ServerArgs:
     # retry a failed device step once (jittered backoff) before it
     # counts as a breaker failure
     device_retry: bool = True
+    # -- adapter-executor plane (runtime/executor.py) ------------------
+    # route host adapter work (fused-path overlay CHECK actions, quota
+    # adapter calls, provider refresh) through the bounded per-handler
+    # executor; False = the pre-executor inline loop (the behavioral
+    # oracle — shadow replay and the generic path always use it)
+    host_executor: bool = True
+    # worker threads per handler lane (the bulkhead's concurrency
+    # share) and pending-action cap per lane (overflow sheds typed)
+    executor_workers: int = 2
+    executor_queue_cap: int = 256
+    # what an unresolvable host action (deadline overrun, bulkhead
+    # shed, open breaker) contributes to the response: "open" → OK
+    # with a 1s/1-use TTL, "closed" → UNAVAILABLE (mixs exposes
+    # --host-fail-policy)
+    host_fail_policy: str = "closed"
+    # extra per-action wall bound even when the request carries no
+    # deadline (ms; 0 = bound by the request deadline only)
+    host_action_timeout_ms: float = 0.0
+    # per-handler circuit breaker: consecutive failed/overrun actions
+    # that trip it, and the open window before a half-open probe
+    host_breaker_failures: int = 3
+    host_breaker_reset_s: float = 5.0
     # -- rule-level telemetry (runtime/rulestats.py) -------------------
     # fold per-rule hit/deny/err counts into on-device accumulators
     # inside the fused check step (requires fused=True to do anything)
@@ -254,6 +276,22 @@ class RuntimeServer:
             "last_error": None,
             "last_error_revision": None,
         }
+        # adapter-executor plane (runtime/executor.py): built BEFORE
+        # the controller so the initial publish's dispatcher already
+        # runs host actions bulkheaded; lanes + breakers persist
+        # across config swaps (handler identity outlives snapshots)
+        self.executor = None
+        if self.args.host_executor:
+            from istio_tpu.runtime.executor import (AdapterExecutor,
+                                                    ExecutorConfig)
+            self.executor = AdapterExecutor(ExecutorConfig(
+                workers=self.args.executor_workers,
+                queue_cap=self.args.executor_queue_cap,
+                fail_policy=self.args.host_fail_policy,
+                action_timeout_s=self.args.host_action_timeout_ms
+                / 1e3,
+                breaker_failures=self.args.host_breaker_failures,
+                breaker_reset_s=self.args.host_breaker_reset_s))
         self.controller = Controller(
             store, default_manifest=manifest,
             identity_attr=self.args.identity_attr,
@@ -266,7 +304,8 @@ class RuntimeServer:
             on_publish=self._on_config_publish,
             initial_prewarm=self.args.initial_prewarm,
             prewarm_hook=self._prewarm_instep_for,
-            warm_parent_plans=not self._sharded_serving)
+            warm_parent_plans=not self._sharded_serving,
+            executor=self.executor)
         self._rulestats_drainer = RuleStatsDrainer(
             self.rulestats, self.args.rulestats_drain_s) \
             if (self.args.rule_telemetry and self.args.fused
@@ -380,6 +419,20 @@ class RuntimeServer:
             import logging
             logging.getLogger("istio_tpu.runtime.server").exception(
                 "rulestats attach failed")
+        # maintenance lane: (re)register the published handlers'
+        # provider-refresh jobs (list_adapter's TTL loop) with the
+        # executor's scheduler — refresh runs pinned off the timed
+        # request window, and a failing provider keeps serving the
+        # last good list while the counters say so
+        if self.executor is not None:
+            try:
+                self.executor.register_refreshables(
+                    dispatcher.handlers)
+            except Exception:
+                import logging
+                logging.getLogger(
+                    "istio_tpu.runtime.server").exception(
+                    "refreshable registration failed")
         # sharded serving plane: rebuild the shard banks / replica
         # lanes for the freshly published snapshot and swap every lane
         # atomically (set_routers) — old banks keep serving while the
@@ -525,7 +578,8 @@ class RuntimeServer:
                             identity_attr=self.args.identity_attr,
                             buckets=buckets,
                             rule_telemetry=self.args.rule_telemetry,
-                            recorder=recorder)
+                            recorder=recorder,
+                            executor=self.executor)
                         b.content_key = key
                         banks.append(b)
                 bank_map = {b.shard_id: b for b in banks}
@@ -545,7 +599,8 @@ class RuntimeServer:
                     buckets=buckets,
                     rule_telemetry=self.args.rule_telemetry,
                     recorder=recorder,
-                    dispatcher=dispatcher if i == 0 else None)
+                    dispatcher=dispatcher if i == 0 else None,
+                    executor=self.executor)
                     for i in range(n_lanes)]
                 routers = [
                     ShardRouter({s: banks[i]
@@ -563,7 +618,8 @@ class RuntimeServer:
                 buckets=buckets,
                 rule_telemetry=self.args.rule_telemetry,
                 recorder=recorder,
-                dispatcher=dispatcher if i == 0 else None)
+                dispatcher=dispatcher if i == 0 else None,
+                executor=self.executor)
                 for i in range(n_lanes)]
             routers = [
                 ShardRouter({s: banks[i] for s in range(plan.n_shards)},
@@ -729,23 +785,25 @@ class RuntimeServer:
             return bag
         return d.preprocess(bag)
 
-    def _run_check_batch(self,
-                         bags: Sequence[Bag]) -> Sequence[CheckResponse]:
+    def _run_check_batch(self, bags: Sequence[Bag],
+                         deadline: float | None = None
+                         ) -> Sequence[CheckResponse]:
         # pre-batched entries (check_many / BatchCheck) under sharded
         # serving route through the shard path too — a mixed-namespace
         # batch fans across banks inside the router; lane attribution
         # rides replica 0 (the submitting caller chose no lane)
         rr = self._replica_router
         if rr is not None and rr.routers:
-            return rr.routers[0].check(bags)
-        return self.resilience.run_batch(bags)
+            return rr.routers[0].check(bags, deadline=deadline)
+        return self.resilience.run_batch(bags, deadline=deadline)
 
-    def _run_check_batch_device(self, bags: Sequence[Bag]
+    def _run_check_batch_device(self, bags: Sequence[Bag],
+                                deadline: float | None = None
                                 ) -> Sequence[CheckResponse]:
         """The device serving path (ResilientChecker's primary).
         Resolved per call: a config swap publishes a new dispatcher and
         the breaker/fallback must follow it."""
-        return self.controller.dispatcher.check(bags)
+        return self.controller.dispatcher.check(bags, deadline=deadline)
 
     def _run_check_batch_oracle(self, bags: Sequence[Bag]
                                 ) -> Sequence[CheckResponse]:
@@ -903,11 +961,21 @@ class RuntimeServer:
 
     def quota(self, bag: Bag, quota_name: str,
               args: QuotaArgs | None = None,
-              preprocessed: bool = False) -> QuotaResult:
+              preprocessed: bool = False,
+              deadline: float | None = None) -> QuotaResult:
+        """`deadline`: absolute perf_counter instant bounding the host
+        quota adapter call (the executor plane); callers without one
+        inherit the server default — a wedged shared-quota backend
+        must never hold a front thread unbounded."""
         d = self.controller.dispatcher
         if not preprocessed:
             bag = self.preprocess(bag)
-        return d.quota(bag, quota_name, args or QuotaArgs())
+        if deadline is None and self.args.default_check_deadline_ms:
+            import time as _time
+            deadline = _time.perf_counter() + \
+                self.args.default_check_deadline_ms / 1e3
+        return d.quota(bag, quota_name, args or QuotaArgs(),
+                       deadline=deadline)
 
     def quota_fused(self, bag: Bag, quota_name: str, args: QuotaArgs,
                     check_result):
@@ -1186,6 +1254,21 @@ class RuntimeServer:
                 self.rulestats.drain()
             except Exception:
                 pass
+        # executor AFTER the batchers (no more batches can submit host
+        # actions) and BEFORE the controller (handlers close last):
+        # in-flight adapter calls get a bounded grace, wedged workers
+        # are leaked as daemons — never waited on forever. The
+        # conservation ledger must read exact at quiescence.
+        if self.executor is not None:
+            self.executor.close()
+            from istio_tpu.runtime import monitor as _monitor
+            hc = _monitor.host_action_counters()
+            if not hc["exact"]:
+                import logging
+                logging.getLogger("istio_tpu.runtime.server").warning(
+                    "host action conservation residue at shutdown: "
+                    "submitted=%d resolved=%d", hc["submitted"],
+                    hc["resolved"])
         self.controller.close()
 
     def close(self) -> None:

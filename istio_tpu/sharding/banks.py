@@ -74,11 +74,12 @@ class ShardBank:
     content_key: str = ""
     built_revision: int = 0
 
-    def check(self, bags) -> list:
+    def check(self, bags, deadline: float | None = None) -> list:
         """The router's per-bank entry: resilient when wired."""
         if self.checker is not None:
-            return list(self.checker.run_batch(bags))
-        return self.dispatcher.check(bags)
+            return list(self.checker.run_batch(bags,
+                                               deadline=deadline))
+        return self.dispatcher.check(bags, deadline=deadline)
 
     @property
     def n_rules(self) -> int:
@@ -237,17 +238,23 @@ def compile_shard_bank(parent: Snapshot, handlers: Mapping[str, Any],
                        identity_attr: str,
                        buckets: Sequence[int] = (),
                        rule_telemetry: bool = True,
-                       recorder: Any = None) -> ShardBank:
+                       recorder: Any = None,
+                       executor: Any = None) -> ShardBank:
     """Compile ONE shard of `plan` into a ShardBank — the unit the
     delta-compilation path pays per CHANGED shard (unchanged shards
-    carry their previous bank via rebind_bank instead)."""
+    carry their previous bank via rebind_bank instead). `executor`:
+    the server's AdapterExecutor — host-overlay rules pinned to this
+    bank run their adapter work bulkheaded like the monolithic path
+    (lanes are per HANDLER, shared across banks by design: the
+    backend behind a handler is one resource however many banks call
+    it)."""
     from istio_tpu.runtime.fused import build_fused_plan
 
     sub, l2g = shard_snapshot(parent, plan, k)
     fused = build_fused_plan(sub, rule_telemetry=rule_telemetry)
     disp = Dispatcher(sub, handlers, identity_attr,
                       fused=fused, buckets=tuple(buckets),
-                      recorder=recorder)
+                      recorder=recorder, executor=executor)
     cost = float(plan.shard_cost[k]) if plan.shard_cost else 0.0
     return ShardBank(shard_id=k, snapshot=sub, dispatcher=disp,
                      local_to_global=l2g, predicted_cost=cost,
@@ -283,7 +290,8 @@ def full_bank(parent: Snapshot, handlers: Mapping[str, Any],
               buckets: Sequence[int] = (),
               rule_telemetry: bool = True,
               recorder: Any = None,
-              dispatcher: Dispatcher | None = None) -> ShardBank:
+              dispatcher: Dispatcher | None = None,
+              executor: Any = None) -> ShardBank:
     """A bank over the WHOLE snapshot — the replica-only mode's lane
     executor (each replica owns its own FusedPlan over the full rule
     set). `dispatcher` reuses an existing one (lane 0 rides the
@@ -296,7 +304,7 @@ def full_bank(parent: Snapshot, handlers: Mapping[str, Any],
                                  rule_telemetry=rule_telemetry)
         dispatcher = Dispatcher(parent, handlers, identity_attr,
                                 fused=fused, buckets=tuple(buckets),
-                                recorder=recorder)
+                                recorder=recorder, executor=executor)
     return ShardBank(
         shard_id=shard_id, snapshot=parent, dispatcher=dispatcher,
         local_to_global=np.arange(len(parent.rules), dtype=np.int64),

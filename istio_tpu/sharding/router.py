@@ -58,9 +58,12 @@ class ShardRouter:
         self.batches = 0
         self.misrouted = 0
 
-    def check(self, bags: Sequence) -> list:
+    def check(self, bags: Sequence,
+              deadline: float | None = None) -> list:
         """The lane's run_batch hook — returns exactly one
-        CheckResponse per (non-padding) input row, in input order."""
+        CheckResponse per (non-padding) input row, in input order.
+        `deadline`: the batch's min remaining absolute instant,
+        threaded to each bank's host-action fold (executor plane)."""
         bags = trim_pads(list(bags))
         if not bags:
             return []
@@ -105,7 +108,7 @@ class ShardRouter:
                 # when wired: retry → per-bank breaker → the bank's
                 # CPU-oracle fallback — a faulting bank answers
                 # correctly (slower) instead of failing the batch
-                resp.extend(bank.check(padded))
+                resp.extend(bank.check(padded, deadline=deadline))
             t2 = time.perf_counter()
             monitor.observe_shard_stage("bank_check", t2 - t1)
             if len(resp) < len(idxs):
@@ -176,12 +179,13 @@ class ReplicaRouter:
             for i in range(self.n_replicas)]
 
     def _make_run(self, lane: int):
-        def run(bags):
+        def run(bags, deadline=None):
             routers = self._routers
             if not routers:
                 raise RuntimeError("replica router has no published "
                                    "shard routers yet")
-            return routers[lane % len(routers)].check(bags)
+            return routers[lane % len(routers)].check(
+                bags, deadline=deadline)
         return run
 
     # -- publication (config swaps fan here) --------------------------

@@ -1,0 +1,265 @@
+"""Executor smoke: the CI gate that the adapter-executor plane
+actually isolates, bounds and accounts host adapter work.
+
+Boots the overlay serving stack (make_store(host_overlay_every) — the
+genuinely-unfusable list shapes) behind the REAL python gRPC front
+with a server-side default check deadline, wedges ONE adapter at the
+chaos seam, and FAILS (nonzero exit) unless:
+
+  1. ZERO requests exceed their deadline: every RPC against the
+     wedged handler's rules answers within the deadline budget (the
+     wedged backend holds its lane's workers, never the batch fold);
+  2. degradation is TYPED AND COUNTED: wedged-rule responses carry
+     the fail-closed UNAVAILABLE verdict, the executor's conservation
+     ledger stays EXACT (submitted == sum of typed outcomes, overruns
+     and breaker short-circuits visible), and rules on OTHER handlers
+     keep their clean verdicts at full speed (bulkhead);
+  3. /debug/executor agrees over real HTTP: lane state (breaker open
+     on the wedged lane), the same conservation counters, and the
+     maintenance/provider freshness view; the mixer_host_action_*
+     families expose on /metrics;
+  4. the lane breaker recovers by half-open probe once the wedge
+     clears, and verdicts return to the clean baseline;
+  5. the OPA scenario holds oracle parity: make_opa_store traffic
+     (real Rego allow AND deny verdicts through the executor's opa
+     lane) matches the generic host-oracle path status-for-status.
+
+Runnable under JAX_PLATFORMS=cpu; tier-1 invokes main() in-process
+(tests/test_executor_smoke.py).
+
+Usage: JAX_PLATFORMS=cpu python scripts/executor_smoke.py [--rules N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REQUIRED_METRICS = ("mixer_host_actions_total",
+                    "mixer_host_actions_submitted_total",
+                    "mixer_host_action_seconds",
+                    "mixer_list_provider_refresh_total",
+                    "mixer_list_provider_refresh_failures")
+
+WEDGED = "cilist.istio-system"
+DEADLINE_MS = 400.0
+
+
+def _overlay_request(i: int, n_services: int) -> dict:
+    """Request matching make_store(host_overlay_every=5) rule i
+    (i % 5 == 2: k=(i//5)%3 → 0 cilist / 1 provlist / 2 dynpat)."""
+    return {
+        "destination.service":
+            f"svc{i % n_services}.ns{i % 23}.svc.cluster.local",
+        "source.namespace": "ns2",
+        "request.method": "GET",
+        # k==7 rules gate on request.path.startsWith("/api/v{i%3}/")
+        # — the path must satisfy it or the rule (and its overlay
+        # action) never fires and the smoke measures nothing
+        "request.path": f"/api/v{i % 3}/items",
+    }
+
+
+def main(n_rules: int = 60, n_checks: int = 24) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from istio_tpu.api.client import MixerClient
+    from istio_tpu.api.grpc_server import MixerGrpcServer
+    from istio_tpu.attribute.bag import bag_from_mapping
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.runtime import RuntimeServer, ServerArgs
+    from istio_tpu.runtime import monitor
+    from istio_tpu.runtime.resilience import CHAOS
+    from istio_tpu.testing import workloads
+    from istio_tpu.utils import tracing
+
+    failures: list[str] = []
+    CHAOS.reset()
+    n_services = max(n_rules // 2, 1)
+    store = workloads.make_store(n_rules, host_overlay_every=5)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=16, buckets=(8, 16),
+        default_check_deadline_ms=DEADLINE_MS,
+        host_breaker_failures=2, host_breaker_reset_s=0.4,
+        default_manifest=workloads.MESH_MANIFEST))
+    intro = IntrospectServer(runtime=srv)
+    g = MixerGrpcServer(runtime=srv)
+    client = None
+    base = monitor.host_action_counters()
+    try:
+        plan = srv.controller.dispatcher.fused
+        if plan is not None:
+            plan.prewarm((8, 16))
+        http_port = intro.start()
+        grpc_port = g.start()
+        client = MixerClient(f"127.0.0.1:{grpc_port}",
+                             enable_check_cache=False)
+
+        # overlay rules by handler kind (i%5==2; k=(i//5)%3)
+        ci_rules = [i for i in range(2, n_rules, 5)
+                    if (i // 5) % 3 == 0]
+        prov_rules = [i for i in range(2, n_rules, 5)
+                      if (i // 5) % 3 == 1]
+        if not ci_rules or not prov_rules:
+            failures.append("overlay workload lost its handler mix")
+            raise RuntimeError("bad workload")
+        ci_req = _overlay_request(ci_rules[0], n_services)
+        prov_req = _overlay_request(prov_rules[0], n_services)
+
+        # clean verdicts over the wire = the conformance baseline
+        clean_ci = client.check(ci_req).precondition.status.code
+        clean_prov = client.check(prov_req).precondition.status.code
+
+        # ---- wedge ONE adapter; drive closed-loop load -------------
+        CHAOS.wedge_adapter(WEDGED)
+        budget_s = DEADLINE_MS / 1e3 + 0.35   # deadline + wire slack
+        wedged_codes = []
+        for k in range(n_checks):
+            t0 = time.perf_counter()
+            resp = client.check(_overlay_request(
+                ci_rules[k % len(ci_rules)], n_services))
+            wall = time.perf_counter() - t0
+            wedged_codes.append(resp.precondition.status.code)
+            if wall > budget_s:
+                failures.append(
+                    f"request {k} against the wedged handler took "
+                    f"{wall * 1e3:.0f}ms > {budget_s * 1e3:.0f}ms "
+                    f"budget — a wedged adapter held the batch")
+        # typed degradation: fail-closed UNAVAILABLE (14), never OK,
+        # never a hang converted to INTERNAL
+        bad = [c for c in wedged_codes if c != 14]
+        if bad:
+            failures.append(
+                f"wedged-rule verdicts not typed UNAVAILABLE: "
+                f"{sorted(set(bad))}")
+        # bulkhead: the OTHER handler's rules still answer their
+        # clean verdict, fast
+        t0 = time.perf_counter()
+        code = client.check(prov_req).precondition.status.code
+        prov_wall = time.perf_counter() - t0
+        if code != clean_prov:
+            failures.append(
+                f"bulkhead broken: provlist verdict flipped "
+                f"{clean_prov} -> {code} while cilist was wedged")
+        if prov_wall > budget_s:
+            failures.append(
+                f"bulkhead broken: provlist request took "
+                f"{prov_wall * 1e3:.0f}ms behind the wedged lane")
+        hc = monitor.host_action_counters()
+        d_outcomes = {k: hc["outcomes"][k] - base["outcomes"][k]
+                      for k in hc["outcomes"]}
+        if d_outcomes["overrun"] < 2:
+            failures.append(
+                f"overruns not counted: {d_outcomes}")
+        if d_outcomes["breaker_open"] < 1:
+            failures.append(
+                f"open lane breaker never short-circuited: "
+                f"{d_outcomes}")
+        if not hc["exact"]:
+            failures.append(
+                f"host-action conservation broken: submitted="
+                f"{hc['submitted']} resolved={hc['resolved']}")
+
+        # ---- /debug/executor + /metrics agree over real HTTP -------
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/debug/executor",
+                timeout=10) as r:
+            dbg = json.load(r)
+        if not dbg.get("enabled"):
+            failures.append("/debug/executor reports disabled")
+        lane = dbg.get("lanes", {}).get(WEDGED)
+        if lane is None:
+            failures.append(f"/debug/executor missing lane {WEDGED}")
+        elif lane["breaker"]["state"] != "open":
+            failures.append(
+                f"/debug/executor breaker state "
+                f"{lane['breaker']['state']!r}, expected open")
+        cs = dbg.get("counters", {})
+        if cs.get("submitted") != hc["submitted"]:
+            failures.append("/debug/executor counters disagree with "
+                            "the in-process ledger")
+        provs = dbg.get("providers", {})
+        if "provlist.istio-system" not in provs:
+            failures.append("/debug/executor missing the provider "
+                            "freshness view")
+        if WEDGED not in dbg.get("chaos", {}).get("adapter_wedged",
+                                                  ()):
+            failures.append("/debug/executor chaos pane missing the "
+                            "armed wedge")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/metrics",
+                timeout=10) as r:
+            text = r.read().decode()
+        for name in REQUIRED_METRICS:
+            if name not in text:
+                failures.append(f"metric absent from /metrics: "
+                                f"{name}")
+
+        # ---- recovery: unwedge → half-open probe → clean verdict ---
+        CHAOS.unwedge_adapter(WEDGED)
+        time.sleep(0.45)
+        code = client.check(ci_req).precondition.status.code
+        if code != clean_ci:
+            failures.append(
+                f"post-recovery verdict diverged: {code} != "
+                f"{clean_ci}")
+        if srv.executor.lane(WEDGED).breaker.state != "closed":
+            failures.append(
+                f"lane breaker did not recover (state="
+                f"{srv.executor.lane(WEDGED).breaker.state})")
+
+        # ---- OPA scenario parity gate (in-process) -----------------
+        opa_store = workloads.make_opa_store(42)
+        opa_srv = RuntimeServer(opa_store, ServerArgs(
+            batch_window_s=0.0005, max_batch=16, buckets=(8, 16),
+            default_manifest=workloads.MESH_MANIFEST))
+        try:
+            bags = [bag_from_mapping(x)
+                    for x in workloads.make_opa_requests(24, 42)]
+            d = opa_srv.controller.dispatcher
+            fused = [r.status_code for r in d.check(bags)]
+            oracle = [r.status_code
+                      for r in d.check_host_oracle(bags)]
+            if fused != oracle:
+                failures.append(
+                    f"OPA executor-path verdicts diverged from the "
+                    f"host oracle: "
+                    f"{sum(a != b for a, b in zip(fused, oracle))}/"
+                    f"{len(bags)} rows")
+            if 7 not in fused or 0 not in fused:
+                failures.append(
+                    f"OPA corpus lost its allow/deny mix: "
+                    f"{sorted(set(fused))}")
+        finally:
+            opa_srv.close()
+    finally:
+        CHAOS.reset()
+        if client is not None:
+            client.close()
+        g.stop()
+        intro.close()
+        srv.close()
+        tracing.shutdown()
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"executor smoke ok: {n_checks} wedged-handler RPCs all "
+              f"answered inside the {DEADLINE_MS:.0f}ms deadline with "
+              f"typed UNAVAILABLE, bulkhead held, conservation exact, "
+              f"/debug/executor+metrics agree, breaker recovered, OPA "
+              f"parity on 24 rows")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rules", type=int, default=60)
+    ap.add_argument("--checks", type=int, default=24)
+    args = ap.parse_args()
+    sys.exit(main(args.rules, args.checks))

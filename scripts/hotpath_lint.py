@@ -104,6 +104,17 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
     "istio_tpu/canary/recorder.py": frozenset({
         "TrafficRecorder.tap",
     }),
+    # adapter-executor plane (ISSUE 12): submit runs once per host
+    # action on the dispatcher's batch worker (breaker check + a
+    # non-blocking queue put — never a wait), and resolve is THE
+    # designated deadline-bounded fold boundary (its Event.wait is
+    # the one place the batch may block on host work, bounded by the
+    # request deadline). The reworked Dispatcher._overlay_active and
+    # _check_fused host fold stay linted above.
+    "istio_tpu/runtime/executor.py": frozenset({
+        "HandlerLane.submit", "AdapterExecutor.submit",
+        "AdapterExecutor.resolve",
+    }),
     # sharded serving plane (ISSUE 10): the shard router runs on every
     # lane's step worker (check = route + per-bank fused check + fold)
     # and the lane selector on every front thread's submit — host

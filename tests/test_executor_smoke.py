@@ -1,0 +1,25 @@
+"""Tier-1 hook for scripts/executor_smoke.py: the CI gate that the
+adapter-executor plane isolates, bounds and accounts host adapter
+work — a chaos-wedged adapter over the real gRPC front never holds a
+request past its deadline, degradation is typed and counted, the
+bulkhead protects sibling handlers, /debug/executor agrees over real
+HTTP, the lane breaker recovers, and the OPA scenario holds oracle
+parity. Runs main() in-process (the introspect_smoke pattern)."""
+import importlib.util
+import os
+import sys
+
+
+def test_executor_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "executor_smoke.py")
+    spec = importlib.util.spec_from_file_location("executor_smoke",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(n_rules=60, n_checks=24)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0
