@@ -41,6 +41,11 @@ class MixerClient:
         self._cache: dict[tuple, list] = {}
         self._lock = threading.Lock()
         self._dedup_counter = 0
+        # check-cache accounting (the server-issued grant bench/test
+        # surface): hits never crossed the wire; expirations count
+        # entries evicted on TTL, exhaustions on spent use-count
+        self.cache_stats = {"hits": 0, "misses": 0,
+                            "expired": 0, "exhausted": 0}
 
     # -- caching (mixerclient check_cache semantics) --
 
@@ -87,6 +92,9 @@ class MixerClient:
                     resp, expiry, uses = entry
                     if expiry <= now or uses <= 0:     # evict spent entries
                         del self._cache[ref]
+                        self.cache_stats[
+                            "expired" if expiry <= now
+                            else "exhausted"] += 1
                         continue
                     if hit is None:
                         sig = self._signature(
@@ -95,7 +103,9 @@ class MixerClient:
                             entry[2] -= 1
                             hit = resp
                 if hit is not None:
+                    self.cache_stats["hits"] += 1
                     return hit
+                self.cache_stats["misses"] += 1
         req = pb.CheckRequest()
         bag_to_compressed(values, msg=req.attributes)
         req.global_word_count = len(GLOBAL_WORD_LIST)

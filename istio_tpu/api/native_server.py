@@ -54,12 +54,17 @@ class _RowRequest:
         self.quotas = quotas
 
 
+# must mirror Server::kLatBuckets in httpd.cpp: the wire latency
+# histogram's log-bucket count (bucket i covers ≤ 1µs·2^(i/8))
+_LAT_BUCKETS = 192
+
+
 def start_echo_server(max_batch: int = 1024) -> tuple[int, Any]:
     """Wire-ceiling mode: the C++ server answers every Check with a
     fixed OK CheckResponse, no engine — (port, stop_fn). Single home
     of the h2srv C ABI for bench/scripts (with _load_lib below)."""
     lib = _load_lib()
-    h = lib.h2srv_start(0, max_batch, 256, 2000, 1, 1)
+    h = lib.h2srv_start(0, max_batch, 256, 2000, 1, 1, 0)
     if not h:
         raise RuntimeError("h2srv_start failed (echo)")
     return lib.h2srv_port(h), lambda: lib.h2srv_stop(h)
@@ -69,9 +74,14 @@ def _load_lib() -> ctypes.CDLL:
     lib = ctypes.CDLL(ensure_httpd_built())
     lib.h2srv_start.restype = ctypes.c_void_p
     lib.h2srv_start.argtypes = [ctypes.c_int32] * 3 + \
-        [ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
+        [ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+         ctypes.c_int32]
     lib.h2srv_port.restype = ctypes.c_int32
     lib.h2srv_port.argtypes = [ctypes.c_void_p]
+    lib.h2srv_latency.restype = None
+    lib.h2srv_latency.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.POINTER(ctypes.c_int64)]
     lib.h2srv_take.restype = ctypes.c_int64
     lib.h2srv_take.argtypes = [ctypes.c_void_p, ctypes.c_int32,
                                ctypes.c_char_p, ctypes.c_int64]
@@ -99,20 +109,28 @@ class NativeMixerServer(MixerGrpcServer):
 
     def __init__(self, runtime: RuntimeServer, port: int = 0,
                  max_batch: int = 1024, min_fill: int = 256,
-                 window_us: int = 2000, pumps: int = 2):
+                 window_us: int = 2000, pumps: int = 2,
+                 continuous: bool = False):
         # deliberately NOT calling super().__init__ — no grpc.server
+        # `continuous`: the C++ take policy never holds for min_fill/
+        # window — an idle pump launches the next device step the
+        # moment anything is queued (in-flight depth bounded by
+        # `pumps`); the latency lane vs the occupancy-fill default
         self.runtime = runtime
         self._ref_cache: dict = {}
         self._ref_cache_lock = threading.Lock()
         self._resp_memo: dict = {}
         self._lib = _load_lib()
+        self.continuous = bool(continuous)
         self._h = self._lib.h2srv_start(port, max_batch, min_fill,
-                                        window_us, pumps, 0)
+                                        window_us, pumps, 0,
+                                        1 if continuous else 0)
         if not self._h:
             raise RuntimeError("h2srv_start failed (port in use?)")
         self.port = self._lib.h2srv_port(self._h)
         self._stop_flag = threading.Event()
         self._final_counters: dict | None = None
+        self._final_latency: dict | None = None
         # serializes h2srv_complete against stop(): deferred quota
         # completions fire from pool-worker threads and must never
         # race the server teardown into a freed handle
@@ -157,6 +175,7 @@ class NativeMixerServer(MixerGrpcServer):
         for t in self._pumps:
             t.join(timeout=grace + 30)
         self._final_counters = self.counters()
+        self._final_latency = self.latency_raw()
         if any(t.is_alive() for t in self._pumps):
             # a pump is wedged mid-batch (device stall): freeing the
             # handle under it would turn a stall into a segfault —
@@ -185,6 +204,113 @@ class NativeMixerServer(MixerGrpcServer):
                                   for b in range(16) if hist[b]}
         self._publish_counters(out)
         return out
+
+    # -- wire latency (the measured wire-to-verdict plane) --
+
+    def latency_raw(self) -> dict:
+        """Cumulative wire-to-verdict histogram straight off the C++
+        ABI: {"buckets": [n]*192, "min_ns", "max_ns"}. Bucket i counts
+        requests whose frame-decode → response-frame-write latency was
+        ≤ 1µs·2^(i/8). Use as the `since` baseline for per-window
+        quantiles via latency_snapshot(since=...)."""
+        with self._comp_lock:
+            if self._h is None:
+                return dict(self._final_latency or {
+                    "buckets": [0] * _LAT_BUCKETS,
+                    "min_ns": 0, "max_ns": 0})
+            buckets = (ctypes.c_int64 * _LAT_BUCKETS)()
+            mm = (ctypes.c_int64 * 2)()
+            self._lib.h2srv_latency(self._h, buckets, mm)
+        return {"buckets": [int(v) for v in buckets],
+                "min_ns": int(mm[0]), "max_ns": int(mm[1])}
+
+    @staticmethod
+    def _quantiles(buckets: list, qs=(0.50, 0.95, 0.99)) -> dict:
+        """Quantiles (ms) from the log-bucket counts, geometric-mean
+        interpolated within the landing bucket (bucket ratio 2^(1/8)
+        → ≤ ±4.5% quantile error by construction)."""
+        total = sum(buckets)
+        out = {"n": total}
+        for q in qs:
+            key = "p" + f"{q * 100:g}".replace(".", "")
+            if not total:
+                out[key] = 0.0
+                continue
+            target = q * total
+            acc = 0
+            idx = len(buckets) - 1
+            for i, n in enumerate(buckets):
+                acc += n
+                if acc >= target:
+                    idx = i
+                    break
+            # bucket i spans (2^((i-1)/8), 2^(i/8)] µs → report the
+            # geometric midpoint, in ms
+            hi = 2.0 ** (idx / 8.0)
+            lo = hi / (2.0 ** 0.125) if idx else hi / 2.0
+            out[key] = round((lo * hi) ** 0.5 / 1000.0, 4)
+        return out
+
+    def latency_snapshot(self, since: dict | None = None) -> dict:
+        """Wire-to-verdict latency quantiles — cumulative, or the
+        DELTA vs a latency_raw() baseline (per-bench-window reads).
+        The measurement is taken entirely in C++ (frame decode →
+        response frame write): it includes the take-queue wait, batch
+        formation, the python pump, tensorize, device step and
+        response build — everything a python-side timer misses."""
+        raw = self.latency_raw()
+        buckets = raw["buckets"]
+        if since is not None:
+            buckets = [a - b for a, b in
+                       zip(buckets, since.get("buckets", []))]
+            if len(buckets) != _LAT_BUCKETS:
+                buckets = raw["buckets"]
+        snap = self._quantiles(buckets)
+        # min/max scoped to the SAME window as the quantiles: the
+        # geometric bounds of the extreme non-empty delta buckets
+        # (bucket-resolution, ±9%). The exact lifetime extremes ride
+        # under explicit *_lifetime names — mixing scopes silently
+        # made a warmup-era outlier look like a window straggler.
+        nz = [i for i, v in enumerate(buckets) if v > 0]
+        if nz:
+            lo_hi = 2.0 ** (nz[0] / 8.0) / 1000.0
+            snap["min_ms"] = round(
+                (lo_hi / (2.0 ** 0.125) if nz[0] else lo_hi / 2.0),
+                4)
+            snap["max_ms"] = round(2.0 ** (nz[-1] / 8.0) / 1000.0, 4)
+        else:
+            snap["min_ms"] = snap["max_ms"] = 0.0
+        snap["min_ms_lifetime"] = round(raw["min_ns"] / 1e6, 4)
+        snap["max_ms_lifetime"] = round(raw["max_ns"] / 1e6, 4)
+        snap["raw"] = raw      # pass-through: the next window's base
+        self._publish_latency(snap)
+        return snap
+
+    _LAT_GAUGES: dict = {}
+
+    def _publish_latency(self, snap: dict) -> None:
+        """Mirror the wire quantiles into the shared registry
+        (mixer_native_wire_p{50,95,99}_ms + count) so /metrics carries
+        the measured wire-to-verdict numbers."""
+        from istio_tpu.utils import metrics as hostmetrics
+
+        with NativeMixerServer._NATIVE_GAUGES_LOCK:
+            g = NativeMixerServer._LAT_GAUGES
+            if not g:
+                for k, name, desc in (
+                        ("p50", "mixer_native_wire_p50_ms",
+                         "wire-to-verdict p50 ms"),
+                        ("p95", "mixer_native_wire_p95_ms",
+                         "wire-to-verdict p95 ms"),
+                        ("p99", "mixer_native_wire_p99_ms",
+                         "wire-to-verdict p99 ms"),
+                        ("n", "mixer_native_wire_latency_count",
+                         "wire-to-verdict observations")):
+                    g[k] = hostmetrics.default_registry.gauge(
+                        name, f"native front {desc}")
+        for k in ("p50", "p95", "p99", "n"):
+            if k in snap:
+                g[k].set(float(snap[k]))
 
     # gauges (not counters): the C++ side owns the monotonic totals,
     # we mirror absolute snapshots — lazily created so merely importing

@@ -64,7 +64,13 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         replicas=args.replicas,
         jax_compile_cache_dir=args.jax_compile_cache_dir,
         delta_compile=not args.no_delta_compile,
-        shard_rebalance_budget=args.shard_rebalance_budget))
+        shard_rebalance_budget=args.shard_rebalance_budget,
+        # latency plane: continuous batching + check-cache grants
+        continuous_batching=args.continuous_batching,
+        continuous_depth=args.continuous_depth,
+        check_grants=args.check_grants,
+        grant_ttl_floor_s=args.grant_ttl_floor_s,
+        grant_ttl_cap_s=args.grant_ttl_cap_s))
     server = MixerGrpcServer(runtime, f"{args.address}:{args.port}")
     port = server.start()
     print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
@@ -872,6 +878,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "per republish to chase LPT balance (each "
                         "move recompiles two banks; 0 = perfect plan "
                         "stability)")
+    s.add_argument("--continuous-batching", action="store_true",
+                   help="latency lane: the check batcher dispatches "
+                        "a batch the moment an in-flight slot under "
+                        "--continuous-depth frees instead of holding "
+                        "for window/occupancy fill "
+                        "(runtime/batcher.py)")
+    s.add_argument("--continuous-depth", type=int, default=2,
+                   help="in-flight step bound for continuous "
+                        "batching (default 2: one step executing, "
+                        "one dispatching)")
+    s.add_argument("--check-grants", action="store_true",
+                   help="server-issued check-cache grants: "
+                        "valid_duration/valid_use_count derived from "
+                        "config-generation age (runtime/grants.py) — "
+                        "repeat traffic serves from the client cache "
+                        "and a config delta revokes within "
+                        "--grant-ttl-floor-s")
+    s.add_argument("--grant-ttl-floor-s", type=float, default=1.0,
+                   help="grant TTL right after a config change (the "
+                        "revocation window)")
+    s.add_argument("--grant-ttl-cap-s", type=float, default=5.0,
+                   help="grant TTL ceiling for a long-stable config")
     s.add_argument("--trace-zipkin-url", default="",
                    help="zipkin v2 collector (POST /api/v2/spans)")
     s.add_argument("--trace-log-spans", action="store_true",

@@ -365,6 +365,68 @@ def packed_pull_rows(plan) -> int:
     return 5 + plan.n_ref_words + plan.n_overlay_words
 
 
+def latency_floor(engine, batch: int, plan: Any = None, *,
+                  frame_ms: float = 0.05,
+                  pcie_gbps: float = 12.0,
+                  dispatch_ms: float = 0.05,
+                  str_len: int | None = None,
+                  peaks: dict | None = None) -> dict:
+    """The IRREDUCIBLE wire-to-verdict latency floor for one
+    latency-tier batch — what remains when every software overhead is
+    gone, so a measured p99 can be judged as "X ms above physics"
+    instead of against an aspiration:
+
+        frame — per-request wire framing cost (caller supplies the
+                measured echo-server per-request wall; the default is
+                a placeholder)
+        h2d   — the batch's EXACT plane bytes over the host↔device
+                link (PCIe model; a colocated chip pays this, the
+                tunnel pays ~100ms more) + one dispatch overhead
+        step  — the compiled step's roofline time: max(bytes/HBM_peak,
+                mxu_ops/MXU_peak) from the program's own shapes
+        d2h   — the packed pull's exact bytes back + one dispatch
+
+    Everything above this floor is queueing, batching policy, python,
+    or response build — attackable; the floor itself moves only with
+    hardware or a smaller compiled program."""
+    if peaks is None:
+        import jax
+        peaks = peaks_for(jax.devices()[0].platform)
+    model = model_check_step(engine, batch, plan=plan,
+                             str_len=str_len)
+    h2d_bytes = batch_plane_bytes(engine.ruleset.layout, batch,
+                                  str_len=str_len)
+    h2d_ms = h2d_bytes / (pcie_gbps * 1e9) * 1e3 + dispatch_ms
+    step_ms = max(model.bytes_per_step / (peaks["hbm_gbps"] * 1e9),
+                  model.mxu_ops_per_step
+                  / (peaks["mxu_tops"] * 1e12)) * 1e3
+    d2h = model.component("d2h_packed")
+    d2h_bytes = d2h.bytes if d2h is not None else batch * 4
+    d2h_ms = d2h_bytes / (pcie_gbps * 1e9) * 1e3 + dispatch_ms
+    floor = frame_ms + h2d_ms + step_ms + d2h_ms
+    return {
+        "floor_ms": round(floor, 4),
+        "breakdown": {
+            "frame_ms": round(frame_ms, 4),
+            "h2d_ms": round(h2d_ms, 4),
+            "device_step_ms": round(step_ms, 4),
+            "d2h_ms": round(d2h_ms, 4),
+        },
+        "batch": batch,
+        "h2d_bytes": int(h2d_bytes),
+        "d2h_bytes": int(d2h_bytes),
+        "pcie_gbps": pcie_gbps,
+        "roof_platform": peaks["label"],
+        "derivation": (
+            "frame (measured echo per-request wire cost) + h2d "
+            "(exact batch plane bytes / PCIe + dispatch) + device "
+            "step (compiled-shape roofline: max(bytes/HBM, ops/MXU)) "
+            "+ d2h (exact packed-pull bytes / PCIe + dispatch) — "
+            "the irreducible floor; measured p99 minus this is the "
+            "attackable software gap"),
+    }
+
+
 def bench_fields(engine, batch: int, step_s: float, prefix: str,
                  plan: Any = None,
                  str_len: int | None = None) -> dict:

@@ -218,17 +218,34 @@ int main(int argc, char** argv) {
   t_rec_end = now_s();
   close(fd);
 
+  if (lat.empty()) {
+    // a window with zero recorded completions cannot report
+    // quantiles — fail loudly (the harness raises PerfError) instead
+    // of indexing an empty vector / dividing by zero
+    fprintf(stderr, "no recorded completions\n");
+    return 2;
+  }
   std::sort(lat.begin(), lat.end());
   double dur = t_rec_end - t_rec_start;
-  double p50 = lat[lat.size() / 2] * 1e3;
-  double p99 = lat[std::min(lat.size() - 1,
-                            static_cast<size_t>(lat.size() * 0.99))] *
-               1e3;
+  // full client-side quantile ladder from the exact per-request
+  // latency vector — the INDEPENDENT check on the server's wire
+  // histogram (two clocks, two codebases; they must agree to within
+  // the client's queueing skew)
+  auto q = [&](double frac) {
+    return lat[std::min(lat.size() - 1,
+                        static_cast<size_t>(lat.size() * frac))] * 1e3;
+  };
+  double mean = 0;
+  for (double v : lat) mean += v;
+  mean = mean / lat.size() * 1e3;
   printf(
-      "{\"checks_per_sec\": %.1f, \"p50_ms\": %.3f, \"p99_ms\": %.3f, "
+      "{\"checks_per_sec\": %.1f, \"p50_ms\": %.3f, \"p90_ms\": %.3f, "
+      "\"p95_ms\": %.3f, \"p99_ms\": %.3f, \"p999_ms\": %.3f, "
+      "\"mean_ms\": %.3f, \"min_ms\": %.3f, \"max_ms\": %.3f, "
       "\"n\": %zu, \"errors\": %ld, \"duration_s\": %.3f, "
       "\"warmup_completions\": %ld, \"depth\": %d}\n",
-      lat.size() / dur, p50, p99, lat.size(), errors, dur,
-      warmup_completions, depth);
+      lat.size() / dur, q(0.50), q(0.90), q(0.95), q(0.99), q(0.999),
+      mean, lat.front() * 1e3, lat.back() * 1e3, lat.size(), errors,
+      dur, warmup_completions, depth);
   return 0;
 }

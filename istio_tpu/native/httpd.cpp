@@ -41,6 +41,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
@@ -380,6 +381,13 @@ struct Stream {
   bool dispatched = false;   // handed to the pump queue
   bool closed = false;       // RST/error — completion is discarded
   int64_t send_window = 65535;
+  // wire-to-verdict timestamp: set the instant the request's gRPC
+  // frame is fully decoded (enqueue_request), read when the response
+  // frames are queued for write — the latency histogram measures
+  // EVERYTHING between (queue wait, batch formation, python pump,
+  // tensorize, device step, response build), which python-side timers
+  // structurally cannot (they never see the C++ queue or framing)
+  int64_t t_decode_ns = 0;
   std::string pending_out;   // DATA bytes parked on flow control
   bool trailers_after_data = false;
   std::string trailer_buf;   // trailers to emit once pending_out drains
@@ -440,6 +448,11 @@ struct Server {
   int32_t min_fill = 256;
   int64_t window_us = 2000;
   int32_t n_pumps = 1;
+  // continuous batching (the latency lane): an idle pump takes
+  // whatever is queued IMMEDIATELY — no min_fill / window_us hold —
+  // so a request never waits for a batch to fill; in-flight step
+  // pipelining is bounded by n_pumps (each pump runs one step)
+  bool continuous = false;
   bool echo = false;
   std::string echo_resp;
 
@@ -457,6 +470,18 @@ struct Server {
   // [7] protocol_errors [8] bytes_in [9] bytes_out
   std::atomic<int64_t> counters[10] = {};
   int64_t hist[16] = {0};
+  // wire-to-verdict latency histogram: 192 log-spaced buckets, bucket
+  // i covers latencies up to 1µs·2^(i/8) (ratio 2^(1/8) ≈ 1.09, so a
+  // quantile read interpolates within ±4.5%); covers 1µs .. ~16s.
+  // Relaxed atomics, same pattern as counters[]: written only by the
+  // IO thread per response — a mutex here would put lock traffic on
+  // the exact hot path this histogram exists to measure. Read (rare)
+  // by h2srv_latency without locking; single-writer makes the
+  // min/max read-modify-write races a non-issue.
+  static constexpr int kLatBuckets = 192;
+  std::atomic<int64_t> lat_hist[kLatBuckets] = {};
+  std::atomic<int64_t> lat_min_ns{0};   // 0 = no observation yet
+  std::atomic<int64_t> lat_max_ns{0};
 
   std::unordered_map<uint32_t, Conn*> conns;   // by gen
   uint32_t next_gen = 1;
@@ -535,6 +560,31 @@ void put_data_frames(Conn* c, uint32_t stream_id,
   } while (off < data.size());
 }
 
+// wire-to-verdict latency observation (IO thread only; lock-free —
+// see the lat_hist declaration). Bucket i holds latencies in
+// (1µs·2^((i-1)/8), 1µs·2^(i/8)]. Only DISPATCHED streams record:
+// pre-dispatch error fast paths (malformed frame, unknown method,
+// draining UNAVAILABLE) answer in microseconds and would drag the
+// served-verdict quantiles toward zero — the histogram's one job is
+// the wire-to-VERDICT number.
+void record_latency(Server* srv, Stream* st) {
+  if (!st->t_decode_ns || !st->dispatched) return;
+  int64_t ns = mono_ns() - st->t_decode_ns;
+  st->t_decode_ns = 0;
+  if (ns < 1) ns = 1;
+  double us = static_cast<double>(ns) / 1000.0;
+  int idx = us <= 1.0 ? 0
+                      : static_cast<int>(std::ceil(std::log2(us) * 8));
+  if (idx < 0) idx = 0;
+  if (idx >= Server::kLatBuckets) idx = Server::kLatBuckets - 1;
+  srv->lat_hist[idx].fetch_add(1, std::memory_order_relaxed);
+  int64_t mn = srv->lat_min_ns.load(std::memory_order_relaxed);
+  if (!mn || ns < mn)
+    srv->lat_min_ns.store(ns, std::memory_order_relaxed);
+  if (ns > srv->lat_max_ns.load(std::memory_order_relaxed))
+    srv->lat_max_ns.store(ns, std::memory_order_relaxed);
+}
+
 // frame up one gRPC response onto the stream (headers + DATA +
 // trailers), honoring send windows; parks DATA when blocked
 void write_response(Server* srv, Conn* c, uint32_t stream_id,
@@ -546,6 +596,7 @@ void write_response(Server* srv, Conn* c, uint32_t stream_id,
     return;
   }
   Stream& st = it->second;
+  record_latency(srv, &st);
 
   static const std::string hdr_block = resp_headers_block();
   put_frame_header(&c->out, hdr_block.size(), F_HEADERS, FL_END_HEADERS,
@@ -614,6 +665,10 @@ void flush_parked(Server* srv, Conn* c) {
 
 void enqueue_request(Server* srv, Conn* c, uint32_t stream_id,
                      Stream* st) {
+  // frame-decode timestamp: the wire-to-verdict clock starts here —
+  // the complete gRPC frame just arrived, nothing downstream has
+  // touched it yet (write_response stops the clock)
+  st->t_decode_ns = mono_ns();
   // unary gRPC: exactly one length-prefixed message in the body
   if (st->body.size() < 5 || st->body[0] != 0) {
     write_response(srv, c, stream_id, 12,
@@ -646,14 +701,12 @@ void enqueue_request(Server* srv, Conn* c, uint32_t stream_id,
     write_response(srv, c, stream_id, 12, "unknown method " + st->path);
     return;
   }
-  st->dispatched = true;
-  st->body.clear();
-  st->body.shrink_to_fit();
-
   if (srv->draining.load(std::memory_order_relaxed)) {
     // intake stopped (graceful drain): a TYPED rejection, never a
     // silent connection drop — the client sees UNAVAILABLE and can
-    // retry against a peer
+    // retry against a peer. Not dispatched → not latency-recorded
+    // (a drain's instant rejections must not drag the verdict
+    // quantiles).
     write_response(srv, c, stream_id, 14, "server draining");
     return;
   }
@@ -663,6 +716,13 @@ void enqueue_request(Server* srv, Conn* c, uint32_t stream_id,
     write_response(srv, c, stream_id, 0, srv->echo_resp);
     return;
   }
+
+  // dispatched = handed to the pump queue — set only now, past the
+  // error/draining/echo fast paths, so record_latency's dispatched
+  // gate admits exactly the wire-to-VERDICT population
+  st->dispatched = true;
+  st->body.clear();
+  st->body.shrink_to_fit();
 
   item.tag = (static_cast<uint64_t>(c->gen) << 32) | stream_id;
   item.kind = kind;
@@ -1104,12 +1164,13 @@ extern "C" {
 
 void* h2srv_start(int32_t port, int32_t max_batch, int32_t min_fill,
                   int64_t window_us, int32_t n_pumps,
-                  int32_t echo_mode) {
+                  int32_t echo_mode, int32_t continuous) {
   Server* srv = new Server();
   srv->max_batch = max_batch > 0 ? max_batch : 1024;
   srv->min_fill = min_fill > 0 ? min_fill : 256;
   srv->window_us = window_us > 0 ? window_us : 2000;
   srv->n_pumps = n_pumps > 0 ? n_pumps : 1;
+  srv->continuous = continuous != 0;
   srv->echo = echo_mode != 0;
   if (srv->echo) {
     // fixed OK CheckResponse: precondition{status{} dur{5s} uses 10000}
@@ -1212,12 +1273,17 @@ int64_t take_impl(Server* srv, int32_t timeout_ms, uint8_t* buf,
     }
     if (!srv->queue.empty()) {
       int64_t waited_us = (mono_ns() - srv->first_enq_ns) / 1000;
-      if (static_cast<int32_t>(srv->queue.size()) >= srv->min_fill ||
+      if (srv->continuous ||
+          static_cast<int32_t>(srv->queue.size()) >= srv->min_fill ||
           srv->idle_pumps == srv->n_pumps ||
           waited_us >= srv->window_us ||
           srv->draining.load(std::memory_order_relaxed)) {
-        // draining: already-queued rows dispatch IMMEDIATELY — a
-        // shutdown must never hold submitted work for min_fill
+        // continuous: the latency lane — an idle pump launches the
+        // next step the moment anything is queued (the previous step
+        // is already dispatched on another pump; in-flight depth is
+        // bounded by n_pumps). A request NEVER waits for a batch to
+        // fill. draining: already-queued rows dispatch IMMEDIATELY —
+        // a shutdown must never hold submitted work for min_fill.
         break;   // this pump takes the batch
       }
       // wait out the window (bounded; re-checked on every enqueue)
@@ -1340,6 +1406,24 @@ void h2srv_counters(void* h, int64_t* out, int64_t* hist) {
     std::lock_guard<std::mutex> lk(srv->mu);
     memcpy(hist, srv->hist, sizeof(srv->hist));
   }
+  abi_exit(srv);
+}
+
+// Wire-to-verdict latency histogram snapshot: 192 log-spaced bucket
+// counts (bucket i ≤ 1µs·2^(i/8)) into `out`, observed [min_ns,
+// max_ns] into `minmax[2]`. Counts are CUMULATIVE since start — the
+// python side computes per-window quantiles from snapshot deltas.
+void h2srv_latency(void* h, int64_t* out, int64_t* minmax) {
+  Server* srv = static_cast<Server*>(h);
+  if (!abi_enter(srv)) {
+    memset(out, 0, Server::kLatBuckets * sizeof(int64_t));
+    minmax[0] = minmax[1] = 0;
+    return;
+  }
+  for (int i = 0; i < Server::kLatBuckets; i++)
+    out[i] = srv->lat_hist[i].load(std::memory_order_relaxed);
+  minmax[0] = srv->lat_min_ns.load(std::memory_order_relaxed);
+  minmax[1] = srv->lat_max_ns.load(std::memory_order_relaxed);
   abi_exit(srv);
 }
 

@@ -99,10 +99,39 @@ def _layout_blob(layout: BatchLayout, interner: InternTable) -> bytes:
 
 
 class NativeTensorizer:
-    def __init__(self, layout: BatchLayout, interner: InternTable):
+    """Wire → AttributeBatch via the C++ shim, with ZERO-COPY staging
+    for hot batch shapes: the shim writes word values / string bytes
+    straight into persistent, page-aligned slot-tensor staging buffers
+    (a ring per batch shape, rotated per decode), so the dominant
+    shapes pay no per-batch numpy allocation and no astype copies —
+    presence planes are returned as dtype VIEWS of the staging bytes.
+
+    Buffer lifecycle contract: the arrays inside a returned
+    AttributeBatch stay valid for the next `staging_depth - 1`
+    decodes of the SAME shape on this tensorizer. The serving path
+    honors the bound by construction — the batcher pipelines at most
+    `pipeline` (< staging_depth; RuntimeServer._bound_staging_depth
+    raises the ring depth to cover a user-raised pipeline) batches
+    and every consumer finishes its host reads before the batch
+    future resolves. At most _STAGING_SHAPES shapes keep rings,
+    evicted least-recently-used — eviction is safe because in-flight
+    batches keep the old slots alive by reference; the evicted
+    shape's next decode simply re-allocates."""
+
+    # distinct batch shapes that keep staging rings (the serving
+    # bucket ladder is 3-4 shapes; LRU-evicted past the cap so
+    # adversarial shape churn can neither leak memory nor pin the
+    # rings on cold shapes)
+    _STAGING_SHAPES = 4
+
+    def __init__(self, layout: BatchLayout, interner: InternTable,
+                 staging_depth: int = 8):
         import threading
         self.layout = layout
         self.interner = interner
+        self.staging_depth = max(int(staging_depth), 2)
+        # shape key (n rows) → (next slot idx, [slot dicts])
+        self._staging: dict[int, list] = {}
         self._call_lock = threading.Lock()
         lib = ctypes.CDLL(ensure_built())
         lib.shim_create.restype = ctypes.c_void_p
@@ -147,28 +176,101 @@ class NativeTensorizer:
         self._remap = np.arange(self._seed_count, dtype=np.int32)
         self._runtime_values: list = []
         self._flush_threshold = 1 << 17   # ~131k distinct values
+        self._staged_decodes = 0
 
     def tensorize_wire(self, records: Sequence[bytes]) -> AttributeBatch:
         # one decode at a time: the shim handle's intern table and the
         # remap array are shared mutable state (pipelined batches may
-        # arrive concurrently from the batcher pool)
+        # arrive concurrently from the batcher pool) — and the lock is
+        # what makes the staging-ring rotation race-free
         with self._call_lock:
             return self._tensorize_wire_locked(records)
+
+    @staticmethod
+    def _aligned_zeros(shape: tuple, dtype) -> np.ndarray:
+        """Page-aligned persistent staging buffer: the h2d engine can
+        DMA-map a 4096-aligned region without the bounce copy an
+        arbitrary numpy heap pointer may force."""
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        if nbytes == 0:
+            return np.zeros(shape, dtype)
+        raw = np.zeros(nbytes + 4096, np.uint8)
+        off = (-raw.ctypes.data) % 4096
+        return raw[off:off + nbytes].view(dtype).reshape(shape)
+
+    def _fresh_buffers(self, n: int, aligned: bool = False) -> dict:
+        lay = self.layout
+        nmap = max(lay.n_maps, 1)
+        nbyte = max(lay.n_byte_slots, 1)
+        alloc = self._aligned_zeros if aligned else np.zeros
+        return {
+            "ids": alloc((n, lay.n_columns), np.int32),
+            "hash_ids": alloc((n, lay.n_columns), np.int32),
+            "present_u8": alloc((n, max(lay.n_columns, 0)), np.uint8),
+            "map_present_u8": alloc((n, nmap), np.uint8),
+            "str_bytes": alloc((n, nbyte, lay.max_str_len), np.uint8),
+            "str_lens": alloc((n, nbyte), np.int32),
+        }
+
+    def _buffers_for(self, n: int) -> dict:
+        """Staging-ring slot for batch shape `n` (zeroed, ready for
+        the shim). Ring slots are allocated lazily up to
+        staging_depth, then reused round-robin — the reuse bound
+        callers rely on. The shape→ring map is LRU-bounded: a new
+        shape past the cap evicts the least-recently-used ring (dict
+        insertion order = access order; in-flight batches keep
+        evicted slots alive by reference, so eviction never clobbers
+        a live buffer — the evicted shape just re-allocates next
+        time). Note the serving path decodes BUCKET-padded batches,
+        so the live shape set is the bucket ladder, not raw arrival
+        counts."""
+        ring = self._staging.pop(n, None)
+        if ring is not None and ring["depth"] != self.staging_depth:
+            # depth changed mid-life (RuntimeServer raising the bound
+            # for a deeper pipeline): re-anchoring `next` onto a new
+            # modulus can shrink the reuse distance below the old
+            # bound, so start a FRESH ring instead — in-flight
+            # batches keep the old slots alive by reference, exactly
+            # like LRU eviction
+            ring = None
+        if ring is None:
+            if len(self._staging) >= self._STAGING_SHAPES:
+                # evict the least-recently-used shape's ring
+                evicted = next(iter(self._staging))
+                del self._staging[evicted]
+            ring = {"next": 0, "slots": [],
+                    "depth": self.staging_depth}
+        self._staging[n] = ring   # (re)insert at the MRU end
+        idx = ring["next"] % self.staging_depth
+        ring["next"] += 1
+        if idx >= len(ring["slots"]):
+            slot = self._fresh_buffers(n, aligned=True)
+            ring["slots"].append(slot)
+        else:
+            slot = ring["slots"][idx]
+            for arr in slot.values():
+                arr[...] = 0
+        self._staged_decodes += 1
+        return slot
+
+    def staging_stats(self) -> dict:
+        return {"shapes": {n: len(r["slots"])
+                           for n, r in self._staging.items()},
+                "depth": self.staging_depth,
+                "staged_decodes": self._staged_decodes}
 
     def _tensorize_wire_locked(self, records: Sequence[bytes]
                                ) -> AttributeBatch:
         lay = self.layout
         n = len(records)
-        ncol = max(lay.n_columns, 1)
-        nmap = max(lay.n_maps, 1)
-        nbyte = max(lay.n_byte_slots, 1)
-        ids = np.zeros((n, lay.n_columns), np.int32) \
-            if lay.n_columns else np.zeros((n, 0), np.int32)
-        hash_ids = np.zeros_like(ids)
-        present_u8 = np.zeros((n, max(lay.n_columns, 0)), np.uint8)
-        map_present_u8 = np.zeros((n, nmap), np.uint8)
-        str_bytes = np.zeros((n, nbyte, lay.max_str_len), np.uint8)
-        str_lens = np.zeros((n, nbyte), np.int32)
+        buf_set = self._buffers_for(n)
+        ids = buf_set["ids"]
+        hash_ids = buf_set["hash_ids"]
+        present_u8 = buf_set["present_u8"]
+        map_present_u8 = buf_set["map_present_u8"]
+        str_bytes = buf_set["str_bytes"]
+        str_lens = buf_set["str_lens"]
 
         bufs = (ctypes.c_char_p * n)(*records)
         lens = (ctypes.c_int64 * n)(*[len(r) for r in records])
@@ -196,8 +298,11 @@ class NativeTensorizer:
             self._known_ids = self._seed_count
             self._remap = np.arange(self._seed_count, dtype=np.int32)
             self._runtime_values = []
-        return AttributeBatch(ids=ids, present=present_u8.astype(bool),
-                              map_present=map_present_u8.astype(bool),
+        # presence planes are dtype VIEWS of the staging bytes (bool
+        # is 1 byte) — zero copies on the decode path; the view shares
+        # the ring slot's lifecycle like every other plane
+        return AttributeBatch(ids=ids, present=present_u8.view(bool),
+                              map_present=map_present_u8.view(bool),
                               str_bytes=str_bytes, str_lens=str_lens,
                               hash_ids=hash_ids,
                               ephemeral_values=ephemeral)

@@ -100,8 +100,21 @@ class CheckBatcher:
                  observe_latency: bool = True,
                  max_queue: int | None = None,
                  brownout: bool = False,
-                 stage_observer: Callable[[float], None] | None = None):
+                 stage_observer: Callable[[float], None] | None = None,
+                 continuous: bool = False,
+                 continuous_depth: int = 2):
         self.run_batch = run_batch
+        # continuous batching (the latency lane): the flusher
+        # dispatches a batch the moment an in-flight slot under
+        # `continuous_depth` is free — it absorbs whatever is ALREADY
+        # queued but never waits for a window to expire or a batch to
+        # fill. In-flight step pipelining stays bounded (default 2:
+        # one step executing, one dispatching) so continuous mode
+        # can't flood the device with 1-row trips while a fat batch
+        # queues behind them. False = the occupancy-fill policy
+        # (throughput-optimal on serialized transports).
+        self.continuous = bool(continuous)
+        self._continuous_depth = max(int(continuous_depth), 1)
         # deadline propagation (the adapter-executor plane): hooks
         # that accept it get the batch's min remaining deadline, so
         # host adapter actions inherit the request budget end to end
@@ -357,6 +370,7 @@ class CheckBatcher:
         clients were blocked). See __init__ for the hold_at default's
         measured rationale."""
         hold_at = min(self._pipeline, self._hold_at)
+        depth = min(self._continuous_depth, self._pipeline)
         while True:
             item = self._queue.get()
             if item is None:
@@ -364,6 +378,13 @@ class CheckBatcher:
                 return
             batch = [item]
             dmin = self._min_deadline(None, item)
+            if self.continuous:
+                if self._collect_continuous(batch, dmin, depth):
+                    self._flush(batch)
+                    self._drain_on_close()
+                    return
+                self._flush(batch)
+                continue
             deadline = time.perf_counter() + self.window_s
             while len(batch) < self.max_batch:
                 busy = self._inflight_n >= hold_at
@@ -396,6 +417,41 @@ class CheckBatcher:
                 batch.append(nxt)
                 dmin = self._min_deadline(dmin, nxt)
             self._flush(batch)
+
+    def _collect_continuous(self, batch: list, dmin: float | None,
+                            depth: int) -> bool:
+        """Latency-lane collection: greedily absorb whatever is
+        ALREADY queued, then dispatch the moment an in-flight slot
+        under `depth` is free — never wait for fill or a window (a
+        request never waits for a batch to fill). While every slot is
+        busy, hold in fine quanta and keep absorbing arrivals, but
+        never past the earliest row deadline. Returns True when the
+        close sentinel arrived (the caller flushes, then drains)."""
+        while len(batch) < self.max_batch:
+            if self._inflight_n < depth:
+                try:   # a step slot is free: take what's here and go
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    return False
+            else:
+                timeout = 0.0005
+                if dmin is not None:
+                    slack = dmin - time.perf_counter()
+                    if slack <= 0.0005:
+                        # dispatch now: _flush blocks on the pipeline
+                        # semaphore at worst — holding longer would
+                        # guarantee the row sheds in _run_one
+                        return False
+                    timeout = min(timeout, slack)
+                try:
+                    nxt = self._queue.get(timeout=timeout)
+                except queue.Empty:
+                    continue
+            if nxt is None:
+                return True
+            batch.append(nxt)
+            dmin = self._min_deadline(dmin, nxt)
+        return False
 
     def _drain_on_close(self) -> None:
         """Requests that raced past close() must still resolve — flush
@@ -592,6 +648,8 @@ class CheckBatcher:
             "buckets": list(self.buckets),
             "closed": self._closed,
             "draining": self._draining,
+            "continuous": self.continuous,
+            "continuous_depth": self._continuous_depth,
             "max_queue": self.max_queue,
             "brownout": self.brownout,
             "healthy": healthy,

@@ -60,13 +60,29 @@ class Controller:
                  initial_prewarm: bool = True,
                  prewarm_hook: Callable[..., None] | None = None,
                  warm_parent_plans: bool = True,
-                 executor=None):
+                 executor=None,
+                 grants=None,
+                 overlap_h2d: bool = False):
         self.store = store
         # AdapterExecutor (runtime/executor.py): handed to every
         # published Dispatcher so host-overlay adapter work runs
         # bulkheaded + deadline-bounded; the executor outlives
         # snapshots (lane breakers persist across swaps)
         self.executor = executor
+        # GrantPolicy (runtime/grants.py): handed to every published
+        # Dispatcher so check responses carry volatility-derived
+        # cache grants. When THIS controller's dispatcher is the
+        # serving surface (warm_parent_plans True — monolithic mode),
+        # revocation fires HERE, immediately before the atomic ref
+        # swap: a request served by the new generation must never
+        # carry a grant computed from the old generation's age. Under
+        # sharding the serving swap is the router swap instead, and
+        # RuntimeServer._rebuild_sharded revokes (delta-scoped) before
+        # set_routers.
+        self.grants = grants
+        # overlapped h2d from the wire decoder's pinned staging
+        # (Dispatcher._stage_h2d) — resolved by the owner per backend
+        self.overlap_h2d = overlap_h2d
         self.identity_attr = identity_attr
         self.debounce_s = debounce_s
         self.on_publish = on_publish
@@ -250,7 +266,14 @@ class Controller:
                                 buckets=self.prewarm_buckets,
                                 recorder=self.canary.recorder
                                 if self.canary is not None else None,
-                                executor=self.executor)
+                                executor=self.executor,
+                                grants=self.grants,
+                                overlap_h2d=self.overlap_h2d)
+        if self.grants is not None and self.warm_parent_plans:
+            # monolithic serving surface: revoke BEFORE the swap (a
+            # global floor is always safe; the sharded plane refines
+            # to the delta's namespaces before ITS router swap)
+            self.grants.on_publish(None)
         self._dispatcher = dispatcher      # atomic publish (GIL ref swap)
         # a successful publish supersedes any earlier veto: introspect
         # must not report a stale rejection against the live config

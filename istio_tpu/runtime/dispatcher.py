@@ -96,10 +96,24 @@ class Dispatcher:
                  buckets: tuple[int, ...] = (),
                  recorder=None,
                  observe: bool = True,
-                 executor=None):
+                 executor=None,
+                 grants=None,
+                 overlap_h2d: bool = False):
         self.snapshot = snapshot
         self.handlers = dict(handlers)
         self.identity_attr = identity_attr
+        # GrantPolicy (runtime/grants.py): when present, every check
+        # response's valid_duration/valid_use_count is min-folded with
+        # the namespace's volatility-derived grant at the respond
+        # stage — the server-issued check-cache grant leg
+        self.grants = grants
+        self._ns_name_of: dict | None = None   # lazy rs.ns_ids inverse
+        # begin the str_bytes h2d right after the C++ wire decode
+        # (async device_put of the tier-narrowed plane from the pinned
+        # staging buffers) so the dominant transfer overlaps the
+        # host-side namespace extraction instead of serializing inside
+        # the jitted call
+        self.overlap_h2d = bool(overlap_h2d)
         # FusedPlan (runtime/fused.py) — when present, check() runs the
         # fused device engine and overlays only host-only actions
         self.fused = fused
@@ -140,6 +154,36 @@ class Dispatcher:
     # resolution
     # ------------------------------------------------------------------
 
+    def _grants_for_rows(self, ns_ids) -> list | None:
+        """Per-row (ttl_s, use_count) from the grant policy — one
+        policy round per DISTINCT namespace in the batch (uniform
+        traffic: one or two lock acquisitions per batch). None when
+        grants are off."""
+        if self.grants is None:
+            return None
+        inv = self._ns_name_of
+        if inv is None:
+            inv = {v: k for k, v in
+                   self.snapshot.ruleset.ns_ids.items()}
+            self._ns_name_of = inv
+        uniq, inverse = np.unique(np.asarray(ns_ids),
+                                  return_inverse=True)
+        gs = self.grants.grants_for(
+            [inv.get(int(u), "") for u in uniq])
+        return [gs[i] for i in inverse]
+
+    def _apply_grants(self, bags: Sequence[Bag], responses) -> None:
+        """Generic/oracle-path grant fold (per-bag namespace lookup —
+        these paths are host-bound anyway). min() like every other
+        TTL source: a grant only shortens a cache budget."""
+        if self.grants is None:
+            return
+        for bag, resp in zip(bags, responses):
+            ttl, uses = self.grants.grant(
+                _namespace_of(bag, self.identity_attr))
+            resp.valid_duration_s = min(resp.valid_duration_s, ttl)
+            resp.valid_use_count = min(resp.valid_use_count, uses)
+
     def _request_ns_ids(self, bags: Sequence[Bag]) -> np.ndarray:
         return np.asarray([self.snapshot.ruleset.namespace_id(
             _namespace_of(bag, self.identity_attr)) for bag in bags],
@@ -178,11 +222,33 @@ class Dispatcher:
         if plan.native is not None and all(w is not None
                                            for w in wires):
             batch = plan.native.tensorize_wire(wires)
+            if self.overlap_h2d:
+                # h2d begins NOW — the transfer runs while
+                # _ns_ids_from_batch does its host-side decode
+                batch = self._stage_h2d(plan, batch)
             ns_ids = self._ns_ids_from_batch(batch)
         else:
             batch = self.snapshot.tensorizer.tensorize(bags)
             ns_ids = self._request_ns_ids(bags)
         return batch, ns_ids
+
+    @staticmethod
+    def _stage_h2d(plan, batch):
+        """Overlapped h2d from the pinned staging: narrow the byte
+        plane to its serve tier FIRST (so the staged shape is exactly
+        the compiled shape), then start the async device_put. The
+        returned batch's str_bytes is a committed device array —
+        packed_check's own narrow/transfer become no-ops for it. Fail-
+        soft: any staging error serves the host-numpy batch as before."""
+        import dataclasses as _dc
+
+        import jax
+        try:
+            nb = plan.narrow_batch(batch)
+            return _dc.replace(nb,
+                               str_bytes=jax.device_put(nb.str_bytes))
+        except Exception:
+            return batch
 
     def _overlay_active(self, packed: np.ndarray, bags: Sequence[Bag],
                         ns_ids: np.ndarray, observe: bool = False
@@ -340,6 +406,7 @@ class Dispatcher:
         out = []
         for bag, rule_idxs, vis in zip(bags, actives, visibles):
             out.append(self._check_one(bag, rule_idxs, vis))
+        self._apply_grants(bags, out)
         if self.observe:
             monitor.observe_stage("respond",
                                   time.perf_counter() - t_respond)
@@ -602,6 +669,11 @@ class Dispatcher:
             tele = plan.telemetry if observe else None
             tele_span = tr._current() \
                 if tele is not None or self.recorder is not None else None
+            # server-issued check-cache grants: one (ttl, uses) pair
+            # per distinct namespace, min-folded into every response
+            # below (allow AND deny — a delta that flips a cached
+            # DENY must revoke it too)
+            grant_of = self._grants_for_rows(ns_ids)
             out = []
             for b, bag in enumerate(bags):
                 resp = CheckResponse()
@@ -666,6 +738,12 @@ class Dispatcher:
                     resp.quota_context = self
                 else:
                     resp.active_quota_rules = ()
+                if grant_of is not None:
+                    g_ttl, g_uses = grant_of[b]
+                    resp.valid_duration_s = min(resp.valid_duration_s,
+                                                g_ttl)
+                    resp.valid_use_count = min(resp.valid_use_count,
+                                               g_uses)
                 out.append(resp)
             if observe:
                 monitor.observe_stage("respond",
@@ -729,6 +807,7 @@ class Dispatcher:
             active, visible, errs = oracle.resolve(bag, ns)
             n_err += errs
             out.append(self._check_one(bag, active, visible))
+        self._apply_grants(bags, out)
         if n_err:
             monitor.RESOLVE_ERRORS.inc(n_err)
         return out

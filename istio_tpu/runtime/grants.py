@@ -1,0 +1,154 @@
+"""Server-issued check-cache grants — TTL/use-count from config
+volatility.
+
+The mixerclient-side check cache (api/client.MixerClient, modeled on
+the reference's mixerclient check_cache) reuses a verdict until the
+response's `valid_duration` / `valid_use_count` budget is spent — so
+the SERVER decides how much repeat traffic never crosses the wire.
+The protocol fields have been wired and client-tested since PR 5;
+until now the serving path emitted the static CheckResult defaults
+(5 s / 10 000 uses) for every response regardless of how volatile the
+config actually is.
+
+This module derives the grant from **delta-compile generation age**:
+a namespace whose rules just changed gets the TTL floor (outstanding
+client caches go stale within one generation — the revocation leg),
+and the grant ramps back toward the cap as the namespace proves
+stable. Deny rules' own configured TTLs still apply (the dispatcher
+folds with min()), so a grant can only ever SHORTEN a verdict's
+cache budget, never extend it.
+
+Applied at the dispatcher's respond stage for every response (allow
+AND deny — a config delta that flips a cached DENY must revoke it
+too). Opt-in via ServerArgs.check_grants: the emitted TTL becomes a
+function of wall time since publish, which exact-parity surfaces
+(sharded-vs-monolithic, mesh-vs-single, canary TTL diffs) must not
+see unless they opt in on both sides.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["GrantPolicy"]
+
+
+class GrantPolicy:
+    """(ttl_s, use_count) per namespace from generation age.
+
+    ttl(ns)  = min(cap,  floor  + age_s * ttl_ramp_per_s)
+    uses(ns) = min(ucap,  ufloor + age_s * use_ramp_per_s)
+
+    where age_s is the wall seconds since the last publish that
+    changed `ns` (or any publish, when the changed set is unknown —
+    the conservative monolithic default). Defaults keep a long-stable
+    config at exactly the pre-grant values (5 s / 10 000), so turning
+    grants on changes nothing for stable configs except the
+    revocation window after a delta.
+    """
+
+    def __init__(self, ttl_floor_s: float = 1.0,
+                 ttl_cap_s: float = 5.0,
+                 ttl_ramp_per_s: float = 0.5,
+                 use_floor: int = 64,
+                 use_cap: int = 10_000,
+                 use_ramp_per_s: float = 1024.0,
+                 quantum_s: float = 0.5):
+        if ttl_floor_s <= 0 or ttl_cap_s < ttl_floor_s:
+            raise ValueError(
+                f"need 0 < ttl_floor_s <= ttl_cap_s, got "
+                f"{ttl_floor_s}/{ttl_cap_s}")
+        self.ttl_floor_s = float(ttl_floor_s)
+        self.ttl_cap_s = float(ttl_cap_s)
+        self.ttl_ramp_per_s = float(ttl_ramp_per_s)
+        self.use_floor = int(use_floor)
+        self.use_cap = int(use_cap)
+        self.use_ramp_per_s = float(use_ramp_per_s)
+        # age quantization: a continuously-varying TTL would defeat
+        # every response memo keyed on it (the native front's
+        # serialization memo) and make byte-exact parity surfaces
+        # time-flaky — grants step at most once per quantum instead
+        self.quantum_s = max(float(quantum_s), 0.0)
+        self._lock = threading.Lock()
+        now = time.monotonic()
+        # last change instant: per-namespace when a delta publish
+        # names its changed set, plus the global instant every
+        # namespace inherits when a publish can't attribute changes
+        self._global_change = now
+        self._ns_change: dict[str, float] = {}
+        self.generation = 0
+        self._grants_issued = 0
+        self._revocations = 0
+
+    # -- publish side --------------------------------------------------
+
+    def on_publish(self, changed_namespaces=None) -> None:
+        """A config generation published. `changed_namespaces`: the
+        delta-compile changed set (iterable of ns names) — only those
+        namespaces drop to the TTL floor; None = attribution unknown
+        (monolithic rebuild), every namespace revokes."""
+        now = time.monotonic()
+        with self._lock:
+            self.generation += 1
+            self._revocations += 1
+            if changed_namespaces is None:
+                self._global_change = now
+                self._ns_change.clear()
+            else:
+                for ns in changed_namespaces:
+                    self._ns_change[ns] = now
+
+    # -- serve side ----------------------------------------------------
+
+    def _quantize(self, age: float) -> float:
+        if self.quantum_s <= 0:
+            return age
+        return (age // self.quantum_s) * self.quantum_s
+
+    def _pair(self, age: float) -> tuple[float, int]:
+        age = self._quantize(age)
+        return (min(self.ttl_cap_s,
+                    self.ttl_floor_s + age * self.ttl_ramp_per_s),
+                min(self.use_cap,
+                    self.use_floor + int(age * self.use_ramp_per_s)))
+
+    def grant(self, ns: str) -> tuple[float, int]:
+        """(ttl_s, use_count) for one namespace, now."""
+        now = time.monotonic()
+        with self._lock:
+            changed = self._ns_change.get(ns, self._global_change)
+            age = max(now - max(changed, self._global_change), 0.0)
+            self._grants_issued += 1
+        return self._pair(age)
+
+    def grants_for(self, ns_names) -> list[tuple[float, int]]:
+        """Vector form for the respond loop — one clock read, one
+        lock round for the whole batch's distinct namespaces."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for ns in ns_names:
+                changed = self._ns_change.get(ns, self._global_change)
+                age = max(now - max(changed, self._global_change), 0.0)
+                out.append(self._pair(age))
+            self._grants_issued += len(out)
+        return out
+
+    def stats(self) -> dict:
+        """Introspect/bench view: params + live per-ns ages."""
+        now = time.monotonic()
+        with self._lock:
+            ages = {ns: round(now - t, 3)
+                    for ns, t in sorted(self._ns_change.items())[:32]}
+            return {
+                "generation": self.generation,
+                "ttl_floor_s": self.ttl_floor_s,
+                "ttl_cap_s": self.ttl_cap_s,
+                "ttl_ramp_per_s": self.ttl_ramp_per_s,
+                "use_floor": self.use_floor,
+                "use_cap": self.use_cap,
+                "global_age_s": round(now - self._global_change, 3),
+                "ns_ages_s": ages,
+                "grants_issued": self._grants_issued,
+                "revocations": self._revocations,
+            }

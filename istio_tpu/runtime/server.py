@@ -155,6 +155,33 @@ class ServerArgs:
     # that trip it, and the open window before a half-open probe
     host_breaker_failures: int = 3
     host_breaker_reset_s: float = 5.0
+    # -- latency plane (measured wire-to-verdict; runtime/grants.py,
+    #    batcher continuous lane, dispatcher staged h2d) --------------
+    # begin the str_bytes h2d right after the C++ wire decode (async
+    # device_put of the tier-narrowed plane from the zero-copy staging
+    # buffers) so the dominant transfer overlaps the host-side
+    # namespace extraction. None = auto: on for real accelerator
+    # backends, off on cpu (where device_put may alias host memory
+    # and overlapping buys nothing).
+    overlap_h2d: bool | None = None
+    # continuous batching on the latency tier: the check batcher
+    # dispatches a batch the moment an in-flight slot under
+    # `continuous_depth` frees — a request never waits for a batch to
+    # fill or a window to expire. False keeps the occupancy-fill
+    # policy (throughput-optimal on serialized transports).
+    continuous_batching: bool = False
+    continuous_depth: int = 2
+    # server-issued check-cache grants: valid_duration/valid_use_count
+    # derived from config-generation age (runtime/grants.GrantPolicy)
+    # so repeat traffic serves from the CLIENT cache and a config
+    # delta revokes outstanding grants within the TTL floor. Opt-in:
+    # the emitted TTL becomes time-dependent, which byte-exact parity
+    # surfaces (shard/mesh/canary TTL comparisons) must opt into
+    # knowingly.
+    check_grants: bool = False
+    grant_ttl_floor_s: float = 1.0
+    grant_ttl_cap_s: float = 5.0
+    grant_ttl_ramp_per_s: float = 0.5
     # -- rule-level telemetry (runtime/rulestats.py) -------------------
     # fold per-rule hit/deny/err counts into on-device accumulators
     # inside the fused check step (requires fused=True to do anything)
@@ -292,6 +319,28 @@ class RuntimeServer:
                 / 1e3,
                 breaker_failures=self.args.host_breaker_failures,
                 breaker_reset_s=self.args.host_breaker_reset_s))
+        # check-cache grant policy (runtime/grants.py): built before
+        # the controller so the initial publish's dispatcher already
+        # clamps TTLs; revocation fires from _on_config_publish with
+        # the delta's changed-namespace set when sharding knows it
+        self.grants = None
+        if self.args.check_grants:
+            from istio_tpu.runtime.grants import GrantPolicy
+            self.grants = GrantPolicy(
+                ttl_floor_s=self.args.grant_ttl_floor_s,
+                ttl_cap_s=self.args.grant_ttl_cap_s,
+                ttl_ramp_per_s=self.args.grant_ttl_ramp_per_s)
+        # overlapped h2d: auto-resolve None → on for real accelerator
+        # backends only (on cpu jax may alias the staging buffer
+        # zero-copy and the "transfer" overlaps nothing)
+        overlap = self.args.overlap_h2d
+        if overlap is None:
+            try:
+                import jax
+                overlap = jax.default_backend() not in ("cpu",)
+            except Exception:
+                overlap = False
+        self._overlap_h2d = bool(overlap)
         self.controller = Controller(
             store, default_manifest=manifest,
             identity_attr=self.args.identity_attr,
@@ -305,7 +354,9 @@ class RuntimeServer:
             initial_prewarm=self.args.initial_prewarm,
             prewarm_hook=self._prewarm_instep_for,
             warm_parent_plans=not self._sharded_serving,
-            executor=self.executor)
+            executor=self.executor,
+            grants=self.grants,
+            overlap_h2d=self._overlap_h2d)
         self._rulestats_drainer = RuleStatsDrainer(
             self.rulestats, self.args.rulestats_drain_s) \
             if (self.args.rule_telemetry and self.args.fused
@@ -344,7 +395,9 @@ class RuntimeServer:
                      buckets=buckets,
                      hold_at=self.args.hold_at,
                      max_queue=max_queue,
-                     brownout=self.args.brownout))
+                     brownout=self.args.brownout,
+                     continuous=self.args.continuous_batching,
+                     continuous_depth=self.args.continuous_depth))
             self.batcher = self._replica_router
             # the controller's initial publish fired before the router
             # existed — build the first generation's banks now
@@ -359,7 +412,9 @@ class RuntimeServer:
                 buckets=buckets,
                 hold_at=self.args.hold_at,
                 max_queue=max_queue,
-                brownout=self.args.brownout)
+                brownout=self.args.brownout,
+                continuous=self.args.continuous_batching,
+                continuous_depth=self.args.continuous_depth)
         # the REPORT coalescer: records from concurrent Report RPCs
         # share packed device trips (see report()). Separate instance
         # so report trips are separately counted and the two queues
@@ -413,6 +468,18 @@ class RuntimeServer:
         the fresh snapshot (draining the outgoing plan first so a
         config swap never drops in-flight counts). Must never raise —
         telemetry is an observer of the publish, not a participant."""
+        # grant revocation ordering: the monolithic serving surface
+        # revokes INSIDE the controller, immediately before the
+        # dispatcher ref swap (a response from the new generation must
+        # never carry an old-generation grant); the sharded serving
+        # surface revokes inside _rebuild_sharded before set_routers,
+        # delta-scoped when the bank diff attributes the change.
+        # staging-ring reuse bound: the zero-copy decoder's buffer
+        # lifecycle contract requires staging_depth > the number of
+        # batches concurrently in flight — raise the ring depth to
+        # cover the configured pipeline (growing is always safe: the
+        # ring allocates slots lazily and never shrinks live ones)
+        self._bound_staging_depth(dispatcher)
         try:
             self.rulestats.attach(dispatcher)
         except Exception:
@@ -445,6 +512,11 @@ class RuntimeServer:
             try:
                 self._rebuild_sharded(dispatcher)
             except Exception as exc:
+                # conservative revoke: a failed rebuild left grant
+                # state un-attributed — shortening budgets is always
+                # safe, a stale long grant is not
+                if self.grants is not None:
+                    self.grants.on_publish(None)
                 # surfaced, not just logged: /debug/shards renders the
                 # ledger so an on-call sees WHICH generation failed to
                 # build banks and that the previous one keeps serving
@@ -476,6 +548,25 @@ class RuntimeServer:
             import logging
             logging.getLogger("istio_tpu.runtime.server").exception(
                 "in-step quota prewarm failed")
+
+    def _bound_staging_depth(self, dispatcher) -> None:
+        """Keep the wire decoder's staging ring deeper than the
+        number of batches that can be in flight against it (+2
+        slack: the decode in progress and the batch a pump still
+        holds). Under sharded serving every replica LANE shares the
+        same bank — and therefore the same tensorizer — so the bound
+        scales with replicas, not just the per-lane pipeline. Slots
+        allocate lazily, so a deep bound costs nothing until used."""
+        try:
+            plan = getattr(dispatcher, "fused", None)
+            native = getattr(plan, "native", None)
+            if native is not None:
+                lanes = max(self.args.replicas, 1)
+                native.staging_depth = max(
+                    native.staging_depth,
+                    self.args.pipeline * lanes + 2)
+        except Exception:
+            pass   # decoder hardening must never break a publish
 
     def _rebuild_sharded(self, dispatcher) -> None:
         """Build the sharded serving generation for a published
@@ -579,9 +670,37 @@ class RuntimeServer:
                             buckets=buckets,
                             rule_telemetry=self.args.rule_telemetry,
                             recorder=recorder,
-                            executor=self.executor)
+                            executor=self.executor,
+                            grants=self.grants,
+                            overlap_h2d=self._overlap_h2d)
                         b.content_key = key
                         banks.append(b)
+                # grant revocation scoped to the DELTA: only the
+                # recompiled banks' namespaces drop to the TTL floor
+                # (reused banks' configs are content-identical — their
+                # outstanding client grants stay valid); a scratch
+                # rebuild (nothing reused) revokes globally. This runs
+                # BEFORE the router swap below — new-generation
+                # responses never carry old-generation grants.
+                if self.grants is not None:
+                    changed = {k for k in range(plan.n_shards)
+                               if k not in reused_ids}
+                    if reused_ids:
+                        # union the OLD plan's namespaces for the
+                        # changed shards: a namespace whose rules
+                        # were entirely DELETED is absent from the
+                        # new ns_to_shard but its cached verdicts
+                        # still need revoking (shard ids are stable
+                        # under delta planning, so the old map's
+                        # shard numbering matches)
+                        ns_maps = [plan.ns_to_shard]
+                        if prev_plan is not None:
+                            ns_maps.append(prev_plan.ns_to_shard)
+                        self.grants.on_publish(
+                            {ns for m in ns_maps
+                             for ns, s in m.items() if s in changed})
+                    else:
+                        self.grants.on_publish(None)
                 bank_map = {b.shard_id: b for b in banks}
                 routers = [ShardRouter(bank_map, plan,
                                        self.args.identity_attr,
@@ -600,8 +719,15 @@ class RuntimeServer:
                     rule_telemetry=self.args.rule_telemetry,
                     recorder=recorder,
                     dispatcher=dispatcher if i == 0 else None,
-                    executor=self.executor)
+                    executor=self.executor,
+                    grants=self.grants,
+                    overlap_h2d=self._overlap_h2d)
                     for i in range(n_lanes)]
+                # un-attributable rebuild: revoke every namespace
+                # (the delta-scoped refinement only exists on the
+                # sharded success path)
+                if self.grants is not None:
+                    self.grants.on_publish(None)
                 routers = [
                     ShardRouter({s: banks[i]
                                  for s in range(plan.n_shards)},
@@ -619,8 +745,14 @@ class RuntimeServer:
                 rule_telemetry=self.args.rule_telemetry,
                 recorder=recorder,
                 dispatcher=dispatcher if i == 0 else None,
-                executor=self.executor)
+                executor=self.executor,
+                grants=self.grants,
+                overlap_h2d=self._overlap_h2d)
                 for i in range(n_lanes)]
+            # replica-only publishes carry no delta attribution:
+            # conservative global revoke, same as monolithic
+            if self.grants is not None:
+                self.grants.on_publish(None)
             routers = [
                 ShardRouter({s: banks[i] for s in range(plan.n_shards)},
                             plan, self.args.identity_attr, replica=i)
@@ -680,6 +812,8 @@ class RuntimeServer:
             b.dispatcher.fused.prewarm(
                 buckets,
                 backoff=None if first_build else _serving_backoff)
+        for b in banks:   # staging-ring depth >= pipeline bound
+            self._bound_staging_depth(b.dispatcher)
         router.set_routers(routers, plan)
         # telemetry fan: bank plans' per-rule accumulators merge into
         # the one aggregator by qualified rule name (lane 0 in

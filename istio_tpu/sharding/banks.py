@@ -239,7 +239,9 @@ def compile_shard_bank(parent: Snapshot, handlers: Mapping[str, Any],
                        buckets: Sequence[int] = (),
                        rule_telemetry: bool = True,
                        recorder: Any = None,
-                       executor: Any = None) -> ShardBank:
+                       executor: Any = None,
+                       grants: Any = None,
+                       overlap_h2d: bool = False) -> ShardBank:
     """Compile ONE shard of `plan` into a ShardBank — the unit the
     delta-compilation path pays per CHANGED shard (unchanged shards
     carry their previous bank via rebind_bank instead). `executor`:
@@ -247,14 +249,16 @@ def compile_shard_bank(parent: Snapshot, handlers: Mapping[str, Any],
     bank run their adapter work bulkheaded like the monolithic path
     (lanes are per HANDLER, shared across banks by design: the
     backend behind a handler is one resource however many banks call
-    it)."""
+    it). `grants`/`overlap_h2d`: the latency plane's GrantPolicy and
+    staged-h2d flag, per-bank like the monolithic dispatcher."""
     from istio_tpu.runtime.fused import build_fused_plan
 
     sub, l2g = shard_snapshot(parent, plan, k)
     fused = build_fused_plan(sub, rule_telemetry=rule_telemetry)
     disp = Dispatcher(sub, handlers, identity_attr,
                       fused=fused, buckets=tuple(buckets),
-                      recorder=recorder, executor=executor)
+                      recorder=recorder, executor=executor,
+                      grants=grants, overlap_h2d=overlap_h2d)
     cost = float(plan.shard_cost[k]) if plan.shard_cost else 0.0
     return ShardBank(shard_id=k, snapshot=sub, dispatcher=disp,
                      local_to_global=l2g, predicted_cost=cost,
@@ -267,7 +271,10 @@ def build_shard_banks(parent: Snapshot,
                       identity_attr: str,
                       buckets: Sequence[int] = (),
                       rule_telemetry: bool = True,
-                      recorder: Any = None) -> list[ShardBank]:
+                      recorder: Any = None,
+                      executor: Any = None,
+                      grants: Any = None,
+                      overlap_h2d: bool = False) -> list[ShardBank]:
     """Compile every shard of `plan` into a ShardBank. Raises
     ShardingUnsupported when the snapshot cannot shard; individual
     bad rules never fail a bank (compile_ruleset demotes them to the
@@ -276,7 +283,10 @@ def build_shard_banks(parent: Snapshot,
                                 identity_attr=identity_attr,
                                 buckets=buckets,
                                 rule_telemetry=rule_telemetry,
-                                recorder=recorder)
+                                recorder=recorder,
+                                executor=executor,
+                                grants=grants,
+                                overlap_h2d=overlap_h2d)
              for k in range(plan.n_shards)]
     log.info("built %d shard banks (%s rules/bank, %d global rules "
              "replicated)", len(banks),
@@ -291,7 +301,9 @@ def full_bank(parent: Snapshot, handlers: Mapping[str, Any],
               rule_telemetry: bool = True,
               recorder: Any = None,
               dispatcher: Dispatcher | None = None,
-              executor: Any = None) -> ShardBank:
+              executor: Any = None,
+              grants: Any = None,
+              overlap_h2d: bool = False) -> ShardBank:
     """A bank over the WHOLE snapshot — the replica-only mode's lane
     executor (each replica owns its own FusedPlan over the full rule
     set). `dispatcher` reuses an existing one (lane 0 rides the
@@ -304,7 +316,9 @@ def full_bank(parent: Snapshot, handlers: Mapping[str, Any],
                                  rule_telemetry=rule_telemetry)
         dispatcher = Dispatcher(parent, handlers, identity_attr,
                                 fused=fused, buckets=tuple(buckets),
-                                recorder=recorder, executor=executor)
+                                recorder=recorder, executor=executor,
+                                grants=grants,
+                                overlap_h2d=overlap_h2d)
     return ShardBank(
         shard_id=shard_id, snapshot=parent, dispatcher=dispatcher,
         local_to_global=np.arange(len(parent.rules), dtype=np.int64),

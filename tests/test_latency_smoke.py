@@ -1,0 +1,27 @@
+"""Tier-1 hook for scripts/latency_smoke.py: the CI gate that the
+measured wire-to-verdict latency plane works end to end — the C++
+wire histogram measures (present, finite, ordered, client-agreeing
+p99) under closed-loop load over the real native front, the wire
+decode path holds verdict parity with the host oracle over HTTP, the
+continuous-batching lane never serves a stale generation across a
+live config swap (with the grant revocation observable at the wire),
+and a caching MixerClient sees ≥90% hits on repeat traffic. Runs
+main() in-process (the introspect_smoke pattern)."""
+import importlib.util
+import os
+import sys
+
+
+def test_latency_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "latency_smoke.py")
+    spec = importlib.util.spec_from_file_location("latency_smoke",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(n_rules=80, n_loop=200)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0
