@@ -250,6 +250,83 @@ def _telemetry_overhead_fields(srv, prefix: str, n_reqs: int = 256,
                 f"{type(exc).__name__}: {exc}"}
 
 
+def _tail_fields(prefix: str, stages: dict | None,
+                 forens_base: dict | None) -> dict:
+    """Tail-forensics ledger for a SERVED scenario (ISSUE 14;
+    fail-soft like the telemetry ledger): per-stage p99-vs-p50 skew —
+    the stage whose tail diverges most from its median is where the
+    scenario's p99 lives — plus the flight-recorder exemplar count,
+    the control-plane events that fired in the window, and any typed
+    ring drops, all deltaed against the scenario's own
+    monitor.forensics_counters() baseline."""
+    try:
+        from istio_tpu.runtime import monitor
+
+        out: dict = {}
+        if stages:
+            skew = {s: round(max(d.get("p99_ms", 0.0)
+                                 - d.get("p50_ms", 0.0), 0.0), 3)
+                    for s, d in stages.items()}
+            out[prefix + "tail_stage_skew_ms"] = skew
+            if skew:
+                out[prefix + "tail_worst_stage"] = \
+                    max(skew, key=skew.get)
+        fc = monitor.forensics_counters()
+        base = forens_base or {}
+        out[prefix + "tail_slow_exemplars"] = \
+            fc["slow_captured"] - base.get("slow_captured", 0)
+        out[prefix + "tail_events_in_window"] = \
+            fc["events_recorded"] - base.get("events_recorded", 0)
+        bd = base.get("dropped", {})
+        out[prefix + "tail_forensics_dropped"] = {
+            r: v - bd.get(r, 0) for r, v in fc["dropped"].items()}
+        return out
+    except Exception as exc:
+        return {prefix + "tail_error":
+                f"{type(exc).__name__}: {exc}"}
+
+
+def _forensics_overhead_fields(srv, prefix: str, n_reqs: int = 128,
+                               steps: int = 4) -> dict:
+    """Flight-recorder cost ledger (ISSUE 14 acceptance: ≤2% under
+    clean traffic): checks/sec through the in-process serving path
+    with the recorder ON vs OFF — the fast path is one threshold
+    compare per batch, and this pins that claim per scenario.
+    Fail-soft by contract."""
+    try:
+        from istio_tpu.runtime import forensics
+        from istio_tpu.testing import workloads
+
+        rec = forensics.RECORDER
+        if not rec.enabled:
+            return {prefix + "forensics_note":
+                    "flight recorder disabled"}
+        bags = workloads.make_bags(n_reqs)
+
+        def cps() -> float:
+            srv.check_many(bags)            # warm (jit, memo paths)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                srv.check_many(bags)
+            return steps * len(bags) / (time.perf_counter() - t0)
+
+        on = cps()
+        rec.configure(enabled=False)
+        try:
+            off = cps()
+        finally:
+            rec.configure(enabled=True)
+        overhead = (off - on) / off * 100.0 if off > 0 else 0.0
+        return {
+            prefix + "forensics_overhead_pct": round(overhead, 2),
+            prefix + "forensics_on_checks_per_sec": round(on, 1),
+            prefix + "forensics_off_checks_per_sec": round(off, 1),
+        }
+    except Exception as exc:
+        return {prefix + "forensics_error":
+                f"{type(exc).__name__}: {exc}"}
+
+
 def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -1970,10 +2047,12 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
         from istio_tpu.runtime import monitor
         counters0 = monitor.serving_counters()
         resil0 = monitor.resilience_counters()
+        forens0 = monitor.forensics_counters()
     except Exception:   # counters are diagnostics, never a crash
         monitor = None
         counters0 = {}
         resil0 = {}
+        forens0 = {}
 
     def resilience_fields() -> dict:
         """Shed / expired / fallback deltas for THIS scenario."""
@@ -2356,6 +2435,16 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             # rule-telemetry cost for THIS served scenario (ISSUE 4
             # acceptance: accumulators-on vs off + drain wall)
             tele_fields = _telemetry_overhead_fields(srv, "served_")
+            # tail forensics for THIS served scenario (ISSUE 14):
+            # stage skew attribution + exemplar/event window counts +
+            # recorder-on-vs-off overhead
+            tail_fields = {
+                **_tail_fields("served_",
+                               sat_stage_fields.get(
+                                   "served_stage_decomposition"),
+                               forens0),
+                **_forensics_overhead_fields(srv, "served_"),
+            }
         finally:
             g.stop()
             srv.close()
@@ -2378,6 +2467,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
             **batched_fields,
             **report_fields,
             **tele_fields,
+            **tail_fields,
             "device_sync_ms": round(sync_ms, 1),
             **_grpc_ceiling_fields(),
             **counter_fields(),
@@ -2439,9 +2529,11 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                 _mon.reset_latency_window()
                 native_stage_base = _mon.stage_baseline()
                 native_resil0 = _mon.resilience_counters()
+                native_forens0 = _mon.forensics_counters()
             except Exception:
                 _mon, native_stage_base = None, None
                 native_resil0 = {}
+                native_forens0 = {}
             dicts = workloads.make_request_dicts(512)
             payloads = perf.make_check_payloads(dicts, quota_every=4)
 
@@ -2681,6 +2773,17 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                 stage_fields = {}
             tele_fields = _telemetry_overhead_fields(
                 srv, "served_native_")
+            # tail forensics for the native scenario (ISSUE 14): the
+            # skew attribution reads the same stage delta computed
+            # above; overhead A/B rides the in-process path
+            tail_fields = {
+                **_tail_fields("served_native_",
+                               stage_fields.get(
+                                   "served_native_stage_"
+                                   "decomposition"),
+                               native_forens0),
+                **_forensics_overhead_fields(srv, "served_native_"),
+            }
 
             # -- measured wire-to-verdict p99 (the tentpole number) --
             # occupancy-fill per-window wire p99s (the server config
@@ -2890,6 +2993,7 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
             **nrep_fields,
             **stage_fields,
             **tele_fields,
+            **tail_fields,
             # phase_errors: failures during a phase (retried once,
             # except the *-final entries whose retry also failed) —
             # phases listed in served_native_stubbed_phases emit -1.0

@@ -555,12 +555,21 @@ class NativeMixerServer(MixerGrpcServer):
         # this batch can't reach in time answer DEADLINE_EXCEEDED
         # pre-tensorize instead of queueing dead device work
         deadline = self._deadline_from(None)
+        import time as _time
+
+        from istio_tpu.runtime import forensics
+        t_dec0 = _time.perf_counter()
         bags = []
         for _, _, payload, gwc, _, _, _ in checks:
             native = gwc in (0, len(GLOBAL_WORD_LIST))
             bags.append(self.runtime.preprocess(
                 LazyWireBag(payload, gwc or None,
                             native_ok=native)))
+        # flight-recorder pre-mark: the wire→bag decode wall joins the
+        # next batch tape on this pump thread (httpd.cpp's t_decode_ns
+        # covers the C++ side; this is the python envelope's share)
+        forensics.RECORDER.note_wire_decode(
+            _time.perf_counter() - t_dec0)
         # in-step quota (ServerArgs.quota_in_step): eligible
         # single-quota rows allocate IN the check trip — no
         # pool-flush trip serialized behind it, no defer
@@ -602,6 +611,13 @@ class NativeMixerServer(MixerGrpcServer):
             if span is not None:
                 span["tags"]["status"] = str(exc.grpc_code)
             return
+        finally:
+            # a dispatch that ended in a typed rejection (or expired
+            # every chunk) ran no batch_begin — drop the decode
+            # pre-mark so a stale wall never inflates the NEXT
+            # batch's wire_decode stage (no-op when a chunk consumed
+            # it normally)
+            forensics.RECORDER.clear_premarks()
         # `status` tag (batch-level: ok or the first non-OK code) so
         # /debug/traces can filter failing check spans on this front
         if span is not None:

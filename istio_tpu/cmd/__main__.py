@@ -70,7 +70,13 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         continuous_depth=args.continuous_depth,
         check_grants=args.check_grants,
         grant_ttl_floor_s=args.grant_ttl_floor_s,
-        grant_ttl_cap_s=args.grant_ttl_cap_s))
+        grant_ttl_cap_s=args.grant_ttl_cap_s,
+        # tail-latency forensics (runtime/forensics.py): flight
+        # recorder threshold/mode + the /debug/profile capture dir
+        flight_recorder=not args.no_flight_recorder,
+        slow_threshold_ms=args.slow_threshold_ms,
+        slow_adaptive=args.slow_adaptive,
+        profile_dir=args.profile_dir))
     server = MixerGrpcServer(runtime, f"{args.address}:{args.port}")
     port = server.start()
     print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
@@ -93,7 +99,9 @@ def cmd_mixs(args: argparse.Namespace) -> int:
               f"{args.monitoring_host}:{intro.port} "
               "(/metrics /healthz /readyz /debug/config /debug/queues"
               " /debug/cache /debug/traces /debug/resilience"
-              " /debug/analysis /debug/rulestats /debug/canary)")
+              " /debug/analysis /debug/rulestats /debug/canary"
+              " /debug/slow /debug/events /debug/profile"
+              " /debug/threads)")
     _serve_forever()
     server.stop()
     if intro is not None:
@@ -888,6 +896,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="in-flight step bound for continuous "
                         "batching (default 2: one step executing, "
                         "one dispatching)")
+    s.add_argument("--no-flight-recorder", action="store_true",
+                   help="disable the per-request flight recorder "
+                        "(/debug/slow stays empty; the event "
+                        "timeline keeps recording)")
+    s.add_argument("--slow-threshold-ms", type=float, default=0.0,
+                   help="flight-recorder capture threshold in ms "
+                        "(0 = the live SLO target)")
+    s.add_argument("--slow-adaptive", action="store_true",
+                   help="adaptive threshold: track the live window "
+                        "p99 (never below the configured base)")
+    s.add_argument("--profile-dir", default=None,
+                   help="directory for /debug/profile jax.profiler "
+                        "captures (default: MIXS_PROFILE_DIR env or "
+                        "a per-capture tempdir)")
     s.add_argument("--check-grants", action="store_true",
                    help="server-issued check-cache grants: "
                         "valid_duration/valid_use_count derived from "

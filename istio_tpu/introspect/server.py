@@ -137,6 +137,10 @@ class IntrospectServer:
         "/debug/roofline": "_h_roofline",
         "/debug/report": "_h_report",
         "/debug/shards": "_h_shards",
+        "/debug/slow": "_h_slow",
+        "/debug/events": "_h_events",
+        "/debug/profile": "_h_profile",
+        "/debug/threads": "_h_threads",
     }
 
     @staticmethod
@@ -767,7 +771,10 @@ class IntrospectServer:
         `?status=X` filters by the span `status` tag: `status=failed`
         keeps every span whose status is set and not ok/0 (the check
         spans tag their google.rpc code), a specific value keeps exact
-        matches."""
+        matches. `?min_ms=N` keeps spans at least that long (the tail
+        complement of ?status — a slow span is rarely a failed one),
+        and `?trace=ID` keeps one trace's spans — the deep link the
+        /debug/slow exemplars carry."""
         if self._ring is None:
             self._send_json(req, {"error": "trace ring not installed"},
                             503)
@@ -776,7 +783,8 @@ class IntrospectServer:
         # span must stay visible in ?status=failed for as long as the
         # ring holds it, even behind a burst of newer ok spans
         spans = self._ring.snapshot()
-        want = self._query(req).get("status")
+        q = self._query(req)
+        want = q.get("status")
         if want == "failed":
             spans = [s for s in spans
                      if (s.get("tags") or {}).get("status")
@@ -784,7 +792,102 @@ class IntrospectServer:
         elif want:
             spans = [s for s in spans
                      if (s.get("tags") or {}).get("status") == want]
+        trace = q.get("trace")
+        if trace:
+            spans = [s for s in spans if s.get("traceId") == trace]
+        try:
+            min_ms = float(q.get("min_ms", 0) or 0)
+        except ValueError:
+            min_ms = 0.0
+        if min_ms > 0:
+            # span durations are zipkin µs
+            spans = [s for s in spans
+                     if s.get("duration", 0) >= min_ms * 1000.0]
         self._send_json(req, {
             "dropped": self._ring.dropped,
             "spans": spans[-128:],
         })
+
+    # -- forensics plane (runtime/forensics.py) ------------------------
+
+    def _h_slow(self, req: BaseHTTPRequestHandler) -> None:
+        """Flight-recorder view: the top-K slowest retained requests,
+        each with its per-stage attribution (queue_wait / tensorize /
+        h2d / device_step / fold / grant / respond / per-handler host
+        waits / wire_decode), the control-plane events that overlapped
+        its lifetime, and a /debug/traces deep link by trace id.
+        `?k=N` sizes the list (default 10). Zero-shaped on a clean
+        server: threshold/config always serve, `slowest` is empty."""
+        from istio_tpu.runtime import forensics
+
+        q = self._query(req)
+        try:
+            k = int(q.get("k", 10) or 10)
+        except ValueError:
+            k = 10
+        self._send_json(req, forensics.RECORDER.snapshot(top_k=k))
+
+    def _h_events(self, req: BaseHTTPRequestHandler) -> None:
+        """Mesh event timeline: the bounded ring of control-plane
+        events (config publishes, canary verdicts, bank rebuilds,
+        prewarm start/end per shape, breaker transitions, quota
+        flushes, grant revocations, provider refreshes, chaos arms,
+        quiesce/shutdown). `?kind=X` filters, `?n=N` bounds (default
+        128). The same ring annotates /debug/slow exemplars."""
+        from istio_tpu.runtime import forensics, monitor
+
+        q = self._query(req)
+        try:
+            n = int(q.get("n", 128) or 128)
+        except ValueError:
+            n = 128
+        events = forensics.EVENTS.snapshot(kind=q.get("kind"),
+                                           limit=n)
+        self._send_json(req, {
+            "retained": len(forensics.EVENTS),
+            "counters": monitor.forensics_counters(),
+            "events": events,
+        })
+
+    def _h_profile(self, req: BaseHTTPRequestHandler) -> None:
+        """On-demand device profiling: `?seconds=N` (default 1, max
+        60) drives one jax.profiler trace capture into the configured
+        directory (ServerArgs.profile_dir / MIXS_PROFILE_DIR / a fresh
+        tempdir) and returns the artifact listing. The handler thread
+        blocks for the capture window (admin surface — serving is
+        untouched); concurrent captures answer 409. Fail-soft where
+        the profiler is unavailable ({"available": false})."""
+        import os
+
+        from istio_tpu.runtime import forensics
+
+        q = self._query(req)
+        try:
+            seconds = float(q.get("seconds", 1.0) or 1.0)
+        except ValueError:
+            seconds = 1.0
+        directory = None
+        if self.runtime is not None:
+            directory = getattr(self.runtime.args, "profile_dir",
+                                None)
+        # None → capture_profile mkdtemps lazily (only once the lock
+        # is held and the profiler imports — no tempdir litter from
+        # busy/unavailable polls)
+        directory = directory or os.environ.get("MIXS_PROFILE_DIR") \
+            or None
+        try:
+            payload = forensics.capture_profile(directory, seconds)
+        except forensics.ProfileBusy as exc:
+            self._send_json(req, {"error": str(exc)}, 409)
+            return
+        self._send_json(req, payload,
+                        200 if payload.get("available") else 503)
+
+    def _h_threads(self, req: BaseHTTPRequestHandler) -> None:
+        """Host-side thread-stack dump (sys._current_frames): every
+        live thread's python stack, keyed by name — the wedged-pump /
+        wedged-lane diagnostic that otherwise needs gdb on a serving
+        process."""
+        from istio_tpu.runtime import forensics
+
+        self._send_json(req, forensics.thread_stacks())

@@ -529,6 +529,15 @@ class CheckBatcher:
             batch = self._shed_stale(batch)
             if not batch:
                 return
+            # flight-recorder tape (runtime/forensics.py): opened per
+            # batch on this worker thread; the monitor.observe_stage
+            # calls below and in the dispatcher feed it, and the
+            # completion note captures a slow exemplar only when the
+            # batch's slowest request crossed the threshold. Check
+            # path only — report batches carry their own stages.
+            if self._observe_latency:
+                from istio_tpu.runtime import forensics
+                forensics.RECORDER.batch_begin()
             self._size_hist.observe(len(batch))
             bags = [bag for bag, _ in batch]
             padded = pad_to_bucket(bags, self.buckets) \
@@ -601,10 +610,20 @@ class CheckBatcher:
             # feeds the e2e histogram + sliding-window p99 tracker
             if self._observe_latency:
                 done = time.perf_counter()
+                e2e_max, slow_fut = 0.0, None
                 for _, fut in batch:
                     t = getattr(fut, "_t_enq", None)
                     if t is not None:
-                        monitor.observe_check_e2e(done - t)
+                        e2e = done - t
+                        monitor.observe_check_e2e(e2e)
+                        if e2e > e2e_max:
+                            e2e_max, slow_fut = e2e, fut
+                # one exemplar per batch at most: batch-mates share
+                # the stage timeline the tape recorded above
+                from istio_tpu.runtime import forensics
+                forensics.RECORDER.note_batch(
+                    e2e_max, len(batch),
+                    getattr(slow_fut, "_trace", None))
         except Exception as exc:
             # belt over the inner handler: NO failure in batch prep or
             # result distribution may abandon the futures — an
@@ -661,6 +680,10 @@ class CheckBatcher:
         here on resolves a typed UNAVAILABLE immediately; queued and
         in-flight batches are unaffected (drain() waits them out)."""
         self._draining = True
+        from istio_tpu.runtime import forensics
+        forensics.record_event(
+            "quiesce",
+            lane="check" if self._observe_latency else "report")
 
     def drain(self, deadline: float | None = 5.0) -> bool:
         """Block until the queue is empty and no batch is in flight

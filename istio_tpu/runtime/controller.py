@@ -250,6 +250,12 @@ class Controller:
                 log.error("config publish VETOED (generation %d kept "
                           "serving): %s", self._dispatcher.snapshot
                           .revision, rejection)
+                from istio_tpu.runtime import forensics
+                forensics.record_event(
+                    "canary_veto",
+                    serving_generation=self._dispatcher.snapshot
+                    .revision,
+                    reason=str(rejection)[:200])
                 if self.on_canary_reject is not None:
                     try:
                         self.on_canary_reject(rejection)
@@ -307,6 +313,13 @@ class Controller:
             t.daemon = True
             t.start()
         monitor.CONFIG_GENERATION.set(snapshot.revision)
+        # mesh event timeline: the publish IS the event a p99 spike at
+        # swap time gets attributed to (runtime/forensics.py)
+        from istio_tpu.runtime import forensics
+        forensics.record_event("config_publish",
+                               generation=snapshot.revision,
+                               rules=len(snapshot.rules),
+                               errors=len(snapshot.errors))
         log.info("published config generation %d (%d rules, %d handlers,"
                  " %d instances, %d errors)", snapshot.revision,
                  len(snapshot.rules), len(handlers),

@@ -380,6 +380,12 @@ class DeviceQuotaPool:
 
     def _flush(self, batch: list) -> None:
         now = self._clock()
+        # mesh event timeline (runtime/forensics.py): a flush trip is
+        # a control-plane event a concurrent request's tail can ride
+        # behind; coalesced so a quota-heavy window is one ring entry
+        from istio_tpu.runtime import forensics
+        forensics.record_event("quota_flush", coalesce_s=0.25,
+                               items=len(batch))
         # dedup WITHIN the window too: a sidecar retransmission can land
         # in the same batch as its original, before _flush has written
         # the dedup cache — memquota's mutex serializes those, replaying

@@ -672,8 +672,15 @@ class Dispatcher:
             # server-issued check-cache grants: one (ttl, uses) pair
             # per distinct namespace, min-folded into every response
             # below (allow AND deny — a delta that flips a cached
-            # DENY must revoke it too)
+            # DENY must revoke it too). The flight-recorder tape gets
+            # the grant decision as its own stage (a post-revocation
+            # policy stampede must be attributable).
+            t_grant = time.perf_counter()
             grant_of = self._grants_for_rows(ns_ids)
+            if observe and self.grants is not None:
+                from istio_tpu.runtime import forensics
+                forensics.RECORDER.stage_mark(
+                    "grant", time.perf_counter() - t_grant)
             out = []
             for b, bag in enumerate(bags):
                 resp = CheckResponse()

@@ -159,7 +159,8 @@ class HandlerLane:
         # device path — per-handler state surfaces via snapshot()
         self.breaker = CircuitBreaker(config.breaker_failures,
                                       config.breaker_reset_s,
-                                      publish=False)
+                                      publish=False,
+                                      name=f"handler:{name}")
         self._queue: "queue.Queue[HostAction | None]" = \
             queue.Queue(maxsize=max(int(config.queue_cap), 1))
         self._lock = threading.Lock()
@@ -389,7 +390,14 @@ class AdapterExecutor:
             # the request's deadline is already gone: don't wait at
             # all — claim whatever finished, else expire the action
             timeout = 0.0
+        t_wait0 = time.perf_counter()
         got = act._claim(timeout)
+        # flight-recorder tape: the fold's claim wait, per handler
+        # lane — the stage a wedged adapter's victims show up under
+        # (runtime/forensics.py; no-op off-batch)
+        from istio_tpu.runtime import forensics
+        forensics.RECORDER.host_wait(act.handler,
+                                     time.perf_counter() - t_wait0)
         if got is None:
             # still running at the bound: the batch folds with the
             # policy verdict; the worker's eventual completion counts
@@ -523,6 +531,9 @@ class AdapterExecutor:
                 st["last_error"] = err
             st["next_due"] = time.monotonic() + st["interval_s"]
             st["in_flight"] = False
+        from istio_tpu.runtime import forensics
+        forensics.record_event("provider_refresh", coalesce_s=0.5,
+                               provider=name, ok=err is None)
 
     def refresh_now(self, name: str) -> bool:
         """Synchronous one-shot refresh (tests, /debug triggers);

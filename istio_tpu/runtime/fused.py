@@ -604,9 +604,15 @@ class FusedPlan:
         passes a serving-latency yield (controller._serving_backoff)
         so a loaded single core keeps serving while this thread traces
         jaxprs — the warm yields to traffic, never the reverse."""
+        from istio_tpu.runtime import forensics
         for b, tier in pairs:
             if should_stop is not None and should_stop():
                 return
+            # mesh event timeline: prewarm start/end per shape — the
+            # compile whose GIL hold a swap-window p99 spike blames
+            forensics.record_event("prewarm", shape=f"{b}x{tier}",
+                                   phase="start")
+            t_w0 = time.perf_counter()
             batch = self._dummy_batch(b, tier)
             self.packed_check(batch, np.zeros(b, np.int32),
                               observe=False)
@@ -614,6 +620,9 @@ class FusedPlan:
                     self.report_rules:
                 self.packed_report(batch, np.zeros(b, np.int32),
                                    observe=False)
+            forensics.record_event(
+                "prewarm", shape=f"{b}x{tier}", phase="end",
+                wall_ms=round((time.perf_counter() - t_w0) * 1e3, 1))
             if backoff is not None:
                 backoff()
 

@@ -92,11 +92,20 @@ class GrantPolicy:
             self.generation += 1
             self._revocations += 1
             if changed_namespaces is None:
+                scope = "all"
                 self._global_change = now
                 self._ns_change.clear()
             else:
-                for ns in changed_namespaces:
+                changed = list(changed_namespaces)
+                scope = f"{len(changed)} namespaces"
+                for ns in changed:
                     self._ns_change[ns] = now
+        # mesh event timeline: a revocation storm (every client cache
+        # dropping to the TTL floor at once) is exactly the event a
+        # post-publish p99 spike needs next to it
+        from istio_tpu.runtime import forensics
+        forensics.record_event("grant_revoke", scope=scope,
+                               generation=self.generation)
 
     # -- serve side ----------------------------------------------------
 

@@ -256,6 +256,50 @@ def host_action_counters() -> dict:
     }
 
 
+# -- tail-latency forensics plane (runtime/forensics.py) --------------
+#
+# Two bounded rings back the forensics surfaces: the flight recorder's
+# slow-request exemplar ring (/debug/slow) and the mesh event timeline
+# (/debug/events). Overflow on either is bounded AND typed — the
+# dropped family below is zero-shaped per ring before the first drop
+# (a dashboard must distinguish "never dropped" from "counter
+# missing"), exactly the promtext doctrine the shed counters follow.
+FORENSICS_RINGS = ("slow", "events")
+FORENSICS_DROPPED = prometheus_client.Counter(
+    "mixer_forensics_dropped_total",
+    "forensics ring entries evicted by overflow, by ring "
+    "(slow = flight-recorder exemplars, events = mesh event "
+    "timeline)", ["ring"], registry=REGISTRY)
+FORENSICS_SLOW = prometheus_client.Counter(
+    "mixer_forensics_slow_exemplars_total",
+    "slow-request exemplars captured by the flight recorder "
+    "(one per over-threshold batch)", registry=REGISTRY)
+FORENSICS_EVENTS = prometheus_client.Counter(
+    "mixer_forensics_events_total",
+    "control-plane events recorded on the mesh event timeline",
+    registry=REGISTRY)
+for _r in FORENSICS_RINGS:
+    FORENSICS_DROPPED.labels(ring=_r)
+
+
+def note_forensics_drop(ring: str) -> None:
+    if ring not in FORENSICS_RINGS:
+        ring = "slow"
+    FORENSICS_DROPPED.labels(ring=ring).inc()
+
+
+def forensics_counters() -> dict:
+    """Forensics counter snapshot as one JSON-able dict — read by
+    /debug/slow, the forensics smoke and bench.py (per served
+    scenario: tail_* keys delta against a baseline of this)."""
+    return {
+        "slow_captured": int(FORENSICS_SLOW._value.get()),
+        "events_recorded": int(FORENSICS_EVENTS._value.get()),
+        "dropped": {r: int(FORENSICS_DROPPED.labels(
+            ring=r)._value.get()) for r in FORENSICS_RINGS},
+    }
+
+
 # -- end-to-end Check() latency decomposition ------------------------
 #
 # Stage semantics (one observation per BATCH per stage; e2e is one
@@ -295,8 +339,22 @@ CHECK_SLO_GAUGE = hostmetrics.default_registry.gauge(
     f"empty — mask alerts on mixer_check_e2e_seconds_count), else 0")
 
 
+# forensics stage tap (runtime/forensics.py registers the flight
+# recorder's thread-local tape here at import): every check stage
+# observation ALSO lands on the open batch tape, so the recorder needs
+# no second set of timers on the hot path. None until forensics loads.
+_STAGE_TAP = None
+
+
+def set_stage_tap(fn) -> None:
+    global _STAGE_TAP
+    _STAGE_TAP = fn
+
+
 def observe_stage(stage: str, seconds: float) -> None:
     CHECK_STAGE_SECONDS.observe(seconds, stage=stage)
+    if _STAGE_TAP is not None:
+        _STAGE_TAP(stage, seconds)
 
 
 def observe_check_e2e(seconds: float) -> None:

@@ -99,9 +99,13 @@ class CircuitBreaker:
     CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
 
     def __init__(self, failures: int = 3, reset_s: float = 5.0,
-                 publish: bool = True):
+                 publish: bool = True, name: str = "device"):
         self.failure_threshold = max(int(failures), 1)
         self.reset_s = reset_s
+        # forensics identity: which breaker transitioned ("device",
+        # "handler:<qualified name>", "bank:<shard>") — the mesh
+        # event timeline records transitions by this name
+        self.name = name
         # False for NON-device breakers (the adapter executor's
         # per-handler lanes): they must not clobber the device
         # breaker's mixer_check_breaker_state gauge — their state
@@ -124,7 +128,15 @@ class CircuitBreaker:
     def _transition(self, to: str) -> None:
         if to == self._state:
             return
-        log.warning("circuit breaker: %s -> %s", self._state, to)
+        log.warning("circuit breaker %s: %s -> %s", self.name,
+                    self._state, to)
+        # mesh event timeline (runtime/forensics.py): a breaker flip
+        # is exactly the control-plane event a slow-request exemplar
+        # needs next to it. record_event never raises and the ring
+        # lock is a leaf, so holding self._lock here is safe.
+        from istio_tpu.runtime import forensics
+        forensics.record_event("breaker", name=self.name,
+                               frm=self._state, to=to)
         self._state = to
         if self._publish_gauge:
             from istio_tpu.runtime import monitor
@@ -241,12 +253,18 @@ class ChaosHooks:
         unwedge_adapter(handler) or reset()."""
         with self._lock:
             self._adapter_wedged.setdefault(handler, threading.Event())
+        # chaos arms are control-plane events too: the forensics
+        # smoke attributes a slow exemplar to the wedge that caused it
+        from istio_tpu.runtime import forensics
+        forensics.record_event("chaos_wedge", handler=handler)
 
     def unwedge_adapter(self, handler: str) -> None:
         with self._lock:
             ev = self._adapter_wedged.pop(handler, None)
         if ev is not None:
             ev.set()
+            from istio_tpu.runtime import forensics
+            forensics.record_event("chaos_unwedge", handler=handler)
 
     def adapter_call(self, handler: str) -> None:
         """Called by the executor's lane worker immediately before a
@@ -341,7 +359,8 @@ class ResilientChecker:
     def __init__(self, device: Callable[[Sequence[Any]], Sequence[Any]],
                  oracle: Callable[[Sequence[Any]], Sequence[Any]],
                  config: ResilienceConfig | None = None,
-                 chaos: ChaosHooks | None = None):
+                 chaos: ChaosHooks | None = None,
+                 name: str = "device"):
         self.device = device
         self.oracle = oracle
         # deadline propagation (the adapter-executor plane): callables
@@ -353,7 +372,8 @@ class ResilientChecker:
         self.config = config or ResilienceConfig()
         self.chaos = chaos if chaos is not None else CHAOS
         self.breaker = CircuitBreaker(self.config.breaker_failures,
-                                      self.config.breaker_reset_s)
+                                      self.config.breaker_reset_s,
+                                      name=name)
 
     def _n_real(self, bags: Sequence[Any]) -> int:
         from istio_tpu.runtime.batcher import trim_pads

@@ -182,6 +182,31 @@ class ServerArgs:
     grant_ttl_floor_s: float = 1.0
     grant_ttl_cap_s: float = 5.0
     grant_ttl_ramp_per_s: float = 0.5
+    # -- tail-latency forensics (runtime/forensics.py) -----------------
+    # per-request flight recorder: requests whose e2e latency exceeds
+    # the threshold capture a complete stage timeline (+ overlapping
+    # control-plane events) into the bounded ring /debug/slow serves.
+    # The fast path is one threshold compare per batch — bench pins
+    # the clean-traffic overhead at ≤2% (forensics_overhead_pct).
+    flight_recorder: bool = True
+    # capture threshold in ms; 0 = the live SLO target
+    # (monitor.CHECK_P99_TARGET_MS — "slow" means "violates the p99
+    # budget" by default)
+    slow_threshold_ms: float = 0.0
+    # adaptive mode: the threshold tracks max(base, live window p99),
+    # refreshed at scrape rate — opt-in (an overloaded server would
+    # otherwise stop capturing exactly when everything is slow, which
+    # is sometimes what you want: only the OUTLIERS above the current
+    # regime are exemplars)
+    slow_adaptive: bool = False
+    # bounded ring capacities (overflow is typed:
+    # mixer_forensics_dropped_total{ring=})
+    slow_ring_capacity: int = 256
+    event_ring_capacity: int = 512
+    # jax.profiler trace capture directory for /debug/profile
+    # (mixs --profile-dir; None → MIXS_PROFILE_DIR env → a tempdir
+    # created per capture)
+    profile_dir: str | None = None
     # -- rule-level telemetry (runtime/rulestats.py) -------------------
     # fold per-rule hit/deny/err counts into on-device accumulators
     # inside the fused check step (requires fused=True to do anything)
@@ -227,6 +252,18 @@ class RuntimeServer:
             compile_cache.configure_persistent_cache(cache_dir)
             compile_cache.install_event_counters()
         self._compile_cache_dir = cache_dir
+        # tail-latency forensics (runtime/forensics.py): arm the
+        # process-wide flight recorder + event ring BEFORE the
+        # controller's initial publish so the first generation's
+        # events (publish, prewarm) land on the timeline
+        from istio_tpu.runtime import forensics
+        forensics.RECORDER.configure(
+            enabled=self.args.flight_recorder,
+            threshold_ms=self.args.slow_threshold_ms,
+            adaptive=self.args.slow_adaptive,
+            capacity=self.args.slow_ring_capacity)
+        forensics.EVENTS.configure(
+            capacity=self.args.event_ring_capacity)
         manifest = self.args.default_manifest
         if manifest is None:
             manifest = GLOBAL_MANIFEST
@@ -789,7 +826,8 @@ class RuntimeServer:
                     fail_policy=self.args.check_fail_policy,
                     breaker_failures=self.args.breaker_failures,
                     breaker_reset_s=self.args.breaker_reset_s,
-                    retry=self.args.device_retry))
+                    retry=self.args.device_retry),
+                name=f"bank:{b.shard_id}")
             prev_brk = breakers.get(b.shard_id)
             if prev_brk is not None:
                 b.checker.breaker = prev_brk
@@ -854,6 +892,14 @@ class RuntimeServer:
         st["banks_recompiled_total"] += n_recompiled
         st["last_wall_s"] = round(wall, 4)
         st["revision"] = snap.revision
+        # mesh event timeline: which banks this generation carried vs
+        # recompiled — the event a shard's cold-bank tail rides next to
+        from istio_tpu.runtime import forensics
+        forensics.record_event("bank_rebuild",
+                               generation=snap.revision,
+                               reused=len(reused_ids),
+                               recompiled=n_recompiled,
+                               wall_ms=round(wall * 1e3, 1))
 
     def _prewarm_instep_for(self, plan) -> None:
         """Controller prewarm_hook: compile the CANDIDATE plan's
@@ -987,15 +1033,18 @@ class RuntimeServer:
         feeds the e2e histogram + live-percentile tracker."""
         import time as _time
 
+        from istio_tpu.runtime import forensics
         from istio_tpu.runtime import monitor as _monitor
 
         t0 = _time.perf_counter()
+        forensics.RECORDER.batch_begin()
         pre = [self.preprocess(b) for b in bags]
         _monitor.observe_stage("queue_wait", _time.perf_counter() - t0)
         out = list(self._run_check_batch(pre))
         e2e = _time.perf_counter() - t0
         for _ in bags:
             _monitor.observe_check_e2e(e2e)
+        forensics.RECORDER.note_direct(e2e, len(bags))
         return out
 
     def check_batch_preprocessed(self,
@@ -1005,14 +1054,22 @@ class RuntimeServer:
         and padded to a bucket shape (the BatchCheck gRPC front)."""
         import time as _time
 
+        from istio_tpu.runtime import forensics
         from istio_tpu.runtime import monitor as _monitor
         from istio_tpu.runtime.batcher import trim_pads
 
         t0 = _time.perf_counter()
+        # flight recorder: the native pump / BatchCheck front's batch
+        # tape — stage marks land on THIS thread (the dispatcher runs
+        # inline below), and the front's wire-decode pre-mark is
+        # absorbed here
+        forensics.RECORDER.batch_begin()
         out = list(self._run_check_batch(bags))
         e2e = _time.perf_counter() - t0
-        for _ in trim_pads(bags):      # padding rows carry no caller
+        real = trim_pads(bags)
+        for _ in real:                 # padding rows carry no caller
             _monitor.observe_check_e2e(e2e)
+        forensics.RECORDER.note_direct(e2e, len(real))
         return out
 
     def submit_report(self, bags: Sequence[Bag]) -> list:
@@ -1242,11 +1299,16 @@ class RuntimeServer:
         # only on SUCCESS: the batcher likewise skips errored batches,
         # so a transient device fault never flips the live p99 / SLO
         # gauges on error-path latency no request was answered with.
+        from istio_tpu.runtime import forensics
+
         t0 = _time.perf_counter()
+        forensics.RECORDER.batch_begin()
         out = self._check_batch_quota_instep_inner(bags, qrows, target)
         e2e = _time.perf_counter() - t0
-        for _ in trim_pads(bags):
+        real = trim_pads(bags)
+        for _ in real:
             _monitor.observe_check_e2e(e2e)
+        forensics.RECORDER.note_direct(e2e, len(real))
         return out
 
     def _check_batch_quota_instep_inner(self, bags: Sequence[Bag],
@@ -1348,6 +1410,9 @@ class RuntimeServer:
         if getattr(self, "_shutdown_done", False):
             return
         self._shutdown_done = True
+        from istio_tpu.runtime import forensics
+        forensics.record_event("shutdown",
+                               deadline_s=deadline)
         # a still-running initial in-step prewarm must not race
         # interpreter/pool teardown (its dummy trips touch jax state):
         # flip the stop flag (polled between shapes), then reap.

@@ -115,6 +115,22 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
         "HandlerLane.submit", "AdapterExecutor.submit",
         "AdapterExecutor.resolve",
     }),
+    # tail-latency forensics (ISSUE 14): the flight recorder's tape
+    # primitives run inside the batch step (batch_begin once per
+    # batch, stage_mark per stage observation via the monitor tap,
+    # host_wait per executor claim) and the capture path (note_batch /
+    # note_direct / _capture) runs only for over-threshold requests —
+    # all host-side dict/deque work; EventTimeline.record is called
+    # from hot sections (quota _flush, breaker transitions) and must
+    # stay a leaf-lock deque append. The serve boundaries (snapshot,
+    # overlapping, capture_profile, thread_stacks) are scrape-rate.
+    "istio_tpu/runtime/forensics.py": frozenset({
+        "FlightRecorder.batch_begin", "FlightRecorder.stage_mark",
+        "FlightRecorder.host_wait", "FlightRecorder.note_wire_decode",
+        "FlightRecorder.note_batch", "FlightRecorder.note_direct",
+        "FlightRecorder._capture", "EventTimeline.record",
+        "EventTimeline._mergeable",
+    }),
     # sharded serving plane (ISSUE 10): the shard router runs on every
     # lane's step worker (check = route + per-bank fused check + fold)
     # and the lane selector on every front thread's submit — host

@@ -1,0 +1,234 @@
+"""Unit tests for the tail-latency forensics plane
+(istio_tpu/runtime/forensics.py): ring bounds + typed drop counters,
+threshold gating, tape stage/host-wait attribution, event-timeline
+overlap + coalescing, the thread-stack dump, and the introspect
+/debug/traces ?min_ms= / ?trace= filters. The module-level RECORDER /
+EVENTS singletons are process-global — every test restores defaults
+so sibling suites (and the smoke) see a clean recorder."""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+import urllib.request
+
+from istio_tpu.runtime import forensics, monitor
+
+
+@contextlib.contextmanager
+def _recorder(threshold_ms=100.0, capacity=8, adaptive=False):
+    rec = forensics.RECORDER
+    try:
+        rec.configure(enabled=True, threshold_ms=threshold_ms,
+                      adaptive=adaptive, capacity=capacity)
+        rec.reset()
+        yield rec
+    finally:
+        rec.configure(enabled=True, threshold_ms=0.0,
+                      adaptive=False, capacity=256)
+        rec.reset()
+
+
+def _capture_one(rec, e2e_s=0.5, stages=(("device_step", 0.3),)):
+    rec.batch_begin()
+    for stage, s in stages:
+        rec.stage_mark(stage, s)
+    rec.note_batch(e2e_s, 4, {"traceId": "t1"})
+
+
+def test_threshold_gates_capture():
+    with _recorder(threshold_ms=100.0) as rec:
+        base = monitor.forensics_counters()["slow_captured"]
+        _capture_one(rec, e2e_s=0.05)      # under: silent
+        assert rec.snapshot()["retained"] == 0
+        _capture_one(rec, e2e_s=0.5)       # over: one exemplar
+        snap = rec.snapshot()
+        assert snap["retained"] == 1
+        assert monitor.forensics_counters()["slow_captured"] \
+            == base + 1
+        ex = snap["slowest"][0]
+        assert ex["e2e_ms"] == 500.0
+        assert ex["trace_id"] == "t1"
+        assert ex["traces_link"] == "/debug/traces?trace=t1"
+
+
+def test_ring_bound_and_typed_drops():
+    with _recorder(threshold_ms=1.0, capacity=4) as rec:
+        base = monitor.forensics_counters()["dropped"]["slow"]
+        for i in range(6):
+            _capture_one(rec, e2e_s=0.01 * (i + 1))
+        snap = rec.snapshot(top_k=16)
+        assert snap["retained"] == 4
+        assert monitor.forensics_counters()["dropped"]["slow"] \
+            == base + 2
+        # top-K is slowest-first over the RETAINED (recent) window
+        e2es = [e["e2e_ms"] for e in snap["slowest"]]
+        assert e2es == sorted(e2es, reverse=True)
+
+
+def test_tape_attributes_stages_and_host_waits():
+    with _recorder(threshold_ms=100.0) as rec:
+        rec.batch_begin()
+        rec.stage_mark("queue_wait", 0.05)
+        rec.stage_mark("device_step", 0.1)
+        rec.host_wait("mq.istio-system", 0.4)
+        rec.note_batch(0.6, 2, None)
+        ex = rec.snapshot()["slowest"][0]
+        assert ex["stages_ms"]["host:mq.istio-system"] == 400.0
+        assert ex["top_stage"] == "host:mq.istio-system"
+        assert ex["stages_ms"]["device_step"] == 100.0
+
+
+def test_disabled_recorder_is_silent_and_clears_tape():
+    with _recorder(threshold_ms=1.0) as rec:
+        rec.batch_begin()
+        rec.stage_mark("device_step", 0.2)
+        rec.configure(enabled=False)
+        rec.batch_begin()              # disabled: clears the tape
+        rec.stage_mark("device_step", 9.9)
+        rec.note_batch(9.9, 1, None)
+        assert rec.snapshot()["retained"] == 0
+        rec.configure(enabled=True)
+
+
+def test_wire_decode_premark_joins_next_batch():
+    with _recorder(threshold_ms=10.0) as rec:
+        rec.note_wire_decode(0.025)
+        rec.batch_begin()
+        rec.stage_mark("device_step", 0.05)
+        rec.note_batch(0.2, 1, None)
+        ex = rec.snapshot()["slowest"][0]
+        assert ex["stages_ms"]["wire_decode"] == 25.0
+
+
+def test_event_overlap_and_pre_window():
+    ring = forensics.EventTimeline(capacity=32)
+    t0 = time.perf_counter()
+    ring.record("config_publish", generation=7)
+    # an event 0.5s in the "past" of a request that starts now must
+    # still annotate it (the pre-window); one 5s back must not
+    with ring._lock:
+        ring._buf[0]["t"] = t0 - 0.5
+    ring.record("breaker", name="device", to="open")
+    with ring._lock:
+        ring._buf[1]["t"] = t0 - 5.0
+    got = ring.overlapping(t0, t0 + 0.01, pre_s=1.0)
+    kinds = [e["kind"] for e in got]
+    assert kinds == ["config_publish"]
+
+
+def test_event_coalescing_and_drop_counter():
+    base = monitor.forensics_counters()["dropped"]["events"]
+    ring = forensics.EventTimeline(capacity=8)
+    for _ in range(5):
+        ring.record("quota_flush", coalesce_s=10.0, items=3)
+    assert len(ring) == 1
+    ev = ring.snapshot()[0]
+    assert ev["n"] == 5
+    assert ev["detail"]["items"] == 15   # numeric fields accumulate
+    for i in range(10):
+        ring.record(f"kind{i}")
+    assert len(ring) == 8
+    assert monitor.forensics_counters()["dropped"]["events"] \
+        == base + 3   # 1 coalesced + 10 distinct into capacity 8
+
+
+def test_event_coalescing_never_masks_identity():
+    """A provider_refresh FAILURE inside the coalesce window of a
+    success (or a different provider) must stay its own entry — the
+    diagnostic identity is the ring's whole point."""
+    ring = forensics.EventTimeline(capacity=8)
+    ring.record("provider_refresh", coalesce_s=10.0,
+                provider="a", ok=True)
+    ring.record("provider_refresh", coalesce_s=10.0,
+                provider="a", ok=False)
+    ring.record("provider_refresh", coalesce_s=10.0,
+                provider="b", ok=False)
+    ring.record("provider_refresh", coalesce_s=10.0,
+                provider="b", ok=False)
+    evs = ring.snapshot()
+    assert [(e["detail"]["provider"], e["detail"]["ok"], e["n"])
+            for e in evs] == \
+        [("a", True, 1), ("a", False, 1), ("b", False, 2)]
+
+
+def test_adaptive_threshold_never_below_base():
+    with _recorder(threshold_ms=50.0, adaptive=True) as rec:
+        # empty/fast window: the adaptive threshold floors at base
+        assert rec.threshold_s() >= 0.05
+
+
+def test_thread_stacks_names_this_thread():
+    import threading
+    dump = forensics.thread_stacks()
+    assert dump["n_threads"] >= 1
+    names = {t["name"] for t in dump["threads"]}
+    assert threading.current_thread().name in names
+    assert all(t["stack"] for t in dump["threads"])
+
+
+def test_capture_profile_fail_soft_or_artifact(tmp_path):
+    out = forensics.capture_profile(str(tmp_path), 0.1)
+    if out.get("available"):
+        assert out["n_files"] >= 1 and out["bytes_total"] > 0
+    else:
+        assert "error" in out
+
+
+def test_traces_min_ms_and_trace_filters():
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.utils import tracing
+
+    intro = IntrospectServer(runtime=None)
+    try:
+        port = intro.start()
+        tr = tracing.get_tracer()
+        tr.emit("fast.span", 0.001)
+        with tr.span("slow.root") as root:
+            tr.emit("slow.child", 0.5)
+        time.sleep(0.05)
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}",
+                    timeout=10) as r:
+                return json.load(r)
+
+        spans = get("/debug/traces?min_ms=100")["spans"]
+        assert spans and all(s["duration"] >= 100_000
+                             for s in spans)
+        assert not any(s["name"] == "fast.span" for s in spans)
+        tid = root["traceId"]
+        spans = get(f"/debug/traces?trace={tid}")["spans"]
+        assert spans and all(s["traceId"] == tid for s in spans)
+    finally:
+        intro.close()
+
+
+def test_debug_slow_and_events_serve_without_runtime():
+    from istio_tpu.introspect import IntrospectServer
+
+    with _recorder(threshold_ms=10.0) as rec:
+        _capture_one(rec, e2e_s=0.3)
+        forensics.record_event("config_publish", generation=1)
+        intro = IntrospectServer(runtime=None)
+        try:
+            port = intro.start()
+
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}",
+                        timeout=10) as r:
+                    return json.load(r)
+
+            slow = get("/debug/slow?k=4")
+            assert slow["retained"] == 1
+            assert slow["slowest"][0]["e2e_ms"] == 300.0
+            ev = get("/debug/events?kind=config_publish&n=4")
+            assert ev["events"]
+            assert all(e["kind"] == "config_publish"
+                       for e in ev["events"])
+            th = get("/debug/threads")
+            assert th["n_threads"] >= 1
+        finally:
+            intro.close()

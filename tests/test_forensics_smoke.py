@@ -1,0 +1,29 @@
+"""Tier-1 hook for scripts/forensics_smoke.py: the CI gate that the
+tail-latency forensics plane attributes slow requests end to end —
+clean traffic under threshold captures zero exemplars, a chaos-wedged
+adapter and a config swap under live load each produce a slow
+exemplar whose stage timeline names the guilty stage AND the
+overlapping control-plane event, /debug/slow + /debug/events +
+/metrics agree over real HTTP, exemplars deep-link into /debug/traces
+by trace id (and ?min_ms= filters by duration), /debug/profile and
+/debug/threads serve, and the recorder's clean-traffic overhead stays
+under the 2% gate. Runs main() in-process (the introspect_smoke
+pattern)."""
+import importlib.util
+import os
+import sys
+
+
+def test_forensics_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "forensics_smoke.py")
+    spec = importlib.util.spec_from_file_location("forensics_smoke",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(n_rules=60, n_checks=8)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0
