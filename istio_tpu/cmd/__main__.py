@@ -266,13 +266,8 @@ def cmd_pilot_discovery(args: argparse.Namespace) -> int:
 
     memory = MemoryRegistry()
     store = MemoryConfigStore()
-    reload_stop = None
     if args.registry_file:
         _load_world(memory, store, args.registry_file)
-        # live reload: istioctl register/deregister edits the file and
-        # must take effect without a restart (the reference writes to
-        # the live registry; here the file IS the registry backend)
-        reload_stop = _watch_registry_file(memory, args.registry_file)
     backends = [memory]
     # platform registries (bootstrap/server.go:360 initServiceControllers)
     if args.consul_address:
@@ -288,6 +283,17 @@ def cmd_pilot_discovery(args: argparse.Namespace) -> int:
     registry = backends[0] if len(backends) == 1 \
         else AggregateRegistry(backends)
     ds = DiscoveryService(registry, store, mesh_view)
+    reload_stop = None
+    if args.registry_file:
+        # live reload: istioctl register/deregister edits the file and
+        # must take effect without a restart (the reference writes to
+        # the live registry; here the file IS the registry backend).
+        # The watcher starts AFTER the DiscoveryService so a reload's
+        # per-service add/remove storm coalesces into ONE snapshot
+        # publish (ds.hold_publishes) instead of a full-world rebuild
+        # per service.
+        reload_stop = _watch_registry_file(memory, args.registry_file,
+                                           ds)
     port = ds.start(args.address, args.port)
     print(f"pilot-discovery: v1 xDS on {args.address}:{port}")
     _serve_forever()
@@ -297,10 +303,12 @@ def cmd_pilot_discovery(args: argparse.Namespace) -> int:
     return 0
 
 
-def _watch_registry_file(memory, path: str):
+def _watch_registry_file(memory, path: str, ds=None):
     """Poll the registry YAML's content; on change, rebuild the memory
-    registry's service set (service handlers fire → the discovery
-    cache invalidates)."""
+    registry's service set (service handlers fire → scoped snapshot
+    publish). `ds`: the DiscoveryService whose hold_publishes()
+    coalesces the rebuild's event storm into one publish."""
+    import contextlib
     import hashlib
     import threading
     import yaml
@@ -341,11 +349,15 @@ def _watch_registry_file(memory, path: str):
                 wanted[svc.hostname] = (svc, [
                     (e["address"], e.get("labels", {}))
                     for e in s.get("endpoints") or ()])
-            for host in [svc.hostname for svc in memory.services()]:
-                if host not in wanted:
-                    memory.remove_service(host)
-            for svc, endpoints in wanted.values():
-                memory.add_service(svc, endpoints)
+            hold = ds.hold_publishes() if ds is not None \
+                else contextlib.nullcontext()
+            with hold:
+                for host in [svc.hostname
+                             for svc in memory.services()]:
+                    if host not in wanted:
+                        memory.remove_service(host)
+                for svc, endpoints in wanted.values():
+                    memory.add_service(svc, endpoints)
 
     t = threading.Thread(target=loop, daemon=True,
                          name="registry-reload")

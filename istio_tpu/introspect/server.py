@@ -55,10 +55,13 @@ class IntrospectServer:
     def __init__(self, runtime: Any = None, port: int = 0,
                  host: str = "127.0.0.1", native: Any = None,
                  probe_controller: Any = None,
-                 trace_capacity: int = 256):
+                 trace_capacity: int = 256, discovery: Any = None):
         self.runtime = runtime
         self.native = native
         self.probe_controller = probe_controller
+        # pilot DiscoveryService whose debug_view() backs
+        # /debug/discovery (None → {"enabled": false})
+        self.discovery = discovery
         self._ring = None
         # extra cache-stat providers: name -> zero-arg callable
         self._cache_stats: dict[str, Callable[[], Any]] = {}
@@ -137,6 +140,7 @@ class IntrospectServer:
         "/debug/roofline": "_h_roofline",
         "/debug/report": "_h_report",
         "/debug/shards": "_h_shards",
+        "/debug/discovery": "_h_discovery",
         "/debug/slow": "_h_slow",
         "/debug/events": "_h_events",
         "/debug/profile": "_h_profile",
@@ -539,6 +543,20 @@ class IntrospectServer:
         payload["occupancy"] = routing["occupancy"]
         payload["misrouted"] = routing["misrouted"]
         self._send_json(req, payload)
+
+    def _h_discovery(self, req: BaseHTTPRequestHandler) -> None:
+        """Pilot discovery serving plane view (pilot/discovery.py):
+        snapshot generation, cache occupancy + hit/miss/carried/
+        invalidated accounting, node-group counts per endpoint, the
+        namespace→shard scope plan (balance + stability), shard watch
+        versions + parked watcher count, push fan-out percentiles and
+        the pilot_discovery_stage_seconds decomposition. {"enabled":
+        false} when no DiscoveryService is attached."""
+        if self.discovery is None:
+            self._send_json(req, {"enabled": False})
+            return
+        self._send_json(req, {"enabled": True,
+                              **self.discovery.debug_view()})
 
     def _h_executor(self, req: BaseHTTPRequestHandler) -> None:
         """Adapter-executor plane view (runtime/executor.py): per-

@@ -425,6 +425,13 @@ class MemoryConfigStore(ConfigStore):
     def register_handler(self, fn: Callable[[Config, str], None]) -> None:
         self._handlers.append(fn)
 
+    def snapshot(self) -> dict[tuple[str, str, str], Config]:
+        """One consistent copy of the full store (the discovery
+        snapshot builder's freeze point — a single lock acquisition,
+        never a per-type scan racing concurrent writers)."""
+        with self._lock:
+            return dict(self._data)
+
     def _notify(self, config: Config, event: str) -> None:
         for fn in list(self._handlers):
             fn(config, event)

@@ -360,3 +360,106 @@ class RouteTable:
         if 0 <= index < len(self.entries):
             return self.entries[index]
         return None
+
+
+class RouteScopeProgram:
+    """Source-admission half of the mesh's route-rule match blocks as
+    ONE compiled program — the per-node part of config generation.
+
+    Per-sidecar RDS generation filters each destination's route rules
+    by the polling node's source identity (`match.source`,
+    route.go buildVirtualHost / model._match_source). The reference
+    re-evaluates that filter per node per rule on the host; here every
+    source-constrained (host, rule) pair lowers its constraint to one
+    `source.service == "..."` predicate in the SAME expression
+    language / ruleset tensors the route NFA and policy engine compile
+    (BASELINE's shared-automaton doctrine), so admission for ALL
+    pending node groups is one batched device step:
+
+        admits [B, C]  →  row b: does node-group b's source satisfy
+                          constrained pair c?
+
+    Unconstrained rules admit every source by construction and never
+    enter the program; a node with no source identity admits
+    everything (the `_match_source` None-source semantics) and skips
+    the device plane entirely. Header/URI match halves are NOT
+    evaluated here — they become envoy match JSON in the generated
+    config (the data plane evaluates them per request; RouteTable
+    evaluates them per request on-device for the policy tie-in).
+
+    `digest` content-addresses the constraint set (host, rule index,
+    source) so snapshots carry the compiled program across
+    generations whenever no source constraint moved (PR 10 doctrine).
+    Compilation is lazy — building a snapshot whose digest matches the
+    previous generation never compiles.
+    """
+
+    def __init__(self, rules_by_host: Mapping[str, Sequence[Any]]):
+        from istio_tpu.compiler.cache import stable_digest
+
+        self._constrained: list[tuple[str, int]] = []
+        self._sources: list[str] = []
+        for host in sorted(rules_by_host):
+            for i, rule in enumerate(rules_by_host[host]):
+                src = (rule.spec.get("match") or {}).get("source")
+                if src:
+                    self._constrained.append((host, i))
+                    self._sources.append(str(src))
+        self._slot = {pair: j for j, pair in
+                      enumerate(self._constrained)}
+        self.n_constrained = len(self._constrained)
+        self.digest = stable_digest(
+            [(h, i, s) for (h, i), s in zip(self._constrained,
+                                            self._sources)])
+
+    @functools.cached_property
+    def _program(self):
+        """Lazy compile: (program, tensorizer) over the constraint
+        predicates; None when nothing in the mesh is
+        source-constrained."""
+        if not self._constrained:
+            return None
+        rules = [Rule(name=f"scope{j}",
+                      match=f"source.service == {_quote(src)}")
+                 for j, src in enumerate(self._sources)]
+        program = compile_ruleset(rules, ROUTE_FINDER, max_str_len=256)
+        return program, Tensorizer(program.layout, program.interner)
+
+    def admit_rows(self, sources: Sequence[str | None]) -> list:
+        """One device step for a batch of node-group source
+        identities → per-row admission maps. Row value None means
+        'admit everything' (no identity, or no constrained rules).
+        The batch pads to a power of two so churn storms reuse a few
+        compiled shapes instead of one per pending-set size."""
+        if self._program is None or not sources:
+            return [None] * len(sources)
+        program, tensorizer = self._program
+        n = len(sources)
+        cap = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+        padded = [s or "" for s in sources] + [""] * (cap - n)
+        bags = [bag_from_mapping({"source.service": s}) for s in padded]
+        batch = tensorizer.tensorize(bags)
+        matched, _, _ = program(batch)
+        m = np.asarray(matched) > 0    # hotpath: sync-ok — THE designated admission-plane pull (one per batched generation)
+        for ridx in program.host_fallback:   # defensive: EQ never falls back
+            for b in range(n):
+                m[b, ridx] = program.host_eval(ridx, bags[b])[0]
+        rows = []
+        for b, s in enumerate(sources):
+            if s is None:
+                rows.append(None)
+            else:
+                rows.append({pair: bool(m[b, j]) for j, pair in
+                             enumerate(self._constrained)})
+        return rows
+
+    def admits(self, row, host: str, rule_index: int) -> bool:
+        """Does the admission row (one admit_rows element) include
+        `rules_by_host[host][rule_index]`? Unconstrained rules and
+        identity-less rows always admit."""
+        if row is None:
+            return True
+        pair = (host, rule_index)
+        if pair not in self._slot:
+            return True
+        return row[pair]

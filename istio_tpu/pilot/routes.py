@@ -214,19 +214,30 @@ def service_domains(service: Service, port: Port,
     return domains
 
 
+def build_virtual_host_from_rules(service: Service, port: Port,
+                                  rules: Sequence[Config]
+                                  ) -> dict[str, Any]:
+    """Virtual-host assembly from an ALREADY-FILTERED, precedence-
+    sorted rule list — the single home shared by the live query path
+    (build_virtual_host) and the snapshot serving plane
+    (pilot/discovery.py), so scoped/batched generation stays
+    byte-identical to direct generation by construction."""
+    routes = [build_http_route(rule, service, port) for rule in rules]
+    routes.append(default_route(service, port))
+    return {"name": f"{service.hostname}|{port.name}",
+            "domains": service_domains(service, port),
+            "routes": routes}
+
+
 def build_virtual_host(service: Service, port: Port,
                        config_store: IstioConfigStore,
                        source: str | None = None,
                        source_labels: Mapping[str, str] | None = None
                        ) -> dict[str, Any]:
-    routes = []
-    for rule in config_store.route_rules(service.hostname, source,
-                                         source_labels):
-        routes.append(build_http_route(rule, service, port))
-    routes.append(default_route(service, port))
-    return {"name": f"{service.hostname}|{port.name}",
-            "domains": service_domains(service, port),
-            "routes": routes}
+    return build_virtual_host_from_rules(
+        service, port,
+        config_store.route_rules(service.hostname, source,
+                                 source_labels))
 
 
 def build_route_config(services: Sequence[Service], port_num: int,

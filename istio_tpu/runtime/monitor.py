@@ -835,6 +835,144 @@ def replica_snapshot() -> dict:
     return out
 
 
+# -- pilot discovery serving plane (istio_tpu/pilot/discovery.py) -----
+#
+# Stage semantics (one observation per unit of discovery work; the
+# units differ by design — the decomposition's job is attributing a
+# slow publish or a slow poll to its stage):
+#   snapshot_build — registry/config freeze + per-namespace content
+#                    digests + per-host indexes, per publish
+#   scope_plan     — namespace→shard delta planning (sharding/planner
+#                    reuse), per publish
+#   invalidate     — snapshot diff + scoped cache sweep + shard
+#                    version bumps/wakeups, per publish
+#   route_eval     — ONE batched source-admission device step shared
+#                    by every pending node group (route_nfa.
+#                    RouteScopeProgram.admit_rows), per batch
+#   generate       — config JSON assembly + cache fill, per batch of
+#                    node groups
+#   serve          — cache lookup → response bytes, per endpoint call
+DISCOVERY_STAGES = ("snapshot_build", "scope_plan", "invalidate",
+                    "route_eval", "generate", "serve")
+
+DISCOVERY_STAGE_SECONDS = hostmetrics.default_registry.histogram(
+    "pilot_discovery_stage_seconds",
+    "per-unit discovery serving stage latency (label: stage; see "
+    "runtime/monitor.py DISCOVERY_STAGES for unit semantics)")
+DISCOVERY_PUSH_FANOUT_SECONDS = hostmetrics.default_registry.histogram(
+    "pilot_discovery_push_fanout_seconds",
+    "delta-push fan-out latency: snapshot publish -> a parked "
+    "version-watcher waking with the new generation (only watchers "
+    "already waiting when the publish landed count — a late watcher "
+    "measures its own arrival, not the push)")
+# cache events, zero-shaped per the promtext doctrine: a dashboard
+# must distinguish "never invalidated" from "counter missing".
+#   hit/miss     — per endpoint call against the current generation
+#   carried      — entries re-stamped to a new generation because
+#                  their namespace deps did NOT change (the scoped-
+#                  invalidation win, counted per publish sweep)
+#   invalidated  — entries dropped by a publish sweep
+DISCOVERY_CACHE_EVENTS = ("hit", "miss", "carried", "invalidated")
+DISCOVERY_CACHE = hostmetrics.default_registry.counter(
+    "pilot_discovery_cache_events_total",
+    "discovery response-cache events, by event (hit/miss per call, "
+    "carried/invalidated per publish sweep)")
+DISCOVERY_GENERATION = hostmetrics.default_registry.gauge(
+    "pilot_discovery_generation",
+    "active discovery snapshot generation")
+for _e in DISCOVERY_CACHE_EVENTS:
+    DISCOVERY_CACHE.inc(0, event=_e)
+
+
+def observe_discovery_stage(stage: str, seconds: float) -> None:
+    DISCOVERY_STAGE_SECONDS.observe(seconds, stage=stage)
+
+
+def observe_discovery_push(seconds: float) -> None:
+    DISCOVERY_PUSH_FANOUT_SECONDS.observe(seconds)
+
+
+def note_discovery_cache(event: str, n: int = 1) -> None:
+    if n:
+        DISCOVERY_CACHE.inc(n, event=event)
+
+
+def set_discovery_generation(version: int) -> None:
+    DISCOVERY_GENERATION.set(float(version))
+
+
+def discovery_stage_baseline() -> dict:
+    """Subtraction token for discovery_latency_snapshot(since=...) —
+    the same delta-window discipline as stage_baseline()."""
+    token = {stage: DISCOVERY_STAGE_SECONDS.state(stage=stage)
+             for stage in DISCOVERY_STAGES}
+    token["__push__"] = DISCOVERY_PUSH_FANOUT_SECONDS.state()
+    return token
+
+
+def discovery_latency_snapshot(since: dict | None = None) -> dict:
+    """Discovery stage decomposition + push fan-out percentiles as one
+    JSON-able dict — /debug/discovery's `stages` pane and the bench's
+    per-scenario attribution."""
+    from istio_tpu.utils.metrics import quantile_from_counts
+
+    empty = ([], 0.0, 0)
+    stages: dict[str, dict] = {}
+    h = DISCOVERY_STAGE_SECONDS
+    for stage in DISCOVERY_STAGES:
+        counts, total, n = h.state(stage=stage)
+        if since is not None:
+            counts, total, n = _delta((counts, total, n),
+                                      since.get(stage, empty))
+        if not n:
+            continue
+        stages[stage] = {
+            "count": n,
+            "sum_ms": round(total * 1e3, 3),
+            "p50_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.5) * 1e3, 3),
+            "p99_ms": round(quantile_from_counts(
+                h.buckets, counts, n, 0.99) * 1e3, 3),
+        }
+    ph = DISCOVERY_PUSH_FANOUT_SECONDS
+    counts, total, n = ph.state()
+    if since is not None:
+        counts, total, n = _delta((counts, total, n),
+                                  since.get("__push__", empty))
+    push = {"count": n}
+    if n:
+        push.update({
+            "p50_ms": round(quantile_from_counts(
+                ph.buckets, counts, n, 0.5) * 1e3, 3),
+            "p99_ms": round(quantile_from_counts(
+                ph.buckets, counts, n, 0.99) * 1e3, 3),
+        })
+    return {"stages": stages, "push": push}
+
+
+def discovery_cache_counters(since: dict | None = None) -> dict:
+    """Cache-event snapshot (+hit_rate) as one JSON-able dict — read
+    by /debug/discovery, the discovery smoke and bench.py. `since`: a
+    previous reading (the counters are process-lifetime cumulative;
+    per-scenario rates must delta against their own baseline)."""
+    out = {}
+    with DISCOVERY_CACHE._lock:
+        vals = dict(DISCOVERY_CACHE._values)
+    for e in DISCOVERY_CACHE_EVENTS:
+        out[e] = 0
+    for labels, v in vals.items():
+        e = dict(labels).get("event")
+        if e in out:
+            out[e] += int(v)
+    if since is not None:
+        for e in DISCOVERY_CACHE_EVENTS:
+            out[e] -= int(since.get(e, 0))
+    calls = out["hit"] + out["miss"]
+    out["hit_rate"] = round(out["hit"] / calls, 4) if calls else None
+    out["generation"] = int(DISCOVERY_GENERATION.value())
+    return out
+
+
 @contextlib.contextmanager
 def resolve_timer():
     RESOLVE_COUNT.inc()

@@ -955,6 +955,126 @@ def make_route_world(n_routes: int = 1000, n_services: int | None = None,
     return services, rules_by_host
 
 
+def make_discovery_world(n_services: int = 48, n_namespaces: int = 8,
+                         replicas: int = 3,
+                         n_routes: int | None = None,
+                         source_ns: int = 2, seed: int = 0):
+    """Discovery-plane fleet world (the PR 9 Zipf fleet harness applied
+    to Pilot): `n_services` services Zipf-assigned over `n_namespaces`
+    namespaces (_fleet_ns_assignment — real namespace skew, a few big
+    app namespaces and a long tail), each namespace's services sharing
+    a PER-NAMESPACE http port (8000+k — per-namespace apps on their own
+    ports is what makes RDS genuinely namespace-scoped: one-namespace
+    churn touches one port's route configs), each service running
+    `replicas` sidecar-fronted instances at distinct IPs. Route rules
+    mix URI prefix/regex, header exact and presence matchers (the
+    VirtualService diet), and services in the first `source_ns`
+    namespaces additionally carry source-constrained rules — the part
+    of generation that is per-node and rides the batched
+    RouteScopeProgram device step; every other namespace's sidecars
+    collapse to ONE shared RDS config per port.
+
+    → (registry, store, nodes, meta): `nodes` are sidecar node-id
+    strings (`sidecar~ip~id~domain`), meta carries ns_ports /
+    nodes_by_ns / rules_by_ns for churn targeting. Build the world
+    BEFORE constructing the DiscoveryService — store/registry events
+    fire per mutation."""
+    from istio_tpu.pilot.model import (Config, ConfigMeta,
+                                       MemoryConfigStore, Port,
+                                       Service)
+    from istio_tpu.pilot.registry import MemoryRegistry
+
+    rng = np.random.default_rng(seed)
+    ns_of = _fleet_ns_assignment(n_services, n_namespaces, seed)
+    registry = MemoryRegistry()
+    store = MemoryConfigStore()
+    nodes: list[str] = []
+    nodes_by_ns: dict[int, list[str]] = {}
+    hosts_by_ns: dict[int, list[str]] = {}
+    node_idx = 0
+    for i in range(n_services):
+        k = int(ns_of[i])
+        ns = f"ns{k}"
+        host = f"svc{i}.{ns}.svc.cluster.local"
+        port = Port("http", 8000 + k, "HTTP")
+        endpoints = []
+        for r in range(replicas):
+            ip = (f"10.{8 + (node_idx >> 14)}."
+                  f"{(node_idx >> 7) & 127}.{node_idx & 127}")
+            endpoints.append((ip, {"version": f"v{r}"}))
+            node = f"sidecar~{ip}~svc{i}-{r}.{ns}~cluster.local"
+            nodes.append(node)
+            nodes_by_ns.setdefault(k, []).append(node)
+            node_idx += 1
+        registry.add_service(
+            Service(hostname=host,
+                    address=f"10.3.{i // 250}.{i % 250}",
+                    ports=(port,)),
+            endpoints)
+        hosts_by_ns.setdefault(k, []).append(host)
+    n_routes = n_routes if n_routes is not None else n_services
+    rules_by_ns: dict[int, list[str]] = {}
+    for j in range(n_routes):
+        i = int(rng.integers(n_services))
+        k = int(ns_of[i])
+        ns = f"ns{k}"
+        host = f"svc{i}.{ns}.svc.cluster.local"
+        kind = j % 4
+        headers: dict = {}
+        if kind == 0:
+            headers["uri"] = {"prefix": f"/api/v{j % 7}/"}
+        elif kind == 1:
+            headers["uri"] = {"regex": f"^/items/[0-9]+/r{j % 11}$"}
+        elif kind == 2:
+            headers["cookie"] = {"exact": f"user=group{j % 13}"}
+        else:
+            headers["uri"] = {"prefix": f"/svc/{j % 17}/"}
+            headers["x-debug"] = {"presence": True}
+        match: dict = {"request": {"headers": headers}}
+        if k < source_ns and j % 2 == 0:
+            peers = hosts_by_ns[k]
+            match["source"] = peers[(j * 7) % len(peers)]
+        name = f"dr{j}"
+        store.create(Config(
+            ConfigMeta(type="route-rule", name=name, namespace=ns),
+            {"destination": {"service": host},
+             "precedence": int(rng.integers(4)),
+             "match": match,
+             "route": [{"labels": {"version": f"v{j % replicas}"}}]}))
+        rules_by_ns.setdefault(k, []).append(name)
+    meta = {
+        "n_sidecars": len(nodes),
+        "ns_ports": {k: 8000 + k for k in range(n_namespaces)},
+        "ns_of": [int(x) for x in ns_of],
+        "nodes_by_ns": nodes_by_ns,
+        "hosts_by_ns": hosts_by_ns,
+        "rules_by_ns": rules_by_ns,
+        "source_ns": source_ns,
+        "n_routes": n_routes,
+    }
+    return registry, store, nodes, meta
+
+
+def churn_discovery_rule(store, meta: dict, ns_index: int,
+                         tick: int) -> str:
+    """One-namespace churn unit: bump one existing route rule's
+    timeout in namespace `ns_index` (store.update fires the change
+    event → scoped publish). Returns the rule name."""
+    from istio_tpu.pilot.model import Config
+
+    names = meta["rules_by_ns"].get(ns_index)
+    if not names:
+        raise ValueError(f"namespace ns{ns_index} has no route rules "
+                         f"to churn")
+    name = names[tick % len(names)]
+    cfg = store.get("route-rule", name, f"ns{ns_index}")
+    spec = dict(cfg.spec)
+    spec["httpReqTimeout"] = {
+        "simpleTimeout": {"timeout": f"{10 + tick}s"}}
+    store.update(Config(cfg.meta, spec))
+    return name
+
+
 def make_route_requests(batch: int, n_services: int | None = None,
                         seed: int = 4) -> list[dict]:
     """Route-manifest-shaped requests (destination.service +

@@ -1,0 +1,270 @@
+"""Discovery smoke: boot the snapshot-served Pilot discovery plane
+over a REAL HTTP front with a Zipf fleet world, and FAIL (nonzero
+exit) unless
+
+  1. every sidecar's SDS/CDS/RDS/LDS pull serves 200 with parseable
+     JSON, and a sampled node set is BYTE-EXACT against the unscoped
+     single-node generation path (legacy per-node builders over the
+     live registry/config store — no snapshot, no cache, no grouping,
+     no batched admission);
+  2. a one-namespace churn invalidates ONLY the scoped node groups:
+     the churned namespace's RDS re-pull is a miss with changed bytes
+     (still parity-exact), an unrelated namespace's RDS re-pull is a
+     HIT on a carried entry, and its SDS entry stays live;
+  3. delta push is scoped: a watcher parked on the churned
+     namespace's shard wakes with the new generation while a watcher
+     on a different shard times out unchanged (no full-fleet
+     re-pull);
+  4. /debug/discovery (on the introspect server AND the discovery
+     front) agrees with the smoke's own accounting — generation,
+     cache entries, hit/miss/carried/invalidated deltas, push
+     fan-out observations, non-empty serve/generate stages;
+  5. draining is typed: after begin_drain() new pulls answer 503
+     UNAVAILABLE (grpc code 14), parked watchers release, and a
+     stop/start cycle serves again.
+
+Runnable under JAX_PLATFORMS=cpu; tier-1 invokes main() in-process
+(tests/test_discovery_smoke.py).
+
+Usage: JAX_PLATFORMS=cpu python scripts/discovery_smoke.py \
+           [--services N] [--namespaces N] [--replicas N] [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _get(port: int, path: str, timeout: float = 30.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def main(n_services: int = 48, n_namespaces: int = 8,
+         replicas: int = 3, seed: int = 7) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from istio_tpu.introspect import IntrospectServer
+    from istio_tpu.pilot.discovery import DiscoveryService
+    from istio_tpu.testing import workloads
+
+    failures: list[str] = []
+    ds = None
+    intro = None
+    try:
+        registry, store, nodes, meta = workloads.make_discovery_world(
+            n_services=n_services, n_namespaces=n_namespaces,
+            replicas=replicas, source_ns=2, seed=seed)
+        ds = DiscoveryService(registry, store)
+        port = ds.start()
+        intro = IntrospectServer(discovery=ds)
+        intro_port = intro.start()
+
+        def node_port(node: str) -> int:
+            return 8000 + meta["ns_of"][nodes.index(node) // replicas]
+
+        # -- 1. full fleet pull over real HTTP + parity sample -------
+        served = 0
+        for n in nodes:
+            p = node_port(n)
+            for path in (f"/v1/routes/{p}/istio/{n}",
+                         f"/v1/clusters/istio/{n}",
+                         f"/v1/listeners/istio/{n}"):
+                code, body = _get(port, path)
+                if code != 200:
+                    failures.append(f"{path}: HTTP {code}")
+                    break
+                json.loads(body)
+                served += 1
+        for i in range(0, n_services, max(n_services // 8, 1)):
+            k = meta["ns_of"][i]
+            code, body = _get(
+                port, f"/v1/registration/svc{i}.ns{k}"
+                      f".svc.cluster.local|http")
+            if code != 200 or not json.loads(body)["hosts"]:
+                failures.append(f"sds svc{i}: bad response")
+        sample = nodes[:: max(len(nodes) // 8, 1)][:8]
+        for n in sample:
+            p = node_port(n)
+            for path in (f"/v1/routes/{p}/istio/{n}",
+                         f"/v1/clusters/istio/{n}",
+                         f"/v1/listeners/istio/{n}"):
+                _, got = _get(port, path)
+                want = ds.reference_bytes(path)
+                if got != want:
+                    failures.append(
+                        f"parity: {path} differs from the unscoped "
+                        f"single-node path")
+
+        # -- 2. one-namespace churn: scoped invalidation -------------
+        churn_k = max(meta["rules_by_ns"])
+        victims = [k for k in sorted(meta["rules_by_ns"])
+                   if k != churn_k and k >= meta["source_ns"]]
+        victim_k = victims[-1] if victims else None
+        if victim_k is None:
+            failures.append("world has no unrelated namespace with "
+                            "rules — cannot judge scoped invalidation")
+            raise RuntimeError("bad world")
+        churn_node = meta["nodes_by_ns"][churn_k][0]
+        victim_node = meta["nodes_by_ns"][victim_k][0]
+        _, churn_before = _get(
+            port, f"/v1/routes/{8000 + churn_k}/istio/{churn_node}")
+        gen_before = ds.generation
+        stats_before = ds._cache.stats()
+
+        # watchers park BEFORE the churn (scoped delta push)
+        snap = ds.snapshot
+        churn_shard = snap.plan.shard_of(f"ns{churn_k}")
+        other = None
+        for k, ns_nodes in sorted(meta["nodes_by_ns"].items()):
+            if snap.plan.shard_of(f"ns{k}") != churn_shard:
+                other = ns_nodes[0]
+                break
+        watch_out: dict = {}
+
+        def watch(tag: str, node: str, timeout: float) -> None:
+            _, body = _get(
+                port, f"/v1/watch/istio/{node}?version={gen_before}"
+                      f"&timeout={timeout}", timeout=timeout + 10)
+            watch_out[tag] = json.loads(body)
+
+        t_in = threading.Thread(target=watch,
+                                args=("scoped", churn_node, 10.0))
+        t_out = threading.Thread(target=watch,
+                                 args=("other", other, 1.5))
+        t_in.start()
+        t_out.start()
+        time.sleep(0.3)
+        workloads.churn_discovery_rule(store, meta, churn_k, 1)
+        t_in.join()
+        t_out.join()
+        if ds.generation != gen_before + 1:
+            failures.append(f"churn publish: generation "
+                            f"{ds.generation} != {gen_before + 1}")
+        if not watch_out.get("scoped", {}).get("changed"):
+            failures.append(f"scoped watcher did not wake: "
+                            f"{watch_out.get('scoped')}")
+        if watch_out.get("other", {}).get("changed"):
+            failures.append(f"out-of-scope watcher woke on an "
+                            f"unrelated churn: {watch_out.get('other')}")
+
+        # unrelated RDS re-pull: HIT on a carried entry
+        h0 = ds._cache.stats()
+        _get(port, f"/v1/routes/{8000 + victim_k}/istio/{victim_node}")
+        h1 = ds._cache.stats()
+        if h1["hits"] - h0["hits"] != 1 or h1["misses"] != h0["misses"]:
+            failures.append(
+                f"one-namespace churn did not leave the unrelated "
+                f"ns{victim_k} RDS entry live (hits +"
+                f"{h1['hits'] - h0['hits']}, misses +"
+                f"{h1['misses'] - h0['misses']})")
+        # unrelated SDS entry stays live too
+        vs = meta["hosts_by_ns"][victim_k][0]
+        _get(port, f"/v1/registration/{vs}|http")
+        h2 = ds._cache.stats()
+        s0 = h2["misses"]
+        _get(port, f"/v1/registration/{vs}|http")
+        h3 = ds._cache.stats()
+        if h3["misses"] != s0:
+            failures.append("unrelated SDS entry not served from "
+                            "cache after churn")
+        # churned RDS: new bytes, still parity-exact
+        path = f"/v1/routes/{8000 + churn_k}/istio/{churn_node}"
+        _, churn_after = _get(port, path)
+        if churn_after == churn_before:
+            failures.append("churned namespace's RDS bytes unchanged "
+                            "after a route-rule update")
+        if churn_after != ds.reference_bytes(path):
+            failures.append("post-churn RDS differs from the "
+                            "unscoped single-node path")
+        stats_after = ds._cache.stats()
+        if stats_after["carried"] <= stats_before["carried"]:
+            failures.append("publish sweep carried no entries — "
+                            "invalidation is not scoped")
+
+        # -- 4. /debug/discovery agreement ---------------------------
+        for where, dbg_port in (("front", port),
+                                ("introspect", intro_port)):
+            _, body = _get(dbg_port, "/debug/discovery")
+            view = json.loads(body)
+            if where == "introspect" and not view.get("enabled"):
+                failures.append("/debug/discovery disabled on the "
+                                "introspect server")
+                continue
+            if view["generation"] != ds.generation:
+                failures.append(f"/debug/discovery ({where}) "
+                                f"generation {view['generation']} != "
+                                f"{ds.generation}")
+            cache = view["cache"]
+            live = ds._cache.stats()
+            for key in ("entries", "hits", "misses", "carried",
+                        "invalidated"):
+                if abs(cache[key] - live[key]) > 2:   # concurrent GETs
+                    failures.append(
+                        f"/debug/discovery ({where}) cache.{key} "
+                        f"{cache[key]} != live {live[key]}")
+            if not view["push"].get("count"):
+                failures.append(f"/debug/discovery ({where}) has no "
+                                f"push fan-out observations after a "
+                                f"watched churn")
+            for stage in ("serve", "generate", "snapshot_build",
+                          "invalidate"):
+                if not view["stages"].get(stage, {}).get("count"):
+                    failures.append(
+                        f"/debug/discovery ({where}) stage "
+                        f"{stage!r} has no observations")
+
+        # -- 5. typed draining + restart cycle -----------------------
+        ds.begin_drain()
+        try:
+            _get(port, f"/v1/routes/{8000 + churn_k}/istio/"
+                       f"{churn_node}")
+            failures.append("draining server served a config pull")
+        except urllib.error.HTTPError as exc:
+            body = json.loads(exc.read())
+            if exc.code != 503 or body.get("code") != "UNAVAILABLE" \
+                    or body.get("grpc_code") != 14:
+                failures.append(f"draining rejection untyped: "
+                                f"{exc.code} {body}")
+        ds.stop()
+        port2 = ds.start()
+        code, _body = _get(port2, f"/v1/clusters/istio/{nodes[0]}")
+        if code != 200:
+            failures.append(f"restart cycle: HTTP {code}")
+    finally:
+        if intro is not None:
+            intro.close()
+        if ds is not None:
+            ds.stop()
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print(f"discovery smoke ok: {meta['n_sidecars']} sidecars / "
+              f"{n_services} services / {n_namespaces} ns, "
+              f"{served} HTTP serves, parity exact "
+              f"({len(sample)}-node sample, pre+post churn), "
+              f"one-ns churn scoped (gen {ds.generation}), "
+              f"push fan-out scoped, typed drain + restart ok")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--services", type=int, default=48)
+    ap.add_argument("--namespaces", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    sys.exit(main(args.services, args.namespaces, args.replicas,
+                  args.seed))

@@ -140,6 +140,22 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
         "ShardRouter.check", "ReplicaRouter.submit",
         "ReplicaRouter.lane_of",
     }),
+    # pilot discovery serving plane (ISSUE 15): cache lookup/store run
+    # on every fleet poll (dict lookup + counters — a 10k-sidecar poll
+    # storm rides these), _serve_cached is the per-call serve path and
+    # _generate_rds_batch the batched generation leg (host JSON
+    # assembly; its device step lives in route_nfa below)
+    "istio_tpu/pilot/discovery.py": frozenset({
+        "SnapshotCache.lookup", "SnapshotCache.peek",
+        "SnapshotCache.store", "DiscoveryService._serve_cached",
+        "DiscoveryService._generate_rds_batch",
+    }),
+    # batched source-admission device step (ISSUE 15): ONE pull per
+    # batched generation — the np.asarray on the matched plane is THE
+    # designated boundary and carries the file's only sync-ok pragma
+    "istio_tpu/pilot/route_nfa.py": frozenset({
+        "RouteScopeProgram.admit_rows",
+    }),
 }
 
 _SYNC_ATTRS = ("item", "block_until_ready")

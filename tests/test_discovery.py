@@ -1,0 +1,415 @@
+"""Discovery serving plane unit tests (pilot/discovery.py +
+pilot/snapshot.py): versioned snapshots, scoped cache invalidation
+(the regression ISSUE 15 satellite 1 pins: a one-service change must
+leave unrelated node groups' entries LIVE — clear_cache is no longer
+the only invalidation path), node-group config sharing, batched
+pending RDS generation, publish coalescing, shard-scoped delta push,
+typed draining and start/stop cycles."""
+import json
+import threading
+import time
+
+import pytest
+
+from istio_tpu.pilot.discovery import DiscoveryService
+from istio_tpu.pilot.model import (Config, ConfigMeta, Port, Service)
+from istio_tpu.pilot.snapshot import MESH_SCOPE, changed_scopes
+from istio_tpu.testing import workloads
+
+
+@pytest.fixture()
+def world():
+    return workloads.make_discovery_world(
+        n_services=24, n_namespaces=6, replicas=2, source_ns=2,
+        seed=3)
+
+
+def _poll_all(ds, nodes, meta, replicas=2):
+    for idx, n in enumerate(nodes):
+        k = meta["ns_of"][idx // replicas]
+        ds.list_routes(str(8000 + k), "c", n)
+        ds.list_clusters("c", n)
+
+
+def test_one_service_change_keeps_unrelated_entries_live(world):
+    """ISSUE 15 satellite 1: registry churn in one namespace must NOT
+    drop other namespaces' scoped cache entries (the old
+    clear_cache-on-event design repaid full generation fleet-wide for
+    any single-service change)."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    _poll_all(ds, nodes, meta)
+    ks = sorted(meta["nodes_by_ns"])
+    churn_k, victim_k = ks[-1], ks[-2]
+    victim_node = meta["nodes_by_ns"][victim_k][0]
+    victim_host = meta["hosts_by_ns"][victim_k][0]
+    ds.list_endpoints(f"{victim_host}|http")    # sds entry for victim
+
+    # one-SERVICE change: a new service appears in churn_k
+    registry.add_service(
+        Service(hostname=f"late.ns{churn_k}.svc.cluster.local",
+                address="10.9.9.9",
+                ports=(Port("http", 8000 + churn_k, "HTTP"),)),
+        [("10.9.9.10", {})])
+    assert ds.generation == 2
+
+    stats = ds._cache.stats()
+    # unrelated RDS group entry survived the sweep and serves as a hit
+    h0 = stats["hits"]
+    ds.list_routes(str(8000 + victim_k), "c", victim_node)
+    assert ds._cache.stats()["hits"] == h0 + 1
+    # unrelated SDS entry likewise
+    m0 = ds._cache.stats()["misses"]
+    ds.list_endpoints(f"{victim_host}|http")
+    assert ds._cache.stats()["misses"] == m0
+    # the churned namespace's RDS regenerated with the new service
+    churn_node = meta["nodes_by_ns"][churn_k][0]
+    body = json.loads(ds.list_routes(str(8000 + churn_k), "c",
+                                     churn_node))
+    names = [v["name"] for v in body["virtual_hosts"]]
+    assert any(n.startswith(f"late.ns{churn_k}") for n in names)
+    # parity with the unscoped single-node path after the change
+    path = f"/v1/routes/{8000 + churn_k}/c/{churn_node}"
+    assert ds._route(path)[0] == ds.reference_bytes(path)
+
+
+def test_identical_sidecars_share_one_generated_config(world):
+    """Replicas of one service hit the same RDS group: the second
+    sidecar's first poll is already a cache hit, and both serve the
+    same bytes."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    k = meta["ns_of"][0]
+    a, b = nodes[0], nodes[1]          # replicas of svc0
+    body_a = ds.list_routes(str(8000 + k), "c", a)
+    h0 = ds._cache.stats()["hits"]
+    body_b = ds.list_routes(str(8000 + k), "c", b)
+    assert ds._cache.stats()["hits"] == h0 + 1
+    assert body_a == body_b
+
+
+def test_batched_pending_generation_fills_all_groups(world):
+    """After a publish invalidates several RDS groups, the FIRST miss
+    regenerates every pending group in one batch — subsequent polls
+    of the other churned groups are hits."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    _poll_all(ds, nodes, meta)
+    # source-ns 0 has per-source groups: churn it so several RDS
+    # groups invalidate at once
+    src_k = 0 if meta["rules_by_ns"].get(0) else 1
+    n_groups_before = ds._cache.stats()["by_endpoint"]["rds"]
+    workloads.churn_discovery_rule(store, meta, src_k, 0)
+    pending = len(ds._pending_rds)
+    assert pending >= 1
+    first = meta["nodes_by_ns"][src_k][0]
+    ds.list_routes(str(8000 + src_k), "c", first)   # one miss...
+    assert not ds._pending_rds                      # ...fills ALL
+    h0 = ds._cache.stats()["hits"]
+    for n in meta["nodes_by_ns"][src_k][1:]:
+        ds.list_routes(str(8000 + src_k), "c", n)
+    assert ds._cache.stats()["hits"] - h0 == \
+        len(meta["nodes_by_ns"][src_k]) - 1
+    assert ds._cache.stats()["by_endpoint"]["rds"] == n_groups_before
+
+
+def test_source_scoped_rds_groups_differ_and_match_reference(world):
+    """Source-constrained route rules give different sidecars
+    different RDS bytes — each byte-exact against the unscoped
+    single-node path (the batched device admission must reproduce the
+    host _match_source filter exactly)."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    src_k = 0 if meta["rules_by_ns"].get(0) else 1
+    ns_nodes = meta["nodes_by_ns"][src_k]
+    port = 8000 + src_k
+    bodies = set()
+    for n in ns_nodes:
+        path = f"/v1/routes/{port}/c/{n}"
+        got = ds._route(path)[0]
+        assert got == ds.reference_bytes(path), n
+        bodies.add(got)
+    # the world seeds source constraints in this namespace; if every
+    # node saw identical routes the admission plane did nothing
+    has_src = any(
+        (store.get("route-rule", name, f"ns{src_k}").spec
+         .get("match") or {}).get("source")
+        for name in meta["rules_by_ns"][src_k])
+    if has_src and len(ns_nodes) > 2:
+        assert len(bodies) > 1
+
+
+def test_hold_publishes_coalesces_a_churn_batch(world):
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    g0 = ds.generation
+    with ds.hold_publishes():
+        for tick in range(4):
+            workloads.churn_discovery_rule(
+                store, meta, max(meta["rules_by_ns"]), tick)
+    assert ds.generation == g0 + 1
+
+
+def test_changed_scopes_and_plan_stability(world):
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    snap1 = ds.snapshot
+    churn_k = max(meta["rules_by_ns"])
+    workloads.churn_discovery_rule(store, meta, churn_k, 0)
+    snap2 = ds.snapshot
+    assert changed_scopes(snap1, snap2) == {f"ns{churn_k}"}
+    # namespaces keep their shards across generations (watch scope
+    # keys are stable — the planner's delta-mode contract)
+    for ns, shard in snap1.plan.ns_to_shard.items():
+        assert snap2.plan.ns_to_shard[ns] == shard
+    assert snap2.scope_reused        # no source constraint moved
+
+
+def test_watch_scoped_wake_and_drain_release(world):
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    churn_k = max(meta["rules_by_ns"])
+    snap = ds.snapshot
+    churn_shard = snap.plan.shard_of(f"ns{churn_k}")
+    other = next(ns_nodes[0] for k, ns_nodes
+                 in sorted(meta["nodes_by_ns"].items())
+                 if snap.plan.shard_of(f"ns{k}") != churn_shard)
+    results = {}
+
+    def park(tag, node, timeout):
+        results[tag] = ds.watch(node, ds.generation, timeout)
+
+    t1 = threading.Thread(target=park, args=(
+        "scoped", meta["nodes_by_ns"][churn_k][0], 10.0))
+    t2 = threading.Thread(target=park, args=("other", other, 1.0))
+    t1.start()
+    t2.start()
+    time.sleep(0.2)
+    workloads.churn_discovery_rule(store, meta, churn_k, 0)
+    t1.join()
+    t2.join()
+    assert results["scoped"]["changed"] is True
+    assert results["other"]["changed"] is False
+
+    # draining releases parked watchers promptly
+    hang = threading.Thread(target=park, args=(
+        "drain", meta["nodes_by_ns"][churn_k][0], 30.0))
+    hang.start()
+    time.sleep(0.1)
+    t0 = time.perf_counter()
+    ds.begin_drain()
+    hang.join(timeout=5)
+    assert not hang.is_alive()
+    assert time.perf_counter() - t0 < 5
+    assert results["drain"]["draining"] is True
+
+
+def test_start_stop_cycles(world):
+    """ISSUE 15 satellite 2: the concurrent front survives repeated
+    start/stop cycles, serving between each."""
+    import urllib.request
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    for cycle in range(10):
+        port = ds.start()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/clusters/c/{nodes[0]}",
+                timeout=10) as r:
+            assert r.status == 200, cycle
+        ds.stop()
+
+
+def test_events_during_drain_republish_on_restart(world):
+    """Review regression: config/registry events landing while
+    drained must not be lost — start() catches the snapshot up, so a
+    restarted server never serves the pre-drain world forever."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    port = ds.start()
+    gen = ds.generation
+    ds.stop()
+    registry.add_service(
+        Service(hostname="late.ns0.svc.cluster.local",
+                address="10.9.9.9",
+                ports=(Port("http", 8000, "HTTP"),)),
+        [("10.9.9.10", {})])
+    assert ds.generation == gen          # generation off while drained
+    port = ds.start()
+    try:
+        assert ds.generation == gen + 1  # caught up before serving
+        node = nodes[0]
+        body = json.loads(ds.list_clusters("c", node))
+        assert any("late.ns0" in c["name"] for c in body["clusters"])
+    finally:
+        ds.stop()
+
+
+def test_cross_namespace_port_join_invalidates_rds():
+    """Review regression: an RDS entry's deps record the namespaces
+    on its port AT BUILD TIME — a service from a NEW namespace joining
+    the port must still invalidate it (port-membership diff), or the
+    carried entry serves routes missing the new virtual host."""
+    from istio_tpu.pilot.registry import MemoryRegistry
+    from istio_tpu.pilot.model import MemoryConfigStore
+
+    registry = MemoryRegistry()
+    store = MemoryConfigStore()
+    registry.add_service(
+        Service(hostname="a.ns1.svc.cluster.local", address="10.0.0.1",
+                ports=(Port("http", 9000, "HTTP"),)),
+        [("10.1.0.1", {})])
+    ds = DiscoveryService(registry, store)
+    node = "sidecar~10.1.0.1~a-0.ns1~cluster.local"
+    path = "/v1/routes/9000/c/" + node
+    before = ds._route(path)[0]
+    # cross-namespace join of the SAME port
+    registry.add_service(
+        Service(hostname="b.ns2.svc.cluster.local", address="10.0.0.2",
+                ports=(Port("http", 9000, "HTTP"),)),
+        [("10.1.0.2", {})])
+    assert 9000 in set(ds._last_publish["changed_ports"])
+    after = ds._route(path)[0]
+    assert after != before
+    assert b"b.ns2.svc.cluster.local" in after
+    assert after == ds.reference_bytes(path)
+
+
+def test_multi_service_node_canonical_instance_order():
+    """Review regression: one node IP hosting several services must
+    generate identical bytes regardless of service REGISTRATION order
+    (live registries return insertion order; the snapshot path and
+    the parity reference both canonicalize), and LDS/CDS stay
+    byte-exact against the reference."""
+    from istio_tpu.pilot.registry import MemoryRegistry
+    from istio_tpu.pilot.model import MemoryConfigStore
+
+    def build(order):
+        registry = MemoryRegistry()
+        store = MemoryConfigStore()
+        svcs = {
+            "zeta": Service(hostname="zeta.ns1.svc.cluster.local",
+                            address="10.0.0.1",
+                            ports=(Port("tcp", 9000, "TCP"),)),
+            "alpha": Service(hostname="alpha.ns1.svc.cluster.local",
+                             address="10.0.0.2",
+                             ports=(Port("http", 9001, "HTTP"),)),
+        }
+        for name in order:
+            az = "zone-" + name
+            registry.add_service(svcs[name], [("10.1.0.9", {}, az)])
+        return DiscoveryService(registry, store)
+
+    node = "sidecar~10.1.0.9~multi.ns1~cluster.local"
+    a = build(("zeta", "alpha"))
+    b = build(("alpha", "zeta"))
+    for path in (f"/v1/listeners/c/{node}", f"/v1/clusters/c/{node}"):
+        ba = a._route(path)[0]
+        bb = b._route(path)[0]
+        assert ba == bb, path                 # order-independent
+        assert ba == a.reference_bytes(path)  # and parity-exact
+        assert bb == b.reference_bytes(path)
+    # az picks the canonical first instance on both
+    assert a.availability_zone("c", node) == \
+        b.availability_zone("c", node)
+
+
+def test_watch_over_capacity_degrades_to_polling(world):
+    """Review regression: parked watchers hold front threads —
+    watch_cap bounds them; over-capacity watchers return immediately
+    (typed over_capacity) instead of parking."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store, watch_cap=2)
+    done = []
+
+    def park(node):
+        done.append(ds.watch(node, ds.generation, 3.0))
+
+    threads = [threading.Thread(target=park, args=(n,))
+               for n in nodes[:2]]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    t0 = time.perf_counter()
+    third = ds.watch(nodes[2], ds.generation, 30.0)
+    assert time.perf_counter() - t0 < 1.0
+    assert third["over_capacity"] is True
+    assert third["changed"] is False
+    ds.begin_drain()
+    for t in threads:
+        t.join(timeout=5)
+    assert len(done) == 2
+
+
+def test_deleted_namespace_wakes_its_old_shard(world):
+    """Review regression: a fully-deleted namespace vanishes from the
+    NEW plan (shard_of falls back to the crc32 hash), but its
+    watchers parked on the PREVIOUS plan's shard — the publish must
+    bump both or those sidecars never learn their services
+    vanished."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    victim_k = max(meta["hosts_by_ns"])
+    node = meta["nodes_by_ns"][victim_k][0]
+    results = {}
+
+    def park():
+        results["w"] = ds.watch(node, ds.generation, 10.0)
+
+    t = threading.Thread(target=park)
+    t.start()
+    time.sleep(0.2)
+    with ds.hold_publishes():
+        # delete the namespace's rules AND services entirely
+        for name in meta["rules_by_ns"].get(victim_k, ()):
+            store.delete("route-rule", name, f"ns{victim_k}")
+        for host in meta["hosts_by_ns"][victim_k]:
+            registry.remove_service(host)
+    t.join(timeout=10)
+    assert results["w"]["changed"] is True
+    assert f"ns{victim_k}" not in ds.snapshot.plan.ns_to_shard
+
+
+def test_hold_during_drain_keeps_dirty_for_restart(world):
+    """Review regression: a hold_publishes() block exiting while
+    drained must LEAVE the dirty flag set so start()'s catch-up
+    publish replays it (the registry-file reload path runs under
+    hold and can race a stop())."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    ds.start()
+    gen = ds.generation
+    ds.stop()
+    with ds.hold_publishes():
+        workloads.churn_discovery_rule(
+            store, meta, max(meta["rules_by_ns"]), 0)
+    assert ds.generation == gen          # still drained: no publish
+    ds.start()
+    try:
+        assert ds.generation == gen + 1  # caught up before serving
+    finally:
+        ds.stop()
+
+
+def test_clear_cache_still_available(world):
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    ds.list_clusters("c", nodes[0])
+    assert ds.cache_size > 0
+    ds.clear_cache()
+    assert ds.cache_size == 0
+
+
+def test_mesh_scope_changes_invalidate_mesh_entries(world):
+    """An egress rule rides every RDS/CDS/LDS — mesh-scoped churn
+    honestly drops mesh-dependent entries AND wakes every shard."""
+    registry, store, nodes, meta = world
+    ds = DiscoveryService(registry, store)
+    _poll_all(ds, nodes, meta)
+    store.create(Config(
+        ConfigMeta(type="egress-rule", name="eg", namespace="default"),
+        {"destination": {"service": "httpbin.org"},
+         "ports": [{"port": 8000, "protocol": "http"}]}))
+    assert MESH_SCOPE in set(ds._last_publish["changed_scopes"])
+    assert ds._last_publish["shards_notified"] == \
+        list(range(ds._scope_shards))
+    assert ds.cache_size == 0          # every entry was mesh-affected

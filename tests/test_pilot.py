@@ -222,7 +222,10 @@ def test_discovery_rest_and_cache(world):
         rds = get(f"/v1/routes/80/istio-proxy/{node}")
         assert any(vh["name"].startswith("reviews")
                    for vh in rds["virtual_hosts"])
-        # cache: repeated call is a hit; config change clears wholesale
+        # cache: repeated call is a hit; a config change runs the
+        # SCOPED publish sweep — in this single-namespace world every
+        # entry depends on the changed scope, so all drop (the
+        # scoped-retention cases live in tests/test_discovery.py)
         n = ds.cache_size
         get(f"/v1/routes/80/istio-proxy/{node}")
         assert ds.cache_size == n
