@@ -327,6 +327,95 @@ def _forensics_overhead_fields(srv, prefix: str, n_reqs: int = 128,
                 f"{type(exc).__name__}: {exc}"}
 
 
+def _audit_fields(srv, prefix: str, n_reqs: int = 128) -> dict:
+    """Mesh-audit-plane ledger per served scenario (ISSUE 16; fail-
+    soft by contract): the auditor's serving-path cost with the
+    background thread ON vs OFF, the violation count over the
+    scenario (must be 0 under clean load), and the fault-
+    explainability rate probed with one real chaos device fault —
+    injected AFTER the measurement windows so the headline numbers
+    never see it.
+
+    Overhead follows the PR 13 calibration doctrine (the forensics
+    smoke's template): windows sized to ≥250ms, 7 PAIRED on/off
+    windows with the within-pair order ALTERNATED (a fixed order
+    turns warming drift into systematic bias), gate read off the
+    lower-quartile (2nd-smallest) off/on ratio — a robust lower
+    bound on real cost that one or two noisy pairs cannot fail."""
+    try:
+        from istio_tpu.runtime import monitor
+        from istio_tpu.runtime.audit import INJECTIONS
+        from istio_tpu.runtime.resilience import CHAOS
+        from istio_tpu.testing import workloads
+
+        aud = getattr(srv, "audit", None)
+        if aud is None:
+            return {prefix + "audit_note": "audit plane disabled"}
+        base = monitor.audit_counters()
+        bags = workloads.make_bags(n_reqs)
+
+        srv.check_many(bags)   # warm (jit, memo paths)
+        t0 = time.perf_counter()
+        srv.check_many(bags)
+        per_call = max(time.perf_counter() - t0, 1e-4)
+        steps = max(4, int(0.25 / per_call))
+
+        def window() -> float:
+            t0 = time.perf_counter()
+            for _s in range(steps):
+                srv.check_many(bags)
+            return steps * len(bags) / (time.perf_counter() - t0)
+
+        ratios = []
+        try:
+            for i in range(7):
+                first_on = i % 2 == 0
+                if first_on:
+                    aud.start()
+                else:
+                    aud.stop()
+                a = window()
+                if first_on:
+                    aud.stop()
+                else:
+                    aud.start()
+                b = window()
+                on, off = (a, b) if first_on else (b, a)
+                ratios.append(off / on if on > 0 else 1.0)
+        finally:
+            aud.start()
+        low = sorted(ratios)[1]
+        overhead = (low - 1.0) / low * 100.0 if low > 0 else 0.0
+
+        # explainability probe: one injected device fault must come
+        # back matched (counter:fallback_total / breaker evidence);
+        # ledger reset scopes the rate to THIS scenario's injection
+        INJECTIONS.reset()
+        try:
+            CHAOS.device_failures = 1
+            srv.check_many(bags[:8])
+        finally:
+            CHAOS.reset()
+        time.sleep(0.1)
+        explain = aud.evaluate()["explainability"]
+
+        cnt = monitor.audit_counters()
+        violations = sum(cnt["violations"][inv]
+                         - base["violations"][inv]
+                         for inv in cnt["violations"])
+        return {
+            prefix + "audit_overhead_pct": round(overhead, 2),
+            prefix + "audit_overhead_ok": overhead <= 2.0,
+            prefix + "audit_violations": violations,
+            prefix + "audit_explainability_rate": explain["rate"],
+            prefix + "audit_evaluations":
+                cnt["evaluations"] - base["evaluations"],
+        }
+    except Exception as exc:
+        return {prefix + "audit_error":
+                f"{type(exc).__name__}: {exc}"}
+
+
 def main() -> None:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
@@ -2629,6 +2718,7 @@ def _served_bench(n_rules: int, on_tpu: bool) -> dict:
                                    "served_stage_decomposition"),
                                forens0),
                 **_forensics_overhead_fields(srv, "served_"),
+                **_audit_fields(srv, "served_"),
             }
         finally:
             g.stop()
@@ -2968,6 +3058,7 @@ def _served_native_bench(n_rules: int, on_tpu: bool) -> dict:
                                    "decomposition"),
                                native_forens0),
                 **_forensics_overhead_fields(srv, "served_native_"),
+                **_audit_fields(srv, "served_native_"),
             }
 
             # -- measured wire-to-verdict p99 (the tentpole number) --

@@ -76,7 +76,11 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         flight_recorder=not args.no_flight_recorder,
         slow_threshold_ms=args.slow_threshold_ms,
         slow_adaptive=args.slow_adaptive,
-        profile_dir=args.profile_dir))
+        profile_dir=args.profile_dir,
+        # mesh audit plane (runtime/audit.py): background invariant
+        # auditor + fault explainability; /debug/audit + /debug/slo
+        audit=not args.no_audit,
+        audit_interval_s=args.audit_interval_ms / 1e3))
     server = MixerGrpcServer(runtime, f"{args.address}:{args.port}")
     port = server.start()
     print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
@@ -922,6 +926,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory for /debug/profile jax.profiler "
                         "captures (default: MIXS_PROFILE_DIR env or "
                         "a per-capture tempdir)")
+    s.add_argument("--no-audit", action="store_true",
+                   help="disable the background mesh audit plane "
+                        "(invariant auditor + fault-explainability "
+                        "scorer; /debug/audit reports enabled=false)")
+    s.add_argument("--audit-interval-ms", type=float, default=500.0,
+                   help="audit evaluation cadence in ms (the quota "
+                        "recount samples every 8th evaluation)")
     s.add_argument("--check-grants", action="store_true",
                    help="server-issued check-cache grants: "
                         "valid_duration/valid_use_count derived from "

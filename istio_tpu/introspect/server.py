@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
@@ -62,6 +63,12 @@ class IntrospectServer:
         # pilot DiscoveryService whose debug_view() backs
         # /debug/discovery (None → {"enabled": false})
         self.discovery = discovery
+        # a runtime with a live audit plane folds the discovery scope
+        # program into its plane_agreement invariant — the introspect
+        # server is where the two planes first meet in one process
+        aud = getattr(runtime, "audit", None)
+        if aud is not None and discovery is not None:
+            aud.attach_discovery(discovery)
         self._ring = None
         # extra cache-stat providers: name -> zero-arg callable
         self._cache_stats: dict[str, Callable[[], Any]] = {}
@@ -143,6 +150,8 @@ class IntrospectServer:
         "/debug/discovery": "_h_discovery",
         "/debug/slow": "_h_slow",
         "/debug/events": "_h_events",
+        "/debug/audit": "_h_audit",
+        "/debug/slo": "_h_slo",
         "/debug/profile": "_h_profile",
         "/debug/threads": "_h_threads",
     }
@@ -850,8 +859,11 @@ class IntrospectServer:
         events (config publishes, canary verdicts, bank rebuilds,
         prewarm start/end per shape, breaker transitions, quota
         flushes, grant revocations, provider refreshes, chaos arms,
-        quiesce/shutdown). `?kind=X` filters, `?n=N` bounds (default
-        128). The same ring annotates /debug/slow exemplars."""
+        audit violations, quiesce/shutdown). `?kind=X` (alias
+        `?type=X`) filters by event kind, `?since_s=S` keeps only
+        events recorded within the last S seconds, `?n=N` bounds
+        (default 128). The same ring annotates /debug/slow
+        exemplars."""
         from istio_tpu.runtime import forensics, monitor
 
         q = self._query(req)
@@ -859,13 +871,56 @@ class IntrospectServer:
             n = int(q.get("n", 128) or 128)
         except ValueError:
             n = 128
-        events = forensics.EVENTS.snapshot(kind=q.get("kind"),
-                                           limit=n)
+        events = forensics.EVENTS.snapshot(
+            kind=q.get("kind") or q.get("type"), limit=n)
+        since_s = q.get("since_s")
+        if since_s is not None:
+            try:
+                horizon = time.time() - float(since_s)
+                events = [e for e in events if e["wall"] >= horizon]
+            except ValueError:
+                pass
         self._send_json(req, {
             "retained": len(forensics.EVENTS),
             "counters": monitor.forensics_counters(),
             "events": events,
         })
+
+    # -- mesh audit plane (runtime/audit.py) ---------------------------
+
+    def _h_audit(self, req: BaseHTTPRequestHandler) -> None:
+        """Live invariant auditor: the six mesh-wide AuditCheck
+        verdicts (report/check/quota conservation, grant coherence,
+        plane agreement, shard routing) with evidence and the
+        generation checked at, plus the fault-explainability scorer's
+        records and rate. `?refresh=1` forces a fresh evaluation
+        before serving (the background thread evaluates on its own
+        interval otherwise). Serves `{"enabled": false}` when no
+        audit plane is attached."""
+        aud = getattr(self.runtime, "audit", None)
+        if aud is None:
+            self._send_json(req, {"enabled": False})
+            return
+        q = self._query(req)
+        if q.get("refresh") or not aud.snapshot()["evaluations"]:
+            self._send_json(req, aud.evaluate())
+            return
+        self._send_json(req, aud.snapshot())
+
+    def _h_slo(self, req: BaseHTTPRequestHandler) -> None:
+        """One fused per-plane SLO scorecard: check wire p99 vs its
+        target, report export lag + in-flight ledger, discovery push
+        fan-out p99, quota flush age, and the audit plane's own
+        healthy/explainability verdicts. Each plane reports
+        ok / miss / no_data; `overall` is the worst verdict."""
+        from istio_tpu.runtime import forensics, monitor
+        from istio_tpu.runtime.slo import scorecard
+
+        aud = getattr(self.runtime, "audit", None)
+        self._send_json(req, scorecard(
+            monitor, forensics,
+            audit=aud.snapshot() if aud is not None else None,
+            discovery=self.discovery))
 
     def _h_profile(self, req: BaseHTTPRequestHandler) -> None:
         """On-demand device profiling: `?seconds=N` (default 1, max

@@ -312,6 +312,43 @@ class DeviceQuotaPool:
             fut.set(QuotaResult(granted_amount=0, status_code=14,
                                 status_message="quota pool closed"))
 
+    def audit_view(self) -> dict:
+        """Sampled counter-plane reading for the mesh audit plane
+        (runtime/audit.py quota_conservation). Copies the CURRENT
+        counter handle reference and host bookkeeping under the locks
+        (briefly, in the documented _counts_lock→_lock order), then
+        pulls OUTSIDE both: counter arrays are functional — every trip
+        swaps the pool onto a NEW handle rather than mutating this one
+        — so a blocked pull only ever delays the auditor, never the
+        serving path. Returns raw facts; the auditor judges. Cell
+        invariants that hold regardless of tick staleness: every cell
+        is >= 0, every cell is <= the pool's largest window max (each
+        alloc caps in-window usage at max, so no single slot can ever
+        accrue more), and cells beyond the allocated bucket range are
+        exactly 0. The exact used<=max recount runs against the HOST
+        memquota oracle (adapters/memquota._Window.used), which owns
+        window gc — raw device row sums may legitimately include
+        not-yet-reclaimed slots from expired ticks."""
+        with self._counts_lock:
+            with self._lock:
+                handle = self.counts
+                n_used = len(self._bucket_of)
+        arr = np.asarray(handle)
+        max_limit = max((l["max"] for l in self.limits.values()),
+                        default=0)
+        used = arr[:n_used] if n_used else arr[:0]
+        beyond = arr[n_used:]
+        return {
+            "n_buckets": self.n_buckets,
+            "n_used": n_used,
+            "max_limit": int(max_limit),
+            "negative_cells": int((arr < 0).sum()),
+            "max_cell": int(used.max()) if used.size else 0,
+            "over_cap_cells": int((used > max_limit).sum())
+            if used.size else 0,
+            "nonzero_beyond_keymap": int((beyond != 0).sum()),
+        }
+
     # -- internals ------------------------------------------------------
 
     def _prewarm(self) -> None:

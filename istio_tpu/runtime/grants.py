@@ -79,6 +79,12 @@ class GrantPolicy:
         self.generation = 0
         self._grants_issued = 0
         self._revocations = 0
+        # audit watermark: the policy generation current when the most
+        # recent grant was issued. Revoke-before-swap (PR 12) means a
+        # post-publish grant must always carry the post-publish
+        # generation — the audit plane checks issue watermark vs the
+        # publish count it observed (runtime/audit.py grant_coherence).
+        self._issued_at_generation = 0
 
     # -- publish side --------------------------------------------------
 
@@ -128,6 +134,7 @@ class GrantPolicy:
             changed = self._ns_change.get(ns, self._global_change)
             age = max(now - max(changed, self._global_change), 0.0)
             self._grants_issued += 1
+            self._issued_at_generation = self.generation
         return self._pair(age)
 
     def grants_for(self, ns_names) -> list[tuple[float, int]]:
@@ -141,7 +148,20 @@ class GrantPolicy:
                 age = max(now - max(changed, self._global_change), 0.0)
                 out.append(self._pair(age))
             self._grants_issued += len(out)
+            if out:
+                self._issued_at_generation = self.generation
         return out
+
+    def watermark(self) -> dict:
+        """Grant/generation coherence reading for the audit plane —
+        one lock round, no TTL math."""
+        with self._lock:
+            return {
+                "generation": self.generation,
+                "revocations": self._revocations,
+                "grants_issued": self._grants_issued,
+                "issued_at_generation": self._issued_at_generation,
+            }
 
     def stats(self) -> dict:
         """Introspect/bench view: params + live per-ns ages."""

@@ -973,6 +973,74 @@ def discovery_cache_counters(since: dict | None = None) -> dict:
     return out
 
 
+# -- mesh audit plane (runtime/audit.py) -------------------------------
+# Families for the background invariant auditor. Zero-shaped per the
+# promtext doctrine: every (invariant, status) series a dashboard can
+# alert on must exist BEFORE the first evaluation — "no audit data" and
+# "audit never ran" are different incidents.
+AUDIT_INVARIANTS = ("report_conservation", "check_accounting",
+                    "quota_conservation", "grant_coherence",
+                    "plane_agreement", "routing_conservation")
+AUDIT_STATUSES = ("ok", "degraded", "violated")
+FAULT_KINDS = ("wedge", "device", "oracle", "adapter")
+
+AUDIT_CHECKS = prometheus_client.Counter(
+    "mixer_audit_checks", "audit evaluations per invariant per verdict",
+    ["invariant", "status"], registry=REGISTRY)
+AUDIT_VIOLATIONS = prometheus_client.Counter(
+    "mixer_audit_violations",
+    "transitions of an invariant into the violated state",
+    ["invariant"], registry=REGISTRY)
+AUDIT_EVALUATIONS = prometheus_client.Counter(
+    "mixer_audit_evaluations", "full auditor passes", registry=REGISTRY)
+AUDIT_HEALTHY = prometheus_client.Gauge(
+    "mixer_audit_healthy",
+    "1 while no mesh invariant is in the violated state (the "
+    "/readyz-adjacent audit verdict)", registry=REGISTRY)
+FAULT_INJECTIONS = prometheus_client.Counter(
+    "mixer_fault_explainability_injections",
+    "chaos injections registered with the explainability scorer",
+    ["kind"], registry=REGISTRY)
+FAULT_MATCHED = prometheus_client.Counter(
+    "mixer_fault_explainability_matched",
+    "chaos injections matched to a forensics exemplar/event in window",
+    ["kind"], registry=REGISTRY)
+FAULT_EXPLAINABILITY = prometheus_client.Gauge(
+    "mixer_fault_explainability_rate",
+    "matched / (matched + expired-unmatched) chaos injections; "
+    "vacuously 1.0 with no injections", registry=REGISTRY)
+for _inv in AUDIT_INVARIANTS:
+    AUDIT_VIOLATIONS.labels(invariant=_inv)
+    for _st in AUDIT_STATUSES:
+        AUDIT_CHECKS.labels(invariant=_inv, status=_st)
+for _k in FAULT_KINDS:
+    FAULT_INJECTIONS.labels(kind=_k)
+    FAULT_MATCHED.labels(kind=_k)
+AUDIT_HEALTHY.set(1.0)
+FAULT_EXPLAINABILITY.set(1.0)
+
+
+def audit_counters() -> dict:
+    """One JSON-able reading of the audit + explainability families —
+    read by /debug/audit, the audit smoke and bench.py."""
+    checks = {inv: {st: int(AUDIT_CHECKS.labels(
+        invariant=inv, status=st)._value.get())
+        for st in AUDIT_STATUSES} for inv in AUDIT_INVARIANTS}
+    return {
+        "evaluations": int(AUDIT_EVALUATIONS._value.get()),
+        "healthy": bool(AUDIT_HEALTHY._value.get() >= 1.0),
+        "checks": checks,
+        "violations": {inv: int(AUDIT_VIOLATIONS.labels(
+            invariant=inv)._value.get()) for inv in AUDIT_INVARIANTS},
+        "explainability_rate": float(
+            FAULT_EXPLAINABILITY._value.get()),
+        "injections": {k: int(FAULT_INJECTIONS.labels(
+            kind=k)._value.get()) for k in FAULT_KINDS},
+        "matched": {k: int(FAULT_MATCHED.labels(
+            kind=k)._value.get()) for k in FAULT_KINDS},
+    }
+
+
 @contextlib.contextmanager
 def resolve_timer():
     RESOLVE_COUNT.inc()

@@ -217,6 +217,22 @@ class ChaosHooks:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.reset()
+        # injection observer (the audit plane's explainability scorer
+        # registers expected-signature records here). Assigned AFTER
+        # reset() and never touched by it: the scorer's registration
+        # must survive the chaos suite's per-scenario resets. Called
+        # OUTSIDE self._lock at each injection-commit point; must
+        # never raise. Zero cost while chaos is unarmed.
+        self.on_inject: Callable[..., None] | None = None
+
+    def _notify(self, kind: str, **detail) -> None:
+        cb = self.on_inject
+        if cb is None:
+            return
+        try:
+            cb(kind, **detail)
+        except Exception:
+            pass
 
     def reset(self) -> None:
         with getattr(self, "_lock", threading.Lock()):
@@ -257,6 +273,7 @@ class ChaosHooks:
         # smoke attributes a slow exemplar to the wedge that caused it
         from istio_tpu.runtime import forensics
         forensics.record_event("chaos_wedge", handler=handler)
+        self._notify("wedge", handler=handler)
 
     def unwedge_adapter(self, handler: str) -> None:
         with self._lock:
@@ -285,6 +302,7 @@ class ChaosHooks:
                 return
             self.adapter_failures[handler] = n - 1
             self.injected_adapter += 1
+        self._notify("adapter", handler=handler)
         raise RuntimeError(
             f"chaos: injected adapter failure ({handler})")
 
@@ -300,6 +318,7 @@ class ChaosHooks:
                 return
             self.device_failures -= 1
             self.injected_device += 1
+        self._notify("device")
         exc = self.device_exception
         raise exc() if exc is not None else \
             RuntimeError("chaos: injected device-step failure")
@@ -313,6 +332,7 @@ class ChaosHooks:
                 return
             self.oracle_failures -= 1
             self.injected_oracle += 1
+        self._notify("oracle")
         raise RuntimeError("chaos: injected oracle failure")
 
     def snapshot(self) -> dict:

@@ -238,6 +238,23 @@ class ServerArgs:
     # but never gate — the "this rule is SUPPOSED to change" hatch
     canary_waivers: tuple = ()
 
+    # -- mesh audit plane (runtime/audit.py) ---------------------------
+    # background invariant auditor: report/check/quota conservation,
+    # grant coherence, plane agreement, shard routing — plus the
+    # fault-explainability scorer. Strictly off the hot path (reads
+    # existing counters/ledgers on its own thread); violations emit
+    # audit_violation events, bump mixer_audit_* and flip the
+    # mixer_audit_healthy gauge. /debug/audit + /debug/slo serve it.
+    audit: bool = True
+    # evaluation cadence; the quota counter-plane recount samples
+    # every audit_quota_every-th evaluation (its pull is the one
+    # audit read that can touch the device transport)
+    audit_interval_s: float = 0.5
+    audit_quota_every: int = 8
+    # fault-explainability matching window: an injection unmatched to
+    # a forensics exemplar/event past this long counts unexplained
+    audit_explain_window_s: float = 10.0
+
 
 class RuntimeServer:
     def __init__(self, store: Store, args: ServerArgs | None = None):
@@ -494,6 +511,22 @@ class RuntimeServer:
             import logging
             logging.getLogger("istio_tpu.runtime.server").exception(
                 "initial in-step quota prewarm failed")
+        # mesh audit plane: background invariant auditor + fault
+        # explainability scorer (runtime/audit.py). Created LAST so
+        # every surface it reads (controller, batchers, grants,
+        # routers) already exists; reads snapshots only — nothing on
+        # the hot path learns it is being audited.
+        self.audit = None
+        if self.args.audit:
+            from istio_tpu.runtime.audit import (AuditPlane,
+                                                 install_chaos_observer)
+            install_chaos_observer()
+            self.audit = AuditPlane(
+                self,
+                interval_s=self.args.audit_interval_s,
+                explain_window_s=self.args.audit_explain_window_s,
+                quota_every=self.args.audit_quota_every)
+            self.audit.start()
 
     # -- API surface (grpcServer.go Check/Report semantics) --
     # Preprocessing (the APA phase) happens exactly ONCE per request, in
@@ -1413,6 +1446,10 @@ class RuntimeServer:
         from istio_tpu.runtime import forensics
         forensics.record_event("shutdown",
                                deadline_s=deadline)
+        # stop the audit thread first: a mid-teardown evaluation would
+        # read surfaces (batchers, pools) as they are being closed
+        if getattr(self, "audit", None) is not None:
+            self.audit.stop()
         # a still-running initial in-step prewarm must not race
         # interpreter/pool teardown (its dummy trips touch jax state):
         # flip the stop flag (polled between shapes), then reap.

@@ -1,0 +1,26 @@
+"""Tier-1 hook for scripts/audit_smoke.py: the CI gate that the mesh
+audit plane keeps auditing — six invariants silent under clean
+two-front (gRPC + native) load, every chaos fault class matched to
+named forensics evidence (explainability 1.0), and a deliberately
+corrupted conservation counter flips mixer_audit_healthy with the
+ledger evidence served on /debug/audit. Runs main() in-process (the
+chaos_smoke pattern: a subprocess would pay a second jax import for
+no extra coverage; the script stays runnable standalone under
+JAX_PLATFORMS=cpu)."""
+import importlib.util
+import os
+import sys
+
+
+def test_audit_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "audit_smoke.py")
+    spec = importlib.util.spec_from_file_location("audit_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(n_rules=30, n_checks=16)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0

@@ -232,3 +232,49 @@ def test_debug_slow_and_events_serve_without_runtime():
             assert th["n_threads"] >= 1
         finally:
             intro.close()
+
+
+def test_debug_events_type_and_since_filters():
+    """/debug/events ?type= (alias of ?kind=) and ?since_s= narrow
+    the timeline to one event class inside a recency window — the
+    incident-forensics query ("what audit violations in the last
+    minute") must not require client-side filtering."""
+    from istio_tpu.introspect import IntrospectServer
+
+    forensics.EVENTS.reset()
+    forensics.record_event("audit_violation", invariant="x")
+    forensics.record_event("config_publish", generation=9)
+    intro = IntrospectServer(runtime=None)
+    try:
+        port = intro.start()
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}",
+                    timeout=10) as r:
+                return json.load(r)
+
+        # ?type= behaves exactly like ?kind=
+        ev = get("/debug/events?type=audit_violation")
+        assert ev["events"]
+        assert all(e["kind"] == "audit_violation"
+                   for e in ev["events"])
+        # a generous window keeps both events; a zero-width one
+        # (since the future) drops everything
+        ev = get("/debug/events?since_s=60")
+        kinds = {e["kind"] for e in ev["events"]}
+        assert {"audit_violation", "config_publish"} <= kinds
+        ev = get("/debug/events?since_s=0")
+        assert ev["events"] == []
+        # both filters compose
+        ev = get("/debug/events?type=config_publish&since_s=60")
+        assert ev["events"]
+        assert all(e["kind"] == "config_publish"
+                   for e in ev["events"])
+        # a malformed since_s is ignored, not a 500
+        ev = get("/debug/events?since_s=bogus&type=config_publish")
+        assert all(e["kind"] == "config_publish"
+                   for e in ev["events"])
+    finally:
+        intro.close()
+        forensics.EVENTS.reset()

@@ -265,3 +265,41 @@ def test_sliding_window_quantiles():
     assert w.total == 200
     w.reset()
     assert len(w) == 0 and w.quantile(0.5) == 0.0
+
+
+def test_audit_families_zero_shaped_before_first_evaluation():
+    """The mesh-audit families (runtime/audit.py) are pre-shaped at
+    import: every invariant x status series of mixer_audit_checks,
+    every invariant of mixer_audit_violations, every fault kind of
+    the explainability counters — all present in the prometheus
+    exposition BEFORE the first evaluation, so a dashboard can tell
+    'auditor never ran' from 'scrape broken'. The gauges boot to
+    their healthy values (1.0), never unset."""
+    import prometheus_client
+
+    from istio_tpu.runtime import monitor
+
+    text = prometheus_client.generate_latest(
+        monitor.REGISTRY).decode()
+    for inv in monitor.AUDIT_INVARIANTS:
+        assert f'mixer_audit_violations_total{{invariant="{inv}"}} ' \
+            in text, inv
+        for st in monitor.AUDIT_STATUSES:
+            assert (f'mixer_audit_checks_total{{invariant="{inv}",'
+                    f'status="{st}"}} ') in text, (inv, st)
+    for kind in monitor.FAULT_KINDS:
+        assert ('mixer_fault_explainability_injections_total'
+                f'{{kind="{kind}"}} ') in text, kind
+        assert ('mixer_fault_explainability_matched_total'
+                f'{{kind="{kind}"}} ') in text, kind
+    # the gauges carry their boot values, not absence
+    assert "mixer_audit_healthy " in text
+    assert "mixer_fault_explainability_rate " in text
+    assert "mixer_audit_evaluations_total " in text
+    # a registry that has seen NO audit activity in this process
+    # would expose all-zero counters; with sibling suites running
+    # first we can only pin shape — but healthy/explainability must
+    # never read below their floor absent a real violation
+    counters = monitor.audit_counters()
+    assert set(counters["checks"]) == set(monitor.AUDIT_INVARIANTS)
+    assert 0.0 <= counters["explainability_rate"] <= 1.0
