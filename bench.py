@@ -557,6 +557,7 @@ def main() -> None:
     discovery = _discovery_bench(on_tpu)
     analysis = _analysis_bench(on_tpu)
     canary = _canary_bench(on_tpu)
+    soak = _soak_bench(on_tpu)
 
     baseline_cps = 1e9 / (PER_PREDICATE_NS * n_rules)
     out = {
@@ -656,6 +657,7 @@ def main() -> None:
     out.update(discovery)
     out.update(analysis)
     out.update(canary)
+    out.update(soak)
     print(json.dumps(out))
 
 
@@ -3306,6 +3308,56 @@ def _grpc_ceiling_fields() -> dict:
     except Exception as exc:
         return {"served_grpc_ceiling_error":
                 f"{type(exc).__name__}: {exc}"}
+
+
+def _soak_bench(on_tpu: bool) -> dict:
+    """Whole-mesh chaos soak at sustained scale (istio_tpu/soak/):
+    the tier-1 smoke's exact machinery with a longer storm, canary
+    gating on, and a bigger fleet — throughput sustained through the
+    storm, per-plane p99s over the soak window, the recovery bound,
+    and the gate verdicts. Headline fields follow the median-window
+    doctrine indirectly: the soak covers the whole storm, so its
+    percentiles are storm-inclusive by construction — the honest
+    worst-case companion to the clean-path served numbers."""
+    prefix = "soak_"
+    try:
+        from istio_tpu.soak.harness import SoakConfig, run_soak
+
+        cfg = SoakConfig(
+            seed=0,
+            storm_s=45.0 if on_tpu else 15.0,
+            n_rules=64 if on_tpu else 32,
+            n_sidecars_grpc=6 if on_tpu else 3,
+            n_sidecars_native=2 if on_tpu else 1,
+            n_services=24 if on_tpu else 12,
+            recovery_timeout_s=60.0,
+            canary=True, restart=True)
+        res = run_soak(cfg)
+        fields: dict = {
+            prefix + "seed": res["seed"],
+            prefix + "all_gates_ok": res["all_ok"],
+            prefix + "gates": {k: bool(v)
+                               for k, v in res["gates"].items()},
+            prefix + "throughput_rps": res["throughput_rps"],
+            prefix + "fleet_checks": res["fleet"]["checks"],
+            prefix + "fleet_outcomes": res["fleet"]["outcomes"],
+            prefix + "recovery_s":
+                res["metrics"]["soak_recovery_s"],
+            prefix + "explainability_rate":
+                res["metrics"]["soak_explainability_rate"],
+            prefix + "violations_after_recovery":
+                res["metrics"]["soak_violations_after_recovery"],
+            prefix + "fault_kinds":
+                res["metrics"]["soak_fault_kinds"],
+            prefix + "restart_wall_s": res["restart_wall_s"],
+        }
+        # per-plane p99s over the soak window (stage histograms
+        # deltaed against the storm-start baseline inside run_soak)
+        for stage, s in res["latency"].get("stages", {}).items():
+            fields[f"{prefix}p99_{stage}_ms"] = s["p99_ms"]
+        return fields
+    except Exception as exc:
+        return {prefix + "error": f"{type(exc).__name__}: {exc}"}
 
 
 if __name__ == "__main__":

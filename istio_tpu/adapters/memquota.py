@@ -145,8 +145,15 @@ class MemQuotaHandler(Handler):
 
     def handle_quota(self, template: str, instance: Mapping[str, Any],
                      args: QuotaArgs) -> QuotaResult:
-        now = self._clock()
         name = instance.get("name", "")
+        # quota-backend chaos seam (stall latency / injected failures,
+        # keyed by instance name) — sits BEFORE the backend lock so a
+        # stalled call exercises the executor lane's deadline path, not
+        # a lock convoy. Lazy import keeps the adapter importable
+        # standalone; the probe is two dict lookups when unarmed.
+        from istio_tpu.runtime.resilience import CHAOS
+        CHAOS.quota_call(name)
+        now = self._clock()
         lim = self._limits.get(name)
         if lim is None:
             return QuotaResult(granted_amount=0,

@@ -271,6 +271,13 @@ class DiscoveryService:
         entries, and wake only the watch shards whose namespaces
         changed. Returns the publish audit record."""
         with self._publish_lock:
+            # discovery-push chaos seam: an armed delay stalls the
+            # pipeline inside the publish lock (watchers stay parked on
+            # the old generation until the delayed push completes) and
+            # registers with the injection ledger. Lazy import keeps
+            # pilot importable without the runtime package.
+            from istio_tpu.runtime.resilience import CHAOS
+            CHAOS.discovery_publish()
             prev = self._snapshot
             t0 = time.perf_counter()
             snap = build_snapshot(self.registry, self.config_store,

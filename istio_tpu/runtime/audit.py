@@ -172,12 +172,20 @@ class InjectionLedger:
             rc = monitor.resilience_counters()
             return {"fallback_total": rc["fallback_total"],
                     "batch_failures_total": rc["batch_failures_total"]}
+        if kind == "discovery":
+            # note() fires INSIDE publish, before the generation bump —
+            # the baseline is the generation the delayed push started
+            # from; evidence is the generation advancing past it (the
+            # stalled push completed)
+            return {"generation":
+                    int(monitor.DISCOVERY_GENERATION.value())}
         hc = monitor.host_action_counters()
         out = hc.get("outcomes", {})
         return {"error": out.get("error", 0),
                 "overrun": out.get("overrun", 0),
                 "breaker_open": out.get("breaker_open", 0),
-                "expired": out.get("expired", 0)}
+                "expired": out.get("expired", 0),
+                "retries": hc.get("retries", 0)}
 
     # -- matching (runs on the audit thread) ---------------------------
 
@@ -192,13 +200,16 @@ class InjectionLedger:
         except Exception:
             exemplars = []
         rc = monitor.resilience_counters()
-        hc = monitor.host_action_counters().get("outcomes", {})
+        _hc_full = monitor.host_action_counters()
+        hc = dict(_hc_full.get("outcomes", {}))
+        hc["retries"] = _hc_full.get("retries", 0)
+        gen = int(monitor.DISCOVERY_GENERATION.value())
         with self._lock:
             for rec in self._records:
                 if rec["matched"] or rec["expired"]:
                     continue
                 matched_by = self._signature(rec, events, exemplars,
-                                             rc, hc)
+                                             rc, hc, gen)
                 if matched_by:
                     rec["matched"] = True
                     rec["matched_by"] = matched_by
@@ -224,7 +235,7 @@ class InjectionLedger:
 
     @staticmethod
     def _signature(rec: dict, events: list, exemplars: list,
-                   rc: dict, hc: dict) -> str:
+                   rc: dict, hc: dict, gen: int = 0) -> str:
         """The expected-signature match for one injection record —
         returns the evidence name, or '' while unexplained."""
         kind = rec["kind"]
@@ -268,6 +279,30 @@ class InjectionLedger:
             if rc["batch_failures_total"] > \
                     base.get("batch_failures_total", 0):
                 return "counter:batch_failures_total"
+            return ""
+        if kind == "quota":
+            # an injected backend failure rides the executor's mq lane
+            # and lands as a typed host-action error outcome; a single
+            # transient failure may instead be absorbed by the lane's
+            # one jittered retry (outcome ok, retries bumped), and
+            # under a storm the lane breaker may absorb the tail
+            if hc.get("error", 0) > base.get("error", 0):
+                return "counter:host_action error"
+            if hc.get("retries", 0) > base.get("retries", 0):
+                return "counter:host_action retries"
+            handler = rec["detail"].get("handler", "")
+            ev = event(("breaker",), name=f"host:{handler}")
+            if ev is not None:
+                return f"event:breaker host:{handler}"
+            for oc in ("overrun", "breaker_open", "expired"):
+                if hc.get(oc, 0) > base.get(oc, 0):
+                    return f"counter:host_action {oc}"
+            return ""
+        if kind == "discovery":
+            # the delayed publish completed: generation advanced past
+            # the mid-publish baseline
+            if gen > base.get("generation", 0):
+                return "counter:discovery_generation"
             return ""
         return ""
 

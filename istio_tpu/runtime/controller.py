@@ -119,6 +119,7 @@ class Controller:
         # old dispatcher keeps serving
         self.prewarm_hook = prewarm_hook
         self._prewarm_stop = False
+        self._closing = False
         self._prewarm_thread: threading.Thread | None = None
         # post-swap background warm (the shapes live traffic was NOT
         # serving pre-swap): stoppable per swap — a superseding swap
@@ -172,7 +173,15 @@ class Controller:
     ORPHAN_DRAIN_S = 2.0
 
     def rebuild(self) -> Dispatcher:
+        # a debounce Timer that fires into teardown must not start a
+        # rebuild: compiling a candidate plan while the interpreter /
+        # device stack is being torn down is the XLA abort the
+        # shutdown-reap regression test guards against
+        if self._closing:
+            return self._dispatcher
         with self._rebuild_serial:
+            if self._closing:
+                return self._dispatcher
             return self._rebuild_locked()
 
     def _rebuild_locked(self) -> Dispatcher:
@@ -212,7 +221,10 @@ class Controller:
                     swap_rest = [p for p in pairs
                                  if p not in set(first)]
                     plan.begin_warm()
-                    plan.warm_shapes(first, backoff=_serving_backoff)
+                    plan.warm_shapes(
+                        first,
+                        should_stop=lambda: self._prewarm_stop,
+                        backoff=_serving_backoff)
                     if self.prewarm_hook is not None:
                         # extra shapes the OWNER serves through this
                         # plan (RuntimeServer: the merged check+quota
@@ -369,17 +381,37 @@ class Controller:
         self._swap_warm_thread = t
         t.start()
 
-    def close(self) -> None:
+    def begin_close(self) -> None:
+        """Flag-only first phase of close(): stop admitting rebuilds,
+        cancel the debounce timer and flip every warm thread's stop
+        flag — NO joins. RuntimeServer.shutdown calls this FIRST so
+        in-flight warms start winding down while the fronts drain,
+        instead of discovering the stop flag only after the device
+        stack is half torn down."""
+        self._closing = True
+        self._prewarm_stop = True
         with self._lock:
             if self._timer is not None:
                 self._timer.cancel()
+        ev = self._swap_warm_stop
+        if ev is not None:
+            ev.set()
+
+    def close(self) -> None:
+        self.begin_close()
+        # reap any IN-FLIGHT rebuild (a debounce Timer that fired just
+        # before begin_close may still be compiling a candidate on its
+        # own thread): rebuild() holds _rebuild_serial for the whole
+        # publish, so acquiring it here is the join. New rebuilds are
+        # already refused by the _closing guard.
+        with self._rebuild_serial:
+            pass
         # stop + reap the initial prewarm: a daemon thread still inside
         # an XLA compile at interpreter exit aborts the process
         # ("terminate called without an active exception"). The join is
         # UNTIMED on purpose: the flag is polled between shapes, so the
         # thread exits after at most the in-flight compile — a timed
         # join that expires mid-compile re-opens the teardown abort.
-        self._prewarm_stop = True
         t = self._prewarm_thread
         if t is not None and t.is_alive():
             t.join()

@@ -982,7 +982,8 @@ AUDIT_INVARIANTS = ("report_conservation", "check_accounting",
                     "quota_conservation", "grant_coherence",
                     "plane_agreement", "routing_conservation")
 AUDIT_STATUSES = ("ok", "degraded", "violated")
-FAULT_KINDS = ("wedge", "device", "oracle", "adapter")
+FAULT_KINDS = ("wedge", "device", "oracle", "adapter", "quota",
+               "discovery")
 
 AUDIT_CHECKS = prometheus_client.Counter(
     "mixer_audit_checks", "audit evaluations per invariant per verdict",

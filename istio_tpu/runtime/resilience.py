@@ -263,6 +263,22 @@ class ChaosHooks:
                     ev.set()   # release stuck workers before dropping
             self._adapter_wedged: dict[str, threading.Event] = {}
             self.injected_adapter = 0
+            # -- quota-backend seams (memquota host lane, keyed by
+            #    instance name) — the soak's "quota-backend stall" -----
+            # sleep added to every handle_quota on this instance
+            self.quota_latency_s: dict[str, float] = {}
+            # fail the next N handle_quota calls on this instance
+            self.quota_failures: dict[str, int] = {}
+            self.injected_quota = 0
+            # -- discovery-plane seam: sleep inserted at the top of
+            #    DiscoveryService.publish (inside the publish lock, so
+            #    the delay is a REAL push-pipeline stall) --------------
+            self.discovery_push_delay_s = 0.0
+            self.injected_discovery = 0
+            # replay provenance: the seeded smokes stamp their --seed
+            # here after reset() so /debug/resilience names the seed
+            # any injected-fault run is replayable from
+            self.seed: int | None = None
 
     def wedge_adapter(self, handler: str) -> None:
         """Every subsequent call on `handler`'s lane blocks until
@@ -306,6 +322,42 @@ class ChaosHooks:
         raise RuntimeError(
             f"chaos: injected adapter failure ({handler})")
 
+    def quota_call(self, name: str) -> None:
+        """Called by MemQuotaHandler.handle_quota immediately before
+        the real cell allocation — the quota-backend seam (stall
+        latency + injected backend failures per instance name). Inert
+        fields cost two dict lookups per quota. Latency-only arms do
+        not notify the ledger (the device_latency_s precedent): a
+        stall is not a fault, just tail pressure."""
+        lat = self.quota_latency_s.get(name, 0.0)
+        if lat:
+            time.sleep(lat)
+        if self.quota_failures.get(name, 0) <= 0:
+            return
+        with self._lock:
+            n = self.quota_failures.get(name, 0)
+            if n <= 0:
+                return
+            self.quota_failures[name] = n - 1
+            self.injected_quota += 1
+        self._notify("quota", handler=name)
+        raise RuntimeError(
+            f"chaos: injected quota-backend failure ({name})")
+
+    def discovery_publish(self) -> None:
+        """Called at the top of DiscoveryService.publish, inside the
+        publish lock — an armed delay stalls the whole push pipeline
+        (watchers stay parked on the old generation). Each delayed
+        publish registers with the ledger; the expected evidence is
+        the generation still advancing (the delayed push completed)."""
+        lat = self.discovery_push_delay_s
+        if not lat:
+            return
+        time.sleep(lat)
+        with self._lock:
+            self.injected_discovery += 1
+        self._notify("discovery")
+
     def device_step(self) -> None:
         """Called immediately before a real check device step."""
         lat = self.device_latency_s
@@ -346,6 +398,12 @@ class ChaosHooks:
             "adapter_latency_s": dict(self.adapter_latency_s),
             "adapter_failures_pending": dict(self.adapter_failures),
             "injected_adapter": self.injected_adapter,
+            "quota_latency_s": dict(self.quota_latency_s),
+            "quota_failures_pending": dict(self.quota_failures),
+            "injected_quota": self.injected_quota,
+            "discovery_push_delay_s": self.discovery_push_delay_s,
+            "injected_discovery": self.injected_discovery,
+            "seed": self.seed,
         }
 
 

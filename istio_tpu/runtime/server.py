@@ -259,6 +259,10 @@ class ServerArgs:
 class RuntimeServer:
     def __init__(self, store: Store, args: ServerArgs | None = None):
         self.args = args or ServerArgs()
+        # flipped FIRST in shutdown(): every background warm this
+        # server starts (bank prewarm, in-step prewarm) polls it
+        # between shapes so no thread compiles into teardown
+        self._stopping = False
         # persistent XLA compilation cache (compiler/cache.py): wire
         # it BEFORE the first compile so the controller's initial
         # publish already reads/writes cached artifacts
@@ -882,6 +886,7 @@ class RuntimeServer:
         for b in distinct.values():
             b.dispatcher.fused.prewarm(
                 buckets,
+                should_stop=lambda: self._stopping,
                 backoff=None if first_build else _serving_backoff)
         for b in banks:   # staging-ring depth >= pipeline bound
             self._bound_staging_depth(b.dispatcher)
@@ -1446,6 +1451,15 @@ class RuntimeServer:
         from istio_tpu.runtime import forensics
         forensics.record_event("shutdown",
                                deadline_s=deadline)
+        # flip every background-warm stop flag FIRST (flag-only, no
+        # joins): bank prewarms poll _stopping between shapes, and
+        # begin_close() stops the controller admitting new rebuilds
+        # (a debounce Timer firing now becomes a no-op) and flips the
+        # warm threads' flags so they wind down while the fronts drain
+        self._stopping = True
+        ctrl = getattr(self, "controller", None)
+        if ctrl is not None:
+            ctrl.begin_close()
         # stop the audit thread first: a mid-teardown evaluation would
         # read surfaces (batchers, pools) as they are being closed
         if getattr(self, "audit", None) is not None:

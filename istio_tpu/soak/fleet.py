@@ -1,0 +1,289 @@
+"""Fleet-lifecycle simulator: N sidecars living the full client life.
+
+Each simulated sidecar owns a real MixerClient (check-cache optional),
+paces itself closed-loop, and classifies EVERY check it issues into a
+typed outcome — the client half of the conservation story. The server
+half is monitor.serving_counters(): every check that crossed the wire
+was decoded exactly once, every completed answer (ok or denied) was
+counted as a response, and every typed rejection is the difference.
+With the server up (no restart window) the identity is exact:
+
+    wire_checks   == requests_decoded Δ
+    ok + denied   == responses_sent Δ
+    shed + expired + unavailable + error == decoded Δ - responses Δ
+
+Across a mid-soak restart, transport-level failures (connection
+refused while the front is down) never reach the server, so the gate
+degrades to the honest inequality (gates.evaluate_gates).
+
+The discovery leg mirrors a sidecar's xDS loop: park on watch(),
+apply the new generation by pulling its own RDS config, and count a
+version that no longer serves the sidecar's own service as
+`misrouted` — the client-side reading of routing conservation.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Mapping, Sequence
+
+import grpc
+import numpy as np
+
+OUTCOMES = ("ok", "denied", "shed", "expired", "unavailable",
+            "misrouted", "error")
+
+_GRPC_OUTCOME = {
+    grpc.StatusCode.DEADLINE_EXCEEDED: "expired",
+    grpc.StatusCode.RESOURCE_EXHAUSTED: "shed",
+    grpc.StatusCode.UNAVAILABLE: "unavailable",
+}
+
+PERMISSION_DENIED = 7
+
+
+class SidecarLedger:
+    """Typed outcome ledger for one simulated sidecar. Every check the
+    sidecar issued lands in exactly one outcome bucket; wire_checks
+    counts the subset that actually crossed the wire (cache hits
+    answered locally)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.outcomes = {o: 0 for o in OUTCOMES}
+        self.checks = 0
+        self.cache_hits = 0
+        self.reports_ok = 0
+        self.reports_failed = 0
+        self.quota_granted = 0
+        self.quota_denied = 0
+        self.versions_applied = 0
+        self.watch_errors = 0
+
+    def count(self, outcome: str) -> None:
+        with self._lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+
+    @property
+    def wire_checks(self) -> int:
+        return self.checks - self.cache_hits
+
+    def totals(self) -> dict:
+        with self._lock:
+            return {
+                "outcomes": dict(self.outcomes),
+                "checks": self.checks,
+                "cache_hits": self.cache_hits,
+                "wire_checks": self.wire_checks,
+                "reports_ok": self.reports_ok,
+                "reports_failed": self.reports_failed,
+                "quota_granted": self.quota_granted,
+                "quota_denied": self.quota_denied,
+                "versions_applied": self.versions_applied,
+                "watch_errors": self.watch_errors,
+            }
+
+
+def _merge_totals(parts: Sequence[dict]) -> dict:
+    out: dict = {"outcomes": {o: 0 for o in OUTCOMES}}
+    for p in parts:
+        for o, v in p["outcomes"].items():
+            out["outcomes"][o] = out["outcomes"].get(o, 0) + v
+        for k, v in p.items():
+            if k == "outcomes":
+                continue
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def _node_identity(node: str) -> tuple[str, str]:
+    """(own host, namespace) from a workloads.make_discovery_world
+    node id `sidecar~ip~svc{i}-{r}.{ns}~domain`."""
+    inst = node.split("~")[2]
+    svc_inst, ns = inst.split(".", 1)
+    svc = svc_inst.rsplit("-", 1)[0]
+    return f"{svc}.{ns}.svc.cluster.local", ns
+
+
+class FleetSimulator:
+    """N sidecar threads against one target provider.
+
+    `target`: () -> "host:port", re-read every iteration — a mid-soak
+    restart just changes what it returns and the sidecars reconnect
+    (the old channel's failures land as typed `unavailable` outcomes,
+    exactly what a real sidecar sees through a control-plane bounce).
+
+    `discovery`/`nodes`/`ns_ports`: optional xDS leg — one watcher
+    thread per sidecar parks on DiscoveryService.watch and validates
+    each applied generation still serves the sidecar's own service.
+    """
+
+    def __init__(self, target: Callable[[], str],
+                 requests: Sequence[Mapping], *,
+                 n_sidecars: int = 4, seed: int = 0,
+                 pace_s: float = 0.002,
+                 quota_every: int = 0,
+                 quota_name: str = "rq.istio-system",
+                 report_every: int = 0,
+                 enable_check_cache: bool = True,
+                 discovery=None, nodes: Sequence[str] = (),
+                 ns_ports: Mapping[str, int] | None = None):
+        if not requests:
+            raise ValueError("fleet needs a non-empty request set")
+        self._target = target
+        self._requests = list(requests)
+        self.n_sidecars = int(n_sidecars)
+        self._seed = int(seed)
+        self._pace_s = float(pace_s)
+        self._quota_every = int(quota_every)
+        self._quota_name = quota_name
+        self._report_every = int(report_every)
+        self._cache = bool(enable_check_cache)
+        self._discovery = discovery
+        self._nodes = list(nodes)
+        self._ns_ports = dict(ns_ports or {})
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.ledgers = [SidecarLedger() for _ in range(self.n_sidecars)]
+
+    # -- sidecar lifecycle --------------------------------------------
+
+    def _client_for(self, led, cur, cur_target: str | None):
+        """Reconnect when the target moved (mid-soak restart): fold
+        the dying client's cache accounting into the ledger first —
+        cache-answered checks never crossed the wire and wire_checks
+        must say so."""
+        from istio_tpu.api.client import MixerClient
+        t = self._target()
+        if cur is not None and t == cur_target:
+            return cur, cur_target
+        if cur is not None:
+            led.cache_hits += cur.cache_stats["hits"]
+            try:
+                cur.close()
+            except Exception:
+                pass
+        return MixerClient(t, enable_check_cache=self._cache), t
+
+    def _sidecar(self, idx: int) -> None:
+        led = self.ledgers[idx]
+        rng = np.random.default_rng(self._seed * 1009 + idx)
+        order = rng.permutation(len(self._requests))
+        client = None
+        cur_target: str | None = None
+        pos = 0
+        try:
+            while not self._stop.is_set():
+                try:
+                    client, cur_target = self._client_for(
+                        led, client, cur_target)
+                except Exception:
+                    led.count("unavailable")
+                    time.sleep(0.05)
+                    continue
+                rq = self._requests[int(order[pos % len(order)])]
+                pos += 1
+                led.checks += 1
+                quotas = None
+                if self._quota_every and \
+                        pos % self._quota_every == 0:
+                    quotas = {self._quota_name: 1}
+                try:
+                    resp = client.check(rq, quotas=quotas)
+                except grpc.RpcError as exc:
+                    outcome = _GRPC_OUTCOME.get(exc.code(), "error")
+                    led.count(outcome)
+                    if outcome == "unavailable":
+                        # the front is down (restart window): back off
+                        # like a real sidecar instead of hammering the
+                        # dead port at full pace
+                        time.sleep(0.02)
+                except Exception:
+                    led.count("error")
+                else:
+                    code = resp.precondition.status.code
+                    led.count("ok" if code == 0 else
+                              "denied" if code == PERMISSION_DENIED
+                              else "error")
+                    if quotas and code == 0:
+                        qr = resp.quotas.get(self._quota_name)
+                        if qr is not None and qr.granted_amount > 0:
+                            led.quota_granted += 1
+                        else:
+                            led.quota_denied += 1
+                if self._report_every and \
+                        pos % self._report_every == 0:
+                    try:
+                        client.report([rq])
+                        led.reports_ok += 1
+                    except Exception:
+                        led.reports_failed += 1
+                if self._pace_s:
+                    time.sleep(self._pace_s)
+        finally:
+            if client is not None:
+                led.cache_hits += client.cache_stats["hits"]
+                try:
+                    client.close()
+                except Exception:
+                    pass
+
+    # -- discovery watcher leg ----------------------------------------
+
+    def _watcher(self, idx: int) -> None:
+        led = self.ledgers[idx]
+        node = self._nodes[idx % len(self._nodes)]
+        host, ns = _node_identity(node)
+        port = self._ns_ports.get(ns)
+        have = 0
+        while not self._stop.is_set():
+            try:
+                out = self._discovery.watch(node, have, timeout_s=0.25)
+            except Exception:
+                led.watch_errors += 1
+                time.sleep(0.05)
+                continue
+            if not out.get("changed"):
+                continue
+            have = max(have, int(out.get("shard_version", 0)),
+                       int(out.get("version", 0)))
+            led.versions_applied += 1
+            if port is None:
+                continue
+            # apply the generation: the sidecar's own RDS config must
+            # still route its service — a version that lost it is a
+            # misroute as the CLIENT experiences it
+            try:
+                raw = self._discovery.list_routes(str(port), "svc-mesh",
+                                                  node)
+            except Exception:
+                led.count("misrouted")
+                continue
+            if host.encode() not in raw:
+                led.count("misrouted")
+
+    # -- control ------------------------------------------------------
+
+    def start(self) -> "FleetSimulator":
+        for i in range(self.n_sidecars):
+            t = threading.Thread(target=self._sidecar, args=(i,),
+                                 daemon=True, name=f"soak-sidecar-{i}")
+            t.start()
+            self._threads.append(t)
+            if self._discovery is not None and self._nodes:
+                w = threading.Thread(target=self._watcher, args=(i,),
+                                     daemon=True,
+                                     name=f"soak-watch-{i}")
+                w.start()
+                self._threads.append(w)
+        return self
+
+    def stop(self, grace_s: float = 10.0) -> dict:
+        self._stop.set()
+        deadline = time.monotonic() + grace_s
+        for t in self._threads:
+            t.join(max(deadline - time.monotonic(), 0.1))
+        return self.totals()
+
+    def totals(self) -> dict:
+        return _merge_totals([led.totals() for led in self.ledgers])

@@ -1,0 +1,238 @@
+"""Seeded storm choreographer: a deterministic schedule of
+control-side events replayed against the live mesh.
+
+make_schedule(seed, ...) derives every event time/parameter from one
+np.random.default_rng(seed) stream — the replay contract: the printed
+seed line reproduces the exact injection schedule, byte for byte
+(schedule_signature() is what the tier-1 determinism test compares).
+
+The choreographer executes the schedule in typed phases
+(warmup → storm → recovery) against a duck-typed harness:
+
+    harness.churn(ns_index, tick)   discovery-plane one-namespace churn
+    harness.mixer_churn(tick)       mixer config bump → swap + grant
+                                    revocation
+    harness.poke_quota()            one host-path quota call (makes an
+                                    armed quota-backend failure land
+                                    deterministically)
+    harness.canary_poison() /       install / remove a deny-everything
+    harness.canary_heal()           rule (gate-mode canary vetoes it;
+                                    heal restores publishability)
+    harness.restart()               the mid-soak quiesce→restart cycle
+                                    (ordered shutdown, fresh server)
+    harness.wedged_handler          qualified handler name to wedge
+    harness.quota_name              quota instance the stall targets
+
+Chaos arms (wedge, latency, device/oracle faults, quota failures,
+discovery push delay) go straight through the process-wide CHAOS seam
+— every injected FAILURE registers in the InjectionLedger at its
+commit point, which is what the explainability gate scores.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Sequence
+
+import numpy as np
+
+log = logging.getLogger("istio_tpu.soak.storm")
+
+PHASES = ("warmup", "storm", "recovery")
+
+
+@dataclasses.dataclass(frozen=True)
+class StormEvent:
+    t: float            # seconds from storm-phase start
+    kind: str
+    params: tuple = ()  # sorted (key, value) pairs — hashable/stable
+
+    def sig(self) -> tuple:
+        return (round(self.t, 4), self.kind, self.params)
+
+
+def _ev(t: float, kind: str, **params) -> StormEvent:
+    return StormEvent(round(float(t), 4), kind,
+                      tuple(sorted((k, str(v))
+                                   for k, v in params.items())))
+
+
+def make_schedule(seed: int, storm_s: float, *,
+                  n_namespaces: int = 4,
+                  restart: bool = True,
+                  canary: bool = False) -> list[StormEvent]:
+    """The full storm, seeded. Windows are placed so every fault kind
+    lands inside the storm phase with room to clear before recovery:
+
+      * adapter wedge + unwedge (the bulkhead/overrun lever)
+      * adapter latency set + clear
+      * device-fault burst tripping the breaker into oracle fallback,
+        with the quota-backend failure armed INSIDE the outage window
+        (served quota rides the host memquota lane only while the
+        device pools are bypassed — the realistic coupling) plus one
+        deterministic host-path poke so the injection always commits
+      * discovery push delay armed around a churn (the delayed publish
+        is synchronous with the store event — deterministic evidence)
+      * namespace churn ticks (delta publishes) through the storm
+      * mixer config bumps (config swaps → grant revocation storm)
+      * optionally a canary poison/heal pair and the mid-soak restart
+    """
+    rng = np.random.default_rng(seed)
+    span = max(float(storm_s), 2.0)
+    ev: list[StormEvent] = []
+
+    # adapter wedge window, early in the storm
+    t0 = float(rng.uniform(0.05, 0.15)) * span
+    hold = float(rng.uniform(0.25, 0.5))
+    ev.append(_ev(t0, "wedge"))
+    ev.append(_ev(t0 + hold, "unwedge"))
+
+    # adapter latency window
+    t1 = float(rng.uniform(0.2, 0.3)) * span
+    ev.append(_ev(t1, "adapter_latency",
+                  s=round(float(rng.uniform(0.01, 0.03)), 4)))
+    ev.append(_ev(t1 + float(rng.uniform(0.4, 0.8)),
+                  "adapter_latency_clear"))
+
+    # device outage window; quota-backend failures armed inside it
+    t2 = float(rng.uniform(0.35, 0.5)) * span
+    ev.append(_ev(t2, "device_faults", n=int(rng.integers(4, 9))))
+    ev.append(_ev(t2 + 0.1, "quota_faults",
+                  n=int(rng.integers(2, 5))))
+    ev.append(_ev(t2 + 0.15, "poke_quota"))
+
+    # discovery push delay armed around its own churn
+    t3 = float(rng.uniform(0.55, 0.65)) * span
+    ev.append(_ev(t3, "discovery_delay",
+                  s=round(float(rng.uniform(0.05, 0.12)), 4),
+                  ns=int(rng.integers(n_namespaces))))
+
+    # churn ticks through the whole storm
+    for k in range(4 + int(rng.integers(4))):
+        ev.append(_ev(float(rng.uniform(0.05, 0.9)) * span, "churn",
+                      ns=int(rng.integers(n_namespaces)), tick=k))
+
+    # mixer config bumps: swaps under load → grant revocations
+    for k in range(2 + int(rng.integers(3))):
+        ev.append(_ev(float(rng.uniform(0.1, 0.85)) * span,
+                      "mixer_churn", tick=k))
+
+    if canary:
+        t4 = float(rng.uniform(0.15, 0.25)) * span
+        ev.append(_ev(t4, "canary_poison"))
+        ev.append(_ev(t4 + 0.5, "canary_heal"))
+
+    if restart:
+        # fixed mid-storm placement: the restart must land with chaos
+        # windows on both sides, not wander to an edge
+        ev.append(_ev(0.7 * span, "restart"))
+
+    ev.sort(key=lambda e: (e.t, e.kind))
+    return ev
+
+
+def schedule_signature(schedule: Sequence[StormEvent]) -> tuple:
+    return tuple(e.sig() for e in schedule)
+
+
+def clear_chaos() -> None:
+    """Targeted storm-end cleanup: release every armed seam WITHOUT
+    CHAOS.reset() (reset would also drop the seed stamp and injected-
+    counter provenance mid-run)."""
+    from istio_tpu.runtime.resilience import CHAOS
+    for h in list(CHAOS._adapter_wedged):
+        CHAOS.unwedge_adapter(h)
+    CHAOS.adapter_latency_s.clear()
+    CHAOS.adapter_failures.clear()
+    CHAOS.quota_latency_s.clear()
+    CHAOS.quota_failures.clear()
+    CHAOS.device_failures = 0
+    CHAOS.device_latency_s = 0.0
+    CHAOS.oracle_failures = 0
+    CHAOS.discovery_push_delay_s = 0.0
+
+
+class StormChoreographer:
+    """Executes a schedule against the harness on its own thread; the
+    caller drives the phase boundaries (run() blocks through all
+    three). The executed-event log is for operators — determinism is
+    asserted on the SCHEDULE, which is pure f(seed)."""
+
+    def __init__(self, harness, schedule: Sequence[StormEvent],
+                 *, warmup_s: float = 1.0, storm_s: float = 6.0):
+        self.harness = harness
+        self.schedule = list(schedule)
+        self.warmup_s = float(warmup_s)
+        self.storm_s = float(storm_s)
+        self.log: list[dict] = []
+        self.phase = "idle"
+
+    def _note(self, ev: StormEvent) -> None:
+        self.log.append({"phase": self.phase, "t": ev.t,
+                         "kind": ev.kind, "params": dict(ev.params)})
+
+    def _execute(self, ev: StormEvent) -> None:
+        from istio_tpu.runtime.resilience import CHAOS
+        h = self.harness
+        p = dict(ev.params)
+        kind = ev.kind
+        try:
+            if kind == "wedge":
+                CHAOS.wedge_adapter(h.wedged_handler)
+            elif kind == "unwedge":
+                CHAOS.unwedge_adapter(h.wedged_handler)
+            elif kind == "adapter_latency":
+                CHAOS.adapter_latency_s[h.wedged_handler] = \
+                    float(p["s"])
+            elif kind == "adapter_latency_clear":
+                CHAOS.adapter_latency_s.clear()
+            elif kind == "device_faults":
+                CHAOS.device_failures = int(p["n"])
+            elif kind == "quota_faults":
+                CHAOS.quota_failures[h.quota_name] = int(p["n"])
+            elif kind == "poke_quota":
+                h.poke_quota()
+            elif kind == "discovery_delay":
+                CHAOS.discovery_push_delay_s = float(p["s"])
+                try:
+                    # the armed delay needs a publish to stall: drive
+                    # one churn synchronously while armed
+                    h.churn(int(p["ns"]), tick=997)
+                finally:
+                    CHAOS.discovery_push_delay_s = 0.0
+            elif kind == "churn":
+                h.churn(int(p["ns"]), tick=int(p["tick"]))
+            elif kind == "mixer_churn":
+                h.mixer_churn(int(p["tick"]))
+            elif kind == "canary_poison":
+                h.canary_poison()
+            elif kind == "canary_heal":
+                h.canary_heal()
+            elif kind == "restart":
+                h.restart()
+            else:
+                log.warning("unknown storm event kind %r", kind)
+        except Exception:
+            log.exception("storm event %s failed", kind)
+        self._note(ev)
+
+    def run(self) -> list[dict]:
+        self.phase = "warmup"
+        time.sleep(self.warmup_s)
+        self.phase = "storm"
+        t0 = time.monotonic()
+        for ev in self.schedule:
+            delay = ev.t - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            self._execute(ev)
+        # hold the storm open to its nominal span (events may cluster
+        # early), then clear every armed seam
+        tail = self.storm_s - (time.monotonic() - t0)
+        if tail > 0:
+            time.sleep(tail)
+        self.phase = "recovery"
+        clear_chaos()
+        return self.log

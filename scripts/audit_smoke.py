@@ -64,7 +64,8 @@ def _check(snap: dict, name: str) -> dict:
     return next(c for c in snap["checks"] if c["name"] == name)
 
 
-def main(n_rules: int = 40, n_checks: int = 24) -> int:
+def main(n_rules: int = 40, n_checks: int = 24,
+         seed: int | None = None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from istio_tpu.api.client import MixerClient
     from istio_tpu.api.grpc_server import MixerGrpcServer
@@ -81,8 +82,15 @@ def main(n_rules: int = 40, n_checks: int = 24) -> int:
     CHAOS.reset()
     INJECTIONS.reset()
     SEAMS.reset()
+    if seed is not None:
+        # same seed/replay contract as chaos_smoke and soak_smoke:
+        # the printed line reproduces the failing corpus exactly
+        CHAOS.seed = seed
+        print(f"audit seed: {seed} (replay: JAX_PLATFORMS=cpu "
+              f"python scripts/audit_smoke.py --seed {seed})")
     n_services = max(n_rules // 2, 1)
-    store = workloads.make_store(n_rules, host_overlay_every=5)
+    store = workloads.make_store(n_rules, host_overlay_every=5,
+                                 seed=seed)
     srv = RuntimeServer(store, ServerArgs(
         batch_window_s=0.0005, max_batch=16, buckets=(8, 16),
         default_check_deadline_ms=DEADLINE_MS,
@@ -109,7 +117,8 @@ def main(n_rules: int = 40, n_checks: int = 24) -> int:
 
         # ---- 1. clean traffic over both fronts: silence ------------
         base_counters = monitor.audit_counters()
-        reqs = workloads.make_request_dicts(n_checks)
+        reqs = workloads.make_request_dicts(
+            n_checks, seed=1 if seed is None else seed)
         for i, rq in enumerate(reqs):
             (gclient if i % 2 else nclient).check(rq)
         gclient.report(reqs[: n_checks // 2])
@@ -265,5 +274,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--rules", type=int, default=40)
     ap.add_argument("--checks", type=int, default=24)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="reproducible corpus seed (rules + bags)")
     a = ap.parse_args()
-    raise SystemExit(main(n_rules=a.rules, n_checks=a.checks))
+    raise SystemExit(main(n_rules=a.rules, n_checks=a.checks,
+                          seed=a.seed))

@@ -73,6 +73,12 @@ def main(n_rules: int = 24, n_checks: int = 40,
 
     failures: list[str] = []
     CHAOS.reset()
+    if seed is not None:
+        # replay provenance: the seed rides the chaos seam snapshot so
+        # a failure's artifacts name the exact corpus that produced it
+        CHAOS.seed = seed
+        print(f"chaos seed: {seed} (replay: JAX_PLATFORMS=cpu "
+              f"python scripts/chaos_smoke.py --seed {seed})")
     store = workloads.make_store(n_rules, seed=seed)
     srv = RuntimeServer(store, ServerArgs(
         batch_window_s=0.0005, max_batch=16, buckets=(8, 16),

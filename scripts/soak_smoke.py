@@ -1,0 +1,95 @@
+"""Tier-1 gate for the whole-mesh chaos soak (istio_tpu/soak/) — the
+CI proof that the mesh survives a seeded storm and recovers to
+all-gates-green.
+
+A FleetSimulator runs simulated sidecars through BOTH real fronts
+(gRPC + native) with client check-caches, quota traffic and the xDS
+watch loop, while a seeded StormChoreographer replays control-side
+chaos against the live server: adapter wedge + latency, a device-fault
+burst into oracle fallback with a quota-backend stall armed inside the
+outage, a delayed discovery publish, namespace churn, mixer config
+swaps (grant revocation storms), and a mid-soak quiesce→restart under
+live traffic. FAILS (nonzero exit) unless every recovery gate passes:
+exact report conservation across the restart, audit all-ok within the
+bound, fault-explainability rate 1.0 with >= 3 distinct injected
+kinds matched, zero stale-generation grants, discovery↔mixer plane
+agreement, and the client-ledger ↔ mixer_* accounting identity.
+
+The storm schedule is pure f(seed): a failure replays exactly from
+the printed seed line. Runnable under JAX_PLATFORMS=cpu; tier-1
+invokes main() in-process (tests/test_soak_smoke.py).
+
+Usage: JAX_PLATFORMS=cpu python scripts/soak_smoke.py [--seed N]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(seed: int = 0, storm_s: float = 6.0,
+         result_sink: dict | None = None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from istio_tpu.runtime.audit import INJECTIONS, SEAMS
+    from istio_tpu.runtime.resilience import CHAOS
+    from istio_tpu.soak.harness import SoakConfig, run_soak
+    from istio_tpu.utils import tracing
+
+    print(f"soak seed: {seed} (replay: JAX_PLATFORMS=cpu "
+          f"python scripts/soak_smoke.py --seed {seed})")
+    failures: list[str] = []
+    out = None
+    try:
+        out = run_soak(SoakConfig(seed=seed, storm_s=storm_s))
+        if result_sink is not None:
+            result_sink.update(out)
+        for name, ok in out["gates"].items():
+            if not ok:
+                failures.append(f"gate {name} failed: "
+                                f"{out['detail'].get(name, '')}")
+        m = out["metrics"]
+        kinds = m["soak_fault_kinds"]
+        if len(kinds) < 3:
+            failures.append(f"fewer than 3 fault kinds explained: "
+                            f"{kinds}")
+        if out["restarts"] != 1:
+            failures.append(f"expected exactly one mid-soak restart, "
+                            f"got {out['restarts']}")
+        if failures and out is not None:
+            print("soak detail:", out["detail"])
+    except Exception as exc:     # noqa: BLE001 — smoke must report
+        failures.append(f"soak raised: {type(exc).__name__}: {exc}")
+        import traceback
+        traceback.print_exc()
+    finally:
+        SEAMS.reset()
+        INJECTIONS.reset()
+        CHAOS.reset()
+        tracing.shutdown()
+
+    if failures:
+        print("soak smoke FAILURES:")
+        for f in failures:
+            print(" -", f)
+        return 1
+    m = out["metrics"]
+    print(f"soak smoke ok: fleet {out['fleet']['checks']} checks "
+          f"({out['throughput_rps']} rps) through a seeded storm "
+          f"(kinds: {','.join(m['soak_fault_kinds'])}), "
+          f"restart survived with exact conservation, recovered in "
+          f"{m['soak_recovery_s']}s, explainability "
+          f"{m['soak_explainability_rate']}, violations after "
+          f"recovery {m['soak_violations_after_recovery']}")
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--storm-s", type=float, default=6.0)
+    a = ap.parse_args()
+    raise SystemExit(main(seed=a.seed, storm_s=a.storm_s))

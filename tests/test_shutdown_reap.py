@@ -1,0 +1,65 @@
+"""Regression for the start/stop-cycle teardown race: background
+prewarm/warm threads must be stop-flag-checked and REAPED before
+shutdown returns, and a post-close store event must not resurrect a
+rebuild. The original failure mode was a background prewarm thread
+racing interpreter/device teardown (flaky XLA segfault at process
+exit under repeated server cycles)."""
+import threading
+import time
+
+from istio_tpu.runtime import RuntimeServer, ServerArgs
+from istio_tpu.testing import workloads
+
+PREWARM_NAMES = ("prewarm-initial", "prewarm-swap")
+
+
+def _prewarm_threads():
+    return [t for t in threading.enumerate()
+            if t.is_alive() and t.name in PREWARM_NAMES]
+
+
+def test_cycle_reaps_prewarm_threads():
+    """Three build→churn→shutdown cycles: after every shutdown no
+    prewarm/warm thread may still be alive."""
+    for cycle in range(3):
+        store = workloads.make_store(12, seed=cycle)
+        srv = RuntimeServer(store, ServerArgs(
+            batch_window_s=0.0005, max_batch=8, buckets=(8,),
+            audit=False, default_manifest=workloads.MESH_MANIFEST))
+        try:
+            # kick the debounced rebuild path so a swap-warm thread
+            # actually exists when shutdown lands
+            key = ("rule", "istio-system", "report-all")
+            spec = store.get(key)
+            if spec is not None:
+                store.set(key, dict(spec))
+            time.sleep(0.08)
+        finally:
+            srv.shutdown(deadline=5.0)
+            srv.close()
+        leftover = _prewarm_threads()
+        assert not leftover, (
+            f"cycle {cycle}: prewarm threads survived shutdown: "
+            f"{[t.name for t in leftover]}")
+
+
+def test_post_close_store_event_does_not_rebuild():
+    """A store mutation after close() must be a no-op: the controller
+    refuses rebuilds once closing (the _closing guard), so no fresh
+    dispatcher generation appears."""
+    store = workloads.make_store(12, seed=7)
+    srv = RuntimeServer(store, ServerArgs(
+        batch_window_s=0.0005, max_batch=8, buckets=(8,),
+        audit=False, default_manifest=workloads.MESH_MANIFEST))
+    ctrl = srv.controller
+    srv.shutdown(deadline=5.0)
+    srv.close()
+    gen_before = ctrl.dispatcher
+    key = ("rule", "istio-system", "report-all")
+    spec = store.get(key)
+    assert spec is not None
+    store.set(key, dict(spec))
+    time.sleep(0.3)     # > debounce_s: a live controller would rebuild
+    assert ctrl.dispatcher is gen_before, \
+        "store event after close still rebuilt the dispatcher"
+    assert not _prewarm_threads()
