@@ -1477,6 +1477,21 @@ def _analysis_bench(on_tpu: bool) -> dict:
         out["analysis_faults_ms"] = round(
             (time.perf_counter() - t0) * 1e3, 2)
         out["analysis_faults_detected"] = f"{detected}/{len(cases)}"
+
+        # scenario 4: meshlint — the repo-wide concurrency/discipline
+        # analyzer runs as a CI gate over the package itself; its
+        # wall-time and finding count ride the same artifact so a
+        # call-graph blow-up names itself here, not in a stuck CI job
+        try:
+            from istio_tpu.analysis.meshlint import run_meshlint
+            t0 = time.perf_counter()
+            mrep = run_meshlint(
+                root=os.path.dirname(os.path.abspath(__file__)))
+            out["meshlint_wall_s"] = round(
+                time.perf_counter() - t0, 3)
+            out["meshlint_findings"] = len(mrep.findings)
+        except Exception as exc:
+            out["meshlint_error"] = f"{type(exc).__name__}: {exc}"
         return out
     except Exception as exc:   # bench sections never sink the artifact
         return {"analysis_error": f"{type(exc).__name__}: {exc}"}

@@ -1,0 +1,70 @@
+"""meshlint — repo-wide concurrency & discipline analyzer.
+
+Four passes over one shared AST/call-graph universe
+(`callgraph.Universe`), each encoding a doctrine previous PRs
+enforced by review:
+
+  * `lockorder`   — static lock-acquisition graph vs the declared
+                    partial order + leaf-lock manifest;
+  * `hotpath`     — host-sync/blocking discipline over INFERRED
+                    reachability from the hot entry points (replaces
+                    scripts/hotpath_lint.py's hand-kept list);
+  * `metricspass` — every metric use resolves to a registered,
+                    zero-shaped family;
+  * `rejections`  — nothing untyped escapes a front boundary.
+
+Entry points: `run_meshlint(root)` for the real tree, or
+`run_meshlint(sources={...})` for in-memory corpora (fixtures,
+tests). `mixs lint` and scripts/meshlint.py are thin callers."""
+from __future__ import annotations
+
+import time
+
+from istio_tpu.analysis.meshlint import (callgraph, hotpath, lockorder,
+                                         metricspass, model, rejections)
+from istio_tpu.analysis.meshlint.model import (LintFinding,
+                                               MeshlintReport)
+
+__all__ = ["run_meshlint", "LintFinding", "MeshlintReport",
+           "callgraph", "lockorder", "hotpath", "metricspass",
+           "rejections", "model"]
+
+
+def run_meshlint(root: str | None = None,
+                 sources: dict[str, str] | None = None,
+                 passes: tuple[str, ...] = ("lock", "hotpath",
+                                            "metrics", "rejections"),
+                 hot_roots: tuple[str, ...] | None = None,
+                 boundaries: tuple[tuple[str, str], ...] | None = None,
+                 ) -> MeshlintReport:
+    """Run the configured passes and return one report.
+
+    Exactly one of `root` (directory holding the istio_tpu package)
+    or `sources` ({dotted module name: source text}) must be given.
+    `hot_roots` / `boundaries` override the manifests — fixtures use
+    this to point the passes at synthetic modules."""
+    t0 = time.monotonic()
+    if sources is not None:
+        u = callgraph.Universe.from_sources(sources)
+    elif root is not None:
+        u = callgraph.Universe.from_root(root)
+    else:
+        raise ValueError("run_meshlint needs root= or sources=")
+    report = MeshlintReport(n_modules=len(u.modules),
+                            n_functions=len(u.functions))
+    if "lock" in passes:
+        lockorder.run(u, report)
+    if "hotpath" in passes:
+        hotpath.run(u, report,
+                    roots=hot_roots if hot_roots is not None
+                    else hotpath.HOT_ROOTS)
+    if "metrics" in passes:
+        metricspass.run(u, report)
+    if "rejections" in passes:
+        rejections.run(u, report,
+                       boundaries=boundaries if boundaries is not None
+                       else rejections.FRONT_BOUNDARIES)
+    report.findings.sort(key=lambda f: (-int(f.severity), f.path,
+                                        f.line, f.code))
+    report.wall_ms = (time.monotonic() - t0) * 1000.0
+    return report

@@ -1,0 +1,521 @@
+"""Shared AST universe + intraprocedural call graph for meshlint.
+
+One parse of the package feeds all four passes: functions indexed by
+qualified name, classes with resolved bases and inferred attribute
+types, and a best-effort call-resolution oracle. Resolution is
+deliberately CONSERVATIVE — a call that cannot be attributed to a
+scanned function is simply not traversed (never guessed by method
+name), and the load-bearing dynamic seams (constructor-injected
+callbacks like the batcher's `run_batch`) are modeled as DECLARED
+edges in the pass manifests, where they are reviewable data rather
+than resolver magic.
+
+What the resolver does understand:
+  * bare names — module functions, `from x import f` symbols, local
+    `f = Foo` class aliases (constructor call → `Foo.__init__`);
+  * `self.method()` / `cls.method()` / `super().method()` through the
+    scanned base-class chain;
+  * `self.attr.method()` / `local.method()` where the attr/local's
+    class was inferred from `self.attr = Foo(...)`, an annotated
+    assignment, a constructor parameter annotation, or a dataclass
+    field annotation;
+  * `module.func()` / `module.Class(...)` through the import map.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Mapping
+
+# the scanned sub-packages (repo-relative, under the package root) the
+# passes run over by default; native/ is its python half (the C++ side
+# has its own discipline), soak/ is the composition plane
+DEFAULT_PACKAGES = (
+    "istio_tpu/runtime", "istio_tpu/sharding", "istio_tpu/native",
+    "istio_tpu/soak", "istio_tpu/canary", "istio_tpu/pilot",
+    "istio_tpu/api", "istio_tpu/introspect", "istio_tpu/adapters",
+    "istio_tpu/utils",
+)
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    fqn: str                     # "istio_tpu.runtime.batcher:CheckBatcher.submit"
+    module: str                  # dotted module name
+    path: str                    # repo-relative file path
+    qual: str                    # "CheckBatcher.submit" / "helper"
+    node: ast.AST                # FunctionDef | AsyncFunctionDef
+    cls: str | None              # owning class fqn ("module:Class") or None
+
+    @property
+    def name(self) -> str:
+        return self.qual.rsplit(".", 1)[-1]
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    fqn: str                     # "module:Class"
+    module: str
+    name: str
+    bases: list[str]             # resolved class fqns (scanned only)
+    methods: dict = dataclasses.field(default_factory=dict)  # name → fqn
+    attr_types: dict = dataclasses.field(default_factory=dict)  # attr → class fqn
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str                    # dotted
+    path: str                    # repo-relative
+    tree: ast.Module
+    lines: list[str]
+    # alias → dotted module ("np" → "numpy"); symbol alias → (module, name)
+    mod_imports: dict = dataclasses.field(default_factory=dict)
+    sym_imports: dict = dataclasses.field(default_factory=dict)
+    functions: dict = dataclasses.field(default_factory=dict)  # qual → fqn
+    classes: dict = dataclasses.field(default_factory=dict)    # name → fqn
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    """Attribute/Name chain → ('self', '_lock') / ('np', 'asarray')."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "super":
+        parts.append("super()")
+        return tuple(reversed(parts))
+    return None
+
+
+class Universe:
+    """Parsed modules + indexes. Build from a directory tree
+    (`Universe.from_root`) or from in-memory sources
+    (`Universe.from_sources`) — fixtures and unit tests use the
+    latter, so every pass is testable without touching disk."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+
+    # -- construction -------------------------------------------------
+
+    @classmethod
+    def from_root(cls, root: str,
+                  packages: Iterable[str] = DEFAULT_PACKAGES) -> "Universe":
+        u = cls()
+        for pkg in packages:
+            base = os.path.join(root, pkg)
+            if not os.path.isdir(base):
+                continue
+            for dirpath, _dirnames, filenames in os.walk(base):
+                for fn in sorted(filenames):
+                    if not fn.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, fn)
+                    rel = os.path.relpath(path, root)
+                    mod = rel[:-3].replace(os.sep, ".")
+                    if mod.endswith(".__init__"):
+                        mod = mod[:-len(".__init__")]
+                    with open(path, encoding="utf-8") as f:
+                        u._add_module(mod, rel, f.read())
+        u._link()
+        return u
+
+    @classmethod
+    def from_sources(cls, sources: Mapping[str, str]) -> "Universe":
+        """sources: dotted module name → source text."""
+        u = cls()
+        for mod, src in sources.items():
+            rel = mod.replace(".", os.sep) + ".py"
+            u._add_module(mod, rel, src)
+        u._link()
+        return u
+
+    def _add_module(self, mod: str, rel: str, source: str) -> None:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return
+        mi = ModuleInfo(name=mod, path=rel, tree=tree,
+                        lines=source.splitlines())
+        self.modules[mod] = mi
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.mod_imports[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+                    if a.asname:
+                        mi.mod_imports[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                base = node.module
+                if node.level:      # relative import: resolve in-package
+                    parts = mod.split(".")
+                    base = ".".join(parts[:len(parts) - node.level]
+                                    ) + ("." + node.module
+                                         if node.module else "")
+                for a in node.names:
+                    mi.sym_imports[a.asname or a.name] = (base, a.name)
+        # function imports INSIDE functions matter too (the runtime
+        # defers imports to dodge cycles) — collect them module-wide
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and not node.level:
+                for a in node.names:
+                    mi.sym_imports.setdefault(a.asname or a.name,
+                                              (node.module, a.name))
+        self._index_scope(mi, tree, prefix="", cls=None)
+
+    def _index_scope(self, mi: ModuleInfo, node: ast.AST, prefix: str,
+                     cls: str | None) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                cfqn = f"{mi.name}:{prefix}{child.name}"
+                self.classes[cfqn] = ClassInfo(
+                    fqn=cfqn, module=mi.name,
+                    name=f"{prefix}{child.name}",
+                    bases=[b for b in (self._base_name(x)
+                                       for x in child.bases) if b])
+                if not prefix:
+                    mi.classes[child.name] = cfqn
+                self._index_scope(mi, child, f"{prefix}{child.name}.",
+                                  cls=cfqn)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                fqn = f"{mi.name}:{qual}"
+                self.functions[fqn] = FunctionInfo(
+                    fqn=fqn, module=mi.name, path=mi.path, qual=qual,
+                    node=child, cls=cls)
+                mi.functions[qual] = fqn
+                if cls is not None and cls in self.classes:
+                    self.classes[cls].methods[child.name] = fqn
+                # nested defs/classes (stdlib HTTP Handler classes live
+                # inside factory methods) — index them too
+                self._index_scope(mi, child, f"{qual}.", cls=None)
+            else:
+                self._index_scope(mi, child, prefix, cls)
+
+    @staticmethod
+    def _base_name(node: ast.AST) -> str | None:
+        chain = _dotted(node)
+        return ".".join(chain) if chain else None
+
+    def _link(self) -> None:
+        """Resolve class bases to scanned fqns + infer attribute
+        types (constructor assigns, annotations, dataclass fields)."""
+        for ci in self.classes.values():
+            mi = self.modules[ci.module]
+            resolved = []
+            for b in ci.bases:
+                fqn = self.resolve_class(mi, b)
+                if fqn:
+                    resolved.append(fqn)
+            ci.bases = resolved
+        for fi in self.functions.values():
+            if fi.cls is None or fi.name != "__init__":
+                continue
+            ci = self.classes[fi.cls]
+            mi = self.modules[fi.module]
+            ann: dict[str, str] = {}
+            args = fi.node.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.annotation is not None:
+                    t = self._ann_class(mi, a.annotation)
+                    if t:
+                        ann[a.arg] = t
+            for node in ast.walk(fi.node):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        ch = _dotted(t)
+                        if not ch or len(ch) != 2 or ch[0] != "self":
+                            continue
+                        attr = ch[1]
+                        typ = None
+                        if isinstance(node, ast.AnnAssign) \
+                                and node.annotation is not None:
+                            typ = self._ann_class(mi, node.annotation)
+                        if typ is None and node.value is not None:
+                            typ = self._value_class(mi, node.value, ann)
+                        if typ and attr not in ci.attr_types:
+                            ci.attr_types[attr] = typ
+        # class-body annotations (dataclass fields)
+        for ci in self.classes.values():
+            mi = self.modules[ci.module]
+            for mod_node in ast.walk(mi.tree):
+                if isinstance(mod_node, ast.ClassDef) \
+                        and f"{ci.module}:" in ci.fqn \
+                        and ci.name.split(".")[-1] == mod_node.name:
+                    for st in mod_node.body:
+                        if isinstance(st, ast.AnnAssign) \
+                                and isinstance(st.target, ast.Name):
+                            t = self._ann_class(mi, st.annotation)
+                            if t:
+                                ci.attr_types.setdefault(st.target.id, t)
+
+    def _ann_class(self, mi: ModuleInfo, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return self.resolve_class(mi, node.value.strip('"'))
+        if isinstance(node, ast.Subscript):   # Optional[X] / list[X]
+            return None
+        if isinstance(node, ast.BinOp):       # X | None
+            left = self._ann_class(mi, node.left)
+            if left:
+                return left
+            return self._ann_class(mi, node.right)
+        chain = _dotted(node)
+        return self.resolve_class(mi, ".".join(chain)) if chain else None
+
+    def _value_class(self, mi: ModuleInfo, node: ast.AST,
+                     param_ann: dict[str, str]) -> str | None:
+        if isinstance(node, ast.Call):
+            chain = _dotted(node.func)
+            if chain:
+                return self.resolve_class(mi, ".".join(chain))
+        elif isinstance(node, ast.Name):
+            return param_ann.get(node.id)
+        elif isinstance(node, ast.IfExp):
+            return self._value_class(mi, node.body, param_ann) \
+                or self._value_class(mi, node.orelse, param_ann)
+        return None
+
+    # -- resolution ---------------------------------------------------
+
+    def resolve_class(self, mi: ModuleInfo, name: str) -> str | None:
+        """Dotted name in `mi`'s namespace → scanned class fqn."""
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        if not rest:
+            if name in mi.classes:
+                return mi.classes[name]
+            if name in mi.sym_imports:
+                m, sym = mi.sym_imports[name]
+                tgt = self.modules.get(m)
+                if tgt and sym in tgt.classes:
+                    return tgt.classes[sym]
+            return None
+        if head in mi.mod_imports:
+            m = mi.mod_imports[head]
+            tgt = self.modules.get(m)
+            if tgt and rest in tgt.classes:
+                return tgt.classes[rest]
+        if head in mi.sym_imports:     # imported class, nested attr
+            m, sym = mi.sym_imports[head]
+            tgt = self.modules.get(m)
+            if tgt and f"{sym}.{rest}" in tgt.classes:
+                return tgt.classes[f"{sym}.{rest}"]
+        return None
+
+    def method_of(self, cls_fqn: str, name: str,
+                  _seen: frozenset = frozenset()) -> str | None:
+        """Method lookup through the scanned base chain (MRO-ish)."""
+        ci = self.classes.get(cls_fqn)
+        if ci is None or cls_fqn in _seen:
+            return None
+        if name in ci.methods:
+            return ci.methods[name]
+        seen = _seen | {cls_fqn}
+        for b in ci.bases:
+            hit = self.method_of(b, name, seen)
+            if hit:
+                return hit
+        return None
+
+    def is_subclass(self, cls_fqn: str, ancestor_fqn: str,
+                    _seen: frozenset = frozenset()) -> bool:
+        if cls_fqn == ancestor_fqn:
+            return True
+        ci = self.classes.get(cls_fqn)
+        if ci is None or cls_fqn in _seen:
+            return False
+        seen = _seen | {cls_fqn}
+        return any(self.is_subclass(b, ancestor_fqn, seen)
+                   for b in ci.bases)
+
+    def local_types(self, fi: FunctionInfo) -> dict[str, str]:
+        """var name → class fqn from `x = Foo(...)` / annotated
+        assigns / annotated params inside one function."""
+        mi = self.modules[fi.module]
+        out: dict[str, str] = {}
+        args = fi.node.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if a.annotation is not None:
+                t = self._ann_class(mi, a.annotation)
+                if t:
+                    out[a.arg] = t
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                t = self._value_class(mi, node.value, out)
+                if t:
+                    out[node.targets[0].id] = t
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                t = self._ann_class(mi, node.annotation)
+                if t:
+                    out[node.target.id] = t
+        return out
+
+    def _chain_type(self, fi: FunctionInfo, chain: tuple[str, ...],
+                    local: dict[str, str]) -> str | None:
+        """Type of the OBJECT a dotted chain names ('self','a','b') —
+        walks attribute types class-to-class."""
+        if not chain:
+            return None
+        if chain[0] == "self" and fi.cls is not None:
+            cur = fi.cls
+            rest = chain[1:]
+        elif chain[0] in local:
+            cur = local[chain[0]]
+            rest = chain[1:]
+        else:
+            return None
+        for attr in rest:
+            ci = self.classes.get(cur)
+            if ci is None:
+                return None
+            nxt = ci.attr_types.get(attr)
+            if nxt is None:
+                # search base classes' attr types too
+                nxt = self._base_attr_type(ci, attr)
+            if nxt is None:
+                return None
+            cur = nxt
+        return cur
+
+    def _base_attr_type(self, ci: ClassInfo, attr: str,
+                        _seen: frozenset = frozenset()) -> str | None:
+        for b in ci.bases:
+            if b in _seen:
+                continue
+            bi = self.classes.get(b)
+            if bi is None:
+                continue
+            if attr in bi.attr_types:
+                return bi.attr_types[attr]
+            hit = self._base_attr_type(bi, attr, _seen | {ci.fqn})
+            if hit:
+                return hit
+        return None
+
+    def resolve_call(self, fi: FunctionInfo, call: ast.Call,
+                     local: dict[str, str] | None = None) -> str | None:
+        """Best-effort: call expression inside `fi` → callee fqn (a
+        scanned function) or None. Constructor calls resolve to the
+        class's __init__ when scanned."""
+        if local is None:
+            local = self.local_types(fi)
+        mi = self.modules[fi.module]
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            name = fn.id
+            # local alias of a class → constructor
+            cls = local.get(name) or self.resolve_class(mi, name)
+            if cls:
+                init = self.method_of(cls, "__init__")
+                return init
+            # module function (methods defined in the same class body
+            # are NOT bare-name visible — python scoping)
+            if name in mi.functions and "." not in name:
+                return mi.functions[name]
+            if name in mi.sym_imports:
+                m, sym = mi.sym_imports[name]
+                tgt = self.modules.get(m)
+                if tgt and sym in tgt.functions:
+                    return tgt.functions[sym]
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        chain = _dotted(fn)
+        if chain is None:
+            return None
+        *base, meth = chain
+        if not base:
+            return None
+        if base == ["super()"] and fi.cls is not None:
+            ci = self.classes.get(fi.cls)
+            for b in (ci.bases if ci else ()):
+                hit = self.method_of(b, meth)
+                if hit:
+                    return hit
+            return None
+        if base[0] == "self" and len(base) == 1 and fi.cls is not None:
+            return self.method_of(fi.cls, meth)
+        # typed object chains: self.a.b.meth / local.meth
+        t = self._chain_type(fi, tuple(base), local)
+        if t:
+            return self.method_of(t, meth)
+        # module.func / module.Class(...)
+        if len(base) == 1:
+            head = base[0]
+            if head in mi.mod_imports:
+                tgt = self.modules.get(mi.mod_imports[head])
+                if tgt:
+                    if meth in tgt.functions:
+                        return tgt.functions[meth]
+                    if meth in tgt.classes:
+                        return self.method_of(tgt.classes[meth],
+                                              "__init__")
+            cls = self.resolve_class(mi, head)
+            if cls:       # Class.method staticly
+                return self.method_of(cls, meth)
+        elif len(base) == 2:
+            # module.Class.method / package.module.func
+            cls = self.resolve_class(mi, ".".join(base))
+            if cls:
+                return self.method_of(cls, meth)
+            dotted = ".".join(base)
+            if base[0] in mi.mod_imports:
+                dotted = mi.mod_imports[base[0]] + "." + base[1]
+            tgt = self.modules.get(dotted)
+            if tgt and meth in tgt.functions:
+                return tgt.functions[meth]
+        return None
+
+    def calls_in(self, fi: FunctionInfo) -> list[tuple[int, str]]:
+        """All resolvable call sites in `fi` → [(line, callee fqn)].
+        Nested defs are separate functions and are NOT included (they
+        only run if called — and the call site resolves to them)."""
+        local = self.local_types(fi)
+        out: list[tuple[int, str]] = []
+        nested = {n for n in ast.walk(fi.node)
+                  if isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+                  and n is not fi.node}
+        skip: set[ast.AST] = set()
+        for n in nested:
+            for sub in ast.walk(n):
+                skip.add(sub)
+        for node in ast.walk(fi.node):
+            if node in skip or not isinstance(node, ast.Call):
+                continue
+            callee = self.resolve_call(fi, node, local)
+            if callee is not None and callee != fi.fqn:
+                out.append((node.lineno, callee))
+        return out
+
+    def lines_of(self, fi: FunctionInfo) -> list[str]:
+        return self.modules[fi.module].lines
+
+    def find(self, suffix: str) -> FunctionInfo | None:
+        """Function lookup by 'module:Qual' fqn or bare 'Qual' suffix
+        (unique across the universe)."""
+        if suffix in self.functions:
+            return self.functions[suffix]
+        hits = [f for f in self.functions.values()
+                if f.qual == suffix]
+        if len(hits) == 1:
+            return hits[0]
+        return None

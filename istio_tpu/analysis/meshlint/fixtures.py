@@ -1,0 +1,310 @@
+"""Seeded violation corpus — the gate that proves the gate works.
+
+Each fixture is an in-memory module set (`callgraph.Universe.from_
+sources`) seeded with exactly one violation class, plus the expected
+finding code. `selftest()` runs every fixture through the real
+passes and returns the discrepancies: a violation class the analyzer
+stops catching, or noise appearing in the CLEAN fixture, fails
+scripts/meshlint.py before it can fail a PR. A lint that cannot
+demonstrate detection is indistinguishable from one that is broken.
+
+Fixtures use the same manifest-override hooks tests use (hot_roots /
+boundaries), so they exercise the production pass code — not a
+parallel test-only path."""
+from __future__ import annotations
+
+import dataclasses
+
+from istio_tpu.analysis.meshlint import model, run_meshlint
+
+
+@dataclasses.dataclass
+class Fixture:
+    name: str
+    sources: dict
+    expect_codes: tuple[str, ...]      # must ALL appear
+    forbid_codes: tuple[str, ...] = ()  # must NOT appear
+    passes: tuple[str, ...] = ("lock", "hotpath", "metrics",
+                               "rejections")
+    hot_roots: tuple[str, ...] = ()
+    boundaries: tuple = ()
+    expect_errors: bool = True
+
+
+_LOCK_CYCLE_SRC = '''
+import threading
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.b = B()
+
+    def fwd(self):
+        with self._lock:
+            self.b.grab(self)
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def grab(self, a):
+        with self._lock:
+            pass
+
+    def rev(self, a: "A"):
+        with self._lock:
+            with a._lock:
+                pass
+'''
+
+_LOCK_INVERSION_SRC = '''
+import threading
+
+class DeviceQuotaPool:
+    """Same lock names as the real pool: the declared order is
+    _counts_lock THEN _lock."""
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts_lock = threading.Lock()
+
+    def good(self):
+        with self._counts_lock:
+            with self._lock:
+                pass
+
+    def bad(self):
+        with self._lock:
+            with self._counts_lock:
+                pass
+'''
+
+_LOCK_LEAF_SRC = '''
+import threading
+
+class ShardRouter:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._other = threading.Lock()
+
+    def bad(self):
+        with self._stats_lock:
+            with self._other:
+                pass
+'''
+
+_LOCK_SELF_SRC = '''
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad(self):
+        with self._lock:
+            with self._lock:
+                pass
+'''
+
+_LOCK_PRAGMA_SRC = '''
+import threading
+
+class ShardRouter:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._other = threading.Lock()
+
+    def annotated(self):
+        with self._stats_lock:
+            with self._other:   # meshlint: lock-ok fixture exception
+                pass
+'''
+
+_HOTPATH_SRC = '''
+import time
+import numpy as np
+
+class Engine:
+    def step(self, dev):
+        return self._pull(dev)
+
+    def _pull(self, dev):
+        time.sleep(0.01)
+        return np.asarray(dev)
+
+    def annotated(self, dev):
+        return np.asarray(dev)   # hotpath: sync-ok designated pull
+'''
+
+_METRIC_SRC = '''
+import prometheus_client
+from istio_tpu.utils import metrics as hostmetrics
+
+REGISTRY = prometheus_client.CollectorRegistry()
+
+SHAPED = prometheus_client.Counter(
+    "fx_shaped", "ok", ["reason"], registry=REGISTRY)
+for _r in ("a", "b"):
+    SHAPED.labels(reason=_r)
+
+UNSHAPED = prometheus_client.Counter(
+    "fx_unshaped", "never pre-touched", ["reason"], registry=REGISTRY)
+
+NOT_A_FAMILY = object()
+
+HOST_OK = hostmetrics.default_registry.counter("fx_host", "ok")
+HOST_OK.inc(0)
+
+
+def record(n):
+    SHAPED.labels(reason="a").inc(n)
+    NOT_A_FAMILY.inc(n)
+    SHAPED.labels(wrong_key="a").inc(n)
+'''
+
+_REJECT_SRC = '''
+class CheckRejected(RuntimeError):
+    grpc_code = 2
+
+class BadInput(Exception):
+    """An in-universe rejection WITHOUT a wire code."""
+
+class Front:
+    def handler(self, req):
+        try:
+            return self._serve(req)
+        except CheckRejected:
+            return None
+
+    def _serve(self, req):
+        if not req:
+            raise BadInput("bad request")
+        if req == "shed":
+            raise CheckRejected("typed is fine")
+        return req
+
+    def annotated_handler(self, req):
+        raise ValueError("deliberate")   # meshlint: raise-ok fixture
+'''
+
+_CLEAN_SRC = '''
+import threading
+
+class Quiet:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def work(self, items):
+        with self._lock:
+            return [i * 2 for i in items]
+'''
+
+
+FIXTURES: tuple[Fixture, ...] = (
+    Fixture(
+        name="lock-cycle",
+        sources={"fx.locks": _LOCK_CYCLE_SRC},
+        passes=("lock",),
+        expect_codes=(model.LOCK_CYCLE,)),
+    Fixture(
+        name="lock-inversion",
+        sources={"fx.pool": _LOCK_INVERSION_SRC},
+        passes=("lock",),
+        expect_codes=(model.LOCK_INVERSION,)),
+    Fixture(
+        name="leaf-lock",
+        sources={"fx.leaf": _LOCK_LEAF_SRC},
+        passes=("lock",),
+        expect_codes=(model.LOCK_LEAF,)),
+    Fixture(
+        name="self-deadlock",
+        sources={"fx.selfdead": _LOCK_SELF_SRC},
+        passes=("lock",),
+        expect_codes=(model.LOCK_SELF,)),
+    Fixture(
+        name="lock-pragma-honored",
+        sources={"fx.leafok": _LOCK_PRAGMA_SRC},
+        passes=("lock",),
+        expect_codes=(),
+        forbid_codes=(model.LOCK_LEAF,),
+        expect_errors=False),
+    Fixture(
+        name="hotpath-sync",
+        sources={"fx.engine": _HOTPATH_SRC},
+        passes=("hotpath",),
+        hot_roots=("Engine.step", "Engine.annotated"),
+        expect_codes=(model.HOTPATH_SYNC,)),
+    Fixture(
+        name="hotpath-root-missing",
+        sources={"fx.engine": _HOTPATH_SRC},
+        passes=("hotpath",),
+        hot_roots=("Engine.vanished",),
+        expect_codes=(model.HOTPATH_ROOT_MISSING,)),
+    Fixture(
+        name="metric-discipline",
+        sources={"fx.metrics": _METRIC_SRC},
+        passes=("metrics",),
+        expect_codes=(model.METRIC_ZERO_SHAPE,
+                      model.METRIC_UNREGISTERED,
+                      model.METRIC_LABEL_MISMATCH)),
+    Fixture(
+        name="untyped-escape",
+        sources={"fx.front": _REJECT_SRC},
+        passes=("rejections",),
+        boundaries=(("fx.front", "Front.handler"),
+                    ("fx.front", "Front.annotated_handler")),
+        expect_codes=(model.UNTYPED_ESCAPE,)),
+    Fixture(
+        name="clean",
+        sources={"fx.quiet": _CLEAN_SRC},
+        expect_codes=(),
+        forbid_codes=(model.LOCK_CYCLE, model.LOCK_INVERSION,
+                      model.LOCK_LEAF, model.LOCK_SELF,
+                      model.HOTPATH_SYNC, model.METRIC_ZERO_SHAPE,
+                      model.METRIC_UNREGISTERED,
+                      model.UNTYPED_ESCAPE),
+        hot_roots=("Quiet.work",),
+        boundaries=(("fx.quiet", "Quiet.work"),),
+        expect_errors=False),
+)
+
+
+def run_fixture(fx: Fixture) -> model.MeshlintReport:
+    return run_meshlint(
+        sources=fx.sources, passes=fx.passes,
+        hot_roots=fx.hot_roots or None,
+        boundaries=fx.boundaries or None)
+
+
+def selftest() -> list[str]:
+    """Run every fixture; return human-readable discrepancies
+    (empty = the analyzer detects every seeded violation class and
+    stays silent on the clean corpus)."""
+    problems: list[str] = []
+    for fx in FIXTURES:
+        report = run_fixture(fx)
+        codes = report.codes()
+        for want in fx.expect_codes:
+            hits = [f for f in report.findings if f.code == want]
+            config_level = want in (model.HOTPATH_ROOT_MISSING,
+                                    model.BOUNDARY_MISSING)
+            if not hits:
+                problems.append(
+                    f"{fx.name}: expected {want}, not reported")
+            elif not config_level \
+                    and not all(f.line > 0 and f.path for f in hits):
+                problems.append(
+                    f"{fx.name}: {want} reported without a "
+                    f"file:line witness")
+        for bad in fx.forbid_codes:
+            if bad in codes:
+                problems.append(
+                    f"{fx.name}: forbidden {bad} was reported "
+                    f"(pragma/exemption not honored?)")
+        if fx.expect_errors and not report.has_errors:
+            problems.append(f"{fx.name}: expected ERROR findings, "
+                            f"report came back clean")
+        if not fx.expect_errors and report.has_errors:
+            problems.append(
+                f"{fx.name}: unexpected ERRORs: "
+                + "; ".join(str(f) for f in report.errors))
+    return problems

@@ -1,0 +1,567 @@
+"""Lock-order pass — static lock-acquisition graph vs declared order.
+
+Lock identity is the DECLARATION SITE, named `Class._attr` (instance
+locks assigned in the constructor) or `module._NAME` (module-level
+locks). A `threading.Condition(self._lock)` wraps — and therefore IS —
+the underlying lock: acquiring the condition aliases to the lock's id.
+Semaphores are counted resources, not mutexes, and are excluded.
+
+Per function we walk the statement tree lexically, tracking the held
+set through `with` blocks and bare `.acquire()`/`.release()` pairs,
+recording (a) every acquisition together with the locks already held
+and (b) every resolvable call together with the held set at the call
+site. A fixpoint over the call graph then yields, for every function,
+the locks it may acquire TRANSITIVELY — each tagged with the first
+call edge that reaches it, so a finding can replay the full
+acquisition chain as its witness.
+
+The verdicts, against the in-code manifest below:
+
+  * `lock-order-cycle` (ERROR)      — the acquisition graph has a
+    strongly-connected component: some interleaving can deadlock;
+  * `lock-order-inversion` (ERROR)  — an edge contradicts a declared
+    `(first, then)` pair;
+  * `leaf-lock-violation` (ERROR)   — a lock acquired while a LEAF
+    lock is held (leaves are terminal by doctrine: metric primitives,
+    stats/ring locks — nothing may nest under them);
+  * `lock-self-deadlock` (ERROR)    — a non-reentrant lock lexically
+    re-entered in one function body (cross-function self edges are
+    skipped: two frames usually mean two instances);
+  * `lock-order-undeclared` (INFO)  — an observed edge the manifest
+    has no opinion on; surfaced for review, never a gate failure.
+
+`# meshlint: lock-ok` on the inner acquisition line (or on the call
+line that imports the edge) suppresses ordering verdicts for that
+edge — a reviewed, documented exception."""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from istio_tpu.analysis.findings import Severity
+from istio_tpu.analysis.meshlint import callgraph as cg
+from istio_tpu.analysis.meshlint import model
+
+# ---------------------------------------------------------------------------
+# The lock-order manifest (lockorder.toml rendered as code so it ships,
+# versions and reviews with the analyzer).
+#
+# DECLARED_ORDER: (first, then) pairs — taking `then` before `first`
+# on any path is an inversion. The quota pool's discipline is written
+# in prose at runtime/device_quota.py ("Lock order: ALWAYS
+# _counts_lock then self._lock"); this is that sentence as data.
+DECLARED_ORDER: frozenset[tuple[str, str]] = frozenset({
+    ("DeviceQuotaPool._counts_lock", "DeviceQuotaPool._lock"),
+    # quota futures are resolved while the pool lock is held
+    ("DeviceQuotaPool._lock", "QuotaFuture._lock"),
+    # discovery publish: publish serialization → cache invalidation /
+    # pending-group set / watcher wake (discovery.py, PR 15)
+    ("DiscoveryService._publish_lock", "SnapshotCache._lock"),
+    ("DiscoveryService._publish_lock", "DiscoveryService._gen_lock"),
+    ("DiscoveryService._publish_lock", "DiscoveryService._watch"),
+    # batched RDS generation stores under the pending-group lock
+    ("DiscoveryService._gen_lock", "SnapshotCache._lock"),
+    # config rebuild serialization wraps the whole build: store list,
+    # handler-table swap, and the native-extension build gate all
+    # nest under _rebuild_serial (controller.py)
+    ("Controller._rebuild_serial", "Store._lock"),
+    ("Controller._rebuild_serial", "HandlerTable._lock"),
+    ("Controller._rebuild_serial", "build._lock"),
+})
+
+# Leaf locks: terminal by doctrine. Metric primitives are taken on
+# every hot-path sample; stats/ring locks guard fixed-size buffers.
+# Holding ANY of these while acquiring another lock is a violation.
+LEAF_LOCKS: frozenset[str] = frozenset({
+    "Counter._lock", "Gauge._lock", "Histogram._lock",
+    "SlidingWindow._lock", "Registry._lock",        # utils/metrics.py
+    "ShardRouter._stats_lock",                      # sharding/router.py
+    "EventTimeline._lock",                          # forensics ring
+})
+
+# Reentrant locks (threading.RLock) — self edges are legal.
+# Detected from the declaration site too; listed here so fixtures and
+# out-of-universe declarations behave identically.
+KNOWN_REENTRANT: frozenset[str] = frozenset()
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTOR = "Condition"
+
+
+@dataclasses.dataclass
+class LockDecl:
+    lock_id: str            # "DeviceQuotaPool._lock" / "build._lock"
+    path: str
+    line: int
+    reentrant: bool = False
+    alias_of: str | None = None   # Condition(self._x) → underlying id
+
+
+@dataclasses.dataclass
+class Acquisition:
+    lock: str
+    path: str
+    line: int
+    func: str               # qualname of the acquiring function
+    held: tuple[str, ...]   # locks already held at this site
+
+
+@dataclasses.dataclass
+class CallUnder:
+    callee: str             # fqn
+    path: str
+    line: int
+    func: str
+    held: tuple[str, ...]
+
+
+@dataclasses.dataclass
+class LockEdge:
+    """outer → inner acquisition, with a replayable witness chain."""
+    outer: str
+    inner: str
+    path: str
+    line: int               # the line that completes the edge
+    func: str
+    chain: tuple[str, ...]  # witness frames
+
+
+class LockGraph:
+    """Declarations, per-function acquisition facts, transitive
+    closure and the resulting outer→inner edge set."""
+
+    def __init__(self, u: cg.Universe) -> None:
+        self.u = u
+        self.decls: dict[str, LockDecl] = {}
+        self.acquisitions: dict[str, list[Acquisition]] = {}
+        self.calls_under: dict[str, list[CallUnder]] = {}
+        self._collect_decls()
+        for fi in u.functions.values():
+            self._scan_function(fi)
+        # transitive: fqn → {lock: (line, via_callee_fqn|None)}
+        self.transitive: dict[str, dict[str, tuple[int, str | None]]] = {}
+        self._fixpoint()
+        self.edges: list[LockEdge] = self._build_edges()
+
+    # -- declarations -------------------------------------------------
+
+    def _collect_decls(self) -> None:
+        for mi in self.u.modules.values():
+            mod_tail = mi.name.rsplit(".", 1)[-1]
+            # module-level locks
+            for node in mi.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    d = self._lock_value(mi, node.value, owner=None)
+                    if d is not None:
+                        lock_id = f"{mod_tail}.{node.targets[0].id}"
+                        kind, alias = d
+                        self.decls[lock_id] = LockDecl(
+                            lock_id, mi.path, node.lineno,
+                            reentrant=(kind == "RLock"),
+                            alias_of=alias)
+        # instance locks from constructor bodies
+        for fi in self.u.functions.values():
+            if fi.cls is None or fi.name != "__init__":
+                continue
+            cls_name = self.u.classes[fi.cls].name
+            mi = self.u.modules[fi.module]
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                chain = cg._dotted(node.targets[0])
+                if not chain or len(chain) != 2 or chain[0] != "self":
+                    continue
+                d = self._lock_value(mi, node.value, owner=cls_name)
+                if d is None:
+                    continue
+                kind, alias = d
+                lock_id = f"{cls_name}.{chain[1]}"
+                self.decls[lock_id] = LockDecl(
+                    lock_id, mi.path, node.lineno,
+                    reentrant=(kind == "RLock"), alias_of=alias)
+        # resolve alias chains (Condition(self._lock) → _lock's id)
+        for d in self.decls.values():
+            seen = set()
+            while d.alias_of and d.alias_of in self.decls \
+                    and d.alias_of not in seen:
+                seen.add(d.alias_of)
+                tgt = self.decls[d.alias_of]
+                if tgt.alias_of is None:
+                    break
+                d.alias_of = tgt.alias_of
+
+    def _lock_value(self, mi: cg.ModuleInfo, value: ast.AST,
+                    owner: str | None) -> tuple[str, str | None] | None:
+        """`threading.Lock()`-shaped ctor → (kind, alias_of)."""
+        if not isinstance(value, ast.Call):
+            return None
+        chain = cg._dotted(value.func)
+        if chain is None:
+            return None
+        name = chain[-1]
+        head_ok = len(chain) == 1 or chain[0] == "threading"
+        if not head_ok:
+            return None
+        if name in _LOCK_CTORS:
+            return (name, None)
+        if name == _COND_CTOR:
+            # Condition(self._x) aliases; Condition()/Condition(Lock())
+            # owns a fresh lock
+            if value.args:
+                ach = cg._dotted(value.args[0])
+                if ach and len(ach) == 2 and ach[0] == "self" and owner:
+                    return ("Condition", f"{owner}.{ach[1]}")
+            return ("Condition", None)
+        return None
+
+    def canonical(self, lock_id: str) -> str:
+        d = self.decls.get(lock_id)
+        if d is not None and d.alias_of:
+            return d.alias_of
+        return lock_id
+
+    def _reentrant(self, lock_id: str) -> bool:
+        d = self.decls.get(lock_id)
+        return (d is not None and d.reentrant) \
+            or lock_id in KNOWN_REENTRANT
+
+    # -- per-function scan --------------------------------------------
+
+    def _lock_of_expr(self, fi: cg.FunctionInfo, node: ast.AST,
+                      local: dict[str, str]) -> str | None:
+        """Expression in acquiring position → canonical lock id."""
+        chain = cg._dotted(node)
+        if chain is None:
+            return None
+        mi = self.u.modules[fi.module]
+        # module-level lock by bare name or module alias
+        if len(chain) == 1:
+            cand = f"{fi.module.rsplit('.', 1)[-1]}.{chain[0]}"
+            if cand in self.decls:
+                return self.canonical(cand)
+            if chain[0] in mi.sym_imports:
+                m, sym = mi.sym_imports[chain[0]]
+                cand = f"{m.rsplit('.', 1)[-1]}.{sym}"
+                if cand in self.decls:
+                    return self.canonical(cand)
+            return None
+        *base, attr = chain
+        if base == ["self"] and fi.cls is not None:
+            cls = self.u.classes[fi.cls]
+            # walk the base chain: the lock may be declared by a parent
+            for cname in self._class_names(fi.cls):
+                cand = f"{cname}.{attr}"
+                if cand in self.decls:
+                    return self.canonical(cand)
+            return f"{cls.name}.{attr}" if self._looks_lockish(attr) \
+                else None
+        if len(base) == 1 and base[0] in mi.mod_imports:
+            cand = f"{mi.mod_imports[base[0]].rsplit('.', 1)[-1]}.{attr}"
+            if cand in self.decls:
+                return self.canonical(cand)
+        # typed chains: self.pool._counts_lock / p._lock
+        t = self.u._chain_type(fi, tuple(base), local)
+        if t is not None:
+            for cname in self._class_names(t):
+                cand = f"{cname}.{attr}"
+                if cand in self.decls:
+                    return self.canonical(cand)
+        return None
+
+    def _class_names(self, cls_fqn: str) -> list[str]:
+        out, stack, seen = [], [cls_fqn], set()
+        while stack:
+            c = stack.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            ci = self.u.classes.get(c)
+            if ci is None:
+                continue
+            out.append(ci.name)
+            stack.extend(ci.bases)
+        return out
+
+    @staticmethod
+    def _looks_lockish(attr: str) -> bool:
+        return attr.endswith(("_lock", "_cv", "_cond")) \
+            or attr in ("_lock", "lock")
+
+    def _scan_function(self, fi: cg.FunctionInfo) -> None:
+        local = self.u.local_types(fi)
+        acqs: list[Acquisition] = []
+        calls: list[CallUnder] = []
+        nested = set()
+        for n in ast.walk(fi.node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n is not fi.node:
+                nested.add(n)
+
+        def walk_body(body: list[ast.stmt], held: list[str]) -> None:
+            manual: list[str] = []    # .acquire()d in THIS block
+            for st in body:
+                self._note_calls(st, fi, local, held, calls, nested)
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    got: list[str] = []
+                    for item in st.items:
+                        lk = self._lock_of_expr(
+                            fi, item.context_expr, local)
+                        if lk is not None:
+                            acqs.append(Acquisition(
+                                lk, fi.path, item.context_expr.lineno,
+                                fi.qual, tuple(held + got)))
+                            got.append(lk)
+                    walk_body(st.body, held + got)
+                elif isinstance(st, ast.Expr) \
+                        and isinstance(st.value, ast.Call) \
+                        and isinstance(st.value.func, ast.Attribute):
+                    meth = st.value.func.attr
+                    if meth in ("acquire", "release"):
+                        lk = self._lock_of_expr(
+                            fi, st.value.func.value, local)
+                        if lk is not None:
+                            if meth == "acquire":
+                                acqs.append(Acquisition(
+                                    lk, fi.path, st.lineno, fi.qual,
+                                    tuple(held)))
+                                held = held + [lk]
+                                manual.append(lk)
+                            elif lk in held:
+                                held = [h for h in held if h != lk]
+                                if lk in manual:
+                                    manual.remove(lk)
+                elif isinstance(st, (ast.If, ast.While, ast.For,
+                                     ast.AsyncFor)):
+                    walk_body(st.body, list(held))
+                    walk_body(st.orelse, list(held))
+                elif isinstance(st, ast.Try):
+                    walk_body(st.body, list(held))
+                    for h in st.handlers:
+                        walk_body(h.body, list(held))
+                    walk_body(st.orelse, list(held))
+                    walk_body(st.finalbody, list(held))
+
+        walk_body(list(fi.node.body), [])
+        self.acquisitions[fi.fqn] = acqs
+        self.calls_under[fi.fqn] = calls
+
+    def _note_calls(self, st: ast.stmt, fi: cg.FunctionInfo,
+                    local: dict[str, str], held: list[str],
+                    out: list[CallUnder], nested: set) -> None:
+        """Resolvable call sites in statement `st`'s own expressions
+        (compound bodies are walked separately, with their held set)."""
+        if isinstance(st, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                           ast.Try, ast.With, ast.AsyncWith)):
+            # only the header expression(s), not the body
+            headers: list[ast.AST] = []
+            if isinstance(st, (ast.If, ast.While)):
+                headers = [st.test]
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                headers = [st.iter]
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                headers = [i.context_expr for i in st.items]
+            nodes: list[ast.AST] = []
+            for h in headers:
+                nodes.extend(ast.walk(h))
+        else:
+            skip: set[ast.AST] = set()
+            for n in ast.walk(st):
+                if n in nested:
+                    for sub in ast.walk(n):
+                        skip.add(sub)
+            nodes = [n for n in ast.walk(st) if n not in skip]
+        for n in nodes:
+            if isinstance(n, ast.Call):
+                callee = self.u.resolve_call(fi, n, local)
+                if callee is not None and callee != fi.fqn:
+                    out.append(CallUnder(callee, fi.path, n.lineno,
+                                         fi.qual, tuple(held)))
+
+    # -- transitive closure -------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for fqn in self.u.functions:
+            t: dict[str, tuple[int, str | None]] = {}
+            for a in self.acquisitions.get(fqn, ()):
+                t.setdefault(a.lock, (a.line, None))
+            self.transitive[fqn] = t
+        changed = True
+        while changed:
+            changed = False
+            for fqn in self.u.functions:
+                t = self.transitive[fqn]
+                for c in self.calls_under.get(fqn, ()):
+                    for lk in self.transitive.get(c.callee, ()):
+                        if lk not in t:
+                            t[lk] = (c.line, c.callee)
+                            changed = True
+
+    def chain_to(self, fqn: str, lock: str,
+                 _depth: int = 0) -> list[str]:
+        """Witness frames from `fqn` down to the acquisition of
+        `lock`, following the recorded (line, via) back-pointers."""
+        if _depth > 32:
+            return ["… (chain truncated)"]
+        entry = self.transitive.get(fqn, {}).get(lock)
+        if entry is None:
+            return []
+        line, via = entry
+        fi = self.u.functions[fqn]
+        if via is None:
+            return [f"{fi.path}:{line} {fi.qual} — acquires {lock}"]
+        vi = self.u.functions[via]
+        return [f"{fi.path}:{line} {fi.qual} — calls {vi.qual}"] \
+            + self.chain_to(via, lock, _depth + 1)
+
+    # -- edge construction --------------------------------------------
+
+    def _build_edges(self) -> list[LockEdge]:
+        edges: list[LockEdge] = []
+        for fqn, fi in self.u.functions.items():
+            lines = self.u.lines_of(fi)
+            for a in self.acquisitions.get(fqn, ()):
+                if model.has_pragma(lines, a.line, "lock-ok"):
+                    continue
+                for outer in a.held:
+                    edges.append(LockEdge(
+                        outer, a.lock, a.path, a.line, a.func,
+                        chain=(f"{a.path}:{a.line} {a.func} — "
+                               f"acquires {a.lock} while holding "
+                               f"{outer}",)))
+            for c in self.calls_under.get(fqn, ()):
+                if not c.held:
+                    continue
+                if model.has_pragma(lines, c.line, "lock-ok"):
+                    continue
+                for inner in self.transitive.get(c.callee, ()):
+                    for outer in c.held:
+                        if inner == outer:
+                            continue  # cross-frame self edges: skipped
+                        callee_q = self.u.functions[c.callee].qual
+                        chain = tuple(
+                            [f"{c.path}:{c.line} {c.func} — holds "
+                             f"{outer}, calls {callee_q}"]
+                            + self.chain_to(c.callee, inner))
+                        edges.append(LockEdge(
+                            outer, inner, c.path, c.line, c.func,
+                            chain=chain))
+        return edges
+
+
+def _cycles(edges: list[LockEdge]) -> list[list[str]]:
+    """Tarjan SCCs of size > 1 over the distinct edge pairs."""
+    graph: dict[str, set[str]] = {}
+    for e in edges:
+        graph.setdefault(e.outer, set()).add(e.inner)
+        graph.setdefault(e.inner, set())
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    onstack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in onstack:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                onstack.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def run(u: cg.Universe, report: model.MeshlintReport) -> LockGraph:
+    g = LockGraph(u)
+    seen: set[tuple] = set()
+
+    # self-deadlock: lexical re-entry of a non-reentrant lock
+    for fqn, acqs in g.acquisitions.items():
+        fi = u.functions[fqn]
+        lines = u.lines_of(fi)
+        for a in acqs:
+            if a.lock in a.held and not g._reentrant(a.lock):
+                if model.has_pragma(lines, a.line, "lock-ok"):
+                    continue
+                key = (model.LOCK_SELF, a.path, a.line, a.lock)
+                if key in seen:
+                    continue
+                seen.add(key)
+                report.add(model.LintFinding(
+                    model.LOCK_SELF, Severity.ERROR, a.path, a.line,
+                    a.func,
+                    f"non-reentrant lock {a.lock} re-acquired while "
+                    f"already held in this function",
+                    chain=(f"{a.path}:{a.line} {a.func} — re-enters "
+                           f"{a.lock}",)))
+
+    declared = set(DECLARED_ORDER)
+    for e in g.edges:
+        pair = (e.outer, e.inner)
+        if (pair[1], pair[0]) in declared:
+            key = (model.LOCK_INVERSION, e.path, e.line, pair)
+            if key not in seen:
+                seen.add(key)
+                report.add(model.LintFinding(
+                    model.LOCK_INVERSION, Severity.ERROR, e.path,
+                    e.line, e.func,
+                    f"lock order inversion: {e.inner} must be taken "
+                    f"BEFORE {e.outer} (declared order "
+                    f"{e.inner} -> {e.outer})", chain=e.chain))
+        elif e.outer in LEAF_LOCKS:
+            key = (model.LOCK_LEAF, e.path, e.line, pair)
+            if key not in seen:
+                seen.add(key)
+                report.add(model.LintFinding(
+                    model.LOCK_LEAF, Severity.ERROR, e.path, e.line,
+                    e.func,
+                    f"{e.inner} acquired while holding leaf lock "
+                    f"{e.outer} (leaf locks are terminal)",
+                    chain=e.chain))
+        elif pair not in declared:
+            key = (model.LOCK_UNDECLARED, pair)
+            if key not in seen:
+                seen.add(key)
+                report.add(model.LintFinding(
+                    model.LOCK_UNDECLARED, Severity.INFO, e.path,
+                    e.line, e.func,
+                    f"observed lock edge {e.outer} -> {e.inner} is "
+                    f"not in the declared order", chain=e.chain))
+
+    for comp in _cycles(g.edges):
+        # pick a representative edge inside the SCC for anchoring
+        rep = next((e for e in g.edges
+                    if e.outer in comp and e.inner in comp), None)
+        report.add(model.LintFinding(
+            model.LOCK_CYCLE, Severity.ERROR,
+            rep.path if rep else "<graph>",
+            rep.line if rep else 0,
+            rep.func if rep else "<graph>",
+            "lock acquisition cycle: " + " <-> ".join(comp),
+            chain=rep.chain if rep else ()))
+
+    report.stats["lock_decls"] = len(g.decls)
+    report.stats["lock_edges"] = len({(e.outer, e.inner)
+                                      for e in g.edges})
+    return g

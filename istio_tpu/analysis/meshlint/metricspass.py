@@ -1,0 +1,384 @@
+"""Metric-discipline pass — every family registered, every labeled
+family zero-shaped at import.
+
+The promtext doctrine (pinned family-by-family in
+tests/test_metrics_exposition.py, automated here): a scrape taken
+BEFORE the first request must already show every series a dashboard
+will ever join on, so rate() and absence-alerts never see a series
+pop into existence mid-incident. Concretely:
+
+  * prometheus_client families with labelnames are pre-touched at
+    module import (`for _r in REASONS: FAM.labels(reason=_r)`);
+  * homegrown `Registry.counter` families are zero-touched with
+    `FAM.inc(0)` at module level;
+  * homegrown Histograms auto-emit their zero bucket ladder, and
+    gauges have NO boot-set convention (some deliberately boot to 1,
+    e.g. the SLO-met gauge) — both are exempt;
+  * unlabeled prometheus families expose 0 automatically — exempt.
+
+What this pass checks, at every `FAM.inc/observe/set/labels(...)`
+site whose receiver is an ALL_CAPS module-level binding it can
+resolve inside the scanned universe:
+
+  * `metric-unregistered` (ERROR) — the binding is not a metric
+    family declaration (the name exists but is not built by a
+    registry factory / prometheus ctor);
+  * `metric-label-mismatch` (ERROR) — `.labels()` keys disagree with
+    the family's declared labelnames (or `.labels()` on a homegrown
+    family, which has no such method);
+  * `metric-zero-shape` (ERROR, on the declaration) — a family that
+    REQUIRES shaping (labeled prometheus counter/histogram, homegrown
+    counter) has no module-level pretouch;
+  * `metric-unshaped-series` (WARNING) — a literal label value at a
+    use site that the module-level pretouch provably never created
+    (single-label families only; dynamic values are not judged).
+
+`# meshlint: metric-ok` on the declaration (for shaping) or the use
+line suppresses."""
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from istio_tpu.analysis.findings import Severity
+from istio_tpu.analysis.meshlint import callgraph as cg
+from istio_tpu.analysis.meshlint import model
+
+_PROM_CTORS = {"Counter": "counter", "Gauge": "gauge",
+               "Histogram": "histogram", "Summary": "histogram"}
+_HOST_FACTORIES = {"counter", "gauge", "histogram"}
+_EXEMPT_CTORS = {"SlidingWindow", "CollectorRegistry", "Registry"}
+_METRIC_METHODS = {"inc", "observe", "set", "labels"}
+
+
+@dataclasses.dataclass
+class Family:
+    name: str               # binding name (ALL_CAPS)
+    module: str
+    path: str
+    line: int
+    source: str             # "prom" | "host"
+    kind: str               # counter | gauge | histogram
+    labelnames: tuple[str, ...] = ()
+    shaped: bool = False
+    # label value universe established by module-level pretouch
+    # (single-label families only; None = not tracked)
+    pretouched: set | None = None
+
+    @property
+    def needs_shaping(self) -> bool:
+        if self.kind == "gauge":
+            return False
+        if self.source == "prom":
+            return bool(self.labelnames)
+        return self.kind == "counter"   # host histograms auto-ladder
+
+
+def _const_strings(node: ast.AST) -> tuple[str, ...] | None:
+    if isinstance(node, (ast.List, ast.Tuple)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+class MetricIndex:
+    """Families + module constants + pretouch facts, per universe."""
+
+    def __init__(self, u: cg.Universe) -> None:
+        self.u = u
+        # (module, NAME) → Family
+        self.families: dict[tuple[str, str], Family] = {}
+        # (module, NAME) → line of a non-family module binding
+        self.other_bindings: dict[tuple[str, str], int] = {}
+        # (module, NAME) → tuple of constant strings
+        self.constants: dict[tuple[str, str], tuple[str, ...]] = {}
+        for mi in u.modules.values():
+            self._scan_declarations(mi)
+        for mi in u.modules.values():
+            self._scan_pretouch(mi)
+
+    def _scan_declarations(self, mi: cg.ModuleInfo) -> None:
+        for node in mi.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            if not name.isupper():
+                continue
+            key = (mi.name, name)
+            consts = _const_strings(node.value)
+            if consts is not None:
+                self.constants[key] = consts
+                continue
+            fam = self._family_of(mi, name, node)
+            if fam is not None:
+                self.families[key] = fam
+            else:
+                self.other_bindings[key] = node.lineno
+
+    def _family_of(self, mi: cg.ModuleInfo, name: str,
+                   node: ast.Assign) -> Family | None:
+        if not isinstance(node.value, ast.Call):
+            return None
+        chain = cg._dotted(node.value.func)
+        if chain is None:
+            return None
+        tail = chain[-1]
+        if tail in _EXEMPT_CTORS:
+            # metric-adjacent but not a family (sliding windows,
+            # registries) — legal receiver, nothing to verify
+            return Family(name, mi.name, mi.path, node.lineno,
+                          source="exempt", kind="exempt")
+        if tail in _PROM_CTORS:
+            labels: tuple[str, ...] = ()
+            if len(node.value.args) >= 3:
+                labels = _const_strings(node.value.args[2]) or ()
+            for kw in node.value.keywords:
+                if kw.arg == "labelnames":
+                    labels = _const_strings(kw.value) or ()
+            return Family(name, mi.name, mi.path, node.lineno,
+                          source="prom", kind=_PROM_CTORS[tail],
+                          labelnames=labels)
+        if tail in _HOST_FACTORIES and len(chain) > 1:
+            return Family(name, mi.name, mi.path, node.lineno,
+                          source="host", kind=tail)
+        return None
+
+    # -- pretouch -----------------------------------------------------
+
+    def _scan_pretouch(self, mi: cg.ModuleInfo) -> None:
+        def handle(st: ast.stmt, loop_vals: dict) -> None:
+            if isinstance(st, ast.For):
+                vals: tuple[str, ...] | None = None
+                it = st.iter
+                ich = cg._dotted(it) if not isinstance(it, (ast.Tuple,
+                                                            ast.List)) \
+                    else None
+                if isinstance(it, (ast.Tuple, ast.List)):
+                    vals = _const_strings(it)
+                elif ich is not None and len(ich) == 1:
+                    vals = self.constants.get((mi.name, ich[0]))
+                inner = dict(loop_vals)
+                if isinstance(st.target, ast.Name) and vals is not None:
+                    inner[st.target.id] = vals
+                for s in st.body:
+                    handle(s, inner)
+                return
+            if isinstance(st, ast.If):
+                for s in st.body + st.orelse:
+                    handle(s, loop_vals)
+                return
+            if not isinstance(st, ast.Expr):
+                return
+            for call in ast.walk(st.value):
+                if not isinstance(call, ast.Call) \
+                        or not isinstance(call.func, ast.Attribute):
+                    continue
+                meth = call.func.attr
+                fam = self.resolve_receiver(mi, call.func.value)
+                if fam is None:
+                    continue
+                if fam.source == "prom" and meth == "labels":
+                    fam.shaped = True
+                    self._note_values(fam, call, loop_vals)
+                elif fam.source == "host" and meth == "inc" \
+                        and call.args \
+                        and isinstance(call.args[0], ast.Constant) \
+                        and call.args[0].value == 0:
+                    fam.shaped = True
+                    self._note_values(fam, call, loop_vals)
+
+        for st in mi.tree.body:
+            handle(st, {})
+
+    def _note_values(self, fam: Family, call: ast.Call,
+                     loop_vals: dict) -> None:
+        kwargs = [kw for kw in call.keywords if kw.arg]
+        if len(kwargs) != 1:
+            fam.pretouched = None if fam.pretouched is None \
+                else fam.pretouched
+            return
+        if fam.pretouched is None:
+            fam.pretouched = set()
+        v = kwargs[0].value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            fam.pretouched.add(v.value)
+        elif isinstance(v, ast.Name) and v.id in loop_vals:
+            fam.pretouched.update(loop_vals[v.id])
+        else:
+            fam.pretouched = None   # dynamic — can't enumerate
+
+    # -- receiver resolution ------------------------------------------
+
+    def resolve_receiver(self, mi: cg.ModuleInfo,
+                         node: ast.AST) -> Family | None:
+        key = self.receiver_key(mi, node)
+        if key is None:
+            return None
+        return self.families.get(key)
+
+    def receiver_key(self, mi: cg.ModuleInfo,
+                     node: ast.AST) -> tuple[str, str] | None:
+        """ALL_CAPS receiver expression → (declaring module, NAME)."""
+        chain = cg._dotted(node)
+        if chain is None:
+            return None
+        if len(chain) == 1:
+            name = chain[0]
+            if not name.isupper():
+                return None
+            if (mi.name, name) in self.families \
+                    or (mi.name, name) in self.other_bindings \
+                    or (mi.name, name) in self.constants:
+                return (mi.name, name)
+            if name in mi.sym_imports:
+                m, sym = mi.sym_imports[name]
+                if m in self.u.modules:
+                    return (m, sym)
+            return None
+        if len(chain) == 2 and chain[1].isupper():
+            head, name = chain
+            mod = mi.mod_imports.get(head)
+            if mod and mod in self.u.modules:
+                return (mod, name)
+            if head in mi.sym_imports:    # from istio_tpu.runtime import monitor
+                m, sym = mi.sym_imports[head]
+                dotted = f"{m}.{sym}"
+                if dotted in self.u.modules:
+                    return (dotted, name)
+        return None
+
+
+def _use_sites(mi: cg.ModuleInfo):
+    """Every metric-method Call in the module (functions AND module
+    level) → (call node, enclosing qualname)."""
+    sites = []
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _METRIC_METHODS:
+            sites.append(node)
+    return sites
+
+
+def run(u: cg.Universe, report: model.MeshlintReport) -> MetricIndex:
+    idx = MetricIndex(u)
+
+    # declaration-side: shaping contract
+    n_checked = 0
+    for fam in idx.families.values():
+        if fam.source == "exempt":
+            continue
+        n_checked += 1
+        if fam.needs_shaping and not fam.shaped:
+            mi = u.modules[fam.module]
+            if model.has_pragma(mi.lines, fam.line, "metric-ok"):
+                continue
+            what = f"labeled {fam.source} {fam.kind}" \
+                if fam.source == "prom" else f"host {fam.kind}"
+            how = "a module-level .labels(...) pretouch loop" \
+                if fam.source == "prom" else \
+                "a module-level .inc(0) zero-touch"
+            report.add(model.LintFinding(
+                model.METRIC_ZERO_SHAPE, Severity.ERROR, fam.path,
+                fam.line, "<module>",
+                f"family {fam.name} ({what}) is never zero-shaped — "
+                f"add {how} so a pre-traffic scrape already shows "
+                f"every series"))
+
+    # use-side: registration, label keys, series universe
+    seen: set[tuple] = set()
+    for mi in u.modules.values():
+        for call in _use_sites(mi):
+            meth = call.func.attr
+            key = idx.receiver_key(mi, call.func.value)
+            if key is None:
+                continue
+            line = call.lineno
+            if model.has_pragma(mi.lines, line, "metric-ok"):
+                continue
+            fam = idx.families.get(key)
+            if fam is None:
+                if key in idx.constants:
+                    continue    # tuple constants never take these
+                dkey = (model.METRIC_UNREGISTERED, mi.path, line)
+                if dkey in seen:
+                    continue
+                seen.add(dkey)
+                report.add(model.LintFinding(
+                    model.METRIC_UNREGISTERED, Severity.ERROR,
+                    mi.path, line, "<module>",
+                    f"{key[1]}.{meth}() — {key[1]} is not a "
+                    f"registered metric family (declared at "
+                    f"{key[0]} without a registry factory)"))
+                continue
+            if fam.source == "exempt":
+                continue
+            if meth == "labels":
+                if fam.source == "host":
+                    report.add(model.LintFinding(
+                        model.METRIC_LABEL_MISMATCH, Severity.ERROR,
+                        mi.path, line, "<module>",
+                        f"{fam.name}.labels() — host families take "
+                        f"labels as inc/observe/set kwargs, not "
+                        f".labels()"))
+                    continue
+                keys = tuple(sorted(kw.arg for kw in call.keywords
+                                    if kw.arg))
+                want = tuple(sorted(fam.labelnames))
+                n_pos = len(call.args)
+                if keys and keys != want:
+                    report.add(model.LintFinding(
+                        model.METRIC_LABEL_MISMATCH, Severity.ERROR,
+                        mi.path, line, "<module>",
+                        f"{fam.name}.labels({', '.join(keys)}) — "
+                        f"declared labelnames are "
+                        f"({', '.join(want) or 'none'})"))
+                elif not keys and n_pos \
+                        and n_pos != len(fam.labelnames):
+                    report.add(model.LintFinding(
+                        model.METRIC_LABEL_MISMATCH, Severity.ERROR,
+                        mi.path, line, "<module>",
+                        f"{fam.name}.labels() takes "
+                        f"{len(fam.labelnames)} positional label "
+                        f"values, got {n_pos}"))
+                elif keys and len(fam.labelnames) == 1 \
+                        and fam.pretouched is not None:
+                    v = call.keywords[0].value
+                    if isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str) \
+                            and v.value not in fam.pretouched:
+                        report.add(model.LintFinding(
+                            model.METRIC_UNSHAPED_SERIES,
+                            Severity.WARNING, mi.path, line,
+                            "<module>",
+                            f"{fam.name}.labels({keys[0]}="
+                            f"{v.value!r}) — series not in the "
+                            f"module-level pretouch universe "
+                            f"{sorted(fam.pretouched)}"))
+            elif meth in ("inc", "observe", "set") \
+                    and fam.source == "host" \
+                    and fam.kind == "counter" \
+                    and len(call.keywords) == 1 \
+                    and call.keywords[0].arg \
+                    and fam.pretouched:
+                v = call.keywords[0].value
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str) \
+                        and v.value not in fam.pretouched:
+                    report.add(model.LintFinding(
+                        model.METRIC_UNSHAPED_SERIES,
+                        Severity.WARNING, mi.path, line, "<module>",
+                        f"{fam.name}.{meth}({call.keywords[0].arg}="
+                        f"{v.value!r}) — series not in the "
+                        f"module-level zero-touch universe "
+                        f"{sorted(fam.pretouched)}"))
+
+    report.stats["metric_families"] = n_checked
+    return idx

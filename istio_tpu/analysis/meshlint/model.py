@@ -1,0 +1,144 @@
+"""meshlint output contract — findings with file:line + witness chains.
+
+The snapshot analyzer (istio_tpu/analysis/findings.py) verifies CONFIG
+before it reaches the device; meshlint verifies the CODEBASE itself —
+the lock partial order, the hot-path sync discipline, the promtext
+zero-shaping doctrine and the typed-rejection contract that every PR
+since 1 has enforced by reviewer memory. Findings reuse the analyzer's
+Severity vocabulary so `mixs lint` / CI gates threshold identically to
+`mixs analyze`, but where a config finding names RULES and carries an
+attribute-bag witness, a code finding names a FILE:LINE and carries an
+acquisition/call CHAIN — the sequence of functions that realizes the
+claim (e.g. the exact call path on which lock B is taken under A).
+
+Pragma grammar (suppression is a reviewed decision, never silence):
+
+    # meshlint: lock-ok [reason]     — this acquisition/call edge is a
+                                       deliberate, documented ordering
+                                       exception
+    # meshlint: raise-ok [reason]    — this raise deliberately escapes
+                                       a front boundary untyped
+    # meshlint: metric-ok [reason]   — this family/series is exempt
+                                       from the zero-shaping contract
+    # hotpath: sync-ok [reason]      — pre-existing grammar, honored
+                                       by the hot-path pass unchanged
+
+A pragma applies to the physical line it sits on (the offending
+statement's first line)."""
+from __future__ import annotations
+
+import dataclasses
+
+from istio_tpu.analysis.findings import Severity
+
+# finding codes — one vocabulary across passes, fixtures, gates
+LOCK_CYCLE = "lock-order-cycle"          # cyclic lock-acquisition graph
+LOCK_INVERSION = "lock-order-inversion"  # edge against the declared order
+LOCK_LEAF = "leaf-lock-violation"        # lock taken under a leaf lock
+LOCK_SELF = "lock-self-deadlock"         # non-reentrant lock re-entered
+LOCK_UNDECLARED = "lock-order-undeclared"  # edge the manifest doesn't know
+HOTPATH_SYNC = "hotpath-host-sync"       # host sync/blocking in hot code
+HOTPATH_ROOT_MISSING = "hotpath-root-missing"  # configured root vanished
+METRIC_UNREGISTERED = "metric-unregistered"    # use of an unknown family
+METRIC_ZERO_SHAPE = "metric-zero-shape"  # family never zero-shaped
+METRIC_LABEL_MISMATCH = "metric-label-mismatch"  # label keys disagree
+METRIC_UNSHAPED_SERIES = "metric-unshaped-series"  # literal label value
+#                                          outside the pretouch universe
+UNTYPED_ESCAPE = "untyped-front-escape"  # raise escaping a front boundary
+BOUNDARY_MISSING = "front-boundary-missing"  # configured boundary vanished
+
+PRAGMA_PREFIX = "# meshlint:"
+HOTPATH_PRAGMA = "hotpath: sync-ok"
+
+
+def has_pragma(lines: list[str], lineno: int, tag: str) -> bool:
+    """True when the physical line carries `# meshlint: <tag>` (or, for
+    the hot-path pass, the pre-existing `# hotpath: sync-ok`)."""
+    if not (0 < lineno <= len(lines)):
+        return False
+    line = lines[lineno - 1]
+    return f"meshlint: {tag}" in line or \
+        (tag == "sync-ok" and HOTPATH_PRAGMA in line)
+
+
+@dataclasses.dataclass
+class LintFinding:
+    """One code-discipline verdict, anchored at file:line.
+
+    `chain` is the witness: an ordered tuple of human-readable frames
+    ("path:line func — what happened here") realizing the claim — the
+    full acquisition chain for a lock finding, the entry-point call
+    path for a hot-path finding, the propagation path for an escape."""
+    code: str
+    severity: Severity
+    path: str          # repo-relative
+    line: int
+    func: str          # qualified function ("Class.method" or module scope)
+    message: str
+    chain: tuple[str, ...] = ()
+
+    @property
+    def where(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def __str__(self) -> str:
+        s = f"{self.severity.name:7s} {self.code} {self.where} " \
+            f"[{self.func}]: {self.message}"
+        if self.chain:
+            s += "\n" + "\n".join(f"        {i}. {c}"
+                                  for i, c in enumerate(self.chain, 1))
+        return s
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "severity": self.severity.name,
+                "path": self.path, "line": self.line, "func": self.func,
+                "message": self.message, "chain": list(self.chain)}
+
+
+@dataclasses.dataclass
+class MeshlintReport:
+    """All passes' findings over one tree + the stats gates key on."""
+    findings: list[LintFinding] = dataclasses.field(default_factory=list)
+    n_modules: int = 0
+    n_functions: int = 0
+    wall_ms: float = 0.0
+    # per-pass bookkeeping the smoke asserts on (e.g. inferred hot
+    # coverage); passes stash JSON-able extras here
+    stats: dict = dataclasses.field(default_factory=dict)
+
+    def add(self, finding: LintFinding) -> None:
+        self.findings.append(finding)
+
+    def extend(self, findings) -> None:
+        self.findings.extend(findings)
+
+    def by_severity(self, sev: Severity) -> list[LintFinding]:
+        return [f for f in self.findings if f.severity == sev]
+
+    @property
+    def errors(self) -> list[LintFinding]:
+        return self.by_severity(Severity.ERROR)
+
+    @property
+    def warnings(self) -> list[LintFinding]:
+        return self.by_severity(Severity.WARNING)
+
+    @property
+    def has_errors(self) -> bool:
+        return any(f.severity == Severity.ERROR for f in self.findings)
+
+    def codes(self) -> set[str]:
+        return {f.code for f in self.findings}
+
+    def to_dict(self) -> dict:
+        counts: dict[str, int] = {}
+        for f in self.findings:
+            counts[f.code] = counts.get(f.code, 0) + 1
+        return {"n_modules": self.n_modules,
+                "n_functions": self.n_functions,
+                "wall_ms": round(self.wall_ms, 3),
+                "n_errors": len(self.errors),
+                "n_warnings": len(self.warnings),
+                "counts_by_code": counts,
+                "stats": self.stats,
+                "findings": [f.to_dict() for f in self.findings]}

@@ -1,0 +1,385 @@
+"""Typed-rejection pass — untyped raises must not escape the fronts.
+
+Contract (runtime/resilience.py): rejections cross a front as
+`CheckRejected` subclasses carrying `grpc_code`, and every front —
+grpc sync + aio handlers, the native pump's batch legs, the discovery
+HTTP front, the introspect admin front — maps them to wire status.
+An exception WITHOUT a wire code escaping a boundary surfaces as
+transport-default UNKNOWN with no shed/reject accounting: the exact
+bug class PR 6's typed-shed work removed.
+
+The pass computes, per function, the exception classes its explicit
+`raise` statements can propagate (through the call graph, filtered by
+enclosing `except` clauses — `except Exception`/bare catches all; a
+bare `raise` inside `except X` re-raises X) and verifies each
+FRONT_BOUNDARY function lets nothing untyped out. The typed set is
+STRUCTURAL: any scanned class that defines or inherits a `grpc_code`
+attribute.
+
+Scope is deliberately bounded to keep verdicts actionable: escapes of
+IN-UNIVERSE exception classes are tracked through the whole call
+graph, while builtin raises (`ValueError(...)` etc.) are only flagged
+when raised DIRECTLY in a boundary function — a ValueError deep in a
+helper is a programming-error path (grpc's catch-all is the right
+backstop), but an in-universe domain rejection crossing a front
+untyped is a contract violation wherever it starts.
+
+`# meshlint: raise-ok [reason]` on the raise line suppresses.
+`front-boundary-missing` (ERROR) fires when a configured boundary no
+longer resolves, so the manifest cannot rot silently."""
+from __future__ import annotations
+
+import ast
+
+from istio_tpu.analysis.findings import Severity
+from istio_tpu.analysis.meshlint import callgraph as cg
+from istio_tpu.analysis.meshlint import model
+
+# (module substring, qualname suffix) — resolved against the universe
+FRONT_BOUNDARIES: tuple[tuple[str, str], ...] = (
+    # grpc sync front
+    ("api.grpc_server", "MixerGrpcServer._check"),
+    ("api.grpc_server", "MixerGrpcServer._batch_check"),
+    ("api.grpc_server", "MixerGrpcServer._report"),
+    # grpc aio front
+    ("api.grpc_server", "MixerAioGrpcServer._acheck"),
+    ("api.grpc_server", "MixerAioGrpcServer._abatch_check"),
+    ("api.grpc_server", "MixerAioGrpcServer._areport"),
+    # native wire front: the pump thread and its dispatch legs
+    ("api.native_server", "NativeMixerServer._pump_loop"),
+    ("api.native_server", "NativeMixerServer._run_batch"),
+    ("api.native_server", "NativeMixerServer._run_reports"),
+    ("api.native_server", "NativeMixerServer._run_checks"),
+    # discovery HTTP front (nested stdlib handler class)
+    ("pilot.discovery", "Handler.do_GET"),
+    # introspect admin front: do_GET delegates straight to _route
+    ("introspect.server", "Handler.do_GET"),
+    ("introspect.server", "IntrospectServer._route"),
+)
+
+# builtin exception hierarchy (the slice this codebase raises) — used
+# to decide whether an `except` clause catches a class.
+_BUILTIN_BASES: dict[str, str] = {
+    "Exception": "BaseException",
+    "ArithmeticError": "Exception", "ZeroDivisionError":
+        "ArithmeticError", "AssertionError": "Exception",
+    "AttributeError": "Exception", "BufferError": "Exception",
+    "EOFError": "Exception", "ImportError": "Exception",
+    "ModuleNotFoundError": "ImportError", "LookupError": "Exception",
+    "IndexError": "LookupError", "KeyError": "LookupError",
+    "MemoryError": "Exception", "NameError": "Exception",
+    "OSError": "Exception", "IOError": "OSError",
+    "FileNotFoundError": "OSError", "TimeoutError": "OSError",
+    "ConnectionError": "OSError", "BrokenPipeError":
+        "ConnectionError", "ReferenceError": "Exception",
+    "RuntimeError": "Exception", "NotImplementedError":
+        "RuntimeError", "RecursionError": "RuntimeError",
+    "StopIteration": "Exception", "StopAsyncIteration": "Exception",
+    "SyntaxError": "Exception", "SystemError": "Exception",
+    "TypeError": "Exception", "ValueError": "Exception",
+    "UnicodeError": "ValueError", "OverflowError": "ArithmeticError",
+    "KeyboardInterrupt": "BaseException", "SystemExit":
+        "BaseException", "GeneratorExit": "BaseException",
+}
+
+
+class EscapeIndex:
+    """Per-function escaping-exception summaries with witness
+    back-pointers, plus the structural typed set."""
+
+    def __init__(self, u: cg.Universe) -> None:
+        self.u = u
+        # class fqn → raw base-name strings (pre-resolution)
+        self.raw_bases: dict[str, list[str]] = {}
+        # class fqns that define/inherit grpc_code
+        self.typed: set[str] = set()
+        self._collect_classes()
+        # fqn → {exc_key: (line, via_fqn|None, raise_line)} where
+        # exc_key is a class fqn or a builtin name; builtins only
+        # recorded at depth 0 (via is None)
+        self.escapes: dict[str, dict[str, tuple[int, str | None]]] = {}
+        self._direct: dict[str, list[tuple[str, int, tuple]]] = {}
+        self._calls: dict[str, list[tuple[str, int, tuple]]] = {}
+        for fi in u.functions.values():
+            self._scan(fi)
+        self._fixpoint()
+
+    # -- class facts --------------------------------------------------
+
+    def _collect_classes(self) -> None:
+        for mi in self.u.modules.values():
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                fqns = [f for f, ci in self.u.classes.items()
+                        if ci.module == mi.name
+                        and ci.name.split(".")[-1] == node.name]
+                raw = []
+                for b in node.bases:
+                    ch = cg._dotted(b)
+                    if ch:
+                        raw.append(ch[-1])
+                defines = any(
+                    (isinstance(st, ast.Assign)
+                     and any(isinstance(t, ast.Name)
+                             and t.id == "grpc_code"
+                             for t in st.targets))
+                    or (isinstance(st, ast.AnnAssign)
+                        and isinstance(st.target, ast.Name)
+                        and st.target.id == "grpc_code")
+                    for st in node.body)
+                for f in fqns:
+                    self.raw_bases[f] = raw
+                    if defines:
+                        self.typed.add(f)
+        # inheritance closure over scanned bases
+        changed = True
+        while changed:
+            changed = False
+            for f, ci in self.u.classes.items():
+                if f in self.typed:
+                    continue
+                if any(b in self.typed for b in ci.bases):
+                    self.typed.add(f)
+                    changed = True
+
+    def ancestors(self, exc_key: str) -> set[str]:
+        """Simple-name ancestor set (self included) of a class fqn or
+        builtin name — the vocabulary `except` clauses speak."""
+        out: set[str] = set()
+        stack = [exc_key]
+        seen: set[str] = set()
+        while stack:
+            k = stack.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            if k in self.u.classes:
+                ci = self.u.classes[k]
+                out.add(ci.name.split(".")[-1])
+                stack.extend(ci.bases)
+                for rb in self.raw_bases.get(k, ()):
+                    if rb in _BUILTIN_BASES or rb in ("BaseException",):
+                        stack.append(rb)
+            else:
+                out.add(k)
+                if k in _BUILTIN_BASES:
+                    stack.append(_BUILTIN_BASES[k])
+        return out
+
+    def is_typed(self, exc_key: str) -> bool:
+        return exc_key in self.typed
+
+    def display(self, exc_key: str) -> str:
+        if exc_key in self.u.classes:
+            ci = self.u.classes[exc_key]
+            return f"{ci.module.rsplit('.', 1)[-1]}.{ci.name}"
+        return exc_key
+
+    def _caught_by(self, exc_key: str,
+                   handler_stack: tuple) -> bool:
+        """handler_stack: tuple of frozensets of handler names active
+        at the site; None inside a set = bare except."""
+        anc = None
+        for names in handler_stack:
+            if None in names:
+                return True
+            if anc is None:
+                anc = self.ancestors(exc_key)
+            if anc & names:
+                return True
+        return False
+
+    # -- per-function scan --------------------------------------------
+
+    def _exc_key_of(self, fi: cg.FunctionInfo, node: ast.AST,
+                    ) -> str | None:
+        """raise operand → class fqn / builtin name / None."""
+        if isinstance(node, ast.Call):
+            node = node.func
+        ch = cg._dotted(node)
+        if ch is None:
+            return None
+        mi = self.u.modules[fi.module]
+        fqn = self.u.resolve_class(mi, ".".join(ch))
+        if fqn:
+            return fqn
+        tail = ch[-1]
+        if tail in _BUILTIN_BASES or tail == "BaseException":
+            return tail
+        if not tail[:1].isupper():
+            # `raise first` — a VARIABLE holding an exception whose
+            # type is dynamic; model it as Exception (what a front's
+            # catch-all would see), judged at the boundary only
+            return "Exception"
+        # unknown foreign class — keep its simple name so the catch
+        # filter can still match `except Tail`
+        return tail
+
+    def _handler_names(self, handler: ast.ExceptHandler,
+                       ) -> frozenset:
+        if handler.type is None:
+            return frozenset({None})
+        types = handler.type.elts \
+            if isinstance(handler.type, ast.Tuple) else [handler.type]
+        names = set()
+        for t in types:
+            ch = cg._dotted(t)
+            names.add(ch[-1] if ch else None)
+        return frozenset(names)
+
+    def _scan(self, fi: cg.FunctionInfo) -> None:
+        u = self.u
+        local = u.local_types(fi)
+        direct: list[tuple[str, int, tuple]] = []
+        calls: list[tuple[str, int, tuple]] = []
+        nested: set[ast.AST] = set()
+        for n in ast.walk(fi.node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n is not fi.node:
+                for sub in ast.walk(n):
+                    nested.add(sub)
+
+        def visit(node: ast.AST, stack: tuple, cur_handler: str | None,
+                  handler_var: str | None) -> None:
+            if node in nested:
+                return
+            if isinstance(node, ast.Try):
+                hnames = tuple(self._handler_names(h)
+                               for h in node.handlers)
+                inner = stack + tuple(hnames)
+                for st in node.body:
+                    visit(st, inner, cur_handler, handler_var)
+                for h in node.handlers:
+                    ht = self._handler_names(h)
+                    rep = next(iter(ht - {None}), None)
+                    for st in h.body:
+                        visit(st, stack, rep, h.name)
+                for st in node.orelse + node.finalbody:
+                    visit(st, stack, cur_handler, handler_var)
+                return
+            if isinstance(node, ast.Raise):
+                if node.exc is None:
+                    key = cur_handler or "BaseException"
+                    direct.append((key, node.lineno, stack))
+                elif isinstance(node.exc, ast.Name) \
+                        and handler_var and node.exc.id == handler_var:
+                    direct.append((cur_handler or "BaseException",
+                                   node.lineno, stack))
+                else:
+                    key = self._exc_key_of(fi, node.exc)
+                    if key is not None:
+                        direct.append((key, node.lineno, stack))
+                # fall through: the raise operand may contain calls
+            if isinstance(node, ast.Call):
+                callee = u.resolve_call(fi, node, local)
+                if callee is not None and callee != fi.fqn:
+                    calls.append((callee, node.lineno, stack))
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack, cur_handler, handler_var)
+
+        for st in fi.node.body:
+            visit(st, (), None, None)
+        self._direct[fi.fqn] = direct
+        self._calls[fi.fqn] = calls
+
+    # -- fixpoint -----------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for fqn in self.u.functions:
+            esc: dict[str, tuple[int, str | None]] = {}
+            lines = self.u.lines_of(self.u.functions[fqn])
+            for key, line, stack in self._direct.get(fqn, ()):
+                if model.has_pragma(lines, line, "raise-ok"):
+                    continue
+                if not self._caught_by(key, stack):
+                    esc.setdefault(key, (line, None))
+            self.escapes[fqn] = esc
+        changed = True
+        while changed:
+            changed = False
+            for fqn in self.u.functions:
+                esc = self.escapes[fqn]
+                for callee, line, stack in self._calls.get(fqn, ()):
+                    for key in self.escapes.get(callee, ()):
+                        # builtin / foreign names propagate one level
+                        # only when tracked in-universe
+                        if key not in self.u.classes \
+                                and key not in _BUILTIN_BASES \
+                                and key != "BaseException":
+                            pass  # foreign simple name: still track
+                        if key not in esc \
+                                and not self._caught_by(key, stack):
+                            esc[key] = (line, callee)
+                            changed = True
+
+    def chain_to(self, fqn: str, key: str, _depth: int = 0) -> list[str]:
+        if _depth > 32:
+            return ["… (chain truncated)"]
+        entry = self.escapes.get(fqn, {}).get(key)
+        if entry is None:
+            return []
+        line, via = entry
+        fi = self.u.functions[fqn]
+        if via is None:
+            return [f"{fi.path}:{line} {fi.qual} — raises "
+                    f"{self.display(key)}"]
+        vi = self.u.functions[via]
+        return [f"{fi.path}:{line} {fi.qual} — calls {vi.qual}"] \
+            + self.chain_to(via, key, _depth + 1)
+
+
+def resolve_boundaries(u: cg.Universe,
+                       specs: tuple[tuple[str, str], ...]
+                       = FRONT_BOUNDARIES,
+                       ) -> tuple[list[cg.FunctionInfo], list[str]]:
+    found: list[cg.FunctionInfo] = []
+    missing: list[str] = []
+    for mod_sub, suffix in specs:
+        hits = [f for f in u.functions.values()
+                if mod_sub in f.module
+                and (f.qual == suffix
+                     or f.qual.endswith("." + suffix))]
+        if hits:
+            found.extend(hits)
+        else:
+            missing.append(f"{mod_sub}::{suffix}")
+    return found, missing
+
+
+def run(u: cg.Universe, report: model.MeshlintReport,
+        boundaries: tuple[tuple[str, str], ...] = FRONT_BOUNDARIES,
+        ) -> EscapeIndex:
+    idx = EscapeIndex(u)
+    fronts, missing = resolve_boundaries(u, boundaries)
+    for m in missing:
+        report.add(model.LintFinding(
+            model.BOUNDARY_MISSING, Severity.ERROR, "<config>", 0,
+            "<config>",
+            f"front boundary {m!r} no longer resolves — update "
+            f"meshlint.rejections.FRONT_BOUNDARIES"))
+    seen: set[tuple] = set()
+    for fi in fronts:
+        for key, (line, via) in sorted(idx.escapes.get(fi.fqn,
+                                                       {}).items()):
+            in_universe = key in u.classes
+            if not in_universe and via is not None:
+                continue    # builtins judged at the boundary only
+            if idx.is_typed(key):
+                continue
+            dkey = (fi.fqn, key)
+            if dkey in seen:
+                continue
+            seen.add(dkey)
+            chain = tuple(idx.chain_to(fi.fqn, key))
+            report.add(model.LintFinding(
+                model.UNTYPED_ESCAPE, Severity.ERROR, fi.path, line,
+                fi.qual,
+                f"{idx.display(key)} can escape front boundary "
+                f"{fi.qual} without a grpc_code — raise a typed "
+                f"rejection (runtime.resilience.CheckRejected "
+                f"subclass) or catch-and-map at the front",
+                chain=chain))
+    report.stats["front_boundaries"] = len(fronts)
+    report.stats["typed_exceptions"] = len(idx.typed)
+    return idx

@@ -617,7 +617,11 @@ class MixerAioGrpcServer(MixerGrpcServer):
                             self._tag_status(span, first.grpc_code)
                             await context.abort(_reject_status(first),
                                                 str(first))
-                        raise first
+                        # programming errors (non-CheckRejected) ride
+                        # grpc's catch-all to UNKNOWN on purpose — a
+                        # typed wrapper here would mislabel bugs as
+                        # load sheds
+                        raise first   # meshlint: raise-ok bug-surface
             self._tag_status(span, 0)
         monitor.REPORT_RESPONSES.inc()
         return pb.ReportResponse()

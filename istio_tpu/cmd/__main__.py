@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import threading
@@ -165,6 +166,40 @@ def cmd_analyze(args: argparse.Namespace) -> int:
               f"{len(report.warnings)} warning(s), "
               f"{len(report.findings)} finding(s) over "
               f"{report.n_rules} rule(s) in {report.wall_ms:.0f}ms")
+    return 1 if report.has_errors else 0
+
+
+def cmd_lint(args: argparse.Namespace) -> int:
+    """meshlint — the CODE-side sibling of `analyze`: run the
+    concurrency & discipline passes (lock order, inferred hot-path
+    reachability, metric zero-shaping, typed rejections) over the
+    package's own source. Exits 1 when any ERROR-severity finding is
+    present (CI-gateable) or when --selftest finds a violation class
+    the analyzer no longer detects."""
+    from istio_tpu.analysis.meshlint import fixtures, run_meshlint
+
+    if args.selftest:
+        problems = fixtures.selftest()
+        for p in problems:
+            print(f"lint selftest: {p}")
+        if not problems:
+            print(f"lint selftest: ok "
+                  f"({len(fixtures.FIXTURES)} fixtures)")
+        return 1 if problems else 0
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    report = run_meshlint(root=root)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1, default=str))
+    else:
+        for f in report.findings:
+            print(f)
+        print(f"lint: {len(report.errors)} error(s), "
+              f"{len(report.warnings)} warning(s), "
+              f"{len(report.findings)} finding(s) over "
+              f"{report.n_functions} function(s) in "
+              f"{report.n_modules} module(s) in "
+              f"{report.wall_ms:.0f}ms")
     return 1 if report.has_errors else 0
 
 
@@ -968,6 +1003,21 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--json", action="store_true",
                    help="machine-readable report")
     s.set_defaults(fn=cmd_analyze)
+
+    s = sub.add_parser("lint",
+                       help="meshlint: concurrency & discipline "
+                            "static analysis over the package source "
+                            "(exit 1 on ERROR findings)")
+    s.add_argument("--root", default=None,
+                   help="repo root holding the istio_tpu package "
+                        "(default: the installed package's parent)")
+    s.add_argument("--selftest", action="store_true",
+                   help="run the seeded violation corpus instead of "
+                        "the tree (proves every violation class is "
+                        "still detected)")
+    s.add_argument("--json", action="store_true",
+                   help="machine-readable report")
+    s.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser("canary",
                        help="offline shadow replay: recorded corpus "

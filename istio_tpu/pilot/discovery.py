@@ -86,6 +86,12 @@ REGISTRY = prometheus_client.CollectorRegistry()
 CALLS = prometheus_client.Counter(
     "pilot_discovery_calls", "discovery endpoint calls",
     ["endpoint", "cache"], registry=REGISTRY)
+# pre-touch the full series shape (promtext doctrine): a scrape taken
+# before the first poll already shows every endpoint/cache series, so
+# hit-rate dashboards never see a series pop into existence mid-storm
+for _ep in ("sds", "cds", "rds", "lds", "az"):
+    for _c in ("hit", "miss"):
+        CALLS.labels(endpoint=_ep, cache=_c)
 
 DEFAULT_WATCH_TIMEOUT_S = 25.0
 MAX_WATCH_TIMEOUT_S = 60.0

@@ -166,7 +166,9 @@ class Dispatcher:
             inv = {v: k for k, v in
                    self.snapshot.ruleset.ns_ids.items()}
             self._ns_name_of = inv
-        uniq, inverse = np.unique(np.asarray(ns_ids),
+        # ns_ids is the host-side id list built at tensorize time —
+        # never a device buffer, so this asarray copies host memory
+        uniq, inverse = np.unique(np.asarray(ns_ids),  # hotpath: sync-ok host id list
                                   return_inverse=True)
         gs = self.grants.grants_for(
             [inv.get(int(u), "") for u in uniq])

@@ -486,7 +486,7 @@ class ResilientChecker:
                     # one jittered retry absorbs transient device
                     # faults (a dropped tunnel frame, a preempted
                     # step) without involving the breaker
-                    time.sleep(self.config.retry_backoff_s +
+                    time.sleep(self.config.retry_backoff_s +  # hotpath: sync-ok failure-path backoff only
                                random.random() *
                                self.config.retry_jitter_s)
                     monitor.CHECK_DEVICE_RETRIES.inc()

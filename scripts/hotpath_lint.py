@@ -1,30 +1,29 @@
-"""Hot-path lint: ban host-sync calls in the serving batch-build/step
-sections.
+"""Hot-path lint — THIN SHIM over istio_tpu/analysis/meshlint.
 
-The serving hot path (batch build in `runtime/batcher.py`, the fused
-check/report paths in `runtime/dispatcher.py`, the packed device trips
-in `runtime/fused.py`) is engineered around ONE host<->device sync per
-batch — every extra pull costs a full transport RTT (~120ms behind the
-axon tunnel) and a stray `.item()` or `float(jnp_sum(...))` silently
-serializes the pipeline. This AST lint walks the configured hot
-functions and flags:
+The detection logic (host-sync/blocking/allocation checks, the
+`# hotpath: sync-ok` pragma grammar) and, more importantly, the
+COVERAGE now live in `istio_tpu.analysis.meshlint.hotpath`: instead
+of this file's hand-maintained HOT_SECTIONS list, the analyzer
+computes reachability from the hot entry points, so a new helper
+called from hot code is covered the moment it is called — with no
+list to extend per PR.
 
-  * `.item()` calls and `jax.device_get` / `block_until_ready` —
-    always a device sync;
-  * `np.asarray(...)` / `np.array(...)` — a device pull when fed a
-    device buffer (list/list-comp literals are auto-allowed);
-  * `float()` / `int()` / `bool()` whose argument is a CALL expression
-    (`float(x.sum())` syncs the computation it wraps);
-  * blocking I/O on the flusher/dispatcher threads: `open`, `print`,
-    `input`, `time.sleep`, subprocess/urllib/requests use.
+What stays here:
 
-Deliberate boundary crossings — THE designated pull, host-numpy work
-after it — carry a `# hotpath: sync-ok` pragma on the offending line;
-the lint enforces that every crossing is annotated, so a new sync in a
-hot section is a conscious, reviewable decision, never an accident.
+  * `HOT_SECTIONS` — FROZEN as the historical baseline. It is no
+    longer the coverage source; it is the floor the superset test
+    (tests/test_meshlint_smoke.py) pins the inferred coverage
+    against, so a call-graph regression that silently drops a
+    once-hot function fails loudly. Do NOT extend it for new code —
+    new hot helpers are inferred.
+  * `lint_source` / `Violation` — the single-module lint surface
+    tests and downstream tooling import; delegates to the meshlint
+    detector.
+  * `main()` — runs the meshlint hot-path pass over the repo
+    (tier-1 calls this via tests/test_hotpath_lint.py).
 
 Usage: python scripts/hotpath_lint.py [--root DIR]   (exit 1 on
-violations; tier-1 runs main() via tests/test_hotpath_lint.py)
+violations)
 """
 from __future__ import annotations
 
@@ -39,9 +38,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 PRAGMA = "hotpath: sync-ok"
 
-# file (repo-relative) → hot function qualnames (Class.method); the
-# batch-build/step sections of the serving path. Additions here are
-# the review surface when the hot path grows.
+# FROZEN baseline (see module docstring): the last hand-maintained
+# coverage list, kept as the superset-pin floor for the inferred
+# reachability in istio_tpu/analysis/meshlint/hotpath.py.
 HOT_SECTIONS: dict[str, frozenset[str]] = {
     "istio_tpu/runtime/batcher.py": frozenset({
         "CheckBatcher.submit", "CheckBatcher._loop",
@@ -57,73 +56,32 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
         "Dispatcher._ns_ids_from_batch",
         "Dispatcher._request_ns_ids",
         "Dispatcher._report_active_fused",
-        # the report coalescer's dispatch leg (the telemetry
-        # ingestion plane): runs on the report batcher's worker —
-        # adapter fan-out and stage accounting only; the designated
-        # device pulls live in _report_active_fused above
         "Dispatcher.report",
         "Dispatcher._apply_device_status", "Dispatcher._combine",
     }),
     "istio_tpu/runtime/fused.py": frozenset({
         "FusedPlan.packed_check", "FusedPlan.packed_report",
         "FusedPlan.packed_check_instep", "FusedPlan.narrow_batch",
-        # swap-warm oracle bridge (PR 7): consulted on every served
-        # batch by Dispatcher._check_fused — host-numpy tier routing
-        # only, same pragma discipline as narrow_batch
         "FusedPlan.swap_warm_pending", "FusedPlan._serve_width",
     }),
-    # report ingestion entries (the telemetry ingestion plane):
-    # submit_report runs on pump/front threads (ack-after-enqueue —
-    # the admission path must never sync or block), and
-    # _run_report_batch is the coalescer worker's dispatch hook
     "istio_tpu/runtime/server.py": frozenset({
         "RuntimeServer.submit_report",
         "RuntimeServer._run_report_batch",
     }),
-    # quota-plane flush (PR 7): the classic worker's device trip now
-    # builds its tick/last staging under _lock INSIDE the _counts_lock
-    # critical section (ordered with in-step session dispatch); its
-    # designated pull and host-numpy kernel selection carry the only
-    # sync-ok pragmas in the file
     "istio_tpu/runtime/device_quota.py": frozenset({
         "DeviceQuotaPool._flush",
     }),
-    # rule-telemetry fold + drain (PR 4): observe/add_host/sample run
-    # inside the batch step; drain's device→host pull is THE designated
-    # boundary and carries the only sync-ok pragmas in the file
     "istio_tpu/runtime/rulestats.py": frozenset({
         "RuleTelemetry.observe", "RuleTelemetry.add_host",
         "RuleTelemetry.sample", "RuleTelemetry.drain",
     }),
-    # canary recorder tap (PR 5): runs inside the dispatcher's check
-    # hot sections (already linted above) on every served batch —
-    # stride check + bounded tuple appends only. Corpus build / replay
-    # / diff run at config-swap time, NOT here: the replay boundary
-    # (canary/replay.py via the observe-off Dispatcher) is where the
-    # device pulls live, behind dispatcher.py's existing pragmas.
     "istio_tpu/canary/recorder.py": frozenset({
         "TrafficRecorder.tap",
     }),
-    # adapter-executor plane (ISSUE 12): submit runs once per host
-    # action on the dispatcher's batch worker (breaker check + a
-    # non-blocking queue put — never a wait), and resolve is THE
-    # designated deadline-bounded fold boundary (its Event.wait is
-    # the one place the batch may block on host work, bounded by the
-    # request deadline). The reworked Dispatcher._overlay_active and
-    # _check_fused host fold stay linted above.
     "istio_tpu/runtime/executor.py": frozenset({
         "HandlerLane.submit", "AdapterExecutor.submit",
         "AdapterExecutor.resolve",
     }),
-    # tail-latency forensics (ISSUE 14): the flight recorder's tape
-    # primitives run inside the batch step (batch_begin once per
-    # batch, stage_mark per stage observation via the monitor tap,
-    # host_wait per executor claim) and the capture path (note_batch /
-    # note_direct / _capture) runs only for over-threshold requests —
-    # all host-side dict/deque work; EventTimeline.record is called
-    # from hot sections (quota _flush, breaker transitions) and must
-    # stay a leaf-lock deque append. The serve boundaries (snapshot,
-    # overlapping, capture_profile, thread_stacks) are scrape-rate.
     "istio_tpu/runtime/forensics.py": frozenset({
         "FlightRecorder.batch_begin", "FlightRecorder.stage_mark",
         "FlightRecorder.host_wait", "FlightRecorder.note_wire_decode",
@@ -131,41 +89,19 @@ HOT_SECTIONS: dict[str, frozenset[str]] = {
         "FlightRecorder._capture", "EventTimeline.record",
         "EventTimeline._mergeable",
     }),
-    # sharded serving plane (ISSUE 10): the shard router runs on every
-    # lane's step worker (check = route + per-bank fused check + fold)
-    # and the lane selector on every front thread's submit — host
-    # string/dict work only; the banks' device pulls live behind
-    # dispatcher.py's and fused.py's existing pragmas
     "istio_tpu/sharding/router.py": frozenset({
         "ShardRouter.check", "ReplicaRouter.submit",
         "ReplicaRouter.lane_of",
     }),
-    # pilot discovery serving plane (ISSUE 15): cache lookup/store run
-    # on every fleet poll (dict lookup + counters — a 10k-sidecar poll
-    # storm rides these), _serve_cached is the per-call serve path and
-    # _generate_rds_batch the batched generation leg (host JSON
-    # assembly; its device step lives in route_nfa below)
     "istio_tpu/pilot/discovery.py": frozenset({
         "SnapshotCache.lookup", "SnapshotCache.peek",
         "SnapshotCache.store", "DiscoveryService._serve_cached",
         "DiscoveryService._generate_rds_batch",
     }),
-    # batched source-admission device step (ISSUE 15): ONE pull per
-    # batched generation — the np.asarray on the matched plane is THE
-    # designated boundary and carries the file's only sync-ok pragma
     "istio_tpu/pilot/route_nfa.py": frozenset({
         "RouteScopeProgram.admit_rows",
     }),
 }
-
-_SYNC_ATTRS = ("item", "block_until_ready")
-_PULL_FUNCS = {("np", "asarray"), ("np", "array"),
-               ("numpy", "asarray"), ("numpy", "array"),
-               ("jax", "device_get")}
-_CAST_FUNCS = {"float", "int", "bool"}
-_BLOCKING_NAMES = {"open", "input", "print", "breakpoint"}
-_BLOCKING_ATTRS = {("time", "sleep")}
-_BLOCKING_MODULES = {"subprocess", "urllib", "requests", "socket"}
 
 
 @dataclasses.dataclass
@@ -179,67 +115,14 @@ class Violation:
         return f"{self.path}:{self.line}: [{self.func}] {self.message}"
 
 
-def _dotted(node: ast.AST) -> tuple[str, ...] | None:
-    """Attribute/Name chain → ('np', 'asarray') etc."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return tuple(reversed(parts))
-    return None
-
-
-class _HotVisitor(ast.NodeVisitor):
-    def __init__(self, path: str, func: str, lines: list[str],
-                 out: list[Violation]):
-        self.path = path
-        self.func = func
-        self.lines = lines
-        self.out = out
-
-    def _pragma(self, node: ast.AST) -> bool:
-        line = self.lines[node.lineno - 1] \
-            if 0 < node.lineno <= len(self.lines) else ""
-        return PRAGMA in line
-
-    def _flag(self, node: ast.AST, message: str) -> None:
-        if not self._pragma(node):
-            self.out.append(Violation(self.path, node.lineno,
-                                      self.func, message))
-
-    def visit_Call(self, node: ast.Call) -> None:
-        fn = node.func
-        if isinstance(fn, ast.Attribute):
-            if fn.attr in _SYNC_ATTRS:
-                self._flag(node, f".{fn.attr}() is a host sync")
-            chain = _dotted(fn)
-            if chain is not None:
-                if chain[-2:] in _PULL_FUNCS or chain in _PULL_FUNCS:
-                    # list/list-comp literals are provably host-side
-                    arg = node.args[0] if node.args else None
-                    if not isinstance(arg, (ast.List, ast.ListComp)):
-                        self._flag(node,
-                                   f"{'.'.join(chain)}() pulls device "
-                                   f"buffers to host")
-                if chain[:2] in _BLOCKING_ATTRS \
-                        or chain[0] in _BLOCKING_MODULES:
-                    self._flag(node, f"blocking call "
-                                     f"{'.'.join(chain)}()")
-        elif isinstance(fn, ast.Name):
-            if fn.id in _CAST_FUNCS and node.args \
-                    and isinstance(node.args[0], ast.Call):
-                self._flag(node, f"{fn.id}(<call>) syncs the wrapped "
-                                 f"computation")
-            if fn.id in _BLOCKING_NAMES:
-                self._flag(node, f"blocking builtin {fn.id}()")
-        self.generic_visit(node)
-
-
 def lint_source(source: str, hot_names: frozenset[str],
                 path: str = "<memory>") -> list[Violation]:
-    """AST-lint one module's hot functions; importable for tests."""
+    """AST-lint one module's named hot functions (the pre-meshlint
+    surface, kept for tests/tooling); detection delegates to
+    meshlint's hot-path detector so there is exactly one definition
+    of "host sync"."""
+    from istio_tpu.analysis.meshlint.hotpath import sync_sites
+
     tree = ast.parse(source)
     lines = source.splitlines()
     out: list[Violation] = []
@@ -252,10 +135,10 @@ def lint_source(source: str, hot_names: frozenset[str],
                                     ast.AsyncFunctionDef)):
                 qual = f"{prefix}{child.name}"
                 if qual in hot_names:
-                    _HotVisitor(path, qual, lines, out).visit(child)
+                    for line, message in sync_sites(child, lines):
+                        out.append(Violation(path, line, qual,
+                                             message))
                 else:
-                    # nested defs inside a hot function are covered by
-                    # the visitor above; nested hot names still match
                     walk(child, f"{qual}.")
             else:
                 walk(child, prefix)
@@ -267,30 +150,19 @@ def lint_source(source: str, hot_names: frozenset[str],
 def main(root: str | None = None) -> int:
     root = root or os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
-    violations: list[Violation] = []
-    for rel, hot in sorted(HOT_SECTIONS.items()):
-        path = os.path.join(root, rel)
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
-        found = {name.split(".")[-1] for name in hot}
-        present = set()
-        tree = ast.parse(source)
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                present.add(node.name)
-        missing = found - present
-        if missing:
-            violations.append(Violation(
-                rel, 1, "<config>",
-                f"hot functions {sorted(missing)} no longer exist — "
-                f"update HOT_SECTIONS"))
-        violations.extend(lint_source(source, hot, rel))
+    from istio_tpu.analysis.meshlint import run_meshlint
+
+    report = run_meshlint(root=root, passes=("hotpath",))
+    violations = [
+        Violation(f.path, f.line, f.func, f.message)
+        for f in report.findings]
     for v in violations:
         print(f"hotpath_lint: {v}")
     if not violations:
-        n = sum(len(v) for v in HOT_SECTIONS.values())
-        print(f"hotpath_lint: ok ({n} hot functions across "
-              f"{len(HOT_SECTIONS)} files clean)")
+        print(f"hotpath_lint: ok "
+              f"({report.stats.get('hot_reachable', 0)} inferred hot "
+              f"functions from {report.stats.get('hot_roots', 0)} "
+              f"roots clean)")
     return 1 if violations else 0
 
 

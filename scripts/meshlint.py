@@ -1,0 +1,96 @@
+"""meshlint CI gate — proves the analyzer catches AND the tree is
+clean, in one tier-1-runnable script.
+
+Three legs, all must hold (exit 1 otherwise):
+
+  1. **Seeded corpus** (fixtures.selftest): every violation class —
+     lock-order cycle/inversion/leaf/self-deadlock, hot-path
+     host-sync, missing hot root, unregistered / non-zero-shaped /
+     mislabeled metric, untyped front escape — is flagged with a
+     file:line witness, pragmas are honored, and the clean fixture
+     stays silent. A gate that cannot demonstrate detection is
+     indistinguishable from a broken one.
+  2. **Clean tree**: the real repo yields ZERO ERROR-severity
+     findings (real violations get fixed or pragma'd with a reason
+     in the same PR that introduces them).
+  3. **Superset pin**: the inferred hot-path coverage contains every
+     (file, function) the retired hand-maintained HOT_SECTIONS list
+     named — a call-graph regression that silently drops a once-hot
+     function fails here, not in production.
+
+Usage: python scripts/meshlint.py [--root DIR]
+(tier-1 runs main() via tests/test_meshlint_smoke.py)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(root: str | None = None) -> int:
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    from istio_tpu.analysis.meshlint import fixtures, run_meshlint
+
+    failures: list[str] = []
+
+    # -- leg 1: seeded violation corpus -------------------------------
+    problems = fixtures.selftest()
+    for p in problems:
+        failures.append(f"selftest: {p}")
+    print(f"meshlint gate: selftest "
+          f"{'ok' if not problems else 'FAILED'} "
+          f"({len(fixtures.FIXTURES)} fixtures, "
+          f"{len(problems)} problem(s))")
+
+    # -- leg 2: the real tree is ERROR-silent -------------------------
+    report = run_meshlint(root=root)
+    for f in report.errors:
+        failures.append(f"tree: {f}")
+    print(f"meshlint gate: tree "
+          f"{'ok' if not report.errors else 'FAILED'} "
+          f"({report.n_functions} functions in {report.n_modules} "
+          f"modules, {len(report.errors)} error(s), "
+          f"{len(report.warnings)} warning(s), "
+          f"{report.wall_ms:.0f}ms)")
+
+    # -- leg 3: inferred coverage ⊇ the retired HOT_SECTIONS list -----
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "hotpath_lint", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "hotpath_lint.py"))
+    shim = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault(spec.name, shim)
+    spec.loader.exec_module(shim)
+    coverage = report.stats.get("hot_coverage", {})
+    dropped = [
+        f"{path}::{name}"
+        for path, names in sorted(shim.HOT_SECTIONS.items())
+        for name in sorted(names)
+        if name not in set(coverage.get(path, ()))]
+    for d in dropped:
+        failures.append(f"superset: {d} was hot under HOT_SECTIONS "
+                        f"but is not inferred-reachable")
+    baseline = sum(len(v) for v in shim.HOT_SECTIONS.values())
+    print(f"meshlint gate: superset "
+          f"{'ok' if not dropped else 'FAILED'} "
+          f"(inferred {report.stats.get('hot_reachable', 0)} ⊇ "
+          f"baseline {baseline}, {len(dropped)} dropped)")
+
+    if failures:
+        print(f"meshlint gate: {len(failures)} failure(s)")
+        for f in failures:
+            print(f"  FAIL {f}")
+        return 1
+    print("meshlint gate: all legs green")
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=None)
+    sys.exit(main(root=ap.parse_args().root))

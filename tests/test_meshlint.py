@@ -1,0 +1,638 @@
+"""Unit tests for istio_tpu/analysis/meshlint: call-graph resolution,
+lock-graph extraction (with Condition aliasing and witness chains),
+pragma honoring, inferred hot-path reachability, metric discipline,
+and the typed-rejection escape analysis — all on synthetic in-memory
+module sets, the same surface the fixture corpus rides."""
+import textwrap
+
+import pytest
+
+from istio_tpu.analysis.findings import Severity
+from istio_tpu.analysis.meshlint import (callgraph, hotpath, lockorder,
+                                         metricspass, model,
+                                         rejections, run_meshlint)
+
+
+def _universe(**mods):
+    return callgraph.Universe.from_sources(
+        {name: textwrap.dedent(src) for name, src in mods.items()})
+
+
+# ---------------------------------------------------------------------------
+# call graph
+
+
+class TestCallGraph:
+    def test_self_method_and_module_function_resolution(self):
+        u = _universe(m='''
+            def helper():
+                pass
+
+            class C:
+                def a(self):
+                    self.b()
+                    helper()
+
+                def b(self):
+                    pass
+        ''')
+        fi = u.find("C.a")
+        callees = {u.functions[c].qual for _, c in u.calls_in(fi)}
+        assert callees == {"C.b", "helper"}
+
+    def test_attr_type_inference_from_constructor(self):
+        u = _universe(m='''
+            class Inner:
+                def work(self):
+                    pass
+
+            class Outer:
+                def __init__(self):
+                    self.inner = Inner()
+
+                def go(self):
+                    self.inner.work()
+        ''')
+        callees = {u.functions[c].qual
+                   for _, c in u.calls_in(u.find("Outer.go"))}
+        assert callees == {"Inner.work"}
+
+    def test_cross_module_import_resolution(self):
+        u = _universe(
+            a='''
+                def shared():
+                    pass
+            ''',
+            b='''
+                from a import shared
+
+                def caller():
+                    shared()
+            ''')
+        callees = {c for _, c in u.calls_in(u.find("caller"))}
+        assert callees == {"a:shared"}
+
+    def test_local_variable_constructor_type(self):
+        u = _universe(m='''
+            class Worker:
+                def run(self):
+                    pass
+
+            def main():
+                w = Worker()
+                w.run()
+        ''')
+        callees = {u.functions[c].qual
+                   for _, c in u.calls_in(u.find("main"))}
+        assert "Worker.run" in callees
+
+    def test_nested_class_in_function_indexed(self):
+        # the discovery/introspect stdlib-Handler pattern
+        u = _universe(m='''
+            class Server:
+                def start(self):
+                    class Handler:
+                        def do_GET(self):
+                            pass
+                    return Handler
+        ''')
+        assert u.find("Server.start.Handler.do_GET") is not None
+
+    def test_base_class_method_resolution(self):
+        u = _universe(m='''
+            class Base:
+                def shared(self):
+                    pass
+
+            class Child(Base):
+                def go(self):
+                    self.shared()
+        ''')
+        callees = {u.functions[c].qual
+                   for _, c in u.calls_in(u.find("Child.go"))}
+        assert callees == {"Base.shared"}
+
+
+# ---------------------------------------------------------------------------
+# lock-order pass
+
+
+class TestLockOrder:
+    def _report(self, **mods):
+        u = _universe(**mods)
+        report = model.MeshlintReport()
+        graph = lockorder.run(u, report)
+        return u, report, graph
+
+    def test_declaration_extraction_and_condition_alias(self):
+        u, _, g = self._report(m='''
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._wake = threading.Condition(self._lock)
+                    self._own_cv = threading.Condition()
+        ''')
+        assert "P._lock" in g.decls
+        assert g.decls["P._wake"].alias_of == "P._lock"
+        assert g.canonical("P._wake") == "P._lock"
+        assert g.decls["P._own_cv"].alias_of is None
+
+    def test_nested_acquisition_produces_edge_with_chain(self):
+        _, report, g = self._report(m='''
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def go(self):
+                    with self._a:
+                        self._grab()
+
+                def _grab(self):
+                    with self._b:
+                        pass
+        ''')
+        pairs = {(e.outer, e.inner) for e in g.edges}
+        assert ("P._a", "P._b") in pairs
+        edge = next(e for e in g.edges
+                    if (e.outer, e.inner) == ("P._a", "P._b"))
+        # the witness replays the cross-function acquisition chain
+        assert len(edge.chain) == 2
+        assert "calls P._grab" in edge.chain[0]
+        assert "acquires P._b" in edge.chain[1]
+
+    def test_cycle_detected(self):
+        _, report, _ = self._report(m='''
+            import threading
+
+            class X:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def fwd(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def rev(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        ''')
+        assert model.LOCK_CYCLE in report.codes()
+
+    def test_inversion_of_declared_order(self):
+        _, report, _ = self._report(m='''
+            import threading
+
+            class DeviceQuotaPool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._counts_lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        with self._counts_lock:
+                            pass
+        ''')
+        inv = [f for f in report.findings
+               if f.code == model.LOCK_INVERSION]
+        assert inv and inv[0].severity == Severity.ERROR
+        assert inv[0].line > 0
+
+    def test_leaf_lock_violation(self):
+        _, report, _ = self._report(m='''
+            import threading
+
+            class ShardRouter:
+                def __init__(self):
+                    self._stats_lock = threading.Lock()
+                    self._other = threading.Lock()
+
+                def bad(self):
+                    with self._stats_lock:
+                        with self._other:
+                            pass
+        ''')
+        assert model.LOCK_LEAF in report.codes()
+
+    def test_self_deadlock_lexical_only(self):
+        _, report, _ = self._report(m='''
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+
+                def fine_cross_instance(self, other: "W"):
+                    with self._lock:
+                        other.touch()
+
+                def touch(self):
+                    with self._lock:
+                        pass
+        ''')
+        selfs = [f for f in report.findings
+                 if f.code == model.LOCK_SELF]
+        # the lexical re-entry in bad() — and ONLY it (the
+        # cross-frame edge is usually another instance)
+        assert len(selfs) == 1
+        assert selfs[0].func == "W.bad"
+
+    def test_rlock_reentry_allowed(self):
+        _, report, _ = self._report(m='''
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def fine(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        ''')
+        assert model.LOCK_SELF not in report.codes()
+
+    def test_lock_ok_pragma_suppresses(self):
+        _, report, _ = self._report(m='''
+            import threading
+
+            class ShardRouter:
+                def __init__(self):
+                    self._stats_lock = threading.Lock()
+                    self._other = threading.Lock()
+
+                def annotated(self):
+                    with self._stats_lock:
+                        with self._other:   # meshlint: lock-ok test
+                            pass
+        ''')
+        assert model.LOCK_LEAF not in report.codes()
+
+    def test_manual_acquire_release_pairs(self):
+        _, report, g = self._report(m='''
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def go(self):
+                    self._a.acquire()
+                    with self._b:
+                        pass
+                    self._a.release()
+                    with self._b:
+                        pass
+        ''')
+        pairs = {(e.outer, e.inner) for e in g.edges}
+        assert ("Q._a", "Q._b") in pairs
+        # after release, the second `with` holds nothing
+        assert len([e for e in g.edges
+                    if (e.outer, e.inner) == ("Q._a", "Q._b")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# hot-path pass
+
+
+class TestHotpath:
+    def test_reachability_covers_transitive_callees(self):
+        u = _universe(m='''
+            import time
+
+            class E:
+                def entry(self):
+                    self._mid()
+
+                def _mid(self):
+                    self._leaf()
+
+                def _leaf(self):
+                    time.sleep(1)
+        ''')
+        report = model.MeshlintReport()
+        hotpath.run(u, report, roots=("E.entry",), dynamic_edges=(),
+                    cold=frozenset())
+        syncs = [f for f in report.findings
+                 if f.code == model.HOTPATH_SYNC]
+        assert len(syncs) == 1
+        assert syncs[0].func == "E._leaf"
+        # witness chain: entry → _mid → _leaf
+        assert len(syncs[0].chain) == 3
+
+    def test_sync_ok_pragma_honored(self):
+        u = _universe(m='''
+            import numpy as np
+
+            class E:
+                def entry(self, dev):
+                    return np.asarray(dev)   # hotpath: sync-ok pull
+        ''')
+        report = model.MeshlintReport()
+        hotpath.run(u, report, roots=("E.entry",), dynamic_edges=(),
+                    cold=frozenset())
+        assert model.HOTPATH_SYNC not in report.codes()
+
+    def test_dynamic_edge_extends_reachability(self):
+        u = _universe(m='''
+            class A:
+                def entry(self):
+                    cb = self._cb
+                    cb()
+
+                def hidden(self):
+                    print("boom")
+        ''')
+        report = model.MeshlintReport()
+        hotpath.run(u, report, roots=("A.entry",),
+                    dynamic_edges=(("A.entry", "A.hidden"),),
+                    cold=frozenset())
+        assert model.HOTPATH_SYNC in report.codes()
+
+    def test_missing_root_is_config_error(self):
+        u = _universe(m="def real(): pass")
+        report = model.MeshlintReport()
+        hotpath.run(u, report, roots=("gone",), dynamic_edges=(),
+                    cold=frozenset())
+        assert model.HOTPATH_ROOT_MISSING in report.codes()
+
+    def test_host_accessor_casts_allowed(self):
+        u = _universe(m='''
+            class E:
+                def entry(self, spec, dev):
+                    ok = int(spec.get("port", 80))
+                    bad = float(dev.sum())
+                    return ok, bad
+        ''')
+        report = model.MeshlintReport()
+        hotpath.run(u, report, roots=("E.entry",), dynamic_edges=(),
+                    cold=frozenset())
+        msgs = [f.message for f in report.findings]
+        assert any("float(<call>)" in m for m in msgs)
+        assert not any("int(<call>)" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# metric pass
+
+
+class TestMetrics:
+    def _report(self, src):
+        u = _universe(mx=src)
+        report = model.MeshlintReport()
+        metricspass.run(u, report)
+        return report
+
+    def test_unshaped_labeled_prom_family_flagged(self):
+        report = self._report('''
+            import prometheus_client
+            BAD = prometheus_client.Counter(
+                "bad_total", "h", ["reason"])
+        ''')
+        assert model.METRIC_ZERO_SHAPE in report.codes()
+
+    def test_pretouch_loop_over_module_constant_satisfies(self):
+        report = self._report('''
+            import prometheus_client
+            REASONS = ("a", "b")
+            GOOD = prometheus_client.Counter(
+                "good_total", "h", ["reason"])
+            for _r in REASONS:
+                GOOD.labels(reason=_r)
+        ''')
+        assert model.METRIC_ZERO_SHAPE not in report.codes()
+
+    def test_unlabeled_prom_and_gauges_exempt(self):
+        report = self._report('''
+            import prometheus_client
+            from istio_tpu.utils import metrics as hostmetrics
+            PLAIN = prometheus_client.Counter("plain_total", "h")
+            G = prometheus_client.Gauge("g", "h", ["x"])
+            HG = hostmetrics.default_registry.gauge("hg", "h")
+            HH = hostmetrics.default_registry.histogram("hh", "h")
+        ''')
+        assert model.METRIC_ZERO_SHAPE not in report.codes()
+
+    def test_host_counter_needs_zero_touch(self):
+        report = self._report('''
+            from istio_tpu.utils import metrics as hostmetrics
+            NAKED = hostmetrics.default_registry.counter("n", "h")
+        ''')
+        assert model.METRIC_ZERO_SHAPE in report.codes()
+
+    def test_label_mismatch_flagged(self):
+        report = self._report('''
+            import prometheus_client
+            FAM = prometheus_client.Counter("f", "h", ["reason"])
+            FAM.labels(reason="x")
+
+            def use():
+                FAM.labels(cause="y").inc()
+        ''')
+        mism = [f for f in report.findings
+                if f.code == model.METRIC_LABEL_MISMATCH]
+        assert len(mism) == 1
+
+    def test_unregistered_receiver_flagged(self):
+        report = self._report('''
+            THING = object()
+
+            def use():
+                THING.inc(1)
+        ''')
+        assert model.METRIC_UNREGISTERED in report.codes()
+
+    def test_unshaped_series_warning(self):
+        report = self._report('''
+            import prometheus_client
+            FAM = prometheus_client.Counter("f", "h", ["reason"])
+            for _r in ("a", "b"):
+                FAM.labels(reason=_r)
+
+            def use():
+                FAM.labels(reason="zzz").inc()
+        ''')
+        series = [f for f in report.findings
+                  if f.code == model.METRIC_UNSHAPED_SERIES]
+        assert len(series) == 1
+        assert series[0].severity == Severity.WARNING
+
+    def test_metric_ok_pragma_suppresses(self):
+        report = self._report('''
+            import prometheus_client
+            DYN = prometheus_client.Counter(   # meshlint: metric-ok dyn
+                "dyn_total", "h", ["path"])
+        ''')
+        assert model.METRIC_ZERO_SHAPE not in report.codes()
+
+
+# ---------------------------------------------------------------------------
+# rejection pass
+
+
+class TestRejections:
+    def _report(self, src, boundaries):
+        u = _universe(front=src)
+        report = model.MeshlintReport()
+        rejections.run(u, report, boundaries=boundaries)
+        return report
+
+    def test_untyped_in_universe_escape_flagged_with_chain(self):
+        report = self._report('''
+            class CheckRejected(RuntimeError):
+                grpc_code = 2
+
+            class Bad(Exception):
+                pass
+
+            class F:
+                def handler(self, req):
+                    try:
+                        return self._serve(req)
+                    except CheckRejected:
+                        return None
+
+                def _serve(self, req):
+                    raise Bad("nope")
+        ''', boundaries=(("front", "F.handler"),))
+        esc = [f for f in report.findings
+               if f.code == model.UNTYPED_ESCAPE]
+        assert len(esc) == 1
+        assert "Bad" in esc[0].message
+        assert len(esc[0].chain) == 2       # handler → _serve raise
+
+    def test_typed_escape_is_fine(self):
+        report = self._report('''
+            class CheckRejected(RuntimeError):
+                grpc_code = 2
+
+            class Shed(CheckRejected):
+                grpc_code = 8
+
+            class F:
+                def handler(self, req):
+                    raise Shed("over quota")
+        ''', boundaries=(("front", "F.handler"),))
+        assert model.UNTYPED_ESCAPE not in report.codes()
+
+    def test_catch_all_swallows(self):
+        report = self._report('''
+            class Bad(Exception):
+                pass
+
+            class F:
+                def handler(self, req):
+                    try:
+                        raise Bad("x")
+                    except Exception:
+                        return None
+        ''', boundaries=(("front", "F.handler"),))
+        assert model.UNTYPED_ESCAPE not in report.codes()
+
+    def test_catch_by_base_class_swallows(self):
+        report = self._report('''
+            class Bad(RuntimeError):
+                pass
+
+            class F:
+                def handler(self, req):
+                    try:
+                        raise Bad("x")
+                    except RuntimeError:
+                        return None
+        ''', boundaries=(("front", "F.handler"),))
+        assert model.UNTYPED_ESCAPE not in report.codes()
+
+    def test_bare_reraise_inside_handler_tracked(self):
+        report = self._report('''
+            class Bad(Exception):
+                pass
+
+            class F:
+                def handler(self, req):
+                    try:
+                        raise Bad("x")
+                    except Bad:
+                        raise
+        ''', boundaries=(("front", "F.handler"),))
+        assert model.UNTYPED_ESCAPE in report.codes()
+
+    def test_raise_ok_pragma_suppresses(self):
+        report = self._report('''
+            class F:
+                def handler(self, req):
+                    raise ValueError("on purpose")   # meshlint: raise-ok t
+        ''', boundaries=(("front", "F.handler"),))
+        assert model.UNTYPED_ESCAPE not in report.codes()
+
+    def test_deep_builtin_not_judged_at_boundary(self):
+        # builtins are only flagged raised DIRECTLY in the boundary
+        report = self._report('''
+            class F:
+                def handler(self, req):
+                    return self._deep(req)
+
+                def _deep(self, req):
+                    raise ValueError("programming error path")
+        ''', boundaries=(("front", "F.handler"),))
+        assert model.UNTYPED_ESCAPE not in report.codes()
+
+    def test_missing_boundary_is_config_error(self):
+        report = self._report("def f(): pass",
+                              boundaries=(("front", "Gone.handler"),))
+        assert model.BOUNDARY_MISSING in report.codes()
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+class TestDriver:
+    def test_run_meshlint_requires_input(self):
+        with pytest.raises(ValueError):
+            run_meshlint()
+
+    def test_report_json_roundtrip(self):
+        report = run_meshlint(
+            sources={"m": "def f():\n    pass\n"},
+            passes=("lock",))
+        d = report.to_dict()
+        assert d["n_errors"] == 0
+        assert "findings" in d and "stats" in d
+
+    def test_findings_sorted_errors_first(self):
+        report = run_meshlint(sources={"m": textwrap.dedent('''
+            import threading
+
+            class ShardRouter:
+                def __init__(self):
+                    self._stats_lock = threading.Lock()
+                    self._x = threading.Lock()
+                    self._y = threading.Lock()
+
+                def bad(self):
+                    with self._stats_lock:
+                        with self._x:
+                            pass
+
+                def meh(self):
+                    with self._x:
+                        with self._y:
+                            pass
+        ''')}, passes=("lock",))
+        sevs = [f.severity for f in report.findings]
+        assert sevs == sorted(sevs, key=lambda s: -int(s))
+        assert report.has_errors

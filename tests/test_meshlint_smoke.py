@@ -1,0 +1,72 @@
+"""Tier-1 hook for scripts/meshlint.py — the repo-wide concurrency &
+discipline gate. Running main() exercises all three legs in one shot:
+
+  1. the seeded fixture corpus (every violation class — lock-order
+     cycle/inversion/leaf/self-deadlock, hot-path host-sync, missing
+     hot root, unregistered/unshaped/mislabeled metric, untyped front
+     escape — is caught with a file:line witness, pragmas honored,
+     clean fixture silent);
+  2. the real tree is ERROR-silent;
+  3. the inferred hot-path coverage is a superset of the retired
+     hand-maintained HOT_SECTIONS baseline.
+
+A second test pins leg 3's guarantee directly (acceptance criterion:
+inferred coverage ⊇ HOT_SECTIONS), so a refactor of the gate script
+cannot silently drop the pin."""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    path = os.path.join(REPO, "scripts", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(spec.name, None)
+        raise
+    return mod
+
+
+@pytest.fixture(scope="module")
+def gate():
+    mod = _load_script("meshlint")
+    yield mod
+    sys.modules.pop("meshlint", None)
+
+
+def test_meshlint_gate_green(gate, capsys):
+    rc = gate.main(root=REPO)
+    out = capsys.readouterr().out
+    assert rc == 0, f"meshlint gate failed:\n{out}"
+    assert "all legs green" in out
+
+
+def test_inferred_coverage_superset_of_hot_sections(gate):
+    """Acceptance pin: reachability from the hot roots must cover
+    every (file, function) the old hand-maintained list named."""
+    from istio_tpu.analysis.meshlint import run_meshlint
+
+    shim = _load_script("hotpath_lint")
+    try:
+        report = run_meshlint(root=REPO, passes=("hotpath",))
+        coverage = report.stats["hot_coverage"]
+        missing = [
+            f"{path}::{name}"
+            for path, names in sorted(shim.HOT_SECTIONS.items())
+            for name in sorted(names)
+            if name not in set(coverage.get(path, ()))]
+        assert not missing, (
+            "inferred hot coverage dropped baseline functions: "
+            + ", ".join(missing))
+        baseline = sum(len(v) for v in shim.HOT_SECTIONS.values())
+        assert report.stats["hot_reachable"] >= baseline
+    finally:
+        sys.modules.pop("hotpath_lint", None)

@@ -8,6 +8,9 @@ import stat
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="istio_tpu.security needs cryptography")
+
 from istio_tpu.security import pki
 from istio_tpu.security.ca import IstioCA
 from istio_tpu.security.platform import (AwsClient, GcpClient,

@@ -7,6 +7,9 @@ import time
 
 import pytest
 
+pytest.importorskip(
+    "cryptography", reason="istio_tpu.security needs cryptography")
+
 from istio_tpu.security import (IstioCA, generate_csr, generate_key,
                                 key_cert_pair_ok, load_cert, san_uris,
                                 parse_spiffe, spiffe_id)
