@@ -557,6 +557,7 @@ def main() -> None:
     discovery = _discovery_bench(on_tpu)
     analysis = _analysis_bench(on_tpu)
     canary = _canary_bench(on_tpu)
+    secure = _secure_bench(on_tpu)
     soak = _soak_bench(on_tpu)
 
     baseline_cps = 1e9 / (PER_PREDICATE_NS * n_rules)
@@ -657,6 +658,7 @@ def main() -> None:
     out.update(discovery)
     out.update(analysis)
     out.update(canary)
+    out.update(secure)
     out.update(soak)
     print(json.dumps(out))
 
@@ -3323,6 +3325,146 @@ def _grpc_ceiling_fields() -> dict:
     except Exception as exc:
         return {"served_grpc_ceiling_error":
                 f"{type(exc).__name__}: {exc}"}
+
+
+def _secure_bench(on_tpu: bool) -> dict:
+    """Secure serving plane cost ledger (ISSUE 20): the SAME closed-
+    loop check window through a plaintext front and a strict-mTLS
+    front off ONE runtime — interleaved paired windows, median-of-3
+    (the telemetry-ledger method) — yielding the mTLS per-request
+    overhead pct, plus the TLS handshake cost a FRESH connection pays
+    (first check minus the steady-state per-check median) and its
+    amortization horizon on a persistent connection. The mTLS leg
+    includes identity injection (peer SPIFFE SAN folded into the wire
+    bag) — that re-encode is part of the honest secure-plane cost.
+    Fail-soft: a rig without a PKI backend — or any measurement
+    error — emits a note, never takes the artifact down."""
+    prefix = "secure_"
+    from concurrent import futures as _futures
+    try:
+        from istio_tpu.secure.backend import available_backends
+        if not available_backends():
+            return {prefix + "note":
+                    "no PKI backend (cryptography or the openssl "
+                    "CLI) — secure bench skipped"}
+        from istio_tpu.api.client import MixerClient
+        from istio_tpu.api.grpc_server import MixerGrpcServer
+        from istio_tpu.runtime import RuntimeServer, ServerArgs
+        from istio_tpu.secure.mtls import ServingCerts
+        from istio_tpu.security import IstioCA, pki, spiffe_id
+        from istio_tpu.testing import workloads
+
+        n_rules = 256 if on_tpu else 64
+        workers = 4
+        per_worker = 32
+        window_checks = workers * per_worker
+
+        ca = IstioCA.new_self_signed({})
+        root = ca.get_root_certificate()
+        skey = pki.generate_key()
+        certs = ServingCerts(
+            pki.key_to_pem(skey),
+            ca.sign(pki.generate_csr(
+                skey, spiffe_id("istio-system", "mixer"),
+                dns_names=("mixer.local",))),
+            root)
+        wkey = pki.generate_key()
+        wkey_pem = pki.key_to_pem(wkey)
+        wcert = ca.sign(pki.generate_csr(
+            wkey, spiffe_id("default", "bench")))
+
+        reqs = workloads.make_request_dicts(per_worker)
+        srv = RuntimeServer(workloads.make_store(n_rules), ServerArgs(
+            batch_window_s=0.001, max_batch=256,
+            default_manifest=workloads.MESH_MANIFEST))
+        plain = MixerGrpcServer(srv, tls=None)
+        strict = MixerGrpcServer(srv, tls=certs, mtls_mode="strict")
+        clients: list = []
+        pool = _futures.ThreadPoolExecutor(workers)
+        try:
+            p_port = plain.start()
+            s_port = strict.start()
+
+            def mk_mtls():
+                return MixerClient(f"127.0.0.1:{s_port}",
+                                   enable_check_cache=False,
+                                   root_cert_pem=root,
+                                   key_pem=wkey_pem, cert_pem=wcert,
+                                   server_name="mixer.local")
+
+            def window(cls) -> float:
+                """One closed-loop window: `workers` persistent
+                connections each drive `per_worker` sequential
+                checks. Returns wall seconds."""
+                t0 = time.perf_counter()
+                list(pool.map(
+                    lambda cl: [cl.check(r) for r in reqs], cls))
+                return time.perf_counter() - t0
+
+            cls_plain = [MixerClient(f"127.0.0.1:{p_port}",
+                                     enable_check_cache=False)
+                         for _ in range(workers)]
+            cls_mtls = [mk_mtls() for _ in range(workers)]
+            clients += cls_plain + cls_mtls
+            window(cls_plain)       # warm: jit, memo paths, sessions
+            window(cls_mtls)
+            plain_ts, mtls_ts = [], []
+            for _ in range(3):      # interleave so drift hits both
+                plain_ts.append(window(cls_plain))
+                mtls_ts.append(window(cls_mtls))
+            p_med = _med3(plain_ts)[0]
+            m_med = _med3(mtls_ts)[0]
+            overhead = (m_med - p_med) / p_med * 100.0 \
+                if p_med > 0 else 0.0
+
+            # handshake: a fresh mTLS connection's first check pays
+            # TCP + TLS1.3 mutual handshake + cert verification on
+            # top of one steady-state check
+            per_req_ms = m_med / window_checks * 1e3
+            hs = []
+            for _ in range(3):
+                cl = mk_mtls()
+                t0 = time.perf_counter()
+                cl.check(reqs[0])
+                hs.append(time.perf_counter() - t0)
+                cl.close()
+            hs_med = _med3(hs)[0] * 1e3
+            handshake_ms = max(hs_med - per_req_ms, 0.0)
+            # persistent-connection horizon: requests after which the
+            # one-time handshake is <1% of cumulative serving time
+            amortize = int(handshake_ms / (0.01 * per_req_ms)) \
+                if per_req_ms > 0 else 0
+            return {
+                prefix + "plain_checks_per_sec":
+                    round(window_checks / p_med, 1),
+                prefix + "mtls_checks_per_sec":
+                    round(window_checks / m_med, 1),
+                prefix + "mtls_overhead_pct": round(overhead, 2),
+                prefix + "plain_window_s":
+                    [round(t, 4) for t in sorted(plain_ts)],
+                prefix + "mtls_window_s":
+                    [round(t, 4) for t in sorted(mtls_ts)],
+                prefix + "first_check_fresh_conn_ms":
+                    round(hs_med, 3),
+                prefix + "handshake_ms": round(handshake_ms, 3),
+                prefix + "handshake_amortize_1pct_requests": amortize,
+                prefix + "method":
+                    "paired interleaved windows off one runtime, "
+                    "median-of-3; handshake = fresh-connection first "
+                    "check minus steady-state per-check",
+            }
+        finally:
+            pool.shutdown(wait=False)
+            for cl in clients:
+                try:
+                    cl.close()
+                except Exception:
+                    pass
+            plain.stop()
+            strict.stop()
+            srv.close()
+    except Exception as exc:
+        return {prefix + "error": f"{type(exc).__name__}: {exc}"}
 
 
 def _soak_bench(on_tpu: bool) -> dict:

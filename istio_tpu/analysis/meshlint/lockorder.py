@@ -77,6 +77,14 @@ LEAF_LOCKS: frozenset[str] = frozenset({
     "SlidingWindow._lock", "Registry._lock",        # utils/metrics.py
     "ShardRouter._stats_lock",                      # sharding/router.py
     "EventTimeline._lock",                          # forensics ring
+    # secure serving plane (istio_tpu/secure): the cert-bundle holder,
+    # the node agent, the TLS lane's conn/stats lock and the peer-cert
+    # parse cache are all terminal — rotation subscribers run OUTSIDE
+    # WorkloadIdentity._lock precisely so nothing ever nests here
+    "ServingCerts._lock",                           # secure/mtls.py
+    "WorkloadIdentity._lock",                       # secure/identity.py
+    "TlsTerminatingLane._lock",                     # secure/tlslane.py
+    "mtls._PEER_CACHE_LOCK",                        # secure/mtls.py
 })
 
 # Reentrant locks (threading.RLock) — self edges are legal.

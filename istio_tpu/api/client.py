@@ -23,8 +23,36 @@ from istio_tpu.attribute.global_dict import GLOBAL_WORD_LIST
 
 
 class MixerClient:
-    def __init__(self, target: str, enable_check_cache: bool = True):
-        self._channel = grpc.insecure_channel(target)
+    def __init__(self, target: str, enable_check_cache: bool = True,
+                 root_cert_pem: bytes | None = None,
+                 key_pem: bytes | None = None,
+                 cert_pem: bytes | None = None,
+                 server_name: str | None = None):
+        """`root_cert_pem` switches the channel to TLS (server verified
+        against the mesh root); `key_pem`+`cert_pem` add the client's
+        workload identity (mTLS). `server_name` overrides the TLS
+        authority for serving certs issued to a DNS SAN rather than
+        the dial address (the CA-service pattern)."""
+        if root_cert_pem is not None:
+            from istio_tpu.secure.mtls import client_channel_credentials
+            creds = client_channel_credentials(root_cert_pem, key_pem,
+                                               cert_pem)
+            options = []
+            if server_name:
+                options.append(("grpc.ssl_target_name_override",
+                                server_name))
+            self._channel = grpc.secure_channel(target, creds,
+                                                options=options)
+        else:
+            self._channel = grpc.insecure_channel(target)
+        # the identity this client authenticates AS (first spiffe://
+        # URI SAN of its own cert) folds into every cache signature:
+        # a cached verdict was granted to a PRINCIPAL, so a rotation
+        # that changes the principal must never reuse it
+        self._identity: str | None = None
+        if cert_pem is not None:
+            from istio_tpu.secure.mtls import spiffe_identity_from_pem
+            self._identity = spiffe_identity_from_pem(cert_pem)
         self._check = self._channel.unary_unary(
             "/istio.mixer.v1.Mixer/Check",
             request_serializer=pb.CheckRequest.SerializeToString,
@@ -49,14 +77,28 @@ class MixerClient:
 
     # -- caching (mixerclient check_cache semantics) --
 
-    @staticmethod
-    def _signature(ref: "pb.ReferencedAttributes",
+    def set_identity(self, identity: str | None) -> None:
+        """The workload's identity rotated to a different principal:
+        fold the new one into future signatures and drop every cached
+        verdict granted to the old one. (grpcio channel credentials
+        are fixed at construction — a cert swap needs a fresh client;
+        same-principal renewals keep the cache, that's the point of
+        the signature fold being the IDENTITY, not the cert bytes.)"""
+        with self._lock:
+            if identity != self._identity:
+                self._identity = identity
+                self._cache.clear()
+
+    def _signature(self, ref: "pb.ReferencedAttributes",
                    values: Mapping[str, Any]) -> tuple | None:
         """Cache signature of `values` under a response's referenced-
         attribute set; None when the conditions don't transfer (the
         mixerclient can't reuse the verdict). map_key=0 means "no key"
-        — the server reserves local word 0 (wire.py)."""
-        sig = []
+        — the server reserves local word 0 (wire.py). The client's own
+        authenticated identity is the first signature element: verdicts
+        are granted to a principal, so an identity rotation that
+        changes the principal can never hit the old entries."""
+        sig = [("__peer_identity__", None, self._identity)]
         words = list(ref.words)
         gc = len(GLOBAL_WORD_LIST)
         for m in ref.attribute_matches:

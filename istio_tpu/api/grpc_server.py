@@ -21,12 +21,17 @@ from typing import Any
 import grpc
 
 from istio_tpu.runtime import resilience
-from istio_tpu.runtime.resilience import CheckRejected
+from istio_tpu.runtime.resilience import (CheckRejected,
+                                          InvalidArgumentError,
+                                          UnauthenticatedError)
+from istio_tpu.secure.mtls import (MTLS_OFF, MTLS_STRICT, ServingCerts,
+                                   peer_identity_from_auth_context,
+                                   validate_mode)
 
 from istio_tpu.adapters.sdk import QuotaArgs
 from istio_tpu.api import mixer_pb2 as pb
 from istio_tpu.api.wire import (LazyWireBag, RawBatchCheckRequest,
-                                RawCheckRequest,
+                                RawCheckRequest, WireError,
                                 encode_batch_check_response,
                                 referenced_to_proto, update_dict_from_proto)
 from istio_tpu.attribute.bag import bag_from_mapping
@@ -42,9 +47,11 @@ _CLAMP_DURATION_S = 3600.0
 # overload and degradation must surface as DEADLINE_EXCEEDED /
 # RESOURCE_EXHAUSTED / UNAVAILABLE, never a generic INTERNAL
 _REJECT_CODES = {
+    resilience.INVALID_ARGUMENT: grpc.StatusCode.INVALID_ARGUMENT,
     resilience.DEADLINE_EXCEEDED: grpc.StatusCode.DEADLINE_EXCEEDED,
     resilience.RESOURCE_EXHAUSTED: grpc.StatusCode.RESOURCE_EXHAUSTED,
     resilience.UNAVAILABLE: grpc.StatusCode.UNAVAILABLE,
+    resilience.UNAUTHENTICATED: grpc.StatusCode.UNAUTHENTICATED,
 }
 
 
@@ -56,8 +63,15 @@ class MixerGrpcServer:
     """Serves Check/Report for a RuntimeServer core."""
 
     def __init__(self, runtime: RuntimeServer, address: str = "127.0.0.1:0",
-                 max_workers: int = 16):
+                 max_workers: int = 16,
+                 tls: ServingCerts | None = None,
+                 mtls_mode: str = MTLS_OFF):
         self.runtime = runtime
+        self._tls = tls
+        self.mtls_mode = validate_mode(mtls_mode)
+        if self.mtls_mode != MTLS_OFF and tls is None:
+            raise ValueError(
+                f"mtls={self.mtls_mode} needs serving certs (tls=)")
         # ReferencedAttributes protos memoized per (referenced,
         # presence) signature — the fused dispatcher shares those
         # objects across requests with identical device bitmaps, so
@@ -89,7 +103,17 @@ class MixerGrpcServer:
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler("istio.mixer.v1.Mixer",
                                                  handlers),))
-        self.port = self._server.add_insecure_port(address)
+        if tls is not None:
+            # strict: the handshake REQUIRES + verifies the client
+            # cert (grpcio has no request-but-optional mode); _admit
+            # then rejects verified-but-identity-less certs typed.
+            # The credentials are rotation-aware (cert-config fetcher
+            # rides ServingCerts.generation) — see secure/mtls.py.
+            self.port = self._server.add_secure_port(
+                address, tls.grpc_server_credentials(
+                    require_client_auth=self.mtls_mode == MTLS_STRICT))
+        else:
+            self.port = self._server.add_insecure_port(address)
 
     # -- lifecycle --
 
@@ -100,6 +124,46 @@ class MixerGrpcServer:
 
     def stop(self, grace: float = 1.0) -> None:
         self._server.stop(grace).wait()
+
+    # -- admission (secure plane) --
+
+    def _admit(self, context) -> str | None:
+        """Peer-identity admission for every RPC on this front.
+
+        Returns the verified SPIFFE identity (first spiffe:// URI SAN
+        of the TLS-verified client cert) or None for an anonymous
+        peer. In strict mode the handshake already required + verified
+        a client cert; a peer whose VERIFIED cert carries no SPIFFE
+        identity is refused here with a typed UNAUTHENTICATED
+        (runtime/resilience.UnauthenticatedError) — an honest wire
+        status the meshlint typed-rejection pass and the client's
+        error handling both see, never a silent anonymous admit."""
+        identity = None
+        if self._tls is not None and context is not None:
+            try:
+                auth = context.auth_context()
+            except Exception:
+                auth = None
+            identity = peer_identity_from_auth_context(auth)
+        if identity is not None:
+            monitor.IDENTITY_AUTHENTICATED.inc()
+            return identity
+        if self.mtls_mode == MTLS_STRICT:
+            monitor.IDENTITY_UNAUTHENTICATED.inc()
+            raise UnauthenticatedError(
+                "mTLS strict: no verified client certificate identity")
+        return None
+
+    @staticmethod
+    def _identity_attrs(identity: str | None) -> dict | None:
+        """Admission attributes the verified identity contributes:
+        `source.user` (the SPIFFE principal RBAC/authz predicates
+        evaluate — on-device, via the re-encoded wire) and
+        `connection.mtls`. None for anonymous peers (permissive/off):
+        client-supplied attributes pass through untouched."""
+        if identity is None:
+            return None
+        return {"source.user": identity, "connection.mtls": True}
 
     # -- RPCs --
 
@@ -166,13 +230,15 @@ class MixerGrpcServer:
                 "rpc.check",
                 parent=self._traceparent_from(context)) as root:
             try:
-                bag = self._check_bag(request)
+                identity = self._admit(context)
+                bag = self._check_bag(request, identity=identity)
                 deadline = self._deadline_from(context)
                 result = self.runtime.check_preprocessed(
                     bag, deadline=deadline)
                 self._tag_status(root, result.status_code)
                 return self._check_response(request, bag, result,
-                                            deadline=deadline)
+                                            deadline=deadline,
+                                            identity=identity)
             except CheckRejected as exc:
                 # abort() raises — the typed rejection becomes the
                 # RPC's status instead of an INTERNAL stack trace
@@ -189,13 +255,15 @@ class MixerGrpcServer:
         try:
             return self._batch_check_body(
                 request, self._deadline_from(context),
-                parent=self._traceparent_from(context))
+                parent=self._traceparent_from(context),
+                identity=self._admit(context))
         except CheckRejected as exc:
             context.abort(_reject_status(exc), str(exc))
 
     def _batch_check_body(self, request: RawBatchCheckRequest,
                           deadline: float | None,
-                          parent: dict | None = None) -> bytes:
+                          parent: dict | None = None,
+                          identity: str | None = None) -> bytes:
         """Span + dispatch, shared by the sync front (which aborts
         inline) and the aio front (whose abort must be awaited on the
         loop, not called from the executor thread)."""
@@ -205,7 +273,8 @@ class MixerGrpcServer:
                 items=len(request.attributes_raw)) as span:
             try:
                 return self._batch_check_traced(
-                    request, deadline=deadline, span=span)
+                    request, deadline=deadline, span=span,
+                    identity=identity)
             except CheckRejected as exc:
                 # tag BEFORE the span closes: a rejected batch must
                 # show in /debug/traces?status=failed (the unary
@@ -215,11 +284,25 @@ class MixerGrpcServer:
 
     def _batch_check_traced(self, request: RawBatchCheckRequest,
                             deadline: float | None = None,
-                            span: dict | None = None) -> bytes:
+                            span: dict | None = None,
+                            identity: str | None = None) -> bytes:
         gwc = request.global_word_count
         native = gwc in (0, len(GLOBAL_WORD_LIST))
-        bags = [self.runtime.preprocess(
-                    LazyWireBag(raw, gwc or None, native_ok=native))
+        attrs = self._identity_attrs(identity)
+
+        def _bag(raw):
+            bag = LazyWireBag(raw, gwc or None, native_ok=native)
+            if attrs is not None:
+                # the connection's verified identity covers every item
+                # in the batch (one peer, many bags)
+                try:
+                    bag = bag.with_attributes(attrs)
+                except WireError as exc:
+                    raise InvalidArgumentError(
+                        f"malformed check attributes: {exc}") from exc
+            return bag
+
+        bags = [self.runtime.preprocess(_bag(raw))
                 for raw in request.attributes_raw]
         if not bags:
             return b""
@@ -229,8 +312,8 @@ class MixerGrpcServer:
                           if r.status_code), 0)
         self._tag_status(span, first_bad)
         blobs = [
-            self._check_response(None, bag, result,
-                                 quotas=[]).SerializeToString()
+            self._check_response(None, bag, result, quotas=[],
+                                 identity=identity).SerializeToString()
             for bag, result in zip(bags, results)]
         return encode_batch_check_response(blobs)
 
@@ -313,30 +396,50 @@ class MixerGrpcServer:
                 qres[lo + i] = qr
         return results, qres
 
-    def _check_bag(self, request: RawCheckRequest):
+    def _check_bag(self, request: RawCheckRequest,
+                   identity: str | None = None):
         monitor.CHECK_REQUESTS.inc()
         gwc = request.global_word_count
         # a non-default dictionary prefix forces the python wire path —
         # the C++ decoder assumes the full global list
         bag = LazyWireBag(request.attributes_raw, gwc or None,
                           native_ok=gwc in (0, len(GLOBAL_WORD_LIST)))
+        attrs = self._identity_attrs(identity)
+        if attrs is not None:
+            # fold the VERIFIED peer identity into the wire itself
+            # (re-encode) so device tensorization — and therefore the
+            # compiled RBAC predicates — see source.user exactly as
+            # the SnapshotOracle does
+            try:
+                bag = bag.with_attributes(attrs)
+            except WireError as exc:
+                raise InvalidArgumentError(
+                    f"malformed check attributes: {exc}") from exc
         # preprocess ONCE; precondition check and quota loop share the
         # bag (a no-op returning the wire bag when no APA is configured)
         return self.runtime.preprocess(bag)
 
     def _check_response(self, request: RawCheckRequest, bag,
                         result, quotas: list | None = None,
-                        deadline: float | None = None
+                        deadline: float | None = None,
+                        identity: str | None = None
                         ) -> "pb.CheckResponse":
         resp = pb.CheckResponse()
         resp.precondition.status.code = result.status_code
         if result.status_message:
             resp.precondition.status.message = result.status_message
+        ttl_s = min(result.valid_duration_s, _CLAMP_DURATION_S)
+        uses = min(result.valid_use_count, 2**31 - 1)
+        if identity is not None and self.runtime.grants is not None:
+            # identity axis of the grant plane (runtime/grants.py):
+            # a peer whose identity just rotated must not ride a
+            # stale cached verdict — min() like every TTL source
+            ittl, iuses = self.runtime.grants.identity_grant(identity)
+            ttl_s = min(ttl_s, ittl)
+            uses = min(uses, iuses)
         resp.precondition.valid_duration.FromTimedelta(
-            datetime.timedelta(seconds=min(result.valid_duration_s,
-                                           _CLAMP_DURATION_S)))
-        resp.precondition.valid_use_count = min(result.valid_use_count,
-                                                2**31 - 1)
+            datetime.timedelta(seconds=ttl_s))
+        resp.precondition.valid_use_count = uses
         resp.precondition.referenced_attributes.CopyFrom(
             self._referenced_proto(result, bag))
 
@@ -423,6 +526,13 @@ class MixerGrpcServer:
         with tracing.get_tracer().span(
                 "rpc.report", parent=self._traceparent_from(context),
                 records=len(request.attributes)) as root:
+            try:
+                # strict mTLS covers the telemetry path too — an
+                # anonymous peer must not inject report records
+                self._admit(context)
+            except CheckRejected as exc:
+                self._tag_status(root, exc.grpc_code)
+                context.abort(_reject_status(exc), str(exc))
             t0 = time.perf_counter()
             bags = self._decode_report(request)
             monitor.observe_report_stage("wire_decode",
@@ -452,11 +562,18 @@ class MixerAioGrpcServer(MixerGrpcServer):
     role grpcServer.go gets for free from goroutines)."""
 
     def __init__(self, runtime: RuntimeServer,
-                 address: str = "127.0.0.1:0"):
+                 address: str = "127.0.0.1:0",
+                 tls: ServingCerts | None = None,
+                 mtls_mode: str = MTLS_OFF):
         # note: deliberately NOT calling super().__init__ — the sync
         # grpc.server and its thread pool are replaced by an aio
         # server owned by a loop thread
         self.runtime = runtime
+        self._tls = tls
+        self.mtls_mode = validate_mode(mtls_mode)
+        if self.mtls_mode != MTLS_OFF and tls is None:
+            raise ValueError(
+                f"mtls={self.mtls_mode} needs serving certs (tls=)")
         self._ref_cache = {}
         self._ref_cache_lock = threading.Lock()
         self._address = address
@@ -473,10 +590,11 @@ class MixerAioGrpcServer(MixerGrpcServer):
         import asyncio
         deadline = self._deadline_from(context)
         try:
+            identity = self._admit(context)
             # tensorize + device step block — off the loop
             return await asyncio.get_running_loop().run_in_executor(
                 None, self._batch_check_body, request, deadline,
-                self._traceparent_from(context))
+                self._traceparent_from(context), identity)
         except CheckRejected as exc:
             # aio abort is a coroutine and must run ON the loop — the
             # sync _batch_check's inline abort would no-op here
@@ -498,7 +616,8 @@ class MixerAioGrpcServer(MixerGrpcServer):
         try:
             return await self._acheck_traced(
                 request, loop, root,
-                deadline=self._deadline_from(context))
+                deadline=self._deadline_from(context),
+                identity=self._admit(context))
         except CheckRejected as exc:
             self._tag_status(root, exc.grpc_code)
             await context.abort(_reject_status(exc), str(exc))
@@ -507,18 +626,19 @@ class MixerAioGrpcServer(MixerGrpcServer):
 
     async def _acheck_traced(self, request: RawCheckRequest, loop,
                              root,
-                             deadline: float | None = None
+                             deadline: float | None = None,
+                             identity: str | None = None
                              ) -> "pb.CheckResponse":
         import asyncio
         d = self.runtime.controller.dispatcher
         if self.runtime.args.preprocess and d.has_apa:
             # preprocess runs an APA device round-trip — off the loop
             bag = await loop.run_in_executor(None, self._check_bag,
-                                             request)
+                                             request, identity)
         else:
             # identity preprocess: the executor hop would cost more
             # than the work
-            bag = self._check_bag(request)
+            bag = self._check_bag(request, identity)
         # shield: a client cancel must cancel THIS handler only, never
         # the shared batcher future (a cancelled batch-mate would
         # otherwise poison result distribution for the whole batch)
@@ -565,8 +685,10 @@ class MixerAioGrpcServer(MixerGrpcServer):
                     qr = await qr
                 quotas.append((name, qr))
             return self._check_response(request, bag, result,
-                                        quotas=quotas)
-        return self._check_response(request, bag, result)
+                                        quotas=quotas,
+                                        identity=identity)
+        return self._check_response(request, bag, result,
+                                    identity=identity)
 
     async def _areport(self, request: "pb.ReportRequest",
                        context) -> "pb.ReportResponse":
@@ -590,6 +712,12 @@ class MixerAioGrpcServer(MixerGrpcServer):
             return bags
 
         with root as span:
+            try:
+                # strict mTLS covers the telemetry path too
+                self._admit(context)
+            except CheckRejected as exc:
+                self._tag_status(span, exc.grpc_code)
+                await context.abort(_reject_status(exc), str(exc))
             # decode + preprocess are synchronous host work — off the
             # loop; the WAIT for the coalesced batches holds no thread
             # (futures bridge back via wrap_future, like _acheck), so
@@ -661,7 +789,16 @@ class MixerAioGrpcServer(MixerGrpcServer):
             server.add_generic_rpc_handlers((
                 grpc.method_handlers_generic_handler(
                     "istio.mixer.v1.Mixer", handlers),))
-            self.port = server.add_insecure_port(self._address)
+            if self._tls is not None:
+                # same posture as the sync front: strict requires the
+                # client cert at handshake, _admit types the
+                # identity-less-cert rejection
+                self.port = server.add_secure_port(
+                    self._address, self._tls.grpc_server_credentials(
+                        require_client_auth=self.mtls_mode
+                        == MTLS_STRICT))
+            else:
+                self.port = server.add_insecure_port(self._address)
             await server.start()
             self._server = server
             self._ready.set()

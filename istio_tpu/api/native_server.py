@@ -110,13 +110,25 @@ class NativeMixerServer(MixerGrpcServer):
     def __init__(self, runtime: RuntimeServer, port: int = 0,
                  max_batch: int = 1024, min_fill: int = 256,
                  window_us: int = 2000, pumps: int = 2,
-                 continuous: bool = False):
+                 continuous: bool = False, tls=None,
+                 mtls_mode: str = "strict"):
         # deliberately NOT calling super().__init__ — no grpc.server
         # `continuous`: the C++ take policy never holds for min_fill/
         # window — an idle pump launches the next device step the
         # moment anything is queued (in-flight depth bounded by
         # `pumps`); the latency lane vs the occupancy-fill default
         self.runtime = runtime
+        # `tls` (secure.mtls.ServingCerts): start a TLS-terminating
+        # lane (secure/tlslane.py) in front of the C++ pump —
+        # `secure_port` is what clients dial; the plaintext `port`
+        # stays loopback-reachable so the pump's wire accounting and
+        # every parity gate see byte-for-byte the plaintext stream.
+        # Strict mode requires + verifies the client cert at the lane
+        # handshake (connection-level authn; per-request identity→bag
+        # lives on the gRPC fronts — see the tlslane module docstring).
+        self._tls_lane = None
+        self._tls_mode = mtls_mode
+        self._tls_certs = tls
         self._ref_cache: dict = {}
         self._ref_cache_lock = threading.Lock()
         self._resp_memo: dict = {}
@@ -145,8 +157,25 @@ class NativeMixerServer(MixerGrpcServer):
     def start(self) -> int:
         for t in self._pumps:
             t.start()
-        log.info("native mixer server on port %d", self.port)
+        if self._tls_certs is not None:
+            from istio_tpu.secure.tlslane import TlsTerminatingLane
+            self._tls_lane = TlsTerminatingLane(
+                self._tls_certs, self.port, mode=self._tls_mode)
+            self.secure_port = self._tls_lane.start()
+            log.info("native mixer server on port %d (tls lane :%d)",
+                     self.port, self.secure_port)
+        else:
+            log.info("native mixer server on port %d", self.port)
         return self.port
+
+    def tls_lane_stats(self) -> dict:
+        """Connection/handshake accounting of the TLS terminating
+        lane ({} when serving plaintext or already stopped)."""
+        lane = self._tls_lane
+        if lane is None:
+            return {}
+        with lane._lock:
+            return dict(lane.stats)
 
     def stop(self, grace: float = 1.0) -> None:
         """Ordered graceful stop (the native leg of the lifecycle
@@ -158,6 +187,11 @@ class NativeMixerServer(MixerGrpcServer):
             return
         import time as _time
 
+        # 0. the TLS lane stops accepting first (quiesce ordering: the
+        #    outermost intake closes before the pump's)
+        if self._tls_lane is not None:
+            self._tls_lane.stop()
+            self._tls_lane = None
         # 1. stop intake: new wire requests answer UNAVAILABLE in C++;
         #    already-queued rows dispatch to the pumps immediately
         #    (no min_fill hold during a drain)

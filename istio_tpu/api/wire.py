@@ -377,3 +377,17 @@ class LazyWireBag(Bag):
 
     def names(self):
         return list(self._decode())
+
+    def with_attributes(self, extra: Mapping[str, Any]) -> "LazyWireBag":
+        """Fresh bag = this bag's attributes + `extra`, RE-ENCODED to
+        wire bytes (full global dictionary) so the returned bag stays
+        native-tensorizable. This is how admission-time attributes —
+        the verified peer identity (`source.user`, `connection.mtls`)
+        — reach the device plane: a host-side overlay bag would force
+        the whole batch off the C++ tensorizer. `extra` OVERRIDES any
+        client-claimed value of the same name on purpose: an
+        authenticated identity must beat a spoofed wire attribute."""
+        values = dict(self._decode())
+        values.update(extra)
+        return LazyWireBag(
+            bag_to_compressed(values).SerializeToString())

@@ -81,11 +81,73 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         # mesh audit plane (runtime/audit.py): background invariant
         # auditor + fault explainability; /debug/audit + /debug/slo
         audit=not args.no_audit,
-        audit_interval_s=args.audit_interval_ms / 1e3))
-    server = MixerGrpcServer(runtime, f"{args.address}:{args.port}")
+        audit_interval_s=args.audit_interval_ms / 1e3,
+        # secure serving plane (istio_tpu/secure): mTLS posture +
+        # CA-driven workload identity rotation parameters
+        mtls=args.mtls,
+        mtls_identity=args.mtls_identity,
+        mtls_cert_ttl_minutes=args.mtls_cert_ttl_minutes,
+        mtls_rotation_fraction=args.mtls_rotation_fraction))
+    tls = None
+    wi = None
+    if args.mtls != "off":
+        from istio_tpu.secure.mtls import ServingCerts
+
+        def _read(path: str) -> bytes:
+            with open(path, "rb") as f:
+                return f.read()
+
+        if args.tls_key and args.tls_cert and args.tls_root:
+            # static operator-provisioned serving certs (no rotation)
+            tls = ServingCerts(_read(args.tls_key),
+                               _read(args.tls_cert),
+                               _read(args.tls_root))
+        elif args.ca_address:
+            # CA-driven: obtain the serving bundle over the CSR flow,
+            # rotate on the adapter-executor maintenance lane; every
+            # rotation hot-swaps the live fronts AND revokes grants
+            # keyed to the rotated identity (sign → swap → revoke)
+            from istio_tpu.secure.identity import WorkloadIdentity
+            from istio_tpu.security.ca_service import CAClient
+            ca_root = _read(args.ca_root_cert) if args.ca_root_cert \
+                else None
+            credential = _read(args.bootstrap_cert) \
+                if args.bootstrap_cert else b""
+            wi = WorkloadIdentity(
+                CAClient(args.ca_address, root_cert_pem=ca_root),
+                args.mtls_identity,
+                ttl_minutes=args.mtls_cert_ttl_minutes,
+                rotation_fraction=args.mtls_rotation_fraction,
+                credential=credential,
+                dns_names=(args.tls_dns,))
+            try:
+                key_pem, cert_pem, root_pem = wi.ensure()
+            except Exception as exc:
+                print(f"mixs: initial serving-cert issuance failed "
+                      f"({exc}); refusing to serve {args.mtls} without "
+                      "credentials", file=sys.stderr)
+                runtime.close()
+                return 2
+            tls = ServingCerts(key_pem, cert_pem, root_pem)
+            wi.subscribe(lambda b: tls.rotate(b[0], b[1], b[2]))
+            if runtime.grants is not None:
+                wi.subscribe(lambda b: runtime.grants
+                             .on_identity_rotate(wi.identity))
+            if runtime.executor is not None:
+                runtime.executor.register_refreshable(
+                    "workload_identity", wi)
+        else:
+            print("mixs: --mtls needs serving credentials: either "
+                  "--tls-key/--tls-cert/--tls-root or --ca-address",
+                  file=sys.stderr)
+            runtime.close()
+            return 2
+    server = MixerGrpcServer(runtime, f"{args.address}:{args.port}",
+                             tls=tls, mtls_mode=args.mtls)
     port = server.start()
     print(f"mixs: istio.mixer.v1 on {args.address}:{port} "
-          f"(config={'fs:' + args.config_store if args.config_store else 'memory'})")
+          f"(config={'fs:' + args.config_store if args.config_store else 'memory'}"
+          f"{', mtls=' + args.mtls if args.mtls != 'off' else ''})")
     intro = None
     if args.monitoring_port:
         # the reference's :9093 self-monitoring port, upgraded to the
@@ -98,7 +160,9 @@ def cmd_mixs(args: argparse.Namespace) -> int:
         intro = IntrospectServer(runtime=runtime,
                                  port=args.monitoring_port,
                                  host=args.monitoring_host,
-                                 trace_capacity=args.trace_ring)
+                                 trace_capacity=args.trace_ring,
+                                 tls=tls if args.introspect_tls
+                                 else None)
         intro.start()
         print(f"mixs: introspection on "
               f"{args.monitoring_host}:{intro.port} "
@@ -984,6 +1048,54 @@ def build_parser() -> argparse.ArgumentParser:
                    help="zipkin v2 collector (POST /api/v2/spans)")
     s.add_argument("--trace-log-spans", action="store_true",
                    help="log every span (pkg/tracing LogTraceSpans)")
+    s.add_argument("--mtls", default="off",
+                   choices=("off", "permissive", "strict"),
+                   help="secure serving plane (istio_tpu/secure): "
+                        "strict = TLS fronts REQUIRE a CA-signed "
+                        "client cert at handshake and its SPIFFE "
+                        "identity feeds source.user/connection.mtls "
+                        "into the compiled RBAC plane (a verified "
+                        "cert with no SPIFFE SAN answers typed "
+                        "UNAUTHENTICATED); permissive = TLS "
+                        "encryption only, client certs optional and "
+                        "no identity flows; off = plaintext")
+    s.add_argument("--mtls-identity",
+                   default="spiffe://cluster.local/ns/istio-system"
+                           "/sa/istio-mixer",
+                   help="SPIFFE identity on the serving certificate")
+    s.add_argument("--tls-dns", default="mixer.local",
+                   help="DNS SAN on the serving certificate (clients "
+                        "match their target-name override against "
+                        "this)")
+    s.add_argument("--tls-key", default="",
+                   help="static serving key PEM (with --tls-cert/"
+                        "--tls-root; no rotation)")
+    s.add_argument("--tls-cert", default="",
+                   help="static serving cert chain PEM")
+    s.add_argument("--tls-root", default="",
+                   help="static client-verification root PEM")
+    s.add_argument("--ca-address", default="",
+                   help="CSR service (istio-ca) to obtain + rotate "
+                        "the serving bundle from; rotation runs on "
+                        "the adapter-executor maintenance lane and "
+                        "hot-swaps live fronts with zero dropped "
+                        "requests")
+    s.add_argument("--ca-root-cert", default="",
+                   help="root PEM for TLS to the CA service")
+    s.add_argument("--bootstrap-cert", default="",
+                   help="existing cert presented as the onprem CSR "
+                        "credential")
+    s.add_argument("--mtls-cert-ttl-minutes", type=int, default=60,
+                   help="requested serving-cert TTL")
+    s.add_argument("--mtls-rotation-fraction", type=float,
+                   default=0.5,
+                   help="rotate when less than this fraction of the "
+                        "TTL remains")
+    s.add_argument("--introspect-tls", action="store_true",
+                   help="wrap the introspection HTTP port in TLS "
+                        "from the same serving bundle (client certs "
+                        "optional — scrapers rarely hold workload "
+                        "identities)")
     s.set_defaults(fn=cmd_mixs)
 
     s = sub.add_parser("rule-dump",

@@ -56,8 +56,13 @@ class IntrospectServer:
     def __init__(self, runtime: Any = None, port: int = 0,
                  host: str = "127.0.0.1", native: Any = None,
                  probe_controller: Any = None,
-                 trace_capacity: int = 256, discovery: Any = None):
+                 trace_capacity: int = 256, discovery: Any = None,
+                 tls: Any = None):
         self.runtime = runtime
+        # secure.mtls.ServingCerts (or None): TLS-wrap every accepted
+        # connection against the holder's CURRENT context — per-accept
+        # wrapping is what makes a rotate() apply without a rebind
+        self._tls = tls
         self.native = native
         self.probe_controller = probe_controller
         # pilot DiscoveryService whose debug_view() backs
@@ -88,7 +93,14 @@ class IntrospectServer:
         # bind BEFORE touching the global tracer: a bind failure (port
         # in use) raises out of __init__ with no instance to close(),
         # and a ring installed first would leak on the hot path forever
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if tls is not None:
+            class TlsHTTPServer(ThreadingHTTPServer):
+                def get_request(self):   # per-accept TLS wrap
+                    sock, addr = super().get_request()
+                    return outer._tls.wrap_server_socket(sock), addr
+            self._httpd = TlsHTTPServer((host, port), Handler)
+        else:
+            self._httpd = ThreadingHTTPServer((host, port), Handler)
         if trace_capacity:
             from istio_tpu.utils import tracing
             self._ring = tracing.enable_ring(trace_capacity)
@@ -152,6 +164,7 @@ class IntrospectServer:
         "/debug/events": "_h_events",
         "/debug/audit": "_h_audit",
         "/debug/slo": "_h_slo",
+        "/debug/identity": "_h_identity",
         "/debug/profile": "_h_profile",
         "/debug/threads": "_h_threads",
     }
@@ -906,6 +919,26 @@ class IntrospectServer:
             self._send_json(req, aud.evaluate())
             return
         self._send_json(req, aud.snapshot())
+
+    def _h_identity(self, req: BaseHTTPRequestHandler) -> None:
+        """Secure-plane view: the zero-shaped mixer_identity_* counter
+        families (issue/rotate/expiry × ok/failed, authenticated
+        checks, typed UNAUTHENTICATED admissions), the serving
+        WorkloadIdentity's live stats when one is registered on the
+        executor maintenance lane, and this front's ServingCerts
+        generation when TLS is on."""
+        from istio_tpu.runtime import monitor
+        payload: dict = {"counters": monitor.identity_counters()}
+        if self._tls is not None:
+            payload["serving_cert_generation"] = self._tls.generation
+        ex = getattr(self.runtime, "executor", None)
+        wi = None
+        if ex is not None:
+            wi = getattr(ex, "_persistent_refresh",
+                         {}).get("workload_identity")
+        if wi is not None and hasattr(wi, "stats"):
+            payload["workload_identity"] = wi.stats()
+        self._send_json(req, payload)
 
     def _h_slo(self, req: BaseHTTPRequestHandler) -> None:
         """One fused per-plane SLO scorecard: check wire p99 vs its

@@ -324,6 +324,11 @@ class AdapterExecutor:
         self._closed = False
         # maintenance registry: name → {fn, interval_s, next_due, ...}
         self._refresh: dict[str, dict] = {}
+        # persistent refreshables (e.g. the workload-identity rotation
+        # loop): unlike handler providers these are NOT rebuilt from
+        # the published handler map, so a config republish must not
+        # evict them — register_refreshables re-merges this dict
+        self._persistent_refresh: dict[str, Any] = {}
         self._refresh_lock = threading.Lock()
         self._maint_stop = threading.Event()
         self._maint_thread: threading.Thread | None = None
@@ -468,19 +473,56 @@ class AdapterExecutor:
                 if getattr(h, "_provider", None) is None:
                     continue   # nothing to re-pull
                 prev = self._refresh.get(name)
-                fresh[name] = {
-                    "fn": refresh,
-                    "interval_s": interval,
-                    "next_due": now + interval,
-                    "total": prev["total"] if prev else 0,
-                    "failures": prev["failures"] if prev else 0,
-                    "last_success_wall":
-                        prev["last_success_wall"] if prev else None,
-                    "last_error": prev["last_error"] if prev else None,
-                    "in_flight": False,
-                }
+                fresh[name] = self._refresh_entry(refresh, interval,
+                                                  now, prev)
+            # persistent refreshables (identity rotation) survive the
+            # rebuild: carry their live entries across, due times and
+            # stats intact
+            for name, obj in self._persistent_refresh.items():
+                prev = self._refresh.get(name)
+                fresh[name] = prev if prev is not None else \
+                    self._refresh_entry(
+                        obj.refresh,
+                        float(obj.refresh_interval_s), now, None)
             self._refresh = fresh
         if fresh and self._maint_thread is None and not self._closed:
+            self._maint_thread = threading.Thread(
+                target=self._maintenance_loop, daemon=True,
+                name="adapter-maintenance")
+            self._maint_thread.start()
+
+    @staticmethod
+    def _refresh_entry(fn, interval: float, now: float,
+                       prev: "dict | None") -> dict:
+        return {
+            "fn": fn,
+            "interval_s": interval,
+            "next_due": now + interval,
+            "total": prev["total"] if prev else 0,
+            "failures": prev["failures"] if prev else 0,
+            "last_success_wall":
+                prev["last_success_wall"] if prev else None,
+            "last_error": prev["last_error"] if prev else None,
+            "in_flight": False,
+        }
+
+    def register_refreshable(self, name: str, obj: Any) -> None:
+        """Register a PERSISTENT maintenance-lane refreshable — a
+        `refresh()` + `refresh_interval_s` duck (the workload-identity
+        rotation loop rides here). Unlike handler providers it is not
+        evicted when a config republish rebuilds the registry."""
+        refresh = getattr(obj, "refresh", None)
+        interval = float(getattr(obj, "refresh_interval_s", 0.0) or 0.0)
+        if not callable(refresh) or interval <= 0:
+            raise ValueError(
+                f"refreshable {name!r} needs a callable refresh and a "
+                f"positive refresh_interval_s")
+        with self._refresh_lock:
+            self._persistent_refresh[name] = obj
+            self._refresh[name] = self._refresh_entry(
+                refresh, interval, time.monotonic(),
+                self._refresh.get(name))
+        if self._maint_thread is None and not self._closed:
             self._maint_thread = threading.Thread(
                 target=self._maintenance_loop, daemon=True,
                 name="adapter-maintenance")

@@ -76,6 +76,15 @@ class GrantPolicy:
         # namespace inherits when a publish can't attribute changes
         self._global_change = now
         self._ns_change: dict[str, float] = {}
+        # identity axis (secure plane): last rotation/revocation
+        # instant per SPIFFE principal. Folded by the mTLS fronts —
+        # min() over the namespace grant, so a grant issued to a peer
+        # whose identity just rotated drops to the TTL floor and the
+        # old principal's cached verdicts die within one floor window
+        # instead of riding out the full namespace grant. Bounded: a
+        # mesh has few distinct principals per rotation window.
+        self._identity_change: dict[str, float] = {}
+        self._identity_revocations = 0
         self.generation = 0
         self._grants_issued = 0
         self._revocations = 0
@@ -112,6 +121,24 @@ class GrantPolicy:
         from istio_tpu.runtime import forensics
         forensics.record_event("grant_revoke", scope=scope,
                                generation=self.generation)
+
+    def on_identity_rotate(self, identity: str) -> None:
+        """A workload identity rotated (or was revoked+reissued): the
+        principal's outstanding client-cache grants must not outlive
+        the floor window. Called from the WorkloadIdentity on_rotate
+        chain AFTER the serving certs swapped (rotation ordering:
+        sign → swap certs → revoke identity grants)."""
+        now = time.monotonic()
+        with self._lock:
+            if len(self._identity_change) >= 4096:
+                self._identity_change.clear()
+            self._identity_change[identity] = now
+            self._identity_revocations += 1
+            generation = self.generation
+        from istio_tpu.runtime import forensics
+        forensics.record_event("grant_revoke", scope="identity",
+                               identity=identity,
+                               generation=generation)
 
     # -- serve side ----------------------------------------------------
 
@@ -152,6 +179,19 @@ class GrantPolicy:
                 self._issued_at_generation = self.generation
         return out
 
+    def identity_grant(self, identity: str) -> tuple[float, int]:
+        """(ttl_s, use_count) for one authenticated principal, now.
+        A principal that never rotated gets the cap pair — min()
+        against the namespace grant makes that fold a no-op, so the
+        identity axis costs nothing until a rotation actually
+        happens."""
+        now = time.monotonic()
+        with self._lock:
+            changed = self._identity_change.get(identity)
+        if changed is None:
+            return (self.ttl_cap_s, self.use_cap)
+        return self._pair(max(now - changed, 0.0))
+
     def watermark(self) -> dict:
         """Grant/generation coherence reading for the audit plane —
         one lock round, no TTL math."""
@@ -180,4 +220,6 @@ class GrantPolicy:
                 "ns_ages_s": ages,
                 "grants_issued": self._grants_issued,
                 "revocations": self._revocations,
+                "identity_revocations": self._identity_revocations,
+                "identities_tracked": len(self._identity_change),
             }

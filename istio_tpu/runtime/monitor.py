@@ -300,6 +300,58 @@ def forensics_counters() -> dict:
     }
 
 
+# -- secure plane: workload identity + mTLS admission ----------------
+#
+# Lifecycle counters for the WorkloadIdentity rotation loop
+# (istio_tpu/secure/identity.py) and the mTLS admission boundary on
+# the serving fronts. Zero-shaped per the promtext doctrine: every
+# (event, outcome) series exposes at 0 from the first scrape.
+IDENTITY_EVENTS_KINDS = ("issue", "rotate", "expiry")
+IDENTITY_OUTCOMES = ("ok", "failed")
+IDENTITY_EVENTS = prometheus_client.Counter(
+    "mixer_identity_events_total",
+    "workload-identity lifecycle transitions (issue = first obtain, "
+    "rotate = renewal, expiry = cert died before renewal), by "
+    "outcome", ["event", "outcome"], registry=REGISTRY)
+IDENTITY_UNAUTHENTICATED = prometheus_client.Counter(
+    "mixer_identity_unauthenticated_total",
+    "requests rejected typed UNAUTHENTICATED at strict-mTLS "
+    "admission (no verified peer SPIFFE identity)",
+    registry=REGISTRY)
+IDENTITY_AUTHENTICATED = prometheus_client.Counter(
+    "mixer_identity_authenticated_checks_total",
+    "check admissions whose attribute bag carried a verified peer "
+    "SPIFFE identity (source.user from the client cert)",
+    registry=REGISTRY)
+for _e in IDENTITY_EVENTS_KINDS:
+    for _o in IDENTITY_OUTCOMES:
+        IDENTITY_EVENTS.labels(event=_e, outcome=_o)
+
+
+def note_identity(event: str, outcome: str) -> None:
+    if event not in IDENTITY_EVENTS_KINDS:
+        event = "issue"
+    if outcome not in IDENTITY_OUTCOMES:
+        outcome = "failed"
+    IDENTITY_EVENTS.labels(event=event, outcome=outcome).inc()
+
+
+def identity_counters() -> dict:
+    """Secure-plane counter snapshot — /debug/identity, the mtls
+    smoke and bench.py secure_* keys read this."""
+    events = {e: {o: int(IDENTITY_EVENTS.labels(
+        event=e, outcome=o)._value.get())
+        for o in IDENTITY_OUTCOMES} for e in IDENTITY_EVENTS_KINDS}
+    return {
+        "events": events,
+        "rotations_ok": events["rotate"]["ok"],
+        "unauthenticated_total":
+            int(IDENTITY_UNAUTHENTICATED._value.get()),
+        "authenticated_checks_total":
+            int(IDENTITY_AUTHENTICATED._value.get()),
+    }
+
+
 # -- end-to-end Check() latency decomposition ------------------------
 #
 # Stage semantics (one observation per BATCH per stage; e2e is one

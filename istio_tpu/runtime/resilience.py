@@ -44,9 +44,11 @@ from typing import Any, Callable, Sequence
 log = logging.getLogger("istio_tpu.runtime.resilience")
 
 # gRPC status codes the serving path rejects with (google.rpc.Code)
+INVALID_ARGUMENT = 3
 DEADLINE_EXCEEDED = 4
 RESOURCE_EXHAUSTED = 8
 UNAVAILABLE = 14
+UNAUTHENTICATED = 16
 
 
 class CheckRejected(RuntimeError):
@@ -54,6 +56,13 @@ class CheckRejected(RuntimeError):
     status code the API fronts must surface (INTERNAL is reserved for
     genuine bugs; overload and degradation get honest codes)."""
     grpc_code = 2   # UNKNOWN; subclasses override
+
+
+class InvalidArgumentError(CheckRejected):
+    """The request's wire attributes could not be decoded/re-encoded
+    (malformed bag at the identity-injection boundary): the caller
+    sent garbage, not the server — typed so the wire says so."""
+    grpc_code = INVALID_ARGUMENT
 
 
 class DeadlineExceededError(CheckRejected):
@@ -66,6 +75,14 @@ class ResourceExhaustedError(CheckRejected):
 
 class UnavailableError(CheckRejected):
     grpc_code = UNAVAILABLE
+
+
+class UnauthenticatedError(CheckRejected):
+    """Strict-mTLS admission refused a request that presented no
+    verified peer identity (secure/mtls.py). Typed so the wire shows
+    UNAUTHENTICATED — never an opaque TLS alert or INTERNAL — and the
+    meshlint typed-rejection pass can audit the boundary."""
+    grpc_code = UNAUTHENTICATED
 
 
 @dataclasses.dataclass

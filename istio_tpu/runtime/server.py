@@ -255,6 +255,22 @@ class ServerArgs:
     # a forensics exemplar/event past this long counts unexplained
     audit_explain_window_s: float = 10.0
 
+    # -- secure serving plane (istio_tpu/secure/) ----------------------
+    # off | permissive | strict (secure/mtls.py). The API fronts read
+    # this plus a ServingCerts holder the operator/mixs wires; strict
+    # without certs is a construction-time error in each front. The
+    # runtime core itself stays transport-agnostic — the knob lives
+    # here so mixs/operators configure one surface (mixs --mtls).
+    mtls: str = "off"
+    # workload identity the serving fronts present
+    # (spiffe://<domain>/ns/<ns>/sa/<sa>); empty → the mixs default
+    mtls_identity: str = ""
+    # serving-cert TTL and rotation point (fraction of TTL remaining
+    # at which the maintenance lane renews; node-agent half-life
+    # policy) for the WorkloadIdentity the fronts serve from
+    mtls_cert_ttl_minutes: int = 60
+    mtls_rotation_fraction: float = 0.5
+
 
 class RuntimeServer:
     def __init__(self, store: Store, args: ServerArgs | None = None):

@@ -15,6 +15,7 @@ import glob
 import os
 import queue
 import threading
+import weakref
 from typing import Any, Callable, Iterable, Mapping
 
 import yaml
@@ -47,9 +48,18 @@ class Store:
         self._watchers: list[Watcher] = []
         self._validator = validator
         self._queue: "queue.Queue[list[Event] | None]" = queue.Queue()
-        self._delivery = threading.Thread(target=self._deliver, daemon=True,
-                                          name="store-delivery")
+        # The delivery thread must NOT hold a strong reference to the
+        # store (a bound-method target would): a store dropped without
+        # close() would then pin its thread — and itself — forever,
+        # and a long process accumulates one parked thread per dead
+        # store. The thread sees the store only through a weakref; the
+        # finalizer wakes it with the same None sentinel close() uses,
+        # so GC of an unclosed store reaps its thread.
+        self._delivery = threading.Thread(
+            target=_deliver_loop, args=(self._queue, weakref.ref(self)),
+            daemon=True, name="store-delivery")
         self._delivery.start()
+        self._finalizer = weakref.finalize(self, self._queue.put, None)
 
     # -- read --
     def get(self, key: Key) -> Mapping[str, Any] | None:
@@ -90,22 +100,32 @@ class Store:
     def watch(self, watcher: Watcher) -> None:
         self._watchers.append(watcher)
 
-    def _deliver(self) -> None:
-        while True:
-            events = self._queue.get()
-            if events is None:
-                return
-            for w in list(self._watchers):
-                try:
-                    w(events)
-                except Exception:   # watcher isolation (queue.go behavior)
-                    import logging
-                    logging.getLogger("istio_tpu.store").exception(
-                        "config watcher failed")
-
     def close(self) -> None:
-        self._queue.put(None)
+        # finalize() is idempotent: first call enqueues the None
+        # sentinel and detaches the GC finalizer
+        self._finalizer()
         self._delivery.join(timeout=5)
+
+
+def _deliver_loop(q: "queue.Queue[list[Event] | None]",
+                  store_ref: "weakref.ref[Store]") -> None:
+    """Watcher delivery loop — module-level so the thread only holds
+    the queue and a weakref (see Store.__init__)."""
+    while True:
+        events = q.get()
+        if events is None:
+            return
+        store = store_ref()
+        if store is None:
+            return
+        for w in list(store._watchers):
+            try:
+                w(events)
+            except Exception:   # watcher isolation (queue.go behavior)
+                import logging
+                logging.getLogger("istio_tpu.store").exception(
+                    "config watcher failed")
+        del store   # no strong ref while parked on q.get()
 
 
 class MemStore(Store):

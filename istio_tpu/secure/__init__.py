@@ -1,0 +1,39 @@
+"""Secure serving plane — SPIFFE workload identity, CA-driven cert
+rotation, mTLS fronts feeding the device-compiled RBAC plane.
+
+Layout:
+
+  backend.py  — the `PkiBackend` seam: one PEM-bytes API, two
+                implementations (`cryptography` when importable, the
+                `openssl` CLI otherwise) so the PKI plane runs — and
+                tier-1 exercises it — on crypto-less rigs too.
+  identity.py — `WorkloadIdentity`: obtain / cache / rotate short-TTL
+                workload certs against the CA gRPC service, rotation
+                driven off the executor maintenance lane, issuance /
+                rotation / expiry as forensics events + zero-shaped
+                mixer_identity_* counters.
+  mtls.py     — mTLS modes (off|permissive|strict), hot-reloadable
+                serving credentials for the gRPC fronts
+                (dynamic_ssl_server_credentials fetcher) and the
+                stdlib-ssl HTTP fronts (per-accept context swap), and
+                peer SPIFFE identity extraction at request admission.
+  tlslane.py  — stdlib-ssl terminating TLS lane in front of the
+                native h2 pump (the C++ front keeps its exact wire
+                accounting; TLS terminates in the lane).
+"""
+from istio_tpu.secure.backend import (CertInfo, PkiBackend, PkiError,
+                                      available_backends,
+                                      default_backend,
+                                      set_default_backend)
+from istio_tpu.secure.identity import WorkloadIdentity
+from istio_tpu.secure.mtls import (MTLS_MODES, ServingCerts,
+                                   client_channel_credentials,
+                                   peer_identity_from_auth_context)
+from istio_tpu.secure.tlslane import TlsTerminatingLane
+
+__all__ = [
+    "CertInfo", "PkiBackend", "PkiError", "available_backends",
+    "default_backend", "set_default_backend", "WorkloadIdentity",
+    "MTLS_MODES", "ServingCerts", "client_channel_credentials",
+    "peer_identity_from_auth_context", "TlsTerminatingLane",
+]

@@ -1,0 +1,572 @@
+"""The `PkiBackend` seam: one PEM-bytes PKI API, two backends.
+
+The reference CA (security/pkg/pki) assumes a crypto library is always
+there; this rig sometimes has no `cryptography` wheel but always has
+an `openssl` CLI (1.1.1w here). Everything above this module —
+security/pki.py's object helpers, the IstioCA, the CSR gRPC service,
+the mTLS fronts — speaks ONLY this seam, in PEM bytes, so the whole
+secure plane (and its tier-1 tests) runs identically on either rig.
+
+Both backends emit standard PKCS8 private keys and X.509 PEM: the
+outputs interoperate byte-format-for-byte-format (a CSR minted by one
+backend signs under the other, and either output feeds the TLS stack).
+
+openssl-CLI notes (1.1.1-era constraints this module absorbs):
+  * `x509 -req` only supports whole `-days`, but workload TTLs need
+    minute precision (rotation tests, short-TTL grants) — leaf signing
+    therefore drives `openssl ca` with a throwaway database and
+    explicit `-startdate`/`-enddate` GeneralizedTimes.
+  * there is no `-copy_extensions`: the CSR's SANs are parsed out and
+    written into the signing extfile, mirroring ca.go's honor-the-CSR
+    behavior (and the authorization contract stays in ca_service,
+    which authorizes every SAN before this layer ever runs).
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import os
+import re
+import secrets as _secrets
+import shutil
+import subprocess
+import tempfile
+from typing import Sequence
+
+BACKDATE_S = 300          # not_valid_before skew absorbed (ca.go)
+
+
+class PkiError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class CertInfo:
+    """Parsed view of a cert or CSR — everything the plane reads."""
+    subject: str = ""
+    uris: tuple = ()
+    dns: tuple = ()
+    not_after: datetime.datetime | None = None
+    is_ca: bool = False
+    signature_ok: bool = True
+
+
+class PkiBackend:
+    """PEM-bytes-only PKI operations. Subclasses implement; callers
+    never see a backend-native key/cert object."""
+
+    name = "abstract"
+
+    # -- keys --
+    def generate_key(self, ec_key: bool = True) -> bytes:
+        raise NotImplementedError
+
+    def public_key_pem(self, key_pem: bytes) -> bytes:
+        raise NotImplementedError
+
+    def cert_public_key_pem(self, cert_pem: bytes) -> bytes:
+        raise NotImplementedError
+
+    # -- CSRs --
+    def generate_csr(self, key_pem: bytes, uris: Sequence[str] = (),
+                     dns: Sequence[str] = (),
+                     org: str = "istio_tpu") -> bytes:
+        raise NotImplementedError
+
+    def csr_info(self, csr_pem: bytes) -> CertInfo:
+        raise NotImplementedError
+
+    # -- certs --
+    def cert_info(self, cert_pem: bytes) -> CertInfo:
+        raise NotImplementedError
+
+    def self_signed_root(self, org: str,
+                         ttl: datetime.timedelta
+                         ) -> tuple[bytes, bytes]:
+        raise NotImplementedError
+
+    def sign_csr(self, ca_key_pem: bytes, ca_cert_pem: bytes,
+                 csr_pem: bytes, ttl: datetime.timedelta) -> bytes:
+        raise NotImplementedError
+
+    def verify_chain(self, cert_pem: bytes, root_pem: bytes) -> bool:
+        raise NotImplementedError
+
+    # -- derived --
+    def key_cert_pair_ok(self, key_pem: bytes,
+                         cert_pem: bytes) -> bool:
+        try:
+            return self.public_key_pem(key_pem) == \
+                self.cert_public_key_pem(cert_pem)
+        except PkiError:
+            return False
+
+
+# ---------------------------------------------------------------------
+# cryptography backend
+# ---------------------------------------------------------------------
+
+class CryptographyBackend(PkiBackend):
+    """The original istio_tpu/security/pki.py implementation, folded
+    behind the seam."""
+
+    name = "cryptography"
+
+    def generate_key(self, ec_key: bool = True) -> bytes:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import ec, rsa
+        if ec_key:
+            key = ec.generate_private_key(ec.SECP256R1())
+        else:
+            key = rsa.generate_private_key(public_exponent=65537,
+                                           key_size=2048)
+        return key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption())
+
+    def public_key_pem(self, key_pem: bytes) -> bytes:
+        from cryptography.hazmat.primitives import serialization
+        try:
+            key = serialization.load_pem_private_key(key_pem,
+                                                     password=None)
+        except Exception as exc:
+            raise PkiError(f"bad private key: {exc}") from exc
+        return key.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+
+    def cert_public_key_pem(self, cert_pem: bytes) -> bytes:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import serialization
+        try:
+            cert = x509.load_pem_x509_certificate(cert_pem)
+        except Exception as exc:
+            raise PkiError(f"bad certificate: {exc}") from exc
+        return cert.public_key().public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo)
+
+    def generate_csr(self, key_pem: bytes, uris: Sequence[str] = (),
+                     dns: Sequence[str] = (),
+                     org: str = "istio_tpu") -> bytes:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import (hashes,
+                                                    serialization)
+        from cryptography.x509.oid import NameOID
+        key = serialization.load_pem_private_key(key_pem, password=None)
+        builder = x509.CertificateSigningRequestBuilder().subject_name(
+            x509.Name([x509.NameAttribute(NameOID.ORGANIZATION_NAME,
+                                          org)]))
+        sans = [x509.UniformResourceIdentifier(u) for u in uris] + \
+            [x509.DNSName(d) for d in dns]
+        if sans:
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(sans), critical=False)
+        return builder.sign(key, hashes.SHA256()).public_bytes(
+            serialization.Encoding.PEM)
+
+    @staticmethod
+    def _sans(obj) -> tuple[tuple, tuple]:
+        from cryptography import x509
+        try:
+            ext = obj.extensions.get_extension_for_class(
+                x509.SubjectAlternativeName)
+        except x509.ExtensionNotFound:
+            return (), ()
+        return (tuple(ext.value.get_values_for_type(
+                    x509.UniformResourceIdentifier)),
+                tuple(ext.value.get_values_for_type(x509.DNSName)))
+
+    def csr_info(self, csr_pem: bytes) -> CertInfo:
+        from cryptography import x509
+        try:
+            csr = x509.load_pem_x509_csr(csr_pem)
+        except Exception as exc:
+            raise PkiError(f"bad CSR: {exc}") from exc
+        uris, dns = self._sans(csr)
+        return CertInfo(subject=csr.subject.rfc4514_string(),
+                        uris=uris, dns=dns,
+                        signature_ok=csr.is_signature_valid)
+
+    def cert_info(self, cert_pem: bytes) -> CertInfo:
+        from cryptography import x509
+        try:
+            cert = x509.load_pem_x509_certificate(cert_pem)
+        except Exception as exc:
+            raise PkiError(f"bad certificate: {exc}") from exc
+        uris, dns = self._sans(cert)
+        na = getattr(cert, "not_valid_after_utc", None)
+        if na is None:
+            na = cert.not_valid_after.replace(
+                tzinfo=datetime.timezone.utc)
+        try:
+            bc = cert.extensions.get_extension_for_class(
+                x509.BasicConstraints)
+            is_ca = bool(bc.value.ca)
+        except x509.ExtensionNotFound:
+            is_ca = False
+        return CertInfo(subject=cert.subject.rfc4514_string(),
+                        uris=uris, dns=dns, not_after=na, is_ca=is_ca)
+
+    def self_signed_root(self, org: str, ttl: datetime.timedelta
+                         ) -> tuple[bytes, bytes]:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import (hashes,
+                                                    serialization)
+        from cryptography.x509.oid import NameOID
+        key_pem = self.generate_key()
+        key = serialization.load_pem_private_key(key_pem, password=None)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        # the root's subject must differ from leaf subjects (all
+        # O=<org>): subject==issuer on a leaf reads as self-signed to
+        # chain verifiers and TLS handshakes fail
+        name = x509.Name([
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+            x509.NameAttribute(NameOID.COMMON_NAME, f"{org} root CA")])
+        cert = (x509.CertificateBuilder()
+                .subject_name(name).issuer_name(name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(
+                    now - datetime.timedelta(seconds=BACKDATE_S))
+                .not_valid_after(now + ttl)
+                .add_extension(x509.BasicConstraints(ca=True,
+                                                     path_length=None),
+                               critical=True)
+                .add_extension(x509.KeyUsage(
+                    digital_signature=True, key_cert_sign=True,
+                    crl_sign=True, content_commitment=False,
+                    key_encipherment=False, data_encipherment=False,
+                    key_agreement=False, encipher_only=False,
+                    decipher_only=False), critical=True)
+                .sign(key, hashes.SHA256()))
+        return key_pem, cert.public_bytes(serialization.Encoding.PEM)
+
+    def sign_csr(self, ca_key_pem: bytes, ca_cert_pem: bytes,
+                 csr_pem: bytes, ttl: datetime.timedelta) -> bytes:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import (hashes,
+                                                    serialization)
+        key = serialization.load_pem_private_key(ca_key_pem,
+                                                 password=None)
+        ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
+        csr = x509.load_pem_x509_csr(csr_pem)
+        uris, dns = self._sans(csr)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (x509.CertificateBuilder()
+                   .subject_name(csr.subject)
+                   .issuer_name(ca_cert.subject)
+                   .public_key(csr.public_key())
+                   .serial_number(x509.random_serial_number())
+                   .not_valid_before(
+                       now - datetime.timedelta(seconds=BACKDATE_S))
+                   .not_valid_after(now + ttl)
+                   .add_extension(x509.BasicConstraints(
+                       ca=False, path_length=None), critical=True)
+                   .add_extension(x509.ExtendedKeyUsage(
+                       [x509.ExtendedKeyUsageOID.SERVER_AUTH,
+                        x509.ExtendedKeyUsageOID.CLIENT_AUTH]),
+                       critical=False))
+        if uris or dns:
+            builder = builder.add_extension(
+                x509.SubjectAlternativeName(
+                    [x509.UniformResourceIdentifier(u)
+                     for u in uris] +
+                    [x509.DNSName(d) for d in dns]),
+                critical=False)
+        cert = builder.sign(key, hashes.SHA256())
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+    def verify_chain(self, cert_pem: bytes, root_pem: bytes) -> bool:
+        from cryptography import x509
+        try:
+            cert = x509.load_pem_x509_certificate(cert_pem)
+            root = x509.load_pem_x509_certificate(root_pem)
+            cert.verify_directly_issued_by(root)
+            return True
+        except Exception:
+            return False
+
+
+# ---------------------------------------------------------------------
+# openssl-CLI backend
+# ---------------------------------------------------------------------
+
+_SAN_SPLIT = re.compile(r",\s*")
+
+
+class OpensslBackend(PkiBackend):
+    """PKI via the `openssl` binary (1.1.1-compatible invocations)."""
+
+    name = "openssl"
+
+    def __init__(self, binary: str = "openssl"):
+        self._bin = shutil.which(binary) or binary
+
+    def _run(self, args: list[str], stdin: bytes | None = None,
+             ok_rc: tuple[int, ...] = (0,),
+             cwd: str | None = None) -> subprocess.CompletedProcess:
+        env = dict(os.environ)
+        env["LC_ALL"] = "C"                 # stable date formatting
+        env.setdefault("RANDFILE", os.devnull)
+        try:
+            proc = subprocess.run([self._bin] + args, input=stdin,
+                                  capture_output=True, env=env, cwd=cwd,
+                                  timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            raise PkiError(f"openssl {args[0]} failed to run: "
+                           f"{exc}") from exc
+        if proc.returncode not in ok_rc:
+            err = proc.stderr.decode("utf-8", "replace").strip()
+            raise PkiError(f"openssl {args[0]} rc={proc.returncode}: "
+                           f"{err[-500:]}")
+        return proc
+
+    # -- keys --
+
+    def generate_key(self, ec_key: bool = True) -> bytes:
+        if ec_key:
+            args = ["genpkey", "-algorithm", "EC",
+                    "-pkeyopt", "ec_paramgen_curve:P-256",
+                    "-pkeyopt", "ec_param_enc:named_curve"]
+        else:
+            args = ["genpkey", "-algorithm", "RSA",
+                    "-pkeyopt", "rsa_keygen_bits:2048"]
+        return self._run(args).stdout
+
+    def public_key_pem(self, key_pem: bytes) -> bytes:
+        return self._run(["pkey", "-pubout"], stdin=key_pem).stdout
+
+    def cert_public_key_pem(self, cert_pem: bytes) -> bytes:
+        return self._run(["x509", "-pubkey", "-noout"],
+                         stdin=cert_pem).stdout
+
+    # -- CSRs --
+
+    @staticmethod
+    def _alt_section(uris: Sequence[str],
+                     dns: Sequence[str]) -> str:
+        lines = ["[alt]"]
+        for i, u in enumerate(uris, 1):
+            lines.append(f"URI.{i} = {u}")
+        for i, d in enumerate(dns, 1):
+            lines.append(f"DNS.{i} = {d}")
+        return "\n".join(lines) + "\n"
+
+    def generate_csr(self, key_pem: bytes, uris: Sequence[str] = (),
+                     dns: Sequence[str] = (),
+                     org: str = "istio_tpu") -> bytes:
+        with tempfile.TemporaryDirectory(prefix="pki-") as d:
+            key_f = os.path.join(d, "key.pem")
+            with open(key_f, "wb") as fh:
+                fh.write(key_pem)
+            cfg = ("[req]\nprompt = no\ndistinguished_name = dn\n"
+                   f"[dn]\nO = {org}\n")
+            args = ["req", "-new", "-sha256", "-key", key_f]
+            if uris or dns:
+                cfg += "[ext]\nsubjectAltName = @alt\n" + \
+                    self._alt_section(uris, dns)
+                args += ["-reqexts", "ext"]
+            cfg_f = os.path.join(d, "req.cnf")
+            with open(cfg_f, "w") as fh:
+                fh.write(cfg)
+            args += ["-config", cfg_f]
+            return self._run(args).stdout
+
+    @staticmethod
+    def _parse_sans(text: str) -> tuple[tuple, tuple]:
+        uris: list[str] = []
+        dns: list[str] = []
+        lines = text.splitlines()
+        for i, line in enumerate(lines):
+            if "Subject Alternative Name" not in line:
+                continue
+            if i + 1 < len(lines):
+                for part in _SAN_SPLIT.split(lines[i + 1].strip()):
+                    if part.startswith("URI:"):
+                        uris.append(part[4:])
+                    elif part.startswith("DNS:"):
+                        dns.append(part[4:])
+            break
+        return tuple(uris), tuple(dns)
+
+    @staticmethod
+    def _parse_subject(text: str) -> str:
+        m = re.search(r"Subject:\s*(.*)", text)
+        return m.group(1).strip() if m else ""
+
+    def csr_info(self, csr_pem: bytes) -> CertInfo:
+        # -verify makes the rc reflect CSR signature validity; rerun
+        # without it to still parse a tampered CSR's text
+        proc = self._run(["req", "-noout", "-text", "-verify"],
+                         stdin=csr_pem, ok_rc=(0, 1))
+        sig_ok = proc.returncode == 0
+        text = proc.stdout.decode("utf-8", "replace")
+        if not sig_ok and "Certificate Request" not in text:
+            text = self._run(["req", "-noout", "-text"],
+                             stdin=csr_pem).stdout.decode(
+                                 "utf-8", "replace")
+        uris, dns = self._parse_sans(text)
+        return CertInfo(subject=self._parse_subject(text), uris=uris,
+                        dns=dns, signature_ok=sig_ok)
+
+    # -- certs --
+
+    def cert_info(self, cert_pem: bytes) -> CertInfo:
+        text = self._run(["x509", "-noout", "-text"],
+                         stdin=cert_pem).stdout.decode("utf-8",
+                                                       "replace")
+        uris, dns = self._parse_sans(text)
+        na = None
+        m = re.search(r"Not After\s*:\s*(.*)", text)
+        if m:
+            try:
+                na = datetime.datetime.strptime(
+                    m.group(1).strip(), "%b %d %H:%M:%S %Y %Z"
+                ).replace(tzinfo=datetime.timezone.utc)
+            except ValueError:
+                na = None
+        return CertInfo(subject=self._parse_subject(text), uris=uris,
+                        dns=dns, not_after=na, is_ca="CA:TRUE" in text)
+
+    def self_signed_root(self, org: str, ttl: datetime.timedelta
+                         ) -> tuple[bytes, bytes]:
+        key_pem = self.generate_key()
+        days = max(int(ttl.total_seconds() // 86400), 1)
+        with tempfile.TemporaryDirectory(prefix="pki-") as d:
+            key_f = os.path.join(d, "key.pem")
+            with open(key_f, "wb") as fh:
+                fh.write(key_pem)
+            cfg_f = os.path.join(d, "root.cnf")
+            with open(cfg_f, "w") as fh:
+                fh.write(
+                    "[req]\nprompt = no\ndistinguished_name = dn\n"
+                    "x509_extensions = v3ca\n"
+                    f"[dn]\nO = {org}\nCN = {org} root CA\n"
+                    "[v3ca]\n"
+                    "basicConstraints = critical,CA:TRUE\n"
+                    "keyUsage = critical,digitalSignature,"
+                    "keyCertSign,cRLSign\n"
+                    "subjectKeyIdentifier = hash\n")
+            cert = self._run(["req", "-x509", "-new", "-sha256",
+                              "-key", key_f, "-config", cfg_f,
+                              "-days", str(days)]).stdout
+        return key_pem, cert
+
+    @staticmethod
+    def _gtime(dt: datetime.datetime) -> str:
+        return dt.astimezone(datetime.timezone.utc).strftime(
+            "%Y%m%d%H%M%SZ")
+
+    def sign_csr(self, ca_key_pem: bytes, ca_cert_pem: bytes,
+                 csr_pem: bytes, ttl: datetime.timedelta) -> bytes:
+        info = self.csr_info(csr_pem)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        start = self._gtime(now - datetime.timedelta(
+            seconds=BACKDATE_S))
+        end = self._gtime(now + ttl)
+        with tempfile.TemporaryDirectory(prefix="pki-ca-") as d:
+            for fname, blob in (("ca-key.pem", ca_key_pem),
+                                ("ca-cert.pem", ca_cert_pem),
+                                ("in.csr", csr_pem)):
+                with open(os.path.join(d, fname), "wb") as fh:
+                    fh.write(blob)
+            with open(os.path.join(d, "index.txt"), "w"):
+                pass
+            with open(os.path.join(d, "serial"), "w") as fh:
+                fh.write("%016x\n" % _secrets.randbits(63))
+            leaf = ("[leaf]\n"
+                    "basicConstraints = critical,CA:FALSE\n"
+                    "extendedKeyUsage = serverAuth,clientAuth\n"
+                    "subjectKeyIdentifier = hash\n")
+            if info.uris or info.dns:
+                leaf += "subjectAltName = @alt\n" + \
+                    self._alt_section(info.uris, info.dns)
+            with open(os.path.join(d, "ca.cnf"), "w") as fh:
+                fh.write(
+                    "[ca]\ndefault_ca = CA_default\n"
+                    "[CA_default]\n"
+                    f"database = {d}/index.txt\n"
+                    f"serial = {d}/serial\n"
+                    f"new_certs_dir = {d}\n"
+                    f"certificate = {d}/ca-cert.pem\n"
+                    f"private_key = {d}/ca-key.pem\n"
+                    "default_md = sha256\n"
+                    "policy = pol_any\n"
+                    "email_in_dn = no\n"
+                    "unique_subject = no\n"
+                    "x509_extensions = leaf\n"
+                    "[pol_any]\n"
+                    "countryName = optional\n"
+                    "stateOrProvinceName = optional\n"
+                    "localityName = optional\n"
+                    "organizationName = optional\n"
+                    "organizationalUnitName = optional\n"
+                    "commonName = optional\n"
+                    "emailAddress = optional\n" + leaf)
+            self._run(["ca", "-batch", "-config",
+                       os.path.join(d, "ca.cnf"),
+                       "-in", os.path.join(d, "in.csr"),
+                       "-out", os.path.join(d, "leaf.pem"),
+                       "-startdate", start, "-enddate", end,
+                       "-notext", "-md", "sha256"], cwd=d)
+            with open(os.path.join(d, "leaf.pem"), "rb") as fh:
+                return fh.read()
+
+    def verify_chain(self, cert_pem: bytes, root_pem: bytes) -> bool:
+        with tempfile.TemporaryDirectory(prefix="pki-v-") as d:
+            root_f = os.path.join(d, "root.pem")
+            cert_f = os.path.join(d, "cert.pem")
+            with open(root_f, "wb") as fh:
+                fh.write(root_pem)
+            with open(cert_f, "wb") as fh:
+                fh.write(cert_pem)
+            try:
+                self._run(["verify", "-CAfile", root_f, cert_f])
+                return True
+            except PkiError:
+                return False
+
+
+# ---------------------------------------------------------------------
+# backend selection
+# ---------------------------------------------------------------------
+
+_DEFAULT: PkiBackend | None = None
+
+
+def available_backends() -> tuple[str, ...]:
+    names = []
+    try:
+        import cryptography  # noqa: F401
+        names.append("cryptography")
+    except ImportError:
+        pass
+    if shutil.which("openssl"):
+        names.append("openssl")
+    return tuple(names)
+
+
+def default_backend() -> PkiBackend:
+    """`cryptography` when importable, else the openssl CLI. Raises
+    PkiError (not ImportError) when neither exists so callers can gate
+    cleanly."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        avail = available_backends()
+        if "cryptography" in avail:
+            _DEFAULT = CryptographyBackend()
+        elif "openssl" in avail:
+            _DEFAULT = OpensslBackend()
+        else:
+            raise PkiError(
+                "no PKI backend: neither the `cryptography` package "
+                "nor an `openssl` binary is available")
+    return _DEFAULT
+
+
+def set_default_backend(backend: PkiBackend | None) -> None:
+    """Pin (tests) or reset (None) the process-wide backend."""
+    global _DEFAULT
+    _DEFAULT = backend

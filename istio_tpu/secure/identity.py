@@ -1,0 +1,200 @@
+"""WorkloadIdentity — the node-agent leg of the secure plane.
+
+One instance owns one workload's SPIFFE identity: it obtains a
+short-TTL cert from the CA gRPC service (security/ca_service CSR
+flow), caches the bundle, and rotates before expiry. Rotation is
+driven off the adapter-executor MAINTENANCE lane
+(AdapterExecutor.register_refreshable): `refresh()` is the periodic
+hook, so a slow or failing CA occupies the maintenance worker, never
+a request lane.
+
+Every lifecycle transition is observable the PR 13 way: forensics
+events (identity_issue / identity_rotate / identity_expiry) on the
+shared timeline + zero-shaped mixer_identity_* counter families
+(runtime/monitor.identity_counters).
+
+Subscribers (`on_rotate`) receive every fresh bundle — the mTLS
+fronts' ServingCerts holder and the grant plane's identity fold hang
+off this hook, which is what makes "a rotated peer never rides a
+stale grant" one ordered step: sign → swap serving certs → revoke
+identity grants → count + event.
+"""
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+import time
+from typing import Callable, Sequence
+
+from istio_tpu.security import pki
+
+log = logging.getLogger("istio_tpu.secure")
+
+Bundle = tuple  # (key_pem, cert_pem, root_pem)
+
+
+class WorkloadIdentity:
+    """Obtain / cache / rotate one workload's certificate bundle.
+
+    `client`: a security.ca_service.CAClient (or any object with its
+    `sign_csr`). `rotation_fraction`: rotate when less than this
+    fraction of the TTL remains (0.5 = half-life, the reference node
+    agent's policy).
+    """
+
+    def __init__(self, client, identity: str, *,
+                 ttl_minutes: int = 60,
+                 rotation_fraction: float = 0.5,
+                 credential: bytes = b"",
+                 credential_type: str = "onprem",
+                 refresh_interval_s: float | None = None,
+                 dns_names: Sequence[str] = (),
+                 on_rotate: Sequence[Callable[[Bundle], None]] = ()):
+        self.client = client
+        self.identity = identity
+        # serving identities also carry DNS SANs: gRPC clients match
+        # the target-name override against hostnames, not URI SANs
+        self.dns_names = tuple(dns_names)
+        self.ttl_minutes = int(ttl_minutes)
+        self.rotation_fraction = float(rotation_fraction)
+        self.credential = credential
+        self.credential_type = credential_type
+        # maintenance-lane cadence: check due-ness well inside the
+        # rotation window so a one-tick slip never crosses expiry
+        if refresh_interval_s is None:
+            refresh_interval_s = max(
+                min(60.0, self.ttl_minutes * 60.0 * 0.05), 0.05)
+        self.refresh_interval_s = float(refresh_interval_s)
+        self._on_rotate: list[Callable[[Bundle], None]] = \
+            list(on_rotate)
+        self._lock = threading.Lock()
+        self._bundle: Bundle | None = None
+        self._not_after: datetime.datetime | None = None
+        self.generation = 0
+        self.rotations = 0
+        self.failures = 0
+        self.expiries = 0
+        self.last_error: str | None = None
+
+    # -- subscriptions -------------------------------------------------
+
+    def subscribe(self, fn: Callable[[Bundle], None]) -> None:
+        with self._lock:
+            self._on_rotate.append(fn)
+
+    # -- state ---------------------------------------------------------
+
+    def bundle(self) -> Bundle | None:
+        with self._lock:
+            return self._bundle
+
+    def remaining_ttl_s(self) -> float | None:
+        with self._lock:
+            na = self._not_after
+        if na is None:
+            return None
+        return (na - datetime.datetime.now(datetime.timezone.utc)
+                ).total_seconds()
+
+    def due(self) -> bool:
+        rem = self.remaining_ttl_s()
+        if rem is None:
+            return True
+        return rem <= self.ttl_minutes * 60.0 * self.rotation_fraction
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "identity": self.identity,
+                "generation": self.generation,
+                "rotations": self.rotations,
+                "failures": self.failures,
+                "expiries": self.expiries,
+                "ttl_minutes": self.ttl_minutes,
+                "remaining_ttl_s": None if self._not_after is None
+                else (self._not_after - datetime.datetime.now(
+                    datetime.timezone.utc)).total_seconds(),
+                "last_error": self.last_error,
+            }
+
+    # -- lifecycle -----------------------------------------------------
+
+    def ensure(self) -> Bundle:
+        """Obtain the initial bundle if absent; return the live one."""
+        with self._lock:
+            have = self._bundle
+        if have is not None:
+            return have
+        return self._issue("issue")
+
+    def rotate(self) -> Bundle:
+        return self._issue("rotate")
+
+    def refresh(self) -> None:
+        """Maintenance-lane hook: issue when missing, rotate when due.
+        Raises on failure so the lane's refresh counters/forensics see
+        it (the lane logs and retries next interval)."""
+        from istio_tpu.runtime import forensics, monitor
+        rem = self.remaining_ttl_s()
+        if rem is not None and rem <= 0:
+            # the old cert died before we renewed: loudly typed —
+            # fronts serving from this identity are now failing
+            # handshakes and the timeline must say why
+            with self._lock:
+                self.expiries += 1
+            monitor.note_identity("expiry", "failed")
+            forensics.record_event("identity_expiry", coalesce_s=1.0,
+                                   identity=self.identity)
+        if self._bundle is None or self.due():
+            self._issue("issue" if self._bundle is None else "rotate")
+
+    def _issue(self, event: str) -> Bundle:
+        from istio_tpu.runtime import forensics, monitor
+        t0 = time.perf_counter()
+        try:
+            key = pki.generate_key()
+            csr = pki.generate_csr(key, self.identity,
+                                   dns_names=self.dns_names)
+            resp = self.client.sign_csr(csr, self.credential,
+                                        self.credential_type,
+                                        self.ttl_minutes)
+            if not resp.is_approved:
+                raise RuntimeError(
+                    f"CSR rejected: {resp.status_message}")
+            bundle = (pki.key_to_pem(key), bytes(resp.signed_cert),
+                      bytes(resp.cert_chain))
+            not_after = pki.not_after(bundle[1])
+        except Exception as exc:
+            with self._lock:
+                self.failures += 1
+                self.last_error = f"{type(exc).__name__}: {exc}"
+            monitor.note_identity(event, "failed")
+            forensics.record_event(f"identity_{event}",
+                                   coalesce_s=0.0,
+                                   identity=self.identity, ok=False,
+                                   error=str(exc)[:200])
+            raise
+        with self._lock:
+            self._bundle = bundle
+            self._not_after = not_after
+            self.generation += 1
+            if event == "rotate":
+                self.rotations += 1
+            self.last_error = None
+            subscribers = list(self._on_rotate)
+            gen = self.generation
+        # subscribers run OUTSIDE the lock (a ServingCerts.rotate or
+        # grant revocation must never deadlock against stats readers);
+        # one failing subscriber must not starve the rest
+        for fn in subscribers:
+            try:
+                fn(bundle)
+            except Exception:
+                log.exception("identity on_rotate subscriber failed")
+        monitor.note_identity(event, "ok")
+        forensics.record_event(
+            f"identity_{event}", coalesce_s=0.0,
+            identity=self.identity, ok=True, generation=gen,
+            wall_ms=round((time.perf_counter() - t0) * 1e3, 3))
+        return bundle

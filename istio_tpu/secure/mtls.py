@@ -1,0 +1,230 @@
+"""mTLS plumbing for the serving fronts.
+
+Modes (ServerArgs.mtls):
+  off        — plaintext fronts, identity attributes never populated.
+  permissive — TLS serving, cert-less peers still served (Istio's
+               permissive PeerAuthentication): transport encryption
+               without peer identity. grpcio's server API is binary —
+               "don't request client certs" or "require AND verify" —
+               so permissive cannot ALSO collect identities from
+               willing peers; `connection.mtls` stays honest (unset).
+  strict     — TLS serving with the client cert REQUIRED and verified
+               against the mesh root at the handshake (a cert-less
+               peer cannot connect, exactly Istio's strict posture).
+               On top of that, admission rejects any VERIFIED peer
+               whose cert carries no spiffe:// URI SAN with a typed
+               UNAUTHENTICATED (google.rpc code 16) — the identity
+               boundary stays a typed wire status the meshlint
+               typed-rejections pass can audit, never a silent
+               anonymous pass-through.
+
+Hot rotation: `ServingCerts` is the one swappable holder. The gRPC
+fronts serve through `grpc.dynamic_ssl_server_credentials`, whose
+fetcher re-reads the holder per handshake — in-flight RPCs and open
+connections ride out a rotate() untouched (the zero-drop contract,
+gated by scripts/mtls_smoke.py). The stdlib-ssl HTTP fronts wrap
+per-accept against the holder's current SSLContext.
+"""
+from __future__ import annotations
+
+import ssl
+import tempfile
+import threading
+from typing import Mapping
+
+from istio_tpu.secure.backend import default_backend
+
+MTLS_OFF = "off"
+MTLS_PERMISSIVE = "permissive"
+MTLS_STRICT = "strict"
+MTLS_MODES = (MTLS_OFF, MTLS_PERMISSIVE, MTLS_STRICT)
+
+
+def validate_mode(mode: str) -> str:
+    if mode not in MTLS_MODES:
+        raise ValueError(f"mtls must be one of {MTLS_MODES}, "
+                         f"got {mode!r}")
+    return mode
+
+
+class ServingCerts:
+    """Hot-swappable serving credential bundle (key, cert chain, and
+    the client-verification root). `rotate()` bumps the generation;
+    every serving surface re-reads lazily — no front restarts."""
+
+    def __init__(self, key_pem: bytes, cert_pem: bytes,
+                 root_pem: bytes):
+        self._lock = threading.Lock()
+        self._key = bytes(key_pem)
+        self._cert = bytes(cert_pem)
+        self._root = bytes(root_pem)
+        self.generation = 1
+        # per-consumer served-generation marks (grpc fetchers), and a
+        # memoized SSLContext per (generation, verify-mode)
+        self._ctx_cache: dict = {}
+
+    def rotate(self, key_pem: bytes, cert_pem: bytes,
+               root_pem: bytes | None = None) -> int:
+        with self._lock:
+            self._key = bytes(key_pem)
+            self._cert = bytes(cert_pem)
+            if root_pem is not None:
+                self._root = bytes(root_pem)
+            self.generation += 1
+            self._ctx_cache.clear()
+            return self.generation
+
+    def bundle(self) -> tuple[bytes, bytes, bytes, int]:
+        with self._lock:
+            return self._key, self._cert, self._root, self.generation
+
+    @property
+    def root_pem(self) -> bytes:
+        with self._lock:
+            return self._root
+
+    # -- gRPC serving credentials (sync + aio fronts) ------------------
+
+    def grpc_server_credentials(self, require_client_auth: bool = False):
+        """Dynamic server credentials: grpcio calls the fetcher on
+        every handshake; it returns a fresh certificate configuration
+        only when the generation moved (None = keep serving the
+        current one). `require_client_auth` (strict mode): the
+        handshake demands a client cert and verifies it against the
+        root — grpcio offers no request-but-don't-require middle
+        ground (see module docstring)."""
+        import grpc
+        served = {"gen": 0}
+
+        def _config():
+            key, cert, root, gen = self.bundle()
+            served["gen"] = gen
+            return grpc.ssl_server_certificate_configuration(
+                [(key, cert)], root_certificates=root)
+
+        initial = _config()
+
+        def _fetch():
+            if self.generation == served["gen"]:
+                return None
+            return _config()
+
+        return grpc.dynamic_ssl_server_credentials(
+            initial, _fetch,
+            require_client_authentication=bool(require_client_auth))
+
+    # -- stdlib ssl (introspect/discovery HTTP fronts, TLS lane) -------
+
+    def ssl_server_context(self,
+                           require_client_cert: bool = False
+                           ) -> ssl.SSLContext:
+        """Current-generation server SSLContext. Callers wrap
+        PER-ACCEPT (not once at bind) so a rotation applies to every
+        connection accepted after it."""
+        key, cert, root, gen = self.bundle()
+        cache_key = (gen, bool(require_client_cert))
+        with self._lock:
+            ctx = self._ctx_cache.get(cache_key)
+        if ctx is not None:
+            return ctx
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        # load_cert_chain only takes paths — stage into a private
+        # tempdir that dies before this returns
+        with tempfile.TemporaryDirectory(prefix="mtls-") as d:
+            cert_f, key_f = d + "/cert.pem", d + "/key.pem"
+            with open(cert_f, "wb") as fh:
+                fh.write(cert)
+            with open(key_f, "wb") as fh:
+                fh.write(key)
+            ctx.load_cert_chain(cert_f, key_f)
+        ctx.load_verify_locations(cadata=root.decode("ascii"))
+        ctx.verify_mode = ssl.CERT_REQUIRED if require_client_cert \
+            else ssl.CERT_OPTIONAL
+        # gRPC clients REQUIRE a negotiated ALPN property (h2); plain
+        # HTTP scrapers offer http/1.1 or nothing — advertise both so
+        # one context serves the TLS lane and the introspect front
+        ctx.set_alpn_protocols(["h2", "http/1.1"])
+        with self._lock:
+            if len(self._ctx_cache) > 8:
+                self._ctx_cache.clear()
+            self._ctx_cache[cache_key] = ctx
+        return ctx
+
+    def wrap_server_socket(self, sock,
+                           require_client_cert: bool = False):
+        return self.ssl_server_context(require_client_cert).wrap_socket(
+            sock, server_side=True)
+
+    def ssl_client_context(self, server_hostname_ok: bool = False
+                           ) -> ssl.SSLContext:
+        """Client context trusting the root and presenting the
+        workload cert (for smoke drivers / the TLS lane's tests)."""
+        key, cert, root, _gen = self.bundle()
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_verify_locations(cadata=root.decode("ascii"))
+        ctx.check_hostname = False
+        with tempfile.TemporaryDirectory(prefix="mtls-") as d:
+            cert_f, key_f = d + "/cert.pem", d + "/key.pem"
+            with open(cert_f, "wb") as fh:
+                fh.write(cert)
+            with open(key_f, "wb") as fh:
+                fh.write(key)
+            ctx.load_cert_chain(cert_f, key_f)
+        return ctx
+
+
+def client_channel_credentials(root_pem: bytes,
+                               key_pem: bytes | None = None,
+                               cert_pem: bytes | None = None):
+    """grpc channel credentials: server verification against the mesh
+    root, plus the client identity pair when doing mTLS."""
+    import grpc
+    return grpc.ssl_channel_credentials(
+        root_certificates=bytes(root_pem),
+        private_key=bytes(key_pem) if key_pem else None,
+        certificate_chain=bytes(cert_pem) if cert_pem else None)
+
+
+# -- peer identity extraction (request admission) ----------------------
+
+# peer-cert PEM → SPIFFE URI (or None): the TLS layer already VERIFIED
+# the cert against the root; parsing its SAN is pure and cacheable.
+# Bounded: a mesh has few distinct peer certs per rotation window.
+_PEER_CACHE: dict[bytes, "str | None"] = {}
+_PEER_CACHE_LOCK = threading.Lock()
+_PEER_CACHE_CAP = 1024
+
+
+def spiffe_identity_from_pem(cert_pem: bytes) -> str | None:
+    """First spiffe:// URI SAN of a VERIFIED peer cert; None when the
+    cert carries no SPIFFE identity (or does not parse)."""
+    pem = bytes(cert_pem)
+    with _PEER_CACHE_LOCK:
+        if pem in _PEER_CACHE:
+            return _PEER_CACHE[pem]
+    ident = None
+    try:
+        for uri in default_backend().cert_info(pem).uris:
+            if uri.startswith("spiffe://"):
+                ident = uri
+                break
+    except Exception:
+        ident = None
+    with _PEER_CACHE_LOCK:
+        if len(_PEER_CACHE) >= _PEER_CACHE_CAP:
+            _PEER_CACHE.clear()
+        _PEER_CACHE[pem] = ident
+    return ident
+
+
+def peer_identity_from_auth_context(auth_ctx: "Mapping | None"
+                                    ) -> str | None:
+    """grpc `context.auth_context()` → verified peer SPIFFE identity.
+    None for plaintext transports and TLS peers without a client
+    cert — the caller decides what that means per mtls mode."""
+    if not auth_ctx:
+        return None
+    pems = auth_ctx.get("x509_pem_cert") or ()
+    if not pems:
+        return None
+    return spiffe_identity_from_pem(pems[0])

@@ -1,0 +1,158 @@
+"""TLS-terminating lane for the native h2 front.
+
+The native front's C++ pump (native/httpd.cpp) owns exact wire
+accounting — frames decoded, bytes in/out, batch fills — that the
+parity gates compare against the device plane. Compiling OpenSSL into
+it would fork that accounting per rig; instead the lane terminates
+TLS in front of the pump and relays the PLAINTEXT h2 byte stream to
+the loopback native port. The C++ counters see byte-for-byte the same
+stream as a plaintext deployment, so every existing parity/ceiling
+gate survives mtls unchanged.
+
+Trade-off (the builder's call the issue left open): the lane gives
+the native front transport security + CONNECTION-level client-cert
+authentication (strict mode refuses the handshake without a verified
+client cert). Per-request identity→attribute-bag injection lands on
+the gRPC fronts — the take-blob protocol between the pump and Python
+carries no connection identity, and that is the surface the
+acceptance gate (mtls_smoke RBAC parity) exercises.
+
+Rotation: sockets wrap per-accept against the ServingCerts holder's
+CURRENT context — established relays ride out a rotate() untouched.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+import ssl
+import threading
+
+from istio_tpu.secure.mtls import MTLS_STRICT, ServingCerts
+
+log = logging.getLogger("istio_tpu.secure")
+
+_CHUNK = 65536
+
+
+class TlsTerminatingLane:
+    """Accepts TLS on its own port, relays plaintext to `backend_port`
+    (the native pump's loopback listener)."""
+
+    def __init__(self, certs: ServingCerts, backend_port: int,
+                 mode: str = MTLS_STRICT, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.certs = certs
+        self.backend_port = int(backend_port)
+        self.mode = mode
+        self._host = host
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(64)
+        self.port = self._lsock.getsockname()[1]
+        self._stop = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+        self._conns: set = set()
+        self._lock = threading.Lock()
+        self.stats = {"connections": 0, "handshake_failures": 0,
+                      "relays_open": 0}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> int:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="tls-lane")
+        self._accept_thread.start()
+        log.info("TLS lane on port %d -> native :%d (%s)",
+                 self.port, self.backend_port, self.mode)
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    # -- accept + relay ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                raw, _addr = self._lsock.accept()
+            except OSError:
+                return            # listener closed
+            threading.Thread(target=self._serve_conn, args=(raw,),
+                             daemon=True, name="tls-lane-conn").start()
+
+    def _serve_conn(self, raw: socket.socket) -> None:
+        # handshake per-accept against the CURRENT generation: this is
+        # where rotation lands, and where strict mode enforces the
+        # client cert (connection-level authn for the native front)
+        try:
+            tls = self.certs.wrap_server_socket(
+                raw, require_client_cert=self.mode == MTLS_STRICT)
+        except (ssl.SSLError, OSError) as exc:
+            with self._lock:
+                self.stats["handshake_failures"] += 1
+            log.debug("TLS lane handshake failed: %s", exc)
+            try:
+                raw.close()
+            except OSError:
+                pass
+            return
+        try:
+            back = socket.create_connection(
+                (self._host, self.backend_port), timeout=10)
+        except OSError:
+            try:
+                tls.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            self.stats["connections"] += 1
+            self.stats["relays_open"] += 1
+            self._conns.update((tls, back))
+        a = threading.Thread(target=self._pump, args=(tls, back),
+                             daemon=True)
+        b = threading.Thread(target=self._pump, args=(back, tls),
+                             daemon=True)
+        a.start()
+        b.start()
+        a.join()
+        b.join()
+        with self._lock:
+            self.stats["relays_open"] -= 1
+            self._conns.discard(tls)
+            self._conns.discard(back)
+        for s in (tls, back):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _pump(src, dst) -> None:
+        try:
+            while True:
+                data = src.recv(_CHUNK)
+                if not data:
+                    break
+                dst.sendall(data)
+        except (OSError, ssl.SSLError):
+            pass
+        # half-close toward the reader so h2 GOAWAY sequences finish
+        try:
+            dst.shutdown(socket.SHUT_WR)
+        except (OSError, ssl.SSLError):
+            pass

@@ -3,7 +3,9 @@ SPIFFE-style identities, a self-signed-bootstrap CA signing workload
 CSRs, a CSR gRPC service with pluggable platform-credential
 authentication, a secret controller minting per-service-account
 key+cert bundles, and a node agent running the rotation loop.
-Backed by the `cryptography` package (real X.509, not stubs).
+Backed by the `PkiBackend` seam (istio_tpu/secure/backend.py): real
+X.509 via the `cryptography` package when importable, via the
+`openssl` CLI otherwise — the same plane runs on either rig.
 """
 from istio_tpu.security.spiffe import (identity_from_san, spiffe_id,
                                        parse_spiffe)

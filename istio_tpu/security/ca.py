@@ -9,13 +9,9 @@ from __future__ import annotations
 
 import dataclasses
 import datetime
-import threading
 from typing import Callable, Mapping
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.x509.oid import NameOID
-
+from istio_tpu.secure.backend import default_backend
 from istio_tpu.security import pki
 from istio_tpu.security.spiffe import spiffe_id
 
@@ -51,10 +47,8 @@ class IstioCA(CertificateAuthority):
     def __init__(self, signing_key_pem: bytes, signing_cert_pem: bytes,
                  opts: IstioCAOptions | None = None):
         self.opts = opts or IstioCAOptions()
-        self._key = pki.key_from_pem(signing_key_pem)
-        self._cert = pki.load_cert(signing_cert_pem)
-        self._cert_pem = signing_cert_pem
-        self._serial_lock = threading.Lock()
+        self._key_pem = bytes(signing_key_pem)
+        self._cert_pem = bytes(signing_cert_pem)
 
     # -- construction --
 
@@ -64,36 +58,15 @@ class IstioCA(CertificateAuthority):
                         root_ttl: datetime.timedelta = DEFAULT_ROOT_TTL,
                         opts: IstioCAOptions | None = None) -> "IstioCA":
         """NewSelfSignedIstioCAOptions (ca.go:82): reuse the persisted
-        CA secret when present; otherwise mint a root and persist it."""
+        CA secret when present; otherwise mint a root and persist it.
+        The root's subject differs from leaf subjects (the backend
+        appends "CN=<org> root CA"): subject==issuer on a leaf reads
+        as self-signed to chain verifiers and TLS handshakes fail."""
         if secret_store is not None and CA_SECRET_NAME in secret_store:
             blob = secret_store[CA_SECRET_NAME]
             return cls(blob["ca-key.pem"], blob["ca-cert.pem"], opts)
-        key = pki.generate_key()
-        now = datetime.datetime.now(datetime.timezone.utc)
-        # the root's subject must differ from leaf subjects (all
-        # O=<org>): subject==issuer on a leaf reads as self-signed to
-        # chain verifiers and TLS handshakes fail
-        name = x509.Name([
-            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
-            x509.NameAttribute(NameOID.COMMON_NAME, f"{org} root CA")])
-        cert = (x509.CertificateBuilder()
-                .subject_name(name).issuer_name(name)
-                .public_key(key.public_key())
-                .serial_number(x509.random_serial_number())
-                .not_valid_before(now - datetime.timedelta(minutes=5))
-                .not_valid_after(now + root_ttl)
-                .add_extension(x509.BasicConstraints(ca=True,
-                                                     path_length=None),
-                               critical=True)
-                .add_extension(x509.KeyUsage(
-                    digital_signature=True, key_cert_sign=True,
-                    crl_sign=True, content_commitment=False,
-                    key_encipherment=False, data_encipherment=False,
-                    key_agreement=False, encipher_only=False,
-                    decipher_only=False), critical=True)
-                .sign(key, hashes.SHA256()))
-        key_pem = pki.key_to_pem(key)
-        cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+        key_pem, cert_pem = default_backend().self_signed_root(
+            org, root_ttl)
         if secret_store is not None:
             secret_store[CA_SECRET_NAME] = {"ca-key.pem": key_pem,
                                             "ca-cert.pem": cert_pem}
@@ -103,7 +76,9 @@ class IstioCA(CertificateAuthority):
 
     def sign(self, csr_pem: bytes,
              ttl: datetime.timedelta | None = None) -> bytes:
-        """ca.go:182 Sign: honor the CSR's URI SANs, clamp TTL."""
+        """ca.go:182 Sign: honor the CSR's URI SANs, clamp TTL. SAN
+        copying, CA:FALSE and the server+client EKU live in the
+        backend (both implementations emit the same shape)."""
         csr = pki.load_csr(csr_pem)
         if not csr.is_signature_valid:
             raise CAError("CSR signature invalid")
@@ -111,31 +86,11 @@ class IstioCA(CertificateAuthority):
         if ttl > self.opts.max_cert_ttl:
             raise CAError(f"requested TTL {ttl} exceeds max "
                           f"{self.opts.max_cert_ttl}")
-        uris = pki.san_uris(csr)
-        dns = pki.san_dns(csr)
-        now = datetime.datetime.now(datetime.timezone.utc)
-        builder = (x509.CertificateBuilder()
-                   .subject_name(csr.subject)
-                   .issuer_name(self._cert.subject)
-                   .public_key(csr.public_key())
-                   .serial_number(x509.random_serial_number())
-                   .not_valid_before(now - datetime.timedelta(minutes=5))
-                   .not_valid_after(now + ttl)
-                   .add_extension(x509.BasicConstraints(ca=False,
-                                                        path_length=None),
-                                  critical=True)
-                   .add_extension(x509.ExtendedKeyUsage(
-                       [x509.ExtendedKeyUsageOID.SERVER_AUTH,
-                        x509.ExtendedKeyUsageOID.CLIENT_AUTH]),
-                       critical=False))
-        if uris or dns:
-            builder = builder.add_extension(
-                x509.SubjectAlternativeName(
-                    [x509.UniformResourceIdentifier(u) for u in uris] +
-                    [x509.DNSName(d) for d in dns]),
-                critical=False)
-        cert = builder.sign(self._key, hashes.SHA256())
-        return cert.public_bytes(serialization.Encoding.PEM)
+        try:
+            return default_backend().sign_csr(
+                self._key_pem, self._cert_pem, bytes(csr_pem), ttl)
+        except Exception as exc:
+            raise CAError(f"signing failed: {exc}") from exc
 
     def get_root_certificate(self) -> bytes:
         return self._cert_pem

@@ -1,104 +1,126 @@
 """PKI primitives (reference: security/pkg/pki/{crypto.go,san.go},
-ca/{generate_cert,generate_csr}.go) via the `cryptography` package:
-key generation, CSRs carrying SPIFFE URI SANs, PEM load/inspect
-helpers, and key↔cert consistency checks.
-"""
+ca/{generate_cert,generate_csr}.go): key generation, CSRs carrying
+SPIFFE URI SANs, PEM load/inspect helpers, and key↔cert consistency
+checks.
+
+Everything here delegates to the `PkiBackend` seam
+(istio_tpu/secure/backend.py) — `cryptography` when importable, the
+`openssl` CLI otherwise — so this module imports and WORKS on rigs
+without the cryptography wheel. Keys and certs are PEM bytes under
+thin view wrappers; no backend-native object ever escapes."""
 from __future__ import annotations
 
 import datetime
 from typing import Sequence
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec, rsa
-from cryptography.x509.oid import NameOID
+from istio_tpu.secure.backend import (CertInfo, PkiError,
+                                      default_backend)
+
+__all__ = ["PrivateKey", "CertView", "CsrView", "PkiError",
+           "generate_key", "key_to_pem", "key_from_pem",
+           "generate_csr", "load_csr", "load_cert", "san_uris",
+           "san_dns", "key_cert_pair_ok", "verify_chain", "not_after"]
 
 
-def generate_key(ec_key: bool = True):
+class PrivateKey:
+    """PEM-holding key handle (the old cryptography key object role)."""
+
+    __slots__ = ("pem",)
+
+    def __init__(self, pem: bytes):
+        self.pem = bytes(pem)
+
+
+class _PemView:
+    """Parsed cert/CSR: the PEM plus its backend-parsed CertInfo."""
+
+    __slots__ = ("pem", "info")
+
+    def __init__(self, pem: bytes, info: CertInfo):
+        self.pem = bytes(pem)
+        self.info = info
+
+
+class CertView(_PemView):
+    @property
+    def not_valid_after_utc(self) -> datetime.datetime | None:
+        return self.info.not_after
+
+
+class CsrView(_PemView):
+    @property
+    def is_signature_valid(self) -> bool:
+        return self.info.signature_ok
+
+
+def generate_key(ec_key: bool = True) -> PrivateKey:
     """EC P-256 by default (fast, small); RSA-2048 optional (the
     reference default)."""
-    if ec_key:
-        return ec.generate_private_key(ec.SECP256R1())
-    return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    return PrivateKey(default_backend().generate_key(ec_key))
 
 
 def key_to_pem(key) -> bytes:
-    return key.private_bytes(
-        serialization.Encoding.PEM,
-        serialization.PrivateFormat.PKCS8,
-        serialization.NoEncryption())
+    if isinstance(key, PrivateKey):
+        return key.pem
+    if isinstance(key, (bytes, bytearray)):
+        return bytes(key)
+    raise PkiError(f"not a key: {type(key).__name__}")
 
 
-def key_from_pem(pem: bytes):
-    return serialization.load_pem_private_key(pem, password=None)
+def key_from_pem(pem: bytes) -> PrivateKey:
+    return PrivateKey(pem)
 
 
-def generate_csr(key, identity: str, org: str = "istio_tpu",
-                 dns_names: tuple[str, ...] = ()) -> bytes:
+def generate_csr(key, identity: str | None, org: str = "istio_tpu",
+                 dns_names: Sequence[str] = ()) -> bytes:
     """CSR with the workload identity as a URI SAN (generate_csr.go);
     optional DNS SANs for serving certs (e.g. the CA's own TLS cert,
-    server.go:165-199)."""
-    sans = [x509.UniformResourceIdentifier(identity)]
-    sans += [x509.DNSName(d) for d in dns_names]
-    builder = x509.CertificateSigningRequestBuilder().subject_name(
-        x509.Name([x509.NameAttribute(NameOID.ORGANIZATION_NAME, org)])
-    ).add_extension(
-        x509.SubjectAlternativeName(sans), critical=False)
-    return builder.sign(key, hashes.SHA256()).public_bytes(
-        serialization.Encoding.PEM)
+    server.go:165-199). identity=None builds a SAN-free CSR (the
+    vacuous-authorization probe in tests)."""
+    uris = (identity,) if identity else ()
+    return default_backend().generate_csr(key_to_pem(key), uris,
+                                          tuple(dns_names), org)
 
 
-def load_csr(pem: bytes) -> x509.CertificateSigningRequest:
-    return x509.load_pem_x509_csr(pem)
+def load_csr(pem: bytes) -> CsrView:
+    return CsrView(pem, default_backend().csr_info(bytes(pem)))
 
 
-def load_cert(pem: bytes) -> x509.Certificate:
-    return x509.load_pem_x509_certificate(pem)
+def load_cert(pem: bytes) -> CertView:
+    return CertView(pem, default_backend().cert_info(bytes(pem)))
+
+
+def _info_of(cert_or_csr) -> CertInfo:
+    if isinstance(cert_or_csr, _PemView):
+        return cert_or_csr.info
+    if isinstance(cert_or_csr, CertInfo):
+        return cert_or_csr
+    pem = bytes(cert_or_csr)
+    if b"CERTIFICATE REQUEST" in pem:
+        return default_backend().csr_info(pem)
+    return default_backend().cert_info(pem)
 
 
 def san_uris(cert_or_csr) -> list[str]:
     """URI SANs of a cert/CSR (san.go ExtractSANExtension)."""
-    try:
-        ext = cert_or_csr.extensions.get_extension_for_class(
-            x509.SubjectAlternativeName)
-    except x509.ExtensionNotFound:
-        return []
-    return list(ext.value.get_values_for_type(
-        x509.UniformResourceIdentifier))
+    return list(_info_of(cert_or_csr).uris)
 
 
 def san_dns(cert_or_csr) -> list[str]:
     """DNS SANs of a cert/CSR."""
-    try:
-        ext = cert_or_csr.extensions.get_extension_for_class(
-            x509.SubjectAlternativeName)
-    except x509.ExtensionNotFound:
-        return []
-    return list(ext.value.get_values_for_type(x509.DNSName))
+    return list(_info_of(cert_or_csr).dns)
 
 
-def key_cert_pair_ok(key_pem: bytes, cert_pem: bytes) -> bool:
-    key = key_from_pem(key_pem)
-    cert = load_cert(cert_pem)
-    a = key.public_key().public_bytes(
-        serialization.Encoding.DER,
-        serialization.PublicFormat.SubjectPublicKeyInfo)
-    b = cert.public_key().public_bytes(
-        serialization.Encoding.DER,
-        serialization.PublicFormat.SubjectPublicKeyInfo)
-    return a == b
+def key_cert_pair_ok(key_pem, cert_pem: bytes) -> bool:
+    return default_backend().key_cert_pair_ok(key_to_pem(key_pem),
+                                              bytes(cert_pem))
 
 
 def verify_chain(cert_pem: bytes, root_pem: bytes) -> bool:
     """Leaf-signed-by-root check (crypto.go verify path)."""
-    cert = load_cert(cert_pem)
-    root = load_cert(root_pem)
-    try:
-        cert.verify_directly_issued_by(root)
-        return True
-    except Exception:
-        return False
+    return default_backend().verify_chain(bytes(cert_pem),
+                                          bytes(root_pem))
 
 
-def not_after(cert_pem: bytes) -> datetime.datetime:
-    return load_cert(cert_pem).not_valid_after_utc
+def not_after(cert_pem: bytes) -> datetime.datetime | None:
+    return default_backend().cert_info(bytes(cert_pem)).not_after

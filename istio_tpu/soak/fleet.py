@@ -59,6 +59,12 @@ class SidecarLedger:
         self.quota_denied = 0
         self.versions_applied = 0
         self.watch_errors = 0
+        # secure-plane leg (WorkloadIdentity lifecycle): every CSR the
+        # sidecar issued lands in exactly one bucket, same discipline
+        # as the check outcomes
+        self.identity_issues = 0
+        self.identity_rotations = 0
+        self.identity_failures = 0
 
     def count(self, outcome: str) -> None:
         with self._lock:
@@ -81,6 +87,9 @@ class SidecarLedger:
                 "quota_denied": self.quota_denied,
                 "versions_applied": self.versions_applied,
                 "watch_errors": self.watch_errors,
+                "identity_issues": self.identity_issues,
+                "identity_rotations": self.identity_rotations,
+                "identity_failures": self.identity_failures,
             }
 
 
@@ -116,6 +125,16 @@ class FleetSimulator:
     `discovery`/`nodes`/`ns_ports`: optional xDS leg — one watcher
     thread per sidecar parks on DiscoveryService.watch and validates
     each applied generation still serves the sidecar's own service.
+
+    `ca_client`: optional secure-plane leg — each sidecar owns a
+    WorkloadIdentity (spiffe://.../ns/<ns>/sa/sidecar-<i>), obtains
+    its bundle from the CA before the first check and rotates every
+    `identity_rotate_every` checks (deterministic cadence — a soak
+    wants reproducible rotation pressure, not wall-clock TTLs).
+    Issue/rotate outcomes land in the typed ledger. When
+    `tls_server_name` is also set the sidecar's MixerClient fronts
+    mTLS from the live bundle and reconnects after every rotation so
+    each fresh cert actually handshakes.
     """
 
     def __init__(self, target: Callable[[], str],
@@ -127,7 +146,11 @@ class FleetSimulator:
                  report_every: int = 0,
                  enable_check_cache: bool = True,
                  discovery=None, nodes: Sequence[str] = (),
-                 ns_ports: Mapping[str, int] | None = None):
+                 ns_ports: Mapping[str, int] | None = None,
+                 ca_client=None, identity_ns: str = "default",
+                 identity_ttl_minutes: int = 60,
+                 identity_rotate_every: int = 0,
+                 tls_server_name: str | None = None):
         if not requests:
             raise ValueError("fleet needs a non-empty request set")
         self._target = target
@@ -142,13 +165,28 @@ class FleetSimulator:
         self._discovery = discovery
         self._nodes = list(nodes)
         self._ns_ports = dict(ns_ports or {})
+        self._ca_client = ca_client
+        self._identity_ns = identity_ns
+        self._identity_ttl_minutes = int(identity_ttl_minutes)
+        self._identity_rotate_every = int(identity_rotate_every)
+        self._tls_server_name = tls_server_name
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self.ledgers = [SidecarLedger() for _ in range(self.n_sidecars)]
 
     # -- sidecar lifecycle --------------------------------------------
 
-    def _client_for(self, led, cur, cur_target: str | None):
+    def _identity_for(self, idx: int):
+        if self._ca_client is None:
+            return None
+        from istio_tpu.secure.identity import WorkloadIdentity
+        from istio_tpu.security import spiffe_id
+        return WorkloadIdentity(
+            self._ca_client,
+            spiffe_id(self._identity_ns, f"sidecar-{idx}"),
+            ttl_minutes=self._identity_ttl_minutes)
+
+    def _client_for(self, led, cur, cur_target: str | None, wi=None):
         """Reconnect when the target moved (mid-soak restart): fold
         the dying client's cache accounting into the ledger first —
         cache-answered checks never crossed the wire and wire_checks
@@ -163,7 +201,13 @@ class FleetSimulator:
                 cur.close()
             except Exception:
                 pass
-        return MixerClient(t, enable_check_cache=self._cache), t
+        kw = {}
+        if wi is not None and self._tls_server_name:
+            key_pem, cert_pem, root_pem = wi.ensure()
+            kw = dict(root_cert_pem=root_pem, key_pem=key_pem,
+                      cert_pem=cert_pem,
+                      server_name=self._tls_server_name)
+        return MixerClient(t, enable_check_cache=self._cache, **kw), t
 
     def _sidecar(self, idx: int) -> None:
         led = self.ledgers[idx]
@@ -172,11 +216,29 @@ class FleetSimulator:
         client = None
         cur_target: str | None = None
         pos = 0
+        wi = self._identity_for(idx)
+        if wi is not None:
+            try:
+                wi.ensure()
+                led.identity_issues += 1
+            except Exception:
+                led.identity_failures += 1
         try:
             while not self._stop.is_set():
+                if wi is not None and wi.bundle() is None:
+                    # no identity yet (CA was down at start): retry the
+                    # obtain before spending checks — a strict front
+                    # would refuse the handshake anyway
+                    try:
+                        wi.ensure()
+                        led.identity_issues += 1
+                    except Exception:
+                        led.identity_failures += 1
+                        time.sleep(0.05)
+                        continue
                 try:
                     client, cur_target = self._client_for(
-                        led, client, cur_target)
+                        led, client, cur_target, wi)
                 except Exception:
                     led.count("unavailable")
                     time.sleep(0.05)
@@ -218,6 +280,24 @@ class FleetSimulator:
                         led.reports_ok += 1
                     except Exception:
                         led.reports_failed += 1
+                if wi is not None and self._identity_rotate_every \
+                        and pos % self._identity_rotate_every == 0:
+                    try:
+                        wi.rotate()
+                        led.identity_rotations += 1
+                    except Exception:
+                        led.identity_failures += 1
+                    else:
+                        if self._tls_server_name and client is not None:
+                            # handshake the fresh cert: drop the old
+                            # channel (cache accounting folds first)
+                            led.cache_hits += \
+                                client.cache_stats["hits"]
+                            try:
+                                client.close()
+                            except Exception:
+                                pass
+                            client, cur_target = None, None
                 if self._pace_s:
                     time.sleep(self._pace_s)
         finally:

@@ -4,7 +4,23 @@ Forces JAX onto a virtual 8-device CPU platform so multi-chip sharding
 paths (Mesh/pjit/shard_map) are exercised hermetically. Real-TPU runs
 happen only in bench.py. See istio_tpu/platform.py for why plain
 JAX_PLATFORMS=cpu is not enough in this container.
+
+Also points JAX's persistent compilation cache at the repo-local
+`.jax_cache/` (the same dir bench.py uses; entries are keyed by HLO +
+platform, so sharing is safe). The suite builds near-identical engines
+in dozens of modules — each fresh Engine re-traces the same programs,
+and without the disk cache every one is a full XLA compile. With it,
+duplicate compiles are disk hits both within one run and across runs.
+Tests that assert on cache behavior (test_delta_compile, delta_smoke)
+save and restore this config around their own private cache dirs.
 """
+import os
+
 from istio_tpu.platform import force_cpu_platform
 
 force_cpu_platform(8)
+
+from istio_tpu.compiler.cache import configure_persistent_cache
+
+configure_persistent_cache(
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"))

@@ -1,0 +1,35 @@
+"""Tier-1 hook for scripts/mtls_smoke.py: the CI gate that the secure
+serving plane keeps securing — a real CA signs serving + workload
+certs over the CSR wire, strict-mTLS Checks carry the VERIFIED peer
+SPIFFE identity into the device-compiled RBAC plane with EXACT
+SnapshotOracle parity (spoofed source.user overridden), the
+authentication boundary stays typed (UNAUTHENTICATED for a SPIFFE-less
+cert, handshake refusal for no cert), and the serving identity rotates
+under live closed-loop traffic with zero dropped requests plus
+identity_rotate forensics. Runs main() in-process (the audit_smoke
+pattern); skips only when the rig has no PKI backend at all."""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+from istio_tpu.secure.backend import available_backends
+
+if not available_backends():
+    pytest.skip("mtls smoke needs a PKI backend (cryptography or the "
+                "openssl CLI)", allow_module_level=True)
+
+
+def test_mtls_smoke_main():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "mtls_smoke.py")
+    spec = importlib.util.spec_from_file_location("mtls_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        rc = mod.main(n_checks=16, rotations=2, workers=2)
+    finally:
+        sys.modules.pop(spec.name, None)
+    assert rc == 0

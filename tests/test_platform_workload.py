@@ -8,8 +8,12 @@ import stat
 
 import pytest
 
-pytest.importorskip(
-    "cryptography", reason="istio_tpu.security needs cryptography")
+from istio_tpu.secure.backend import available_backends
+
+if not available_backends():
+    pytest.skip("istio_tpu.security needs a PKI backend "
+                "(cryptography or the openssl CLI)",
+                allow_module_level=True)
 
 from istio_tpu.security import pki
 from istio_tpu.security.ca import IstioCA

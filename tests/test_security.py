@@ -7,8 +7,12 @@ import time
 
 import pytest
 
-pytest.importorskip(
-    "cryptography", reason="istio_tpu.security needs cryptography")
+from istio_tpu.secure.backend import available_backends
+
+if not available_backends():
+    pytest.skip("istio_tpu.security needs a PKI backend "
+                "(cryptography or the openssl CLI)",
+                allow_module_level=True)
 
 from istio_tpu.security import (IstioCA, generate_csr, generate_key,
                                 key_cert_pair_ok, load_cert, san_uris,
@@ -136,14 +140,10 @@ def test_csr_dns_san_impersonation_rejected(ca_rig):
 
 def test_csr_without_identities_rejected(ca_rig):
     """A SAN-free CSR must not be vacuously authorized."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.x509.oid import NameOID
     _, client = ca_rig
-    key = generate_key()
-    bare = x509.CertificateSigningRequestBuilder().subject_name(
-        x509.Name([x509.NameAttribute(NameOID.ORGANIZATION_NAME, "x")])
-    ).sign(key, hashes.SHA256()).public_bytes(serialization.Encoding.PEM)
+    # identity=None builds a bare CSR through the backend seam (runs
+    # on either PKI backend, unlike the old direct-cryptography build)
+    bare = generate_csr(generate_key(), None, org="x")
     resp = client.sign_csr(bare, credential=b"spiffe://c/ns/a/sa/b")
     assert not resp.is_approved
     assert "no identities" in resp.status_message
